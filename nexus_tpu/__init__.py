@@ -1,0 +1,23 @@
+"""nexus_tpu — a TPU-native multi-cluster workload-distribution framework.
+
+Re-creation (not a port) of the capability surface of
+SneaksAndData/nexus-configuration-controller: NexusAlgorithmTemplate /
+NexusAlgorithmWorkgroup resources declared once in a controller cluster are
+continuously synchronized — together with dependent Secrets and ConfigMaps —
+to connected shard clusters, kept converged (drift repair, adoption, rogue
+detection, status conditions, rate-limited retries), and materialized as
+JAX/XLA jobs on GKE TPU slices.
+
+Two planes:
+  * control plane  — ``nexus_tpu.api`` / ``nexus_tpu.cluster`` /
+    ``nexus_tpu.controller`` / ``nexus_tpu.shards`` (capability parity with
+    the reference controller, see SURVEY.md §2).
+  * workload plane — ``nexus_tpu.runtime`` / ``nexus_tpu.models`` /
+    ``nexus_tpu.parallel`` / ``nexus_tpu.ops`` / ``nexus_tpu.train``
+    (TPU-native: jax.sharding meshes, pjit/shard_map, Pallas kernels).
+"""
+
+from nexus_tpu.utils.buildmeta import APP_VERSION, BUILD_NUMBER
+
+__version__ = APP_VERSION
+__all__ = ["APP_VERSION", "BUILD_NUMBER", "__version__"]

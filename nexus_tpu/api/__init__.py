@@ -1,0 +1,76 @@
+"""Typed API objects (the CRD-equivalent data model).
+
+Equivalent of nexus-core ``pkg/apis/science/v1`` (reconstructed from call
+sites, SURVEY.md §2b) plus the new TPU-native ``jax_xla`` runtime block that
+the reference does not have (BASELINE.json north star).
+"""
+
+from nexus_tpu.api.types import (
+    GROUP,
+    VERSION,
+    API_VERSION,
+    Condition,
+    ConfigMap,
+    EnvFromSource,
+    EnvVar,
+    ObjectMeta,
+    OwnerReference,
+    Secret,
+    new_resource_ready_condition,
+)
+from nexus_tpu.api.template import (
+    NexusAlgorithmTemplate,
+    NexusAlgorithmSpec,
+    NexusAlgorithmStatus,
+    Container,
+    ComputeResources,
+    WorkgroupRef,
+    RuntimeEnvironment,
+    ErrorHandlingBehaviour,
+    DatadogIntegrationSettings,
+)
+from nexus_tpu.api.workgroup import (
+    NexusAlgorithmWorkgroup,
+    NexusAlgorithmWorkgroupSpec,
+    NexusAlgorithmWorkgroupStatus,
+)
+from nexus_tpu.api.runtime_spec import (
+    JaxXlaRuntime,
+    TpuSliceSpec,
+    ParallelismSpec,
+    ModelRef,
+    TrainSpec,
+    CheckpointSpec,
+)
+
+__all__ = [
+    "GROUP",
+    "VERSION",
+    "API_VERSION",
+    "Condition",
+    "ConfigMap",
+    "EnvFromSource",
+    "EnvVar",
+    "ObjectMeta",
+    "OwnerReference",
+    "Secret",
+    "new_resource_ready_condition",
+    "NexusAlgorithmTemplate",
+    "NexusAlgorithmSpec",
+    "NexusAlgorithmStatus",
+    "Container",
+    "ComputeResources",
+    "WorkgroupRef",
+    "RuntimeEnvironment",
+    "ErrorHandlingBehaviour",
+    "DatadogIntegrationSettings",
+    "NexusAlgorithmWorkgroup",
+    "NexusAlgorithmWorkgroupSpec",
+    "NexusAlgorithmWorkgroupStatus",
+    "JaxXlaRuntime",
+    "TpuSliceSpec",
+    "ParallelismSpec",
+    "ModelRef",
+    "TrainSpec",
+    "CheckpointSpec",
+]

@@ -1,0 +1,303 @@
+"""TPU-native ``jax_xla`` runtime block for NexusAlgorithmTemplate.
+
+This is NEW relative to the reference (which only carries an opaque container
+image + CpuLimit/MemoryLimit/CustomResources, controller_test.go:293-303).
+Per the BASELINE.json north star, templates here declare a JAX/XLA workload
+plus TPU slice topology, and the shard reconciler materializes them as Jobs
+with ``google.com/tpu`` resource requests and ``gke-tpu-topology``
+nodeSelectors — no GPU/NCCL in the loop.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# Known TPU generations with chips-per-host and per-chip peak bf16 FLOP/s.
+# (Public figures: v4 275e12, v5e 197e12, v5p 459e12, v6e "Trillium" 918e12.)
+TPU_GENERATIONS: Dict[str, Dict[str, Any]] = {
+    "v4": {"chips_per_host": 4, "bf16_flops": 275e12, "hbm_gb": 32},
+    "v5e": {"chips_per_host": 4, "bf16_flops": 197e12, "hbm_gb": 16},
+    "v5p": {"chips_per_host": 4, "bf16_flops": 459e12, "hbm_gb": 95},
+    "v6e": {"chips_per_host": 4, "bf16_flops": 918e12, "hbm_gb": 32},
+}
+
+
+def parse_topology(topology: str) -> List[int]:
+    """Parse a GKE TPU topology string like ``"2x2x2"`` into dims."""
+    dims = [int(x) for x in topology.lower().split("x") if x]
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"invalid TPU topology {topology!r}")
+    return dims
+
+
+@dataclass
+class TpuSliceSpec:
+    """Where the workload lands: accelerator generation + ICI slice topology.
+
+    ``accelerator`` + ``topology`` map 1:1 onto GKE's
+    ``cloud.google.com/gke-tpu-accelerator`` / ``cloud.google.com/gke-tpu-topology``
+    nodeSelectors; ``slice_count > 1`` means multislice (DCN between slices).
+    """
+
+    accelerator: str = "v5p"
+    topology: str = "2x2x2"
+    slice_count: int = 1
+
+    @property
+    def chips_per_slice(self) -> int:
+        return math.prod(parse_topology(self.topology))
+
+    @property
+    def total_chips(self) -> int:
+        return self.chips_per_slice * self.slice_count
+
+    @property
+    def chips_per_host(self) -> int:
+        return TPU_GENERATIONS.get(self.accelerator, {"chips_per_host": 4})[
+            "chips_per_host"
+        ]
+
+    @property
+    def hosts_per_slice(self) -> int:
+        return max(1, self.chips_per_slice // self.chips_per_host)
+
+    @property
+    def gke_accelerator(self) -> str:
+        # GKE accelerator selector values, e.g. tpu-v5p-slice / tpu-v5-lite-podslice.
+        mapping = {
+            "v4": "tpu-v4-podslice",
+            "v5e": "tpu-v5-lite-podslice",
+            "v5p": "tpu-v5p-slice",
+            "v6e": "tpu-v6e-slice",
+        }
+        return mapping.get(self.accelerator, f"tpu-{self.accelerator}-slice")
+
+    def peak_flops_per_chip(self) -> float:
+        return TPU_GENERATIONS.get(self.accelerator, {"bf16_flops": 275e12})[
+            "bf16_flops"
+        ]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "accelerator": self.accelerator,
+            "topology": self.topology,
+            "sliceCount": self.slice_count,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TpuSliceSpec":
+        return cls(
+            accelerator=d.get("accelerator", "v5p"),
+            topology=d.get("topology", "2x2x2"),
+            slice_count=int(d.get("sliceCount", 1) or 1),
+        )
+
+
+@dataclass
+class ParallelismSpec:
+    """Logical mesh axis sizes. 1 = axis unused. Product must equal chips.
+
+    Axis semantics (How-to-Scale-Your-Model recipe):
+      data     — pure data parallelism (gradients psum over it)
+      fsdp     — data parallelism with parameter/optimizer sharding (ZeRO-3)
+      tensor   — megatron-style tensor parallelism (activations all-reduce)
+      sequence — context parallelism (ring attention over this axis)
+      expert   — MoE expert parallelism (all_to_all dispatch)
+      pipeline — pipeline stages (usually across slices / DCN)
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    sequence: int = 1
+    expert: int = 1
+    pipeline: int = 1
+
+    def total(self) -> int:
+        return (
+            self.data
+            * self.fsdp
+            * self.tensor
+            * self.sequence
+            * self.expert
+            * self.pipeline
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "tensor": self.tensor,
+            "sequence": self.sequence,
+            "expert": self.expert,
+            "pipeline": self.pipeline,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ParallelismSpec":
+        return cls(
+            data=int(d.get("data", 1) or 1),
+            fsdp=int(d.get("fsdp", 1) or 1),
+            tensor=int(d.get("tensor", 1) or 1),
+            sequence=int(d.get("sequence", 1) or 1),
+            expert=int(d.get("expert", 1) or 1),
+            pipeline=int(d.get("pipeline", 1) or 1),
+        )
+
+
+@dataclass
+class ModelRef:
+    """Which model the runtime builds: a family + preset + overrides."""
+
+    family: str = "mlp"  # mlp | llama | mixtral
+    preset: str = "tiny"
+    overrides: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "family": self.family,
+            "preset": self.preset,
+            "overrides": dict(self.overrides),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModelRef":
+        return cls(
+            family=d.get("family", "mlp"),
+            preset=d.get("preset", "tiny"),
+            overrides=dict(d.get("overrides") or {}),
+        )
+
+
+@dataclass
+class TrainSpec:
+    batch_size: int = 8
+    seq_len: int = 128
+    steps: int = 10
+    learning_rate: float = 3e-4
+    warmup_steps: int = 0
+    weight_decay: float = 0.1
+    gradient_accumulation: int = 1
+    remat: bool = False
+    seed: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "batchSize": self.batch_size,
+            "seqLen": self.seq_len,
+            "steps": self.steps,
+            "learningRate": self.learning_rate,
+            "warmupSteps": self.warmup_steps,
+            "weightDecay": self.weight_decay,
+            "gradientAccumulation": self.gradient_accumulation,
+            "remat": self.remat,
+            "seed": self.seed,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TrainSpec":
+        return cls(
+            batch_size=int(d.get("batchSize", 8) or 8),
+            seq_len=int(d.get("seqLen", 128) or 128),
+            steps=int(d.get("steps", 10) or 10),
+            learning_rate=float(d.get("learningRate", 3e-4) or 3e-4),
+            warmup_steps=int(d.get("warmupSteps", 0) or 0),
+            weight_decay=float(d.get("weightDecay", 0.1) or 0.1),
+            gradient_accumulation=int(d.get("gradientAccumulation", 1) or 1),
+            remat=bool(d.get("remat", False)),
+            seed=int(d.get("seed", 0) or 0),
+        )
+
+
+@dataclass
+class CheckpointSpec:
+    enabled: bool = False
+    directory: str = ""
+    interval_steps: int = 100
+    keep: int = 3
+    resume: bool = True
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "enabled": self.enabled,
+            "directory": self.directory,
+            "intervalSteps": self.interval_steps,
+            "keep": self.keep,
+            "resume": self.resume,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "CheckpointSpec":
+        return cls(
+            enabled=bool(d.get("enabled", False)),
+            directory=d.get("directory", ""),
+            interval_steps=int(d.get("intervalSteps", 100) or 100),
+            keep=int(d.get("keep", 3) or 3),
+            resume=bool(d.get("resume", True)),
+        )
+
+
+@dataclass
+class JaxXlaRuntime:
+    """The full TPU-native runtime declaration carried by a template.
+
+    ``mode`` is ``train`` or ``infer``; ``entrypoint`` selects a registered
+    runtime entrypoint (default: the built-in trainer/inferencer for
+    ``model``).
+    """
+
+    kind: str = "jax_xla"
+    mode: str = "train"
+    entrypoint: str = ""
+    model: ModelRef = field(default_factory=ModelRef)
+    tpu: TpuSliceSpec = field(default_factory=TpuSliceSpec)
+    parallelism: ParallelismSpec = field(default_factory=ParallelismSpec)
+    train: TrainSpec = field(default_factory=TrainSpec)
+    checkpoint: CheckpointSpec = field(default_factory=CheckpointSpec)
+
+    def validate(self) -> List[str]:
+        """Static validation: mesh must tile the slice exactly."""
+        errs: List[str] = []
+        if self.kind != "jax_xla":
+            errs.append(f"unsupported runtime kind {self.kind!r}")
+        if self.mode not in ("train", "infer"):
+            errs.append(f"unsupported mode {self.mode!r}")
+        total = self.parallelism.total()
+        chips = self.tpu.total_chips
+        if total != chips:
+            errs.append(
+                f"parallelism axes product {total} != total chips {chips} "
+                f"({self.tpu.accelerator} {self.tpu.topology} ×{self.tpu.slice_count})"
+            )
+        if self.tpu.accelerator not in TPU_GENERATIONS:
+            errs.append(f"unknown accelerator {self.tpu.accelerator!r}")
+        return errs
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "mode": self.mode,
+            "entrypoint": self.entrypoint,
+            "model": self.model.to_dict(),
+            "tpu": self.tpu.to_dict(),
+            "parallelism": self.parallelism.to_dict(),
+            "train": self.train.to_dict(),
+            "checkpoint": self.checkpoint.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> Optional["JaxXlaRuntime"]:
+        if not d:
+            return None
+        return cls(
+            kind=d.get("kind", "jax_xla"),
+            mode=d.get("mode", "train"),
+            entrypoint=d.get("entrypoint", ""),
+            model=ModelRef.from_dict(d.get("model") or {}),
+            tpu=TpuSliceSpec.from_dict(d.get("tpu") or {}),
+            parallelism=ParallelismSpec.from_dict(d.get("parallelism") or {}),
+            train=TrainSpec.from_dict(d.get("train") or {}),
+            checkpoint=CheckpointSpec.from_dict(d.get("checkpoint") or {}),
+        )

@@ -1,0 +1,298 @@
+"""NexusAlgorithmTemplate — the algorithm template CRD equivalent.
+
+Spec field inventory matches the reference's NexusAlgorithmSpec as
+reconstructed from call sites (SURVEY.md §2b; construction at reference
+controller_test.go:268-324), extended with the TPU-native ``jax_xla`` runtime
+block (BASELINE.json north star). ``get_secret_names`` /
+``get_config_map_names`` mirror the nexus-core template helpers the reconciler
+relies on (reference call sites: controller.go:505,567,648,671).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from nexus_tpu.api.runtime_spec import JaxXlaRuntime
+from nexus_tpu.api.types import (
+    API_VERSION,
+    APIObject,
+    Condition,
+    EnvFromSource,
+    EnvVar,
+    ObjectMeta,
+)
+
+
+@dataclass
+class Container:
+    image: str = ""
+    registry: str = ""
+    version_tag: str = ""
+    service_account_name: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "image": self.image,
+            "registry": self.registry,
+            "versionTag": self.version_tag,
+            "serviceAccountName": self.service_account_name,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Container":
+        return cls(
+            image=d.get("image", ""),
+            registry=d.get("registry", ""),
+            version_tag=d.get("versionTag", ""),
+            service_account_name=d.get("serviceAccountName", ""),
+        )
+
+    @property
+    def full_image(self) -> str:
+        img = f"{self.registry}/{self.image}" if self.registry else self.image
+        return f"{img}:{self.version_tag}" if self.version_tag else img
+
+
+@dataclass
+class ComputeResources:
+    """CPU/memory limits plus custom resources.
+
+    In the TPU build ``custom_resources`` carries ``google.com/tpu`` chip
+    counts (derived from the runtime's TpuSliceSpec by the materializer) —
+    replacing the GPU ecosystem's ``nvidia.com/gpu``.
+    """
+
+    cpu_limit: str = ""
+    memory_limit: str = ""
+    custom_resources: Dict[str, str] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "cpuLimit": self.cpu_limit,
+            "memoryLimit": self.memory_limit,
+            "customResources": dict(self.custom_resources),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ComputeResources":
+        return cls(
+            cpu_limit=d.get("cpuLimit", ""),
+            memory_limit=d.get("memoryLimit", ""),
+            custom_resources=dict(d.get("customResources") or {}),
+        )
+
+
+@dataclass
+class WorkgroupRef:
+    name: str = ""
+    group: str = ""
+    kind: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "group": self.group, "kind": self.kind}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "WorkgroupRef":
+        return cls(
+            name=d.get("name", ""), group=d.get("group", ""), kind=d.get("kind", "")
+        )
+
+
+@dataclass
+class RuntimeEnvironment:
+    environment_variables: List[EnvVar] = field(default_factory=list)
+    mapped_environment_variables: List[EnvFromSource] = field(default_factory=list)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    deadline_seconds: Optional[int] = None
+    maximum_retries: Optional[int] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "environmentVariables": [e.to_dict() for e in self.environment_variables],
+            "mappedEnvironmentVariables": [
+                e.to_dict() for e in self.mapped_environment_variables
+            ],
+            "annotations": dict(self.annotations),
+            "deadlineSeconds": self.deadline_seconds,
+            "maximumRetries": self.maximum_retries,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RuntimeEnvironment":
+        return cls(
+            environment_variables=[
+                EnvVar.from_dict(e) for e in (d.get("environmentVariables") or [])
+            ],
+            mapped_environment_variables=[
+                EnvFromSource.from_dict(e)
+                for e in (d.get("mappedEnvironmentVariables") or [])
+            ],
+            annotations=dict(d.get("annotations") or {}),
+            deadline_seconds=d.get("deadlineSeconds"),
+            maximum_retries=d.get("maximumRetries"),
+        )
+
+
+@dataclass
+class ErrorHandlingBehaviour:
+    """Workload retry policy declared on the template.
+
+    Exit codes in ``transient_exit_codes`` requeue the workload; codes in
+    ``fatal_exit_codes`` fail it permanently (reference shape:
+    controller_test.go:318-321).
+    """
+
+    transient_exit_codes: List[int] = field(default_factory=list)
+    fatal_exit_codes: List[int] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "transientExitCodes": list(self.transient_exit_codes),
+            "fatalExitCodes": list(self.fatal_exit_codes),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ErrorHandlingBehaviour":
+        return cls(
+            transient_exit_codes=[int(x) for x in (d.get("transientExitCodes") or [])],
+            fatal_exit_codes=[int(x) for x in (d.get("fatalExitCodes") or [])],
+        )
+
+
+@dataclass
+class DatadogIntegrationSettings:
+    mount_datadog_socket: Optional[bool] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"mountDatadogSocket": self.mount_datadog_socket}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "DatadogIntegrationSettings":
+        return cls(mount_datadog_socket=d.get("mountDatadogSocket"))
+
+
+@dataclass
+class NexusAlgorithmSpec:
+    container: Container = field(default_factory=Container)
+    compute_resources: ComputeResources = field(default_factory=ComputeResources)
+    workgroup_ref: WorkgroupRef = field(default_factory=WorkgroupRef)
+    command: str = ""
+    args: List[str] = field(default_factory=list)
+    runtime_environment: RuntimeEnvironment = field(default_factory=RuntimeEnvironment)
+    error_handling_behaviour: ErrorHandlingBehaviour = field(
+        default_factory=ErrorHandlingBehaviour
+    )
+    datadog_integration_settings: DatadogIntegrationSettings = field(
+        default_factory=DatadogIntegrationSettings
+    )
+    # TPU-native extension (absent in the reference):
+    runtime: Optional[JaxXlaRuntime] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "container": self.container.to_dict(),
+            "computeResources": self.compute_resources.to_dict(),
+            "workgroupRef": self.workgroup_ref.to_dict(),
+            "command": self.command,
+            "args": list(self.args),
+            "runtimeEnvironment": self.runtime_environment.to_dict(),
+            "errorHandlingBehaviour": self.error_handling_behaviour.to_dict(),
+            "datadogIntegrationSettings": self.datadog_integration_settings.to_dict(),
+            "runtime": self.runtime.to_dict() if self.runtime else None,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "NexusAlgorithmSpec":
+        return cls(
+            container=Container.from_dict(d.get("container") or {}),
+            compute_resources=ComputeResources.from_dict(
+                d.get("computeResources") or {}
+            ),
+            workgroup_ref=WorkgroupRef.from_dict(d.get("workgroupRef") or {}),
+            command=d.get("command", ""),
+            args=list(d.get("args") or []),
+            runtime_environment=RuntimeEnvironment.from_dict(
+                d.get("runtimeEnvironment") or {}
+            ),
+            error_handling_behaviour=ErrorHandlingBehaviour.from_dict(
+                d.get("errorHandlingBehaviour") or {}
+            ),
+            datadog_integration_settings=DatadogIntegrationSettings.from_dict(
+                d.get("datadogIntegrationSettings") or {}
+            ),
+            runtime=JaxXlaRuntime.from_dict(d.get("runtime")),
+        )
+
+
+@dataclass
+class NexusAlgorithmStatus:
+    """Sync bookkeeping written via the status subresource.
+
+    Shape matches the reference status (controller.go:471-473,
+    controller_test.go:957-968).
+    """
+
+    synced_secrets: List[str] = field(default_factory=list)
+    synced_configurations: List[str] = field(default_factory=list)
+    synced_to_clusters: List[str] = field(default_factory=list)
+    conditions: List[Condition] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "syncedSecrets": list(self.synced_secrets),
+            "syncedConfigurations": list(self.synced_configurations),
+            "syncedToClusters": list(self.synced_to_clusters),
+            "conditions": [c.to_dict() for c in self.conditions],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "NexusAlgorithmStatus":
+        return cls(
+            synced_secrets=list(d.get("syncedSecrets") or []),
+            synced_configurations=list(d.get("syncedConfigurations") or []),
+            synced_to_clusters=list(d.get("syncedToClusters") or []),
+            conditions=[Condition.from_dict(c) for c in (d.get("conditions") or [])],
+        )
+
+
+@dataclass
+class NexusAlgorithmTemplate(APIObject):
+    KIND = "NexusAlgorithmTemplate"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NexusAlgorithmSpec = field(default_factory=NexusAlgorithmSpec)
+    status: NexusAlgorithmStatus = field(default_factory=NexusAlgorithmStatus)
+
+    def get_secret_names(self) -> List[str]:
+        """Names of all Secrets this template depends on (mapped env vars)."""
+        return [
+            e.secret_ref
+            for e in self.spec.runtime_environment.mapped_environment_variables
+            if e.secret_ref
+        ]
+
+    def get_config_map_names(self) -> List[str]:
+        """Names of all ConfigMaps this template depends on."""
+        return [
+            e.config_map_ref
+            for e in self.spec.runtime_environment.mapped_environment_variables
+            if e.config_map_ref
+        ]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": self.metadata.to_dict(),
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "NexusAlgorithmTemplate":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=NexusAlgorithmSpec.from_dict(d.get("spec") or {}),
+            status=NexusAlgorithmStatus.from_dict(d.get("status") or {}),
+        )

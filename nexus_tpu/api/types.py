@@ -1,0 +1,345 @@
+"""Core object model shared by all API kinds.
+
+Provides the Kubernetes-shaped metadata/condition/owner-reference machinery the
+controller depends on, plus lightweight Secret/ConfigMap kinds so the framework
+can run against its own in-process cluster store (tests, local shards) as well
+as real Kubernetes API servers.
+
+Reference parity notes (SURVEY.md §2b):
+  * group/version match the reference CRD group ``science.sneaksanddata.com``
+    (reference: .helm/templates/cluster-role-template-editor.yaml:26).
+  * ``new_resource_ready_condition`` mirrors nexus-core
+    ``NewResourceReadyCondition(lastTransitionTime, status, message)``
+    (reference call site: controller.go:433).
+"""
+
+from __future__ import annotations
+
+import copy
+import datetime as _dt
+import itertools
+import threading
+from dataclasses import dataclass, field, fields, is_dataclass
+from typing import Any, Dict, List, Optional
+
+GROUP = "science.sneaksanddata.com"
+VERSION = "v1"
+API_VERSION = f"{GROUP}/{VERSION}"
+
+# Provenance labels stamped on every object the controller writes to a shard
+# (reference test oracle: controller_test.go:183-188).
+LABEL_CONTROLLER_APP = f"{GROUP}/controller-app"
+LABEL_CONFIGURATION_OWNER = f"{GROUP}/configuration-owner"
+CONTROLLER_APP_NAME = "nexus-configuration-controller"
+
+
+def utcnow() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+_uid_counter = itertools.count(1)
+_uid_lock = threading.Lock()
+
+
+def new_uid() -> str:
+    """Process-unique object UID (fake clusters only; real clusters assign)."""
+    with _uid_lock:
+        return f"uid-{next(_uid_counter):08d}"
+
+
+@dataclass
+class OwnerReference:
+    """Ownership link, the unit of adoption / garbage collection.
+
+    Mirrors metav1.OwnerReference as used for template-owned secrets and
+    configmaps (reference: controller.go:647-695, controller_test.go:198-228).
+    """
+
+    api_version: str
+    kind: str
+    name: str
+    uid: str
+    controller: bool = False
+    block_owner_deletion: bool = False
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": self.api_version,
+            "kind": self.kind,
+            "name": self.name,
+            "uid": self.uid,
+            "controller": self.controller,
+            "blockOwnerDeletion": self.block_owner_deletion,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "OwnerReference":
+        return cls(
+            api_version=d.get("apiVersion", ""),
+            kind=d.get("kind", ""),
+            name=d.get("name", ""),
+            uid=d.get("uid", ""),
+            controller=bool(d.get("controller", False)),
+            block_owner_deletion=bool(d.get("blockOwnerDeletion", False)),
+        )
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = ""
+    generation: int = 0
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+    finalizers: List[str] = field(default_factory=list)
+    creation_timestamp: Optional[_dt.datetime] = None
+    deletion_timestamp: Optional[_dt.datetime] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "namespace": self.namespace,
+            "uid": self.uid,
+            "resourceVersion": self.resource_version,
+            "generation": self.generation,
+            "labels": dict(self.labels),
+            "annotations": dict(self.annotations),
+            "ownerReferences": [o.to_dict() for o in self.owner_references],
+            "finalizers": list(self.finalizers),
+            "creationTimestamp": _ts(self.creation_timestamp),
+            "deletionTimestamp": _ts(self.deletion_timestamp),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ObjectMeta":
+        return cls(
+            name=d.get("name", ""),
+            namespace=d.get("namespace", ""),
+            uid=d.get("uid", ""),
+            resource_version=d.get("resourceVersion", ""),
+            generation=int(d.get("generation", 0) or 0),
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+            owner_references=[
+                OwnerReference.from_dict(o) for o in (d.get("ownerReferences") or [])
+            ],
+            finalizers=list(d.get("finalizers") or []),
+            creation_timestamp=_parse_ts(d.get("creationTimestamp")),
+            deletion_timestamp=_parse_ts(d.get("deletionTimestamp")),
+        )
+
+
+def _ts(t: Optional[_dt.datetime]) -> Optional[str]:
+    return t.isoformat() if t is not None else None
+
+
+def _parse_ts(v: Any) -> Optional[_dt.datetime]:
+    if v is None or v == "":
+        return None
+    if isinstance(v, _dt.datetime):
+        return v
+    return _dt.datetime.fromisoformat(v)
+
+
+@dataclass
+class Condition:
+    """metav1.Condition equivalent (status is "True"/"False"/"Unknown")."""
+
+    type: str
+    status: str
+    reason: str = ""
+    message: str = ""
+    last_transition_time: Optional[_dt.datetime] = None
+    observed_generation: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "type": self.type,
+            "status": self.status,
+            "reason": self.reason,
+            "message": self.message,
+            "lastTransitionTime": _ts(self.last_transition_time),
+            "observedGeneration": self.observed_generation,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Condition":
+        return cls(
+            type=d.get("type", ""),
+            status=d.get("status", ""),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+            last_transition_time=_parse_ts(d.get("lastTransitionTime")),
+            observed_generation=int(d.get("observedGeneration", 0) or 0),
+        )
+
+
+CONDITION_READY = "Ready"
+
+
+def new_resource_ready_condition(
+    last_transition_time: _dt.datetime, status: bool, message: str
+) -> Condition:
+    """Build the Ready condition exactly as the sync handlers report it.
+
+    Equivalent of nexus-core ``NewResourceReadyCondition`` (reference call
+    sites: controller.go:433,444,456,469). Reason is "initializing" while
+    False, "ready" once True.
+    """
+    return Condition(
+        type=CONDITION_READY,
+        status="True" if status else "False",
+        reason="ready" if status else "initializing",
+        message=message,
+        last_transition_time=last_transition_time,
+    )
+
+
+@dataclass
+class EnvVar:
+    name: str
+    value: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "value": self.value}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EnvVar":
+        return cls(name=d.get("name", ""), value=d.get("value", ""))
+
+
+@dataclass
+class EnvFromSource:
+    """corev1.EnvFromSource equivalent: exactly one of the refs is set.
+
+    The template's ``MappedEnvironmentVariables`` use this to name the secrets
+    and configmaps the controller must replicate (reference construction:
+    controller_test.go:268-282,311-317).
+    """
+
+    secret_ref: Optional[str] = None
+    config_map_ref: Optional[str] = None
+    prefix: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"prefix": self.prefix}
+        if self.secret_ref is not None:
+            d["secretRef"] = {"name": self.secret_ref}
+        if self.config_map_ref is not None:
+            d["configMapRef"] = {"name": self.config_map_ref}
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EnvFromSource":
+        secret = d.get("secretRef") or {}
+        cm = d.get("configMapRef") or {}
+        return cls(
+            secret_ref=secret.get("name") if secret else None,
+            config_map_ref=cm.get("name") if cm else None,
+            prefix=d.get("prefix", ""),
+        )
+
+
+class APIObject:
+    """Mixin shared by all kinds: kind string, metadata, deep copy, equality."""
+
+    KIND: str = ""
+    metadata: ObjectMeta
+
+    def deepcopy(self):
+        """Never mutate informer-cache objects in place — copy first.
+
+        The reference leans on the same convention ("NEVER modify the store;
+        DeepCopy first", controller.go:429-430).
+        """
+        return copy.deepcopy(self)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def key(self) -> str:
+        """Cache key: ``namespace/name``."""
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+def deep_equal(a: Any, b: Any) -> bool:
+    """Structural equality for specs/data, the drift-detection primitive.
+
+    Equivalent of reflect.DeepEqual as used for spec drift
+    (reference: controller.go:795) and secret/configmap data drift
+    (reference: controller.go:539,600).
+    """
+    if is_dataclass(a) and is_dataclass(b):
+        if type(a) is not type(b):
+            return False
+        return all(
+            deep_equal(getattr(a, f.name), getattr(b, f.name)) for f in fields(a)
+        )
+    if isinstance(a, dict) and isinstance(b, dict):
+        if a.keys() != b.keys():
+            return False
+        return all(deep_equal(a[k], b[k]) for k in a)
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        if len(a) != len(b):
+            return False
+        return all(deep_equal(x, y) for x, y in zip(a, b))
+    return a == b
+
+
+@dataclass
+class Secret(APIObject):
+    """corev1.Secret equivalent; ``data`` values are str for simplicity."""
+
+    KIND = "Secret"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+    type: str = "Opaque"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": "v1",
+            "kind": self.KIND,
+            "metadata": self.metadata.to_dict(),
+            "data": dict(self.data),
+            "type": self.type,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Secret":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            data=dict(d.get("data") or {}),
+            type=d.get("type", "Opaque"),
+        )
+
+
+@dataclass
+class ConfigMap(APIObject):
+    KIND = "ConfigMap"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": "v1",
+            "kind": self.KIND,
+            "metadata": self.metadata.to_dict(),
+            "data": dict(self.data),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ConfigMap":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            data=dict(d.get("data") or {}),
+        )

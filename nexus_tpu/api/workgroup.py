@@ -1,0 +1,118 @@
+"""NexusAlgorithmWorkgroup — named scheduling target group.
+
+In the reference a workgroup names a cluster plus node affinity/tolerations
+(shape from controller_test.go:244-251). In the TPU build a workgroup maps to
+a **TPU slice pool**: capabilities select accelerator generation/topology and
+the scheduler resolves templates' ``workgroup_ref`` to concrete slice
+placements (SURVEY.md §2b).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from nexus_tpu.api.types import API_VERSION, APIObject, Condition, ObjectMeta
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"
+    value: str = ""
+    effect: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "key": self.key,
+            "operator": self.operator,
+            "value": self.value,
+            "effect": self.effect,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Toleration":
+        return cls(
+            key=d.get("key", ""),
+            operator=d.get("operator", "Equal"),
+            value=d.get("value", ""),
+            effect=d.get("effect", ""),
+        )
+
+
+@dataclass
+class NexusAlgorithmWorkgroupSpec:
+    description: str = ""
+    capabilities: Dict[str, bool] = field(default_factory=dict)
+    cluster: str = ""
+    tolerations: List[Toleration] = field(default_factory=list)
+    # Free-form affinity dict (corev1.Affinity equivalent); in the TPU build
+    # the materializer adds gke-tpu nodeSelectors on top of this.
+    affinity: Optional[Dict[str, Any]] = None
+    # TPU-native extension: which slice shapes this workgroup can host.
+    tpu_slice_pools: List[Dict[str, Any]] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "description": self.description,
+            "capabilities": dict(self.capabilities),
+            "cluster": self.cluster,
+            "tolerations": [t.to_dict() for t in self.tolerations],
+            "affinity": self.affinity,
+            "tpuSlicePools": list(self.tpu_slice_pools),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "NexusAlgorithmWorkgroupSpec":
+        return cls(
+            description=d.get("description", ""),
+            capabilities=dict(d.get("capabilities") or {}),
+            cluster=d.get("cluster", ""),
+            tolerations=[Toleration.from_dict(t) for t in (d.get("tolerations") or [])],
+            affinity=d.get("affinity"),
+            tpu_slice_pools=list(d.get("tpuSlicePools") or []),
+        )
+
+
+@dataclass
+class NexusAlgorithmWorkgroupStatus:
+    conditions: List[Condition] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"conditions": [c.to_dict() for c in self.conditions]}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "NexusAlgorithmWorkgroupStatus":
+        return cls(
+            conditions=[Condition.from_dict(c) for c in (d.get("conditions") or [])]
+        )
+
+
+@dataclass
+class NexusAlgorithmWorkgroup(APIObject):
+    KIND = "NexusAlgorithmWorkgroup"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NexusAlgorithmWorkgroupSpec = field(
+        default_factory=NexusAlgorithmWorkgroupSpec
+    )
+    status: NexusAlgorithmWorkgroupStatus = field(
+        default_factory=NexusAlgorithmWorkgroupStatus
+    )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": self.metadata.to_dict(),
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "NexusAlgorithmWorkgroup":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=NexusAlgorithmWorkgroupSpec.from_dict(d.get("spec") or {}),
+            status=NexusAlgorithmWorkgroupStatus.from_dict(d.get("status") or {}),
+        )

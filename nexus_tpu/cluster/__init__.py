@@ -1,0 +1,21 @@
+"""Cluster access layer: object store, typed clients, informers/listers.
+
+Plays the role of client-go + the generated clientset/informers/listers in
+the reference (SURVEY.md §2b ``pkg/generated``). The in-process
+:class:`~nexus_tpu.cluster.store.ClusterStore` doubles as the fake clientset
+used throughout the test suite (equivalent of ``k8sfake.NewSimpleClientset``,
+reference controller_test.go:494-498).
+"""
+
+from nexus_tpu.cluster.store import Action, ClusterStore, NotFoundError, ConflictError
+from nexus_tpu.cluster.informer import Informer, InformerFactory, Lister
+
+__all__ = [
+    "Action",
+    "ClusterStore",
+    "NotFoundError",
+    "ConflictError",
+    "Informer",
+    "InformerFactory",
+    "Lister",
+]

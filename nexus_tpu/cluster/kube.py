@@ -1,0 +1,236 @@
+"""Kubernetes-backed cluster store — the real-cluster deployment mode.
+
+Maps the :class:`~nexus_tpu.cluster.store.ClusterStore` surface onto a real
+Kubernetes API server: Secrets/ConfigMaps via CoreV1, the two Nexus CRDs via
+the CustomObjects API (group ``science.sneaksanddata.com/v1``, the reference
+CRD group — RBAC at reference .helm/templates/cluster-role-template-editor.yaml:26).
+
+Requires the ``kubernetes`` Python client, which is NOT baked into this
+environment — the import below gates the whole module; the in-process
+``ClusterStore`` / ``.localshard`` path is the supported mode here. This
+module keeps the real-cluster path honest and structurally complete: same
+method surface, same watch-event fan-out, so ``Shard`` / ``Controller`` /
+``InformerFactory`` work unchanged on top of it.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import kubernetes  # gated: ImportError here means "use .localshard mode"
+from kubernetes import client as k8s_client
+from kubernetes import config as k8s_config
+from kubernetes import watch as k8s_watch
+
+from nexus_tpu.api.template import NexusAlgorithmTemplate
+from nexus_tpu.api.types import GROUP, VERSION, APIObject, ConfigMap, Secret
+from nexus_tpu.api.workgroup import NexusAlgorithmWorkgroup
+from nexus_tpu.cluster.store import Action, NotFoundError, WatchEvent
+
+logger = logging.getLogger("nexus_tpu.cluster.kube")
+
+_PLURALS = {
+    NexusAlgorithmTemplate.KIND: "nexusalgorithmtemplates",
+    NexusAlgorithmWorkgroup.KIND: "nexusalgorithmworkgroups",
+}
+_CRD_TYPES = {
+    NexusAlgorithmTemplate.KIND: NexusAlgorithmTemplate,
+    NexusAlgorithmWorkgroup.KIND: NexusAlgorithmWorkgroup,
+}
+
+
+class KubeClusterStore:
+    """ClusterStore-compatible adapter over a real Kubernetes API server."""
+
+    def __init__(self, name: str, kubeconfig_path: str, namespace: str = ""):
+        self.name = name
+        self.namespace = namespace
+        api_client = k8s_config.new_client_from_config(kubeconfig_path)
+        self._core = k8s_client.CoreV1Api(api_client)
+        self._custom = k8s_client.CustomObjectsApi(api_client)
+        self.actions: List[Action] = []  # parity with ClusterStore (not used
+        # as a test oracle against real clusters)
+        self._watchers: Dict[str, List[Callable[[WatchEvent], None]]] = {}
+        self._watch_threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- conversion
+    def _to_wire(self, obj: APIObject) -> dict:
+        return obj.to_dict()
+
+    def _from_wire(self, kind: str, body) -> APIObject:
+        if hasattr(body, "to_dict"):
+            body = k8s_client.ApiClient().sanitize_for_serialization(body)
+        if kind == Secret.KIND:
+            return Secret.from_dict(body)
+        if kind == ConfigMap.KIND:
+            return ConfigMap.from_dict(body)
+        return _CRD_TYPES[kind].from_dict(body)
+
+    # ------------------------------------------------------------------- CRUD
+    def create(self, obj: APIObject, field_manager: str = "") -> APIObject:
+        kind = obj.KIND
+        ns = obj.metadata.namespace
+        body = self._to_wire(obj)
+        if kind == Secret.KIND:
+            out = self._core.create_namespaced_secret(
+                ns, body, field_manager=field_manager or None
+            )
+        elif kind == ConfigMap.KIND:
+            out = self._core.create_namespaced_config_map(
+                ns, body, field_manager=field_manager or None
+            )
+        else:
+            out = self._custom.create_namespaced_custom_object(
+                GROUP, VERSION, ns, _PLURALS[kind], body,
+                field_manager=field_manager or None,
+            )
+        return self._from_wire(kind, out)
+
+    def get(self, kind: str, namespace: str, name: str) -> APIObject:
+        try:
+            if kind == Secret.KIND:
+                out = self._core.read_namespaced_secret(name, namespace)
+            elif kind == ConfigMap.KIND:
+                out = self._core.read_namespaced_config_map(name, namespace)
+            else:
+                out = self._custom.get_namespaced_custom_object(
+                    GROUP, VERSION, namespace, _PLURALS[kind], name
+                )
+        except k8s_client.ApiException as e:
+            if e.status == 404:
+                raise NotFoundError(kind, namespace, name) from e
+            raise
+        return self._from_wire(kind, out)
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List[APIObject]:
+        ns = namespace if namespace is not None else self.namespace
+        if kind == Secret.KIND:
+            out = self._core.list_namespaced_secret(ns)
+            items = out.items
+        elif kind == ConfigMap.KIND:
+            out = self._core.list_namespaced_config_map(ns)
+            items = out.items
+        else:
+            out = self._custom.list_namespaced_custom_object(
+                GROUP, VERSION, ns, _PLURALS[kind]
+            )
+            items = out.get("items", [])
+        return [self._from_wire(kind, i) for i in items]
+
+    def update(self, obj: APIObject, field_manager: str = "") -> APIObject:
+        kind = obj.KIND
+        ns = obj.metadata.namespace
+        name = obj.metadata.name
+        body = self._to_wire(obj)
+        try:
+            if kind == Secret.KIND:
+                out = self._core.replace_namespaced_secret(
+                    name, ns, body, field_manager=field_manager or None
+                )
+            elif kind == ConfigMap.KIND:
+                out = self._core.replace_namespaced_config_map(
+                    name, ns, body, field_manager=field_manager or None
+                )
+            else:
+                out = self._custom.replace_namespaced_custom_object(
+                    GROUP, VERSION, ns, _PLURALS[kind], name, body,
+                    field_manager=field_manager or None,
+                )
+        except k8s_client.ApiException as e:
+            if e.status == 404:
+                raise NotFoundError(kind, ns, name) from e
+            raise
+        return self._from_wire(kind, out)
+
+    def update_status(self, obj: APIObject, field_manager: str = "") -> APIObject:
+        kind = obj.KIND
+        ns = obj.metadata.namespace
+        name = obj.metadata.name
+        if kind in _PLURALS:
+            out = self._custom.replace_namespaced_custom_object_status(
+                GROUP, VERSION, ns, _PLURALS[kind], name, self._to_wire(obj),
+                field_manager=field_manager or None,
+            )
+            return self._from_wire(kind, out)
+        raise ValueError(f"{kind} has no status subresource")
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        try:
+            if kind == Secret.KIND:
+                self._core.delete_namespaced_secret(name, namespace)
+            elif kind == ConfigMap.KIND:
+                self._core.delete_namespaced_config_map(name, namespace)
+            else:
+                self._custom.delete_namespaced_custom_object(
+                    GROUP, VERSION, namespace, _PLURALS[kind], name
+                )
+        except k8s_client.ApiException as e:
+            if e.status == 404:
+                raise NotFoundError(kind, namespace, name) from e
+            raise
+
+    # ------------------------------------------------------------------ watch
+    def subscribe(self, kind: str, callback: Callable[[WatchEvent], None]) -> None:
+        with self._lock:
+            start_thread = kind not in self._watchers
+            self._watchers.setdefault(kind, []).append(callback)
+        if start_thread:
+            t = threading.Thread(
+                target=self._watch_loop, args=(kind,), daemon=True,
+                name=f"kube-watch-{self.name}-{kind}",
+            )
+            t.start()
+            self._watch_threads.append(t)
+
+    def unsubscribe(self, kind: str, callback: Callable[[WatchEvent], None]) -> None:
+        with self._lock:
+            cbs = self._watchers.get(kind, [])
+            if callback in cbs:
+                cbs.remove(callback)
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def _watch_loop(self, kind: str) -> None:
+        ns = self.namespace
+        while not self._stop.is_set():
+            try:
+                w = k8s_watch.Watch()
+                if kind == Secret.KIND:
+                    stream = w.stream(
+                        self._core.list_namespaced_secret, ns, timeout_seconds=60
+                    )
+                elif kind == ConfigMap.KIND:
+                    stream = w.stream(
+                        self._core.list_namespaced_config_map, ns, timeout_seconds=60
+                    )
+                else:
+                    stream = w.stream(
+                        self._custom.list_namespaced_custom_object,
+                        GROUP, VERSION, ns, _PLURALS[kind], timeout_seconds=60,
+                    )
+                for event in stream:
+                    if self._stop.is_set():
+                        return
+                    obj = self._from_wire(kind, event["object"])
+                    ev = WatchEvent(event["type"], obj)
+                    with self._lock:
+                        cbs = list(self._watchers.get(kind, []))
+                    for cb in cbs:
+                        cb(ev)
+            except Exception:
+                logger.exception(
+                    "watch stream for %s on %s broke; re-listing in 1s",
+                    kind, self.name,
+                )
+                self._stop.wait(1.0)
+
+    def clear_actions(self) -> None:
+        self.actions = []
+
+    def seed(self, *objs: APIObject) -> None:
+        raise NotImplementedError("seed() is for in-process fake stores only")

@@ -1,0 +1,327 @@
+"""In-process cluster object store — the framework's API-server abstraction.
+
+Serves three roles:
+  1. **Fake clientset for tests** — records every write as an
+     :class:`Action` so tests can assert action-by-action, the reference's
+     test oracle technique (controller_test.go:383-466 ``checkAction``).
+  2. **Local shard backend** — an in-process "cluster" that the shard client
+     writes to and the job launcher executes from (BASELINE config #2).
+  3. **Interface template for real clusters** — a Kubernetes-backed
+     implementation with the same surface can be dropped in
+     (``nexus_tpu.cluster.kube``, gated on the ``kubernetes`` package).
+
+Semantics mirrored from the Kubernetes API machinery the reference builds on:
+  * per-object ``resourceVersion`` bumped on every write; stale-RV updates
+    conflict (optimistic concurrency).
+  * ``update_status`` only touches ``status`` (the status subresource).
+  * watch events (ADDED/MODIFIED/DELETED) fan out to subscribers — the feed
+    informers consume.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from nexus_tpu.api.types import APIObject, ObjectMeta, new_uid, utcnow
+
+
+class NotFoundError(KeyError):
+    """Equivalent of a 404 / apierrors.IsNotFound."""
+
+    def __init__(self, kind: str, namespace: str, name: str):
+        super().__init__(f"{kind} {namespace}/{name} not found")
+        self.kind = kind
+        self.namespace = namespace
+        self.name = name
+
+
+class ConflictError(RuntimeError):
+    """Equivalent of a 409 (already exists / stale resourceVersion)."""
+
+
+class AlreadyExistsError(ConflictError):
+    pass
+
+
+@dataclass
+class Action:
+    """One recorded API interaction, the unit of test assertions."""
+
+    verb: str  # create | update | update-status | delete | get | list
+    kind: str
+    namespace: str
+    name: str
+    obj: Any = None
+    subresource: str = ""
+    field_manager: str = ""
+
+    def matches(self, verb: str, kind: str) -> bool:
+        return self.verb == verb and self.kind == kind
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    obj: Any = None
+
+
+class ClusterStore:
+    """Thread-safe multi-kind object store with watch + action recording."""
+
+    def __init__(self, name: str = "cluster"):
+        self.name = name
+        self._lock = threading.RLock()
+        # (kind, namespace) -> {name: obj}
+        self._objects: Dict[Tuple[str, str], Dict[str, APIObject]] = {}
+        self._rv_counter = 0
+        self.actions: List[Action] = []
+        self._watchers: Dict[str, List[Callable[[WatchEvent], None]]] = {}
+        self.record_reads = False
+
+    # ------------------------------------------------------------------ utils
+    def _next_rv(self) -> str:
+        self._rv_counter += 1
+        return str(self._rv_counter)
+
+    def _bucket(self, kind: str, namespace: str) -> Dict[str, APIObject]:
+        return self._objects.setdefault((kind, namespace), {})
+
+    def _record(self, action: Action) -> None:
+        self.actions.append(action)
+
+    def _notify(self, kind: str, event: WatchEvent) -> None:
+        for cb in list(self._watchers.get(kind, [])):
+            cb(event)
+
+    def clear_actions(self) -> None:
+        with self._lock:
+            self.actions = []
+
+    # ------------------------------------------------------------------- CRUD
+    def create(
+        self, obj: APIObject, field_manager: str = ""
+    ) -> APIObject:
+        kind = obj.KIND
+        with self._lock:
+            meta = obj.metadata
+            bucket = self._bucket(kind, meta.namespace)
+            if meta.name in bucket:
+                raise AlreadyExistsError(
+                    f"{kind} {meta.namespace}/{meta.name} already exists"
+                )
+            stored = obj.deepcopy()
+            if not stored.metadata.uid:
+                stored.metadata.uid = new_uid()
+            stored.metadata.resource_version = self._next_rv()
+            stored.metadata.generation = 1
+            if stored.metadata.creation_timestamp is None:
+                stored.metadata.creation_timestamp = utcnow()
+            bucket[meta.name] = stored
+            self._record(
+                Action(
+                    "create",
+                    kind,
+                    meta.namespace,
+                    meta.name,
+                    stored.deepcopy(),
+                    field_manager=field_manager,
+                )
+            )
+            out = stored.deepcopy()
+        self._notify(kind, WatchEvent("ADDED", out.deepcopy()))
+        return out
+
+    def get(self, kind: str, namespace: str, name: str) -> APIObject:
+        with self._lock:
+            bucket = self._bucket(kind, namespace)
+            if name not in bucket:
+                raise NotFoundError(kind, namespace, name)
+            if self.record_reads:
+                self._record(Action("get", kind, namespace, name))
+            return bucket[name].deepcopy()
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List[APIObject]:
+        with self._lock:
+            out: List[APIObject] = []
+            for (k, ns), bucket in self._objects.items():
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                out.extend(o.deepcopy() for o in bucket.values())
+            if self.record_reads:
+                self._record(Action("list", kind, namespace or "", ""))
+            return out
+
+    def update(
+        self, obj: APIObject, field_manager: str = ""
+    ) -> APIObject:
+        """Full-object update; preserves stored status for status-bearing kinds
+        (spec updates go through ``update``, status through ``update_status`` —
+        matching the subresource split the reference relies on)."""
+        kind = obj.KIND
+        with self._lock:
+            meta = obj.metadata
+            bucket = self._bucket(kind, meta.namespace)
+            if meta.name not in bucket:
+                raise NotFoundError(kind, meta.namespace, meta.name)
+            current = bucket[meta.name]
+            if (
+                meta.resource_version
+                and meta.resource_version != current.metadata.resource_version
+            ):
+                raise ConflictError(
+                    f"{kind} {meta.namespace}/{meta.name}: stale resourceVersion "
+                    f"{meta.resource_version} (current "
+                    f"{current.metadata.resource_version})"
+                )
+            stored = obj.deepcopy()
+            stored.metadata.uid = current.metadata.uid
+            stored.metadata.creation_timestamp = current.metadata.creation_timestamp
+            stored.metadata.deletion_timestamp = current.metadata.deletion_timestamp
+            stored.metadata.resource_version = self._next_rv()
+            stored.metadata.generation = current.metadata.generation + 1
+            if hasattr(current, "status") and hasattr(stored, "status"):
+                stored.status = current.status
+            # finalizer semantics: clearing the last finalizer of a
+            # deletion-pending object completes the delete
+            finalize_now = (
+                stored.metadata.deletion_timestamp is not None
+                and not stored.metadata.finalizers
+            )
+            if finalize_now:
+                bucket.pop(meta.name, None)
+                self._record(Action("delete", kind, meta.namespace, meta.name))
+            else:
+                bucket[meta.name] = stored
+                self._record(
+                    Action(
+                        "update",
+                        kind,
+                        meta.namespace,
+                        meta.name,
+                        stored.deepcopy(),
+                        field_manager=field_manager,
+                    )
+                )
+            out = stored.deepcopy()
+        if finalize_now:
+            self._notify(kind, WatchEvent("DELETED", out.deepcopy()))
+            self._garbage_collect(out)
+            return out
+        self._notify(kind, WatchEvent("MODIFIED", out.deepcopy()))
+        return out
+
+    def update_status(
+        self, obj: APIObject, field_manager: str = ""
+    ) -> APIObject:
+        """Status-subresource update (reference: UpdateStatus,
+        controller.go:434)."""
+        kind = obj.KIND
+        with self._lock:
+            meta = obj.metadata
+            bucket = self._bucket(kind, meta.namespace)
+            if meta.name not in bucket:
+                raise NotFoundError(kind, meta.namespace, meta.name)
+            current = bucket[meta.name]
+            stored = current.deepcopy()
+            stored.status = obj.deepcopy().status  # type: ignore[attr-defined]
+            stored.metadata.resource_version = self._next_rv()
+            bucket[meta.name] = stored
+            self._record(
+                Action(
+                    "update",
+                    kind,
+                    meta.namespace,
+                    meta.name,
+                    stored.deepcopy(),
+                    subresource="status",
+                    field_manager=field_manager,
+                )
+            )
+            out = stored.deepcopy()
+        self._notify(kind, WatchEvent("MODIFIED", out.deepcopy()))
+        return out
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        """Delete an object. Kubernetes finalizer semantics: an object with
+        finalizers is not removed — its ``deletionTimestamp`` is set and a
+        MODIFIED event fires; actual removal happens when the last finalizer
+        is cleared via ``update`` (see SURVEY.md §7 hard part (f))."""
+        pending = None
+        out = None
+        with self._lock:
+            bucket = self._bucket(kind, namespace)
+            if name not in bucket:
+                raise NotFoundError(kind, namespace, name)
+            current = bucket[name]
+            if current.metadata.finalizers:
+                if current.metadata.deletion_timestamp is None:
+                    current.metadata.deletion_timestamp = utcnow()
+                    current.metadata.resource_version = self._next_rv()
+                    self._record(Action("delete", kind, namespace, name))
+                    pending = current.deepcopy()
+                # else: delete already pending; no-op
+            else:
+                gone = bucket.pop(name)
+                self._record(Action("delete", kind, namespace, name))
+                out = gone.deepcopy()
+        if pending is not None:
+            self._notify(kind, WatchEvent("MODIFIED", pending))
+        if out is None:
+            return
+        self._notify(kind, WatchEvent("DELETED", out))
+        # Kubernetes-style cascading GC: children owned (by uid) by the
+        # deleted object are collected. The reference leans on shard-local
+        # ownerReference GC for synced secrets/configmaps (SURVEY §3.3 note).
+        self._garbage_collect(out)
+
+    def _garbage_collect(self, owner: APIObject) -> None:
+        uid = owner.metadata.uid
+        to_delete: List[Tuple[str, str, str]] = []
+        with self._lock:
+            for (kind, ns), bucket in self._objects.items():
+                for name, obj in bucket.items():
+                    refs = obj.metadata.owner_references
+                    if not refs:
+                        continue
+                    if any(r.uid == uid for r in refs):
+                        remaining = [r for r in refs if r.uid != uid]
+                        if remaining:
+                            obj.metadata.owner_references = remaining
+                        else:
+                            to_delete.append((kind, ns, name))
+        for kind, ns, name in to_delete:
+            try:
+                self.delete(kind, ns, name)
+            except NotFoundError:
+                pass
+
+    # ------------------------------------------------------------------ watch
+    def subscribe(self, kind: str, callback: Callable[[WatchEvent], None]) -> None:
+        with self._lock:
+            self._watchers.setdefault(kind, []).append(callback)
+
+    def unsubscribe(self, kind: str, callback: Callable[[WatchEvent], None]) -> None:
+        with self._lock:
+            cbs = self._watchers.get(kind, [])
+            if callback in cbs:
+                cbs.remove(callback)
+
+    # ----------------------------------------------------------------- helper
+    def seed(self, *objs: APIObject) -> None:
+        """Directly place objects without recording actions (test fixtures)."""
+        with self._lock:
+            for obj in objs:
+                stored = obj.deepcopy()
+                if not stored.metadata.uid:
+                    stored.metadata.uid = new_uid()
+                if not stored.metadata.resource_version:
+                    stored.metadata.resource_version = self._next_rv()
+                if stored.metadata.creation_timestamp is None:
+                    stored.metadata.creation_timestamp = utcnow()
+                self._bucket(obj.KIND, obj.metadata.namespace)[
+                    obj.metadata.name
+                ] = stored

@@ -1,0 +1,34 @@
+"""Reconciliation core: workqueue, rate limiting, events, controller.
+
+Equivalent of the reference's L4 layer (``controller.go``) plus the client-go
+workqueue machinery it builds on (SURVEY.md §1, §2a).
+"""
+
+from nexus_tpu.controller.ratelimit import (
+    BucketRateLimiter,
+    ItemExponentialFailureRateLimiter,
+    MaxOfRateLimiter,
+)
+from nexus_tpu.controller.workqueue import RateLimitingQueue, WorkQueue
+from nexus_tpu.controller.events import EventRecorder, FakeRecorder, Event
+from nexus_tpu.controller.controller import (
+    Controller,
+    Element,
+    TYPE_TEMPLATE,
+    TYPE_WORKGROUP,
+)
+
+__all__ = [
+    "BucketRateLimiter",
+    "ItemExponentialFailureRateLimiter",
+    "MaxOfRateLimiter",
+    "RateLimitingQueue",
+    "WorkQueue",
+    "EventRecorder",
+    "FakeRecorder",
+    "Event",
+    "Controller",
+    "Element",
+    "TYPE_TEMPLATE",
+    "TYPE_WORKGROUP",
+]

@@ -1,0 +1,656 @@
+"""The reconciliation core: converge declared templates/workgroups onto shards.
+
+Behavioral spec (reproduced, not translated, from the reference
+``controller.go`` — see SURVEY.md §2a/§3 for the full catalog):
+
+  * Template/Workgroup add+update events enqueue the object; Secret/ConfigMap
+    events resolve ``ownerReferences`` to the owning template and enqueue it
+    (reference: controller.go:169-224), with a resourceVersion-equality skip
+    on resync updates (controller.go:322-328).
+  * Template delete events fan the delete out to every shard inline
+    (reference: controller.go:196-205 — the known-unclear delete path). This
+    build *also* supports a principled finalizer-based path via
+    ``use_finalizers=True`` (SURVEY.md §7 hard part (f)).
+  * The work loop pops a rate-limited queue; success → ``forget``; failure →
+    ``add_rate_limited`` with MaxOf(per-item exponential, global bucket)
+    backoff (controller.go:373-426, 257-260). Two gauges per item:
+    ``reconcile_latency`` and ``workqueue_length`` (controller.go:389-390).
+  * ``template_sync_handler``: lister get → init condition (only when the
+    resource has no conditions) → adopt referenced secrets/configmaps in the
+    controller cluster → per shard: create-or-update template (spec
+    DeepEqual-drift), sync secrets, sync configmaps → ready condition with
+    synced bookkeeping → Synced event. Fail-fast on first error → requeue
+    (controller.go:761-845).
+  * Rogue detection: a shard resource with zero owner references is "rogue" —
+    warning event + error; owned-by-someone-else → adopt by appending this
+    template's owner reference (controller.go:484-502).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from nexus_tpu.api.template import NexusAlgorithmTemplate
+from nexus_tpu.api.types import (
+    API_VERSION,
+    ConfigMap,
+    OwnerReference,
+    Secret,
+    deep_equal,
+    new_resource_ready_condition,
+    utcnow,
+)
+from nexus_tpu.api.workgroup import NexusAlgorithmWorkgroup
+from nexus_tpu.cluster.informer import InformerFactory
+from nexus_tpu.cluster.store import ClusterStore, NotFoundError
+from nexus_tpu.controller.events import (
+    EVENT_TYPE_NORMAL,
+    EVENT_TYPE_WARNING,
+    FIELD_MANAGER,
+    MSG_RESOURCE_EXISTS,
+    MSG_RESOURCE_MISSING,
+    MSG_RESOURCE_OPERATION_FAILED,
+    MSG_RESOURCE_SYNCED,
+    REASON_ERR_RESOURCE_EXISTS,
+    REASON_ERR_RESOURCE_MISSING,
+    REASON_ERR_RESOURCE_SYNC,
+    REASON_SYNCED,
+    EventRecorder,
+)
+from nexus_tpu.controller.ratelimit import default_controller_rate_limiter
+from nexus_tpu.controller.workqueue import RateLimitingQueue
+from nexus_tpu.shards.shard import Shard
+from nexus_tpu.utils.telemetry import (
+    METRIC_RECONCILE_LATENCY,
+    METRIC_WORKQUEUE_LENGTH,
+    StatsdClient,
+    get_client,
+)
+
+logger = logging.getLogger("nexus_tpu.controller")
+
+TYPE_TEMPLATE = "template"
+TYPE_WORKGROUP = "workgroup"
+
+FINALIZER = "science.sneaksanddata.com/shard-cleanup"
+
+
+@dataclass(frozen=True)
+class Element:
+    """Work-queue element: object reference + kind tag (reference:
+    controller.go:86-96). Frozen → hashable → dedupable by the queue."""
+
+    namespace: str
+    name: str
+    obj_type: str
+
+
+class SyncError(RuntimeError):
+    pass
+
+
+class Controller:
+    """Multi-cluster configuration controller."""
+
+    def __init__(
+        self,
+        controller_store: ClusterStore,
+        shards: Sequence[Shard],
+        informer_factory: Optional[InformerFactory] = None,
+        recorder: Optional[EventRecorder] = None,
+        statsd: Optional[StatsdClient] = None,
+        failure_rate_base_delay: float = 0.030,
+        failure_rate_max_delay: float = 5.0,
+        rate_limit_elements_per_second: float = 50.0,
+        rate_limit_elements_burst: int = 300,
+        use_finalizers: bool = False,
+        resync_period: float = 30.0,
+    ):
+        self.store = controller_store
+        self.shards = list(shards)
+        self.informers = informer_factory or InformerFactory(
+            controller_store, resync_period=resync_period
+        )
+        self.recorder = recorder or EventRecorder()
+        self.statsd = statsd or get_client()
+        self.use_finalizers = use_finalizers
+
+        self.work_queue = RateLimitingQueue(
+            default_controller_rate_limiter(
+                base_delay=failure_rate_base_delay,
+                max_delay=failure_rate_max_delay,
+                rate=rate_limit_elements_per_second,
+                burst=rate_limit_elements_burst,
+            )
+        )
+
+        self.template_informer = self.informers.informer(NexusAlgorithmTemplate.KIND)
+        self.workgroup_informer = self.informers.informer(NexusAlgorithmWorkgroup.KIND)
+        self.secret_informer = self.informers.informer(Secret.KIND)
+        self.config_map_informer = self.informers.informer(ConfigMap.KIND)
+
+        self.template_lister = self.template_informer.lister
+        self.workgroup_lister = self.workgroup_informer.lister
+        self.secret_lister = self.secret_informer.lister
+        self.config_map_lister = self.config_map_informer.lister
+
+        self._register_handlers()
+        self._workers: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------ registration
+    def _register_handlers(self) -> None:
+        self.template_informer.add_event_handler(
+            on_add=self.enqueue_resource,
+            on_update=lambda old, new: self.enqueue_resource(new),
+            on_delete=self.handle_object_delete,
+        )
+        self.workgroup_informer.add_event_handler(
+            on_add=self.enqueue_resource,
+            on_update=lambda old, new: self.enqueue_resource(new),
+        )
+        # Dependent resources: owner-resolution enqueue, with the
+        # resourceVersion-equality resync skip (reference:
+        # controller.go:322-328,345-351).
+        for informer in (self.secret_informer, self.config_map_informer):
+            informer.add_event_handler(
+                on_add=self.handle_object,
+                on_update=self._handle_dependent_update,
+                on_delete=self.handle_object,
+            )
+
+    def _handle_dependent_update(self, old, new) -> None:
+        if (
+            old is not None
+            and old.metadata.resource_version == new.metadata.resource_version
+        ):
+            # periodic resync of an unchanged object — nothing to do
+            return
+        self.handle_object(new)
+
+    # ----------------------------------------------------------------- enqueue
+    def enqueue_resource(self, obj) -> None:
+        """Type-switch enqueue of the two CRD kinds (reference:
+        controller.go:136-162)."""
+        if isinstance(obj, NexusAlgorithmTemplate):
+            obj_type = TYPE_TEMPLATE
+        elif isinstance(obj, NexusAlgorithmWorkgroup):
+            obj_type = TYPE_WORKGROUP
+        else:
+            logger.error("unsupported type passed into work queue: %r", type(obj))
+            return
+        self.work_queue.add(
+            Element(obj.metadata.namespace, obj.metadata.name, obj_type)
+        )
+
+    def handle_object(self, obj) -> None:
+        """Resolve a dependent object's ownerReferences to its owning
+        template(s) and enqueue them (reference: controller.go:208-221)."""
+        for ref in obj.metadata.owner_references:
+            if ref.kind != NexusAlgorithmTemplate.KIND:
+                continue
+            try:
+                template = self.template_lister.get(obj.metadata.namespace, ref.name)
+            except NotFoundError:
+                # a shared secret/configmap may carry refs to several
+                # templates; one being gone must not mask the others
+                logger.debug(
+                    "ignore orphaned owner ref %s on %s", ref.name, obj.key()
+                )
+                continue
+            self.enqueue_resource(template)
+
+    def handle_object_delete(self, obj) -> None:
+        """Template deletion: fan the delete out to every shard (reference
+        inline path controller.go:196-205)."""
+        if not isinstance(obj, NexusAlgorithmTemplate):
+            self.handle_object(obj)
+            return
+        if self.use_finalizers:
+            # DELETED only fires after the finalizer was cleared, i.e. after
+            # the sync handler already removed the template from every shard
+            return
+        logger.info("template %s deleted, removing from shards", obj.key())
+        for shard in self.shards:
+            try:
+                shard.delete_template(obj)
+            except NotFoundError:
+                pass
+            except Exception:
+                # one unreachable shard must not strand the template on the
+                # remaining shards; the finalizer path retries, this inline
+                # path at least covers every shard it can
+                logger.exception(
+                    "error deleting template from shard %s", shard.name
+                )
+
+    # --------------------------------------------------------------- work loop
+    def run(self, workers: int = 2, wait_cache_sync_timeout: float = 30.0) -> None:
+        """Start informers, gate on cache sync, spawn worker threads
+        (reference: controller.go:851-884)."""
+        logger.info("starting nexus controller (%d workers)", workers)
+        self.informers.start()
+        for shard in self.shards:
+            shard.start()
+        if not self.informers.wait_for_cache_sync(wait_cache_sync_timeout):
+            raise RuntimeError("failed to wait for controller caches to sync")
+        for shard in self.shards:
+            if not shard.wait_for_cache_sync(wait_cache_sync_timeout):
+                raise RuntimeError(
+                    f"failed to wait for shard {shard.name} caches to sync"
+                )
+        logger.info("informer caches synced; starting workers")
+        self._stop.clear()
+        for i in range(workers):
+            t = threading.Thread(
+                target=self._worker_loop, name=f"nexus-worker-{i}", daemon=True
+            )
+            t.start()
+            self._workers.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.work_queue.shut_down()
+        for t in self._workers:
+            t.join(timeout=5.0)
+        self._workers = []
+        self.informers.stop()
+
+    def _worker_loop(self) -> None:
+        # wait.UntilWithContext semantics: crash-guard the loop, restart after 1s
+        while not self._stop.is_set():
+            try:
+                while self.process_next_work_item():
+                    pass
+                return  # queue shut down
+            except Exception:
+                logger.exception("worker crashed; restarting in 1s")
+                time.sleep(1.0)
+
+    def process_next_work_item(self, timeout: Optional[float] = None) -> bool:
+        """One queue pop + dispatch (reference: controller.go:373-426)."""
+        item, shutdown = self.work_queue.get(timeout=timeout)
+        if shutdown:
+            return False
+        if item is None:  # timeout (test convenience)
+            return True
+        start = time.monotonic()
+        try:
+            try:
+                if item.obj_type == TYPE_TEMPLATE:
+                    self.template_sync_handler(item.namespace, item.name)
+                elif item.obj_type == TYPE_WORKGROUP:
+                    self.workgroup_sync_handler(item.namespace, item.name)
+                else:
+                    logger.error("unknown element type in workqueue: %r", item)
+            except Exception as e:
+                logger.warning("error syncing %r: %s; requeuing", item, e)
+                self.work_queue.add_rate_limited(item)
+            else:
+                self.work_queue.forget(item)
+        finally:
+            self.work_queue.done(item)
+            self.statsd.gauge_duration(
+                METRIC_RECONCILE_LATENCY, start, tags=[f"object_type:{item.obj_type}"]
+            )
+            self.statsd.gauge(METRIC_WORKQUEUE_LENGTH, len(self.work_queue))
+        return True
+
+    def _finalize_template_delete(self, template: NexusAlgorithmTemplate) -> None:
+        """Finalizer-based delete: remove from every shard, then clear the
+        finalizer so the API server completes the delete. Any shard error
+        raises → rate-limited requeue → retried until all shards are clean —
+        the crash-safe path the reference lacks (its inline fan-out,
+        controller.go:195-205, is fire-and-forget; SURVEY.md §7 hard
+        part (f))."""
+        logger.info("finalizing delete of template %s", template.key())
+        for shard in self.shards:
+            try:
+                shard.delete_template(template)
+            except NotFoundError:
+                pass  # already gone from this shard
+        updated = template.deepcopy()
+        updated.metadata.finalizers = [
+            f for f in updated.metadata.finalizers if f != FINALIZER
+        ]
+        self.store.update(updated, field_manager=FIELD_MANAGER)
+        self.template_lister._delete(template)
+
+    # --------------------------------------------------------- status reports
+    def _report_template_init_condition(
+        self, template: NexusAlgorithmTemplate
+    ) -> NexusAlgorithmTemplate:
+        """Init condition is only assigned to new resources (reference:
+        controller.go:428-437)."""
+        if template.status.conditions:
+            return template
+        updated = template.deepcopy()
+        updated.status.conditions = [
+            new_resource_ready_condition(
+                utcnow(), False, f'Algorithm "{template.name}" initializing'
+            )
+        ]
+        return self.store.update_status(updated, field_manager=FIELD_MANAGER)  # type: ignore[return-value]
+
+    def _report_workgroup_init_condition(
+        self, workgroup: NexusAlgorithmWorkgroup
+    ) -> NexusAlgorithmWorkgroup:
+        if workgroup.status.conditions:
+            return workgroup
+        updated = workgroup.deepcopy()
+        updated.status.conditions = [
+            new_resource_ready_condition(
+                utcnow(), False, f'Workgroup "{workgroup.name}" initializing'
+            )
+        ]
+        return self.store.update_status(updated, field_manager=FIELD_MANAGER)  # type: ignore[return-value]
+
+    def _report_template_synced_condition(
+        self,
+        template: NexusAlgorithmTemplate,
+        synced_secrets: List[str],
+        synced_config_maps: List[str],
+        shard_names: List[str],
+    ) -> NexusAlgorithmTemplate:
+        """Ready=True + sync bookkeeping, guarded by status DeepEqual so
+        no-op reconciles don't write (reference: controller.go:463-480 — the
+        new condition first reuses the previous LastTransitionTime so
+        DeepEqual sees only real changes)."""
+        updated = template.deepcopy()
+        prev_ltt = updated.status.conditions[0].last_transition_time
+        updated.status.conditions[0] = new_resource_ready_condition(
+            prev_ltt, True, f'Algorithm "{template.name}" ready'
+        )
+        updated.status.synced_secrets = list(synced_secrets)
+        updated.status.synced_configurations = list(synced_config_maps)
+        updated.status.synced_to_clusters = list(shard_names)
+        if not deep_equal(template.status, updated.status):
+            updated.status.conditions[0].last_transition_time = utcnow()
+            return self.store.update_status(updated, field_manager=FIELD_MANAGER)  # type: ignore[return-value]
+        return template
+
+    def _report_workgroup_synced_condition(
+        self, workgroup: NexusAlgorithmWorkgroup
+    ) -> NexusAlgorithmWorkgroup:
+        updated = workgroup.deepcopy()
+        prev_ltt = updated.status.conditions[0].last_transition_time
+        updated.status.conditions[0] = new_resource_ready_condition(
+            prev_ltt, True, f'Workgroup "{workgroup.name}" ready'
+        )
+        if not deep_equal(workgroup.status, updated.status):
+            updated.status.conditions[0].last_transition_time = utcnow()
+            return self.store.update_status(updated, field_manager=FIELD_MANAGER)  # type: ignore[return-value]
+        return workgroup
+
+    # ------------------------------------------------------ ownership machinery
+    def _is_owned_by(self, meta, template: NexusAlgorithmTemplate) -> bool:
+        return any(
+            ref.uid == template.metadata.uid for ref in meta.owner_references
+        )
+
+    def _is_missing_ownership(self, obj, owner) -> bool:
+        """Rogue / adoption check (reference: controller.go:484-502).
+
+        Returns True when the object exists but lacks this owner (→ adopt).
+        Raises SyncError for rogue objects (zero owner references)."""
+        refs = obj.metadata.owner_references
+        if refs:
+            for ref in refs:
+                if (
+                    ref.kind == NexusAlgorithmTemplate.KIND
+                    and ref.uid == owner.metadata.uid
+                ):
+                    return False
+            return True
+        msg = MSG_RESOURCE_EXISTS.format(obj.metadata.name)
+        self.recorder.event(obj, EVENT_TYPE_WARNING, REASON_ERR_RESOURCE_EXISTS, msg)
+        raise SyncError(msg)
+
+    def _adopt_references(self, template: NexusAlgorithmTemplate) -> None:
+        """Append this template's ownerReference to its referenced secrets and
+        configmaps in the **controller** cluster (reference:
+        controller.go:647-695)."""
+        for kind, lister, names in (
+            (Secret.KIND, self.secret_lister, template.get_secret_names()),
+            (ConfigMap.KIND, self.config_map_lister, template.get_config_map_names()),
+        ):
+            for name in names:
+                try:
+                    referenced = lister.get(template.namespace, name)
+                except NotFoundError:
+                    msg = MSG_RESOURCE_MISSING.format(name, template.name)
+                    self.recorder.event(
+                        template,
+                        EVENT_TYPE_WARNING,
+                        REASON_ERR_RESOURCE_MISSING,
+                        msg,
+                    )
+                    raise SyncError(msg)
+                if self._is_owned_by(referenced.metadata, template):
+                    continue
+                updated = referenced.deepcopy()
+                updated.metadata.owner_references.append(
+                    OwnerReference(
+                        api_version=API_VERSION,
+                        kind=NexusAlgorithmTemplate.KIND,
+                        name=template.name,
+                        uid=template.metadata.uid,
+                    )
+                )
+                try:
+                    stored = self.store.update(updated)
+                except Exception as e:
+                    self.recorder.event(
+                        template,
+                        EVENT_TYPE_WARNING,
+                        REASON_ERR_RESOURCE_SYNC,
+                        MSG_RESOURCE_OPERATION_FAILED.format(name, template.name, e),
+                    )
+                    raise
+                # keep the local cache hot so subsequent stages observe the
+                # adoption even before the watch event lands
+                lister._set(stored)
+
+    # ------------------------------------------------------- dependent syncing
+    def _sync_dependents_to_shard(
+        self,
+        kind: str,
+        names: List[str],
+        controller_template: NexusAlgorithmTemplate,
+        shard_template: NexusAlgorithmTemplate,
+        shard: Shard,
+    ) -> None:
+        """Shared secret/configmap convergence (reference:
+        controller.go:504-626 — the two functions are structurally identical).
+
+        Per referenced name: controller-lister get (missing → warning event +
+        error) → shard-lister get (missing → create on shard) → rogue check →
+        data drift → update data → missing ownership → update owner."""
+        is_secret = kind == Secret.KIND
+        controller_lister = self.secret_lister if is_secret else self.config_map_lister
+        shard_lister = shard.secret_lister if is_secret else shard.config_map_lister
+        create = shard.create_secret if is_secret else shard.create_config_map
+        update = shard.update_secret if is_secret else shard.update_config_map
+
+        for name in names:
+            try:
+                source = controller_lister.get(controller_template.namespace, name)
+            except NotFoundError:
+                msg = MSG_RESOURCE_MISSING.format(name, controller_template.name)
+                self.recorder.event(
+                    controller_template,
+                    EVENT_TYPE_WARNING,
+                    REASON_ERR_RESOURCE_MISSING,
+                    msg,
+                )
+                raise SyncError(msg)
+
+            try:
+                shard_obj = shard_lister.get(shard_template.namespace, name)
+            except NotFoundError:
+                try:
+                    shard_obj = create(shard_template, source, FIELD_MANAGER)
+                except Exception as e:
+                    self.recorder.event(
+                        controller_template,
+                        EVENT_TYPE_WARNING,
+                        REASON_ERR_RESOURCE_SYNC,
+                        MSG_RESOURCE_OPERATION_FAILED.format(
+                            name, controller_template.name, e
+                        ),
+                    )
+                    raise
+                shard_lister._set(shard_obj)
+
+            try:
+                missing_owner = self._is_missing_ownership(shard_obj, shard_template)
+            except SyncError as e:
+                self.recorder.event(
+                    controller_template,
+                    EVENT_TYPE_WARNING,
+                    REASON_ERR_RESOURCE_SYNC,
+                    MSG_RESOURCE_OPERATION_FAILED.format(
+                        name, controller_template.name, e
+                    ),
+                )
+                raise
+
+            if not deep_equal(source.data, shard_obj.data):
+                logger.debug("content changed for %s %s, updating", kind, name)
+                shard_obj = update(shard_obj, source.data, None, FIELD_MANAGER)
+                shard_lister._set(shard_obj)
+            if missing_owner:
+                logger.debug("ownership missing for %s %s, updating", kind, name)
+                shard_obj = update(shard_obj, None, shard_template, FIELD_MANAGER)
+                shard_lister._set(shard_obj)
+
+    # ------------------------------------------------------------ sync handlers
+    def shard_names(self) -> List[str]:
+        return [s.name for s in self.shards]
+
+    def template_sync_handler(self, namespace: str, name: str) -> None:
+        """Core reconcile (reference: controller.go:761-845)."""
+        try:
+            template = self.template_lister.get(namespace, name)
+        except NotFoundError:
+            logger.info(
+                "template %s/%s no longer exists; dropping", namespace, name
+            )
+            return
+
+        if self.use_finalizers:
+            if template.metadata.deletion_timestamp is not None:
+                self._finalize_template_delete(template)
+                return
+            if FINALIZER not in template.metadata.finalizers:
+                updated = template.deepcopy()
+                updated.metadata.finalizers.append(FINALIZER)
+                template = self.store.update(updated, field_manager=FIELD_MANAGER)  # type: ignore[assignment]
+                self.template_lister._set(template)
+
+        template = self._report_template_init_condition(template)
+        self._adopt_references(template)
+
+        for shard in self.shards:
+            shard_template: Optional[NexusAlgorithmTemplate]
+            try:
+                shard_template = shard.template_lister.get(namespace, name)  # type: ignore[assignment]
+            except NotFoundError:
+                shard_template = None
+
+            if shard_template is not None and not deep_equal(
+                shard_template.spec, template.spec
+            ):
+                logger.debug(
+                    "spec drift for template %s on shard %s, updating",
+                    name,
+                    shard.name,
+                )
+                shard_template = shard.update_template(
+                    shard_template, template.spec, FIELD_MANAGER
+                )
+                shard.template_lister._set(shard_template)
+            elif shard_template is None:
+                logger.debug(
+                    "template %s not found in shard %s, creating", name, shard.name
+                )
+                shard_template = shard.create_template(
+                    template.name, template.namespace, template.spec, FIELD_MANAGER
+                )
+                shard.template_lister._set(shard_template)
+
+            self._sync_dependents_to_shard(
+                Secret.KIND,
+                shard_template.get_secret_names(),
+                template,
+                shard_template,
+                shard,
+            )
+            self._sync_dependents_to_shard(
+                ConfigMap.KIND,
+                shard_template.get_config_map_names(),
+                template,
+                shard_template,
+                shard,
+            )
+
+        template = self._report_template_synced_condition(
+            template,
+            template.get_secret_names(),
+            template.get_config_map_names(),
+            self.shard_names(),
+        )
+        self.recorder.event(
+            template,
+            EVENT_TYPE_NORMAL,
+            REASON_SYNCED,
+            MSG_RESOURCE_SYNCED.format(NexusAlgorithmTemplate.KIND),
+        )
+
+    def workgroup_sync_handler(self, namespace: str, name: str) -> None:
+        """Workgroup reconcile: same shape, no dependents (reference:
+        controller.go:697-756)."""
+        try:
+            workgroup = self.workgroup_lister.get(namespace, name)
+        except NotFoundError:
+            logger.info(
+                "workgroup %s/%s no longer exists; dropping", namespace, name
+            )
+            return
+
+        workgroup = self._report_workgroup_init_condition(workgroup)
+
+        for shard in self.shards:
+            shard_wg: Optional[NexusAlgorithmWorkgroup]
+            try:
+                shard_wg = shard.workgroup_lister.get(namespace, name)  # type: ignore[assignment]
+            except NotFoundError:
+                shard_wg = None
+
+            if shard_wg is not None and not deep_equal(shard_wg.spec, workgroup.spec):
+                logger.debug(
+                    "spec drift for workgroup %s on shard %s, updating",
+                    name,
+                    shard.name,
+                )
+                shard_wg = shard.update_workgroup(
+                    shard_wg, workgroup.spec, FIELD_MANAGER
+                )
+                shard.workgroup_lister._set(shard_wg)
+            elif shard_wg is None:
+                shard_wg = shard.create_workgroup(
+                    workgroup.name, workgroup.namespace, workgroup.spec, FIELD_MANAGER
+                )
+                shard.workgroup_lister._set(shard_wg)
+
+        workgroup = self._report_workgroup_synced_condition(workgroup)
+        self.recorder.event(
+            workgroup,
+            EVENT_TYPE_NORMAL,
+            REASON_SYNCED,
+            MSG_RESOURCE_SYNCED.format(NexusAlgorithmWorkgroup.KIND),
+        )

@@ -1,0 +1,96 @@
+"""Event recording — the user-facing surface for sync outcomes.
+
+Equivalent of the reference's event broadcaster → Kubernetes Events wiring
+(controller.go:252-256) and the test-side ``record.FakeRecorder``
+(controller_test.go:540-544). Event reasons/messages match the reference
+constants (controller.go:60-81).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+logger = logging.getLogger("nexus_tpu.events")
+
+EVENT_TYPE_NORMAL = "Normal"
+EVENT_TYPE_WARNING = "Warning"
+
+# Reasons (reference: controller.go:60-70)
+REASON_SYNCED = "Synced"
+REASON_ERR_RESOURCE_EXISTS = "ErrResourceExists"
+REASON_ERR_RESOURCE_MISSING = "ErrResourceMissing"
+REASON_ERR_RESOURCE_SYNC = "ErrResourceSyncError"
+
+# Message formats (reference: controller.go:72-84)
+MSG_RESOURCE_EXISTS = (
+    'Resource "{0}" already exists and is not managed by any Machine Learning Algorithm'
+)
+MSG_RESOURCE_SYNCED = "Resource of type {0} synced successfully"
+MSG_RESOURCE_MISSING = (
+    'Resource "{0}" referenced by NexusAlgorithmTemplate "{1}" is missing in the '
+    "controller cluster"
+)
+MSG_RESOURCE_OPERATION_FAILED = (
+    'Synchronization/update of a resource "{0}" referenced by NexusAlgorithmTemplate '
+    '"{1}" failed with a fatal error {2}'
+)
+
+# FieldManager distinguishes this controller from other writers
+# (reference: controller.go:83).
+FIELD_MANAGER = "nexus-configuration-controller"
+
+
+@dataclass
+class Event:
+    type: str
+    reason: str
+    message: str
+    object_kind: str = ""
+    object_name: str = ""
+    object_namespace: str = ""
+
+
+class EventRecorder:
+    """Records events against objects; logs them and keeps a bounded list."""
+
+    def __init__(self, component: str = "nexus-configuration-controller"):
+        self.component = component
+        self._lock = threading.Lock()
+        self.events: List[Event] = []
+
+    def event(self, obj: Any, event_type: str, reason: str, message: str) -> None:
+        meta = getattr(obj, "metadata", None)
+        ev = Event(
+            type=event_type,
+            reason=reason,
+            message=message,
+            object_kind=getattr(obj, "KIND", ""),
+            object_name=getattr(meta, "name", "") if meta else "",
+            object_namespace=getattr(meta, "namespace", "") if meta else "",
+        )
+        with self._lock:
+            self.events.append(ev)
+            if len(self.events) > 1000:
+                self.events = self.events[-1000:]
+        log = logger.info if event_type == EVENT_TYPE_NORMAL else logger.warning
+        log(
+            "event component=%s kind=%s object=%s/%s reason=%s: %s",
+            self.component,
+            ev.object_kind,
+            ev.object_namespace,
+            ev.object_name,
+            reason,
+            message,
+        )
+
+
+class FakeRecorder(EventRecorder):
+    """Test recorder exposing events as formatted strings, mirroring the
+    reference's ``record.FakeRecorder`` channel contents."""
+
+    def formatted(self) -> List[str]:
+        with self._lock:
+            return [f"{e.type} {e.reason} {e.message}" for e in self.events]

@@ -1,0 +1,119 @@
+"""Requeue rate limiters.
+
+Rebuilds the failure-handling profile of the reference controller
+(SURVEY.md §5 "failure detection"): per-item exponential backoff combined with
+a global token bucket via MaxOf (reference: controller.go:257-260; defaults
+30ms→5s, 50/s burst 300, .helm/values.yaml:159-169).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Sequence
+
+
+class RateLimiter:
+    def when(self, item: Any) -> float:
+        """Seconds to wait before this item may be retried."""
+        raise NotImplementedError
+
+    def forget(self, item: Any) -> None:
+        raise NotImplementedError
+
+    def num_requeues(self, item: Any) -> int:
+        raise NotImplementedError
+
+
+class ItemExponentialFailureRateLimiter(RateLimiter):
+    """Per-item exponential backoff: ``base * 2^failures`` capped at ``max``."""
+
+    def __init__(self, base_delay: float = 0.030, max_delay: float = 5.0):
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self._failures: Dict[Any, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item: Any) -> float:
+        with self._lock:
+            exp = self._failures.get(item, 0)
+            self._failures[item] = exp + 1
+        delay = self.base_delay * (2.0 ** exp)
+        return min(delay, self.max_delay)
+
+    def forget(self, item: Any) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: Any) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class BucketRateLimiter(RateLimiter):
+    """Global token bucket with reservation semantics.
+
+    ``when`` always admits the item but returns how long it must wait for its
+    token — tokens may be borrowed from the future (matching
+    golang.org/x/time/rate ``Reserve().Delay()``).
+    """
+
+    def __init__(self, rate: float = 50.0, burst: int = 300):
+        if rate <= 0:
+            raise ValueError("rate must be positive")
+        self.rate = rate
+        self.burst = burst
+        self._tokens = float(burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def when(self, item: Any) -> float:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                float(self.burst), self._tokens + (now - self._last) * self.rate
+            )
+            self._last = now
+            self._tokens -= 1.0
+            if self._tokens >= 0:
+                return 0.0
+            return -self._tokens / self.rate
+
+    def forget(self, item: Any) -> None:  # token buckets hold no per-item state
+        pass
+
+    def num_requeues(self, item: Any) -> int:
+        return 0
+
+
+class MaxOfRateLimiter(RateLimiter):
+    """Worst-case combination of child limiters (reference:
+    workqueue.NewTypedMaxOfRateLimiter, controller.go:257)."""
+
+    def __init__(self, limiters: Sequence[RateLimiter]):
+        self.limiters = list(limiters)
+
+    def when(self, item: Any) -> float:
+        return max(l.when(item) for l in self.limiters)
+
+    def forget(self, item: Any) -> None:
+        for l in self.limiters:
+            l.forget(item)
+
+    def num_requeues(self, item: Any) -> int:
+        return max(l.num_requeues(item) for l in self.limiters)
+
+
+def default_controller_rate_limiter(
+    base_delay: float = 0.030,
+    max_delay: float = 5.0,
+    rate: float = 50.0,
+    burst: int = 300,
+) -> MaxOfRateLimiter:
+    """The exact combination the reference constructs (controller.go:257-260)."""
+    return MaxOfRateLimiter(
+        [
+            ItemExponentialFailureRateLimiter(base_delay, max_delay),
+            BucketRateLimiter(rate, burst),
+        ]
+    )

@@ -1,0 +1,147 @@
+"""Rate-limited work queue with client-go semantics.
+
+The contract to preserve exactly (SURVEY.md §7 "hard parts (a)"; reference
+comment controller.go:123-128):
+  * **dedup**: adding a key already waiting is a no-op;
+  * **per-key serialization**: a key being processed is never handed to a
+    second worker; re-adds during processing are parked in the dirty set and
+    re-queued when ``done`` is called;
+  * ``add_after`` for delayed requeue, ``add_rate_limited`` consulting the
+    rate limiter, ``forget`` on success resetting backoff;
+  * ``shut_down`` drains blocked getters.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Any, List, Optional, Set, Tuple
+
+from nexus_tpu.controller.ratelimit import RateLimiter
+
+
+class WorkQueue:
+    """FIFO queue with dirty/processing sets (client-go workqueue.Type)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._queue: List[Any] = []
+        self._dirty: Set[Any] = set()
+        self._processing: Set[Any] = set()
+        self._shutting_down = False
+        # delayed adds
+        self._delay_heap: List[Tuple[float, int, Any]] = []
+        self._delay_seq = 0
+        self._delay_thread: Optional[threading.Thread] = None
+
+    # -------------------------------------------------------------- core API
+    def add(self, item: Any) -> None:
+        with self._cond:
+            if self._shutting_down:
+                return
+            if item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item in self._processing:
+                return
+            self._queue.append(item)
+            self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Tuple[Any, bool]:
+        """Block for the next item. Returns ``(item, shutdown)``; when
+        ``shutdown`` is True the worker must exit."""
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not self._queue and not self._shutting_down:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None, False
+                self._cond.wait(remaining)
+            if not self._queue:
+                return None, True
+            item = self._queue.pop(0)
+            self._processing.add(item)
+            self._dirty.discard(item)
+            return item, False
+
+    def done(self, item: Any) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def shutting_down(self) -> bool:
+        with self._cond:
+            return self._shutting_down
+
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutting_down = True
+            self._cond.notify_all()
+
+    # ---------------------------------------------------------- delayed adds
+    def add_after(self, item: Any, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._cond:
+            if self._shutting_down:
+                return
+            self._delay_seq += 1
+            heapq.heappush(
+                self._delay_heap, (time.monotonic() + delay, self._delay_seq, item)
+            )
+            # the delivery thread clears _delay_thread (under this lock)
+            # before exiting, so this check cannot race its shutdown
+            if self._delay_thread is None:
+                self._delay_thread = threading.Thread(
+                    target=self._delay_loop, daemon=True
+                )
+                self._delay_thread.start()
+            else:
+                self._cond.notify_all()
+
+    def _delay_loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._shutting_down or not self._delay_heap:
+                    self._delay_thread = None
+                    return
+                ready_at, _, item = self._delay_heap[0]
+                now = time.monotonic()
+                if ready_at <= now:
+                    heapq.heappop(self._delay_heap)
+                else:
+                    self._cond.wait(min(ready_at - now, 0.05))
+                    continue
+            self.add(item)
+
+
+class RateLimitingQueue(WorkQueue):
+    """WorkQueue + rate limiter (client-go TypedRateLimitingInterface).
+
+    The reconcile loop's failure protocol (reference controller.go:373-426):
+    error → ``add_rate_limited`` (exponential per-item backoff bounded by the
+    global bucket); success → ``forget`` + ``done``.
+    """
+
+    def __init__(self, rate_limiter: RateLimiter):
+        super().__init__()
+        self.rate_limiter = rate_limiter
+
+    def add_rate_limited(self, item: Any) -> None:
+        self.add_after(item, self.rate_limiter.when(item))
+
+    def forget(self, item: Any) -> None:
+        self.rate_limiter.forget(item)
+
+    def num_requeues(self, item: Any) -> int:
+        return self.rate_limiter.num_requeues(item)

@@ -1,0 +1,77 @@
+"""Process bootstrap — the ``main()`` equivalent (reference: main.go:35-109).
+
+Wires: signals → config → logging/statsd → controller-cluster store +
+informer factories → shard loading → controller construction → run.
+
+The controller cluster itself is resolved the same way shards are: a
+``controller_config_path`` pointing at a kubeconfig uses the (import-gated)
+Kubernetes backend; empty path uses an in-process local store — the local /
+test deployment mode (BASELINE configs #1/#2).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from typing import Optional
+
+from nexus_tpu.cluster.store import ClusterStore
+from nexus_tpu.controller.controller import Controller
+from nexus_tpu.shards.loader import get_local_store, load_shards
+from nexus_tpu.utils.config import AppConfig, load_config
+from nexus_tpu.utils.signals import CancelToken, setup_signal_handler
+from nexus_tpu.utils.telemetry import configure_logger, with_statsd
+
+logger = logging.getLogger("nexus_tpu.main")
+
+
+def build_controller(config: AppConfig, controller_store: Optional[ClusterStore] = None) -> Controller:
+    if controller_store is None:
+        if config.controller_config_path:
+            from nexus_tpu.cluster.kube import KubeClusterStore  # noqa: PLC0415
+
+            controller_store = KubeClusterStore(
+                "controller", config.controller_config_path, config.controller_namespace
+            )
+        else:
+            controller_store = get_local_store("controller")
+
+    shards = (
+        load_shards(config.alias, config.shard_config_path, config.controller_namespace)
+        if config.shard_config_path
+        else []
+    )
+    return Controller(
+        controller_store=controller_store,
+        shards=shards,
+        failure_rate_base_delay=config.failure_rate_base_delay,
+        failure_rate_max_delay=config.failure_rate_max_delay,
+        rate_limit_elements_per_second=config.rate_limit_elements_per_second,
+        rate_limit_elements_burst=config.rate_limit_elements_burst,
+        use_finalizers=config.use_finalizers,
+        resync_period=config.resync_period_seconds,
+    )
+
+
+def main(argv: Optional[list] = None, cancel: Optional[CancelToken] = None) -> int:
+    parser = argparse.ArgumentParser(prog="nexus-tpu-controller")
+    parser.add_argument("--config", default=None, help="path to appconfig yaml")
+    args = parser.parse_args(argv)
+
+    if cancel is None:
+        cancel = setup_signal_handler()
+    config = load_config(AppConfig, config_path=args.config)
+    configure_logger(config.log_level, extra_tags={"alias": config.alias})
+    with_statsd("nexus-tpu", config.statsd_address or None)
+
+    controller = build_controller(config)
+    controller.run(workers=config.workers)
+    logger.info("controller running; waiting for shutdown signal")
+    cancel.wait()
+    logger.info("shutting down")
+    controller.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

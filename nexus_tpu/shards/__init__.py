@@ -1,0 +1,11 @@
+"""Multi-cluster shard client layer.
+
+Equivalent of nexus-core ``pkg/shards`` (API reconstructed from call sites,
+SURVEY.md §2b): one :class:`Shard` per connected shard cluster, exposing
+typed remote-write methods that stamp provenance labels and owner references.
+"""
+
+from nexus_tpu.shards.shard import Shard
+from nexus_tpu.shards.loader import load_shards
+
+__all__ = ["Shard", "load_shards"]

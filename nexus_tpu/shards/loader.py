@@ -1,0 +1,86 @@
+"""Shard discovery: one shard per config file in a directory.
+
+Equivalent of nexus-core ``shards.LoadShards(ctx, alias, shardConfigDir,
+namespace, logger)`` (reference call site main.go:73; file-naming contract
+README.md:15 — one ``<name>.kubeconfig`` per shard, mounted from a Secret).
+
+Supported entries in ``shard_config_dir``:
+  * ``<name>.localshard`` / ``<name>.localshard.yaml`` — an in-process local
+    shard backed by a :class:`~nexus_tpu.cluster.store.ClusterStore`,
+    resolved by name via :func:`get_local_store` (file contents are currently
+    ignored; state is in-memory only). This is the test / single-host path,
+    and the path BASELINE config #2 exercises.
+  * ``<name>.kubeconfig`` — a real Kubernetes shard cluster; requires the
+    ``kubernetes`` Python client which is not baked into this environment, so
+    it is import-gated with a clear error.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List, Optional
+
+from nexus_tpu.cluster.store import ClusterStore
+from nexus_tpu.shards.shard import Shard
+
+logger = logging.getLogger("nexus_tpu.shards")
+
+# Registry of named in-process stores so tests / local deployments can
+# pre-register stores that load_shards resolves by name.
+_local_stores: Dict[str, ClusterStore] = {}
+
+
+def register_local_store(name: str, store: ClusterStore) -> None:
+    _local_stores[name] = store
+
+
+def get_local_store(name: str) -> ClusterStore:
+    if name not in _local_stores:
+        _local_stores[name] = ClusterStore(name)
+    return _local_stores[name]
+
+
+def load_shards(
+    alias: str,
+    shard_config_dir: str,
+    namespace: str = "",
+) -> List[Shard]:
+    """Build one Shard per recognized config file in ``shard_config_dir``."""
+    shards: List[Shard] = []
+    if not os.path.isdir(shard_config_dir):
+        raise FileNotFoundError(f"shard config dir {shard_config_dir!r} not found")
+    for entry in sorted(os.listdir(shard_config_dir)):
+        path = os.path.join(shard_config_dir, entry)
+        if not os.path.isfile(path):
+            continue
+        if entry.endswith(".kubeconfig"):
+            shard_name = entry[: -len(".kubeconfig")]
+            shards.append(_load_kube_shard(alias, shard_name, path, namespace))
+        elif entry.endswith(".localshard") or entry.endswith(".localshard.yaml"):
+            shard_name = entry.split(".localshard")[0]
+            shards.append(_load_local_shard(alias, shard_name, path))
+        else:
+            logger.debug("ignoring unrecognized shard config file %s", entry)
+    logger.info("loaded %d shard(s) from %s", len(shards), shard_config_dir)
+    return shards
+
+
+def _load_local_shard(alias: str, shard_name: str, path: str) -> Shard:
+    store = get_local_store(shard_name)
+    return Shard(alias, shard_name, store)
+
+
+def _load_kube_shard(
+    alias: str, shard_name: str, kubeconfig_path: str, namespace: str
+) -> Shard:
+    try:
+        from nexus_tpu.cluster.kube import KubeClusterStore  # noqa: PLC0415
+    except ImportError as e:  # pragma: no cover - environment-dependent
+        raise ImportError(
+            f"shard {shard_name!r} is a kubeconfig shard but the 'kubernetes' "
+            "Python client is not installed; install it or use .localshard "
+            f"configs ({e})"
+        ) from e
+    store = KubeClusterStore(shard_name, kubeconfig_path, namespace)
+    return Shard(alias, shard_name, store)

@@ -1,0 +1,245 @@
+"""Shard — typed client bundle for one shard cluster.
+
+Method surface mirrors the reconstructed nexus-core ``*shards.Shard``
+(SURVEY.md §2b; reference call sites controller.go:519-614,727-807 and
+constructor controller_test.go:507-515).
+
+Write contract (reference test oracle controller_test.go:183-228):
+  * every object written to a shard carries provenance labels
+    ``science.sneaksanddata.com/controller-app`` and
+    ``science.sneaksanddata.com/configuration-owner: <source alias>``;
+  * secrets/configmaps written to a shard carry an ownerReference to the
+    **shard-side** template (owner UIDs differ per cluster, so the owner is
+    re-resolved on the shard — SURVEY.md §7 hard part (c)).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from nexus_tpu.api.template import NexusAlgorithmSpec, NexusAlgorithmTemplate
+from nexus_tpu.api.types import (
+    API_VERSION,
+    CONTROLLER_APP_NAME,
+    LABEL_CONFIGURATION_OWNER,
+    LABEL_CONTROLLER_APP,
+    ConfigMap,
+    ObjectMeta,
+    OwnerReference,
+    Secret,
+)
+from nexus_tpu.api.workgroup import (
+    NexusAlgorithmWorkgroup,
+    NexusAlgorithmWorkgroupSpec,
+)
+from nexus_tpu.cluster.informer import InformerFactory, Lister
+from nexus_tpu.cluster.store import ClusterStore, NotFoundError
+
+
+class Shard:
+    """Client bundle + watch caches for one shard cluster."""
+
+    def __init__(
+        self,
+        source_cluster_alias: str,
+        name: str,
+        store: ClusterStore,
+        informer_factory: Optional[InformerFactory] = None,
+    ):
+        self.source_cluster_alias = source_cluster_alias
+        self.name = name
+        self.store = store
+        self.informers = informer_factory or InformerFactory(store)
+
+        self.template_informer = self.informers.informer(NexusAlgorithmTemplate.KIND)
+        self.workgroup_informer = self.informers.informer(NexusAlgorithmWorkgroup.KIND)
+        self.secret_informer = self.informers.informer(Secret.KIND)
+        self.config_map_informer = self.informers.informer(ConfigMap.KIND)
+
+        # Reference field surface: {Template,Workgroup,Secret,ConfigMap}Lister
+        # + *Synced readiness funcs (controller.go:516,578,792,722,867).
+        self.template_lister: Lister = self.template_informer.lister
+        self.workgroup_lister: Lister = self.workgroup_informer.lister
+        self.secret_lister: Lister = self.secret_informer.lister
+        self.config_map_lister: Lister = self.config_map_informer.lister
+        self.templates_synced: Callable[[], bool] = self.template_informer.has_synced
+        self.workgroups_synced: Callable[[], bool] = self.workgroup_informer.has_synced
+        self.secrets_synced: Callable[[], bool] = self.secret_informer.has_synced
+        self.config_maps_synced: Callable[[], bool] = self.config_map_informer.has_synced
+
+    # --------------------------------------------------------------- plumbing
+    def provenance_labels(self) -> Dict[str, str]:
+        return {
+            LABEL_CONTROLLER_APP: CONTROLLER_APP_NAME,
+            LABEL_CONFIGURATION_OWNER: self.source_cluster_alias,
+        }
+
+    def _resolve_shard_template(
+        self, namespace: str, name: str
+    ) -> Optional[NexusAlgorithmTemplate]:
+        """Owner re-resolution: find the shard-side template so owner refs use
+        the shard-local UID (reference behavior: controller_test.go:198-212)."""
+        try:
+            obj = self.store.get(NexusAlgorithmTemplate.KIND, namespace, name)
+            return obj  # type: ignore[return-value]
+        except NotFoundError:
+            return None
+
+    def _template_owner_ref(
+        self, owner: NexusAlgorithmTemplate
+    ) -> OwnerReference:
+        shard_side = self._resolve_shard_template(
+            owner.metadata.namespace, owner.metadata.name
+        )
+        uid = shard_side.metadata.uid if shard_side is not None else owner.metadata.uid
+        return OwnerReference(
+            api_version=API_VERSION,
+            kind=NexusAlgorithmTemplate.KIND,
+            name=owner.metadata.name,
+            uid=uid,
+        )
+
+    # -------------------------------------------------------------- templates
+    def create_template(
+        self,
+        name: str,
+        namespace: str,
+        spec: NexusAlgorithmSpec,
+        field_manager: str = "",
+    ) -> NexusAlgorithmTemplate:
+        tmpl = NexusAlgorithmTemplate(
+            metadata=ObjectMeta(
+                name=name, namespace=namespace, labels=self.provenance_labels()
+            ),
+            spec=spec,
+        )
+        return self.store.create(tmpl, field_manager=field_manager)  # type: ignore[return-value]
+
+    def update_template(
+        self,
+        template: NexusAlgorithmTemplate,
+        spec: NexusAlgorithmSpec,
+        field_manager: str = "",
+    ) -> NexusAlgorithmTemplate:
+        updated = template.deepcopy()
+        updated.spec = spec
+        updated.metadata.labels.update(self.provenance_labels())
+        return self.store.update(updated, field_manager=field_manager)  # type: ignore[return-value]
+
+    def delete_template(self, template: NexusAlgorithmTemplate) -> None:
+        self.store.delete(
+            NexusAlgorithmTemplate.KIND,
+            template.metadata.namespace,
+            template.metadata.name,
+        )
+
+    # ------------------------------------------------------------- workgroups
+    def create_workgroup(
+        self,
+        name: str,
+        namespace: str,
+        spec: NexusAlgorithmWorkgroupSpec,
+        field_manager: str = "",
+    ) -> NexusAlgorithmWorkgroup:
+        wg = NexusAlgorithmWorkgroup(
+            metadata=ObjectMeta(
+                name=name, namespace=namespace, labels=self.provenance_labels()
+            ),
+            spec=spec,
+        )
+        return self.store.create(wg, field_manager=field_manager)  # type: ignore[return-value]
+
+    def update_workgroup(
+        self,
+        workgroup: NexusAlgorithmWorkgroup,
+        spec: NexusAlgorithmWorkgroupSpec,
+        field_manager: str = "",
+    ) -> NexusAlgorithmWorkgroup:
+        updated = workgroup.deepcopy()
+        updated.spec = spec
+        updated.metadata.labels.update(self.provenance_labels())
+        return self.store.update(updated, field_manager=field_manager)  # type: ignore[return-value]
+
+    # ---------------------------------------------------------------- secrets
+    def create_secret(
+        self,
+        owner: NexusAlgorithmTemplate,
+        secret: Secret,
+        field_manager: str = "",
+    ) -> Secret:
+        shard_secret = Secret(
+            metadata=ObjectMeta(
+                name=secret.metadata.name,
+                namespace=secret.metadata.namespace,
+                labels=self.provenance_labels(),
+                owner_references=[self._template_owner_ref(owner)],
+            ),
+            data=dict(secret.data),
+            type=secret.type,
+        )
+        return self.store.create(shard_secret, field_manager=field_manager)  # type: ignore[return-value]
+
+    def update_secret(
+        self,
+        secret: Secret,
+        data: Optional[Dict[str, str]] = None,
+        owner: Optional[NexusAlgorithmTemplate] = None,
+        field_manager: str = "",
+    ) -> Secret:
+        """Update shard secret data (``data=None`` keeps existing data); when
+        ``owner`` is given, additionally append the owner reference (the
+        adoption write — reference: controller.go:541,552)."""
+        updated = secret.deepcopy()
+        if data is not None:
+            updated.data = dict(data)
+        updated.metadata.labels.update(self.provenance_labels())
+        if owner is not None:
+            ref = self._template_owner_ref(owner)
+            # dedup by uid — the same identity the controller's ownership
+            # check uses — so a stale same-name/different-uid ref can't
+            # block adoption from ever converging
+            if not any(r.uid == ref.uid for r in updated.metadata.owner_references):
+                updated.metadata.owner_references.append(ref)
+        return self.store.update(updated, field_manager=field_manager)  # type: ignore[return-value]
+
+    # ------------------------------------------------------------- configmaps
+    def create_config_map(
+        self,
+        owner: NexusAlgorithmTemplate,
+        config_map: ConfigMap,
+        field_manager: str = "",
+    ) -> ConfigMap:
+        shard_cm = ConfigMap(
+            metadata=ObjectMeta(
+                name=config_map.metadata.name,
+                namespace=config_map.metadata.namespace,
+                labels=self.provenance_labels(),
+                owner_references=[self._template_owner_ref(owner)],
+            ),
+            data=dict(config_map.data),
+        )
+        return self.store.create(shard_cm, field_manager=field_manager)  # type: ignore[return-value]
+
+    def update_config_map(
+        self,
+        config_map: ConfigMap,
+        data: Optional[Dict[str, str]] = None,
+        owner: Optional[NexusAlgorithmTemplate] = None,
+        field_manager: str = "",
+    ) -> ConfigMap:
+        updated = config_map.deepcopy()
+        if data is not None:
+            updated.data = dict(data)
+        updated.metadata.labels.update(self.provenance_labels())
+        if owner is not None:
+            ref = self._template_owner_ref(owner)
+            if not any(r.uid == ref.uid for r in updated.metadata.owner_references):
+                updated.metadata.owner_references.append(ref)
+        return self.store.update(updated, field_manager=field_manager)  # type: ignore[return-value]
+
+    # ------------------------------------------------------------------- misc
+    def start(self) -> None:
+        self.informers.start()
+
+    def wait_for_cache_sync(self, timeout: float = 10.0) -> bool:
+        return self.informers.wait_for_cache_sync(timeout)

@@ -1,0 +1,6 @@
+"""Platform utilities: config loading, telemetry, signals, build metadata.
+
+Equivalent of the reference's nexus-core ``pkg/configurations``,
+``pkg/telemetry``, ``pkg/signals`` and ``pkg/buildmeta`` packages
+(reconstructed from call sites, see SURVEY.md §2b).
+"""

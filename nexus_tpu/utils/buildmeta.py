@@ -1,0 +1,12 @@
+"""Build stamping.
+
+Equivalent of nexus-core ``pkg/buildmeta`` whose ``AppVersion`` /
+``BuildNumber`` vars are injected via ``-ldflags -X`` in the reference image
+build (reference: .container/Dockerfile:14). Here the values come from
+environment variables set at image build time, with dev defaults.
+"""
+
+import os
+
+APP_VERSION: str = os.environ.get("NEXUS_TPU_APP_VERSION", "0.1.0-dev")
+BUILD_NUMBER: str = os.environ.get("NEXUS_TPU_BUILD_NUMBER", "0")

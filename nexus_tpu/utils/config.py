@@ -1,0 +1,95 @@
+"""Config system: yaml file + ``NEXUS__*`` env overrides.
+
+Equivalent of nexus-core ``configurations.LoadConfig[T]`` (reference call site
+main.go:41): binds a typed config struct from a yaml file, overridable by
+``NEXUS__<UPPER_SNAKE>`` environment variables (reference:
+.helm/templates/deployment.yaml:50-69), with ``APPLICATION_ENVIRONMENT``
+selecting an overlay file (``appconfig.<env>.yaml`` next to the base file,
+reference: build.yaml:79).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, Optional, Type, TypeVar
+
+import yaml
+
+T = TypeVar("T")
+
+ENV_PREFIX = "NEXUS__"
+
+
+@dataclass
+class AppConfig:
+    """Application config — field set matches the reference
+    ``models.AppConfig`` (reference: pkg/models/app_config.go:21-32)."""
+
+    alias: str = ""
+    controller_config_path: str = ""
+    shard_config_path: str = ""
+    controller_namespace: str = "default"
+    log_level: str = "INFO"
+    workers: int = 2
+    failure_rate_base_delay: float = 0.030  # seconds (reference default 30ms)
+    failure_rate_max_delay: float = 5.0  # seconds (reference default 5s)
+    rate_limit_elements_per_second: float = 50.0
+    rate_limit_elements_burst: int = 300
+    # TPU-native extensions:
+    statsd_address: str = ""
+    use_finalizers: bool = False
+    resync_period_seconds: float = 30.0
+
+
+def _coerce(value: Any, target_type: Any) -> Any:
+    if target_type is bool and isinstance(value, str):
+        return value.strip().lower() in ("1", "true", "yes", "on")
+    if target_type in (int, float, str):
+        return target_type(value)
+    return value
+
+
+def load_config(
+    cls: Type[T] = AppConfig,  # type: ignore[assignment]
+    config_path: Optional[str] = None,
+    env: Optional[Dict[str, str]] = None,
+) -> T:
+    """Layered load: defaults ← yaml ← environment overlay ← NEXUS__ env."""
+    env = dict(os.environ if env is None else env)
+    values: Dict[str, Any] = {}
+
+    def merge_yaml(path: str) -> None:
+        if path and os.path.isfile(path):
+            with open(path) as f:
+                doc = yaml.safe_load(f) or {}
+            for k, v in doc.items():
+                values[_normalize_key(k)] = v
+
+    config_path = config_path or env.get("NEXUS_TPU_CONFIG", "")
+    if config_path:
+        merge_yaml(config_path)
+        app_env = env.get("APPLICATION_ENVIRONMENT", "")
+        if app_env:
+            base, ext = os.path.splitext(config_path)
+            merge_yaml(f"{base}.{app_env}{ext}")
+
+    for key, value in env.items():
+        if key.startswith(ENV_PREFIX):
+            values[key[len(ENV_PREFIX) :].lower()] = value
+
+    kwargs: Dict[str, Any] = {}
+    for f in fields(cls):  # type: ignore[arg-type]
+        if f.name in values:
+            kwargs[f.name] = _coerce(values[f.name], f.type if isinstance(f.type, type) else type(f.default))
+    return cls(**kwargs)  # type: ignore[call-arg]
+
+
+def _normalize_key(key: str) -> str:
+    """yaml keys may be camelCase or snake_case; normalize to snake_case."""
+    out = []
+    for i, ch in enumerate(key):
+        if ch.isupper() and i > 0 and not key[i - 1].isupper() and key[i - 1] != "_":
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)

@@ -1,0 +1,46 @@
+"""Graceful shutdown: SIGTERM/SIGINT → cancellation token.
+
+Equivalent of nexus-core ``signals.SetupSignalHandler() context.Context``
+(reference call site main.go:40). Python has no context.Context; the
+equivalent is a :class:`CancelToken` whose event is set on the first signal —
+a second signal force-exits, matching the upstream sample-controller contract.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+
+class CancelToken:
+    def __init__(self):
+        self._event = threading.Event()
+
+    def cancel(self) -> None:
+        self._event.set()
+
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        # Untimed Event.wait() can delay signal delivery by seconds on the
+        # main thread; poll in short slices so SIGINT/SIGTERM act promptly.
+        if timeout is not None:
+            return self._event.wait(timeout)
+        while not self._event.wait(0.2):
+            pass
+        return True
+
+
+def setup_signal_handler() -> CancelToken:
+    token = CancelToken()
+
+    def _handler(signum, frame):
+        if token.cancelled():
+            os._exit(1)  # second signal: exit directly
+        token.cancel()
+
+    signal.signal(signal.SIGINT, _handler)
+    signal.signal(signal.SIGTERM, _handler)
+    return token

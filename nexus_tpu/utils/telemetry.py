@@ -1,0 +1,110 @@
+"""Metrics + structured logging.
+
+Equivalent of nexus-core ``pkg/telemetry`` (reconstructed API:
+``ConfigureLogger``, ``WithStatsd``, ``GetClient``, ``Gauge``,
+``GaugeDuration`` — reference call sites main.go:43-44, controller.go:375,
+389-390). Metrics are emitted in DogStatsD wire format over UDP when a statsd
+address is configured, and always mirrored into an in-process registry that
+tests and the benchmark harness can read.
+
+Metric names match the reference constants (controller.go:50-56):
+``reconcile_latency`` and ``workqueue_length``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+METRIC_RECONCILE_LATENCY = "reconcile_latency"
+METRIC_WORKQUEUE_LENGTH = "workqueue_length"
+
+
+def configure_logger(
+    level: str = "INFO", extra_tags: Optional[Dict[str, str]] = None
+) -> logging.Logger:
+    """Configure root logging (the ConfigureLogger equivalent)."""
+    tag_str = " ".join(f"{k}={v}" for k, v in (extra_tags or {}).items())
+    fmt = "%(asctime)s %(levelname)s %(name)s"
+    if tag_str:
+        fmt += f" [{tag_str}]"
+    fmt += " %(message)s"
+    logging.basicConfig(
+        level=getattr(logging, level.upper(), logging.INFO), format=fmt, force=True
+    )
+    return logging.getLogger("nexus_tpu")
+
+
+class StatsdClient:
+    """Minimal DogStatsD client: gauges with tags, fire-and-forget UDP.
+
+    With no address configured it is a pure in-memory registry (the test /
+    no-Datadog path)."""
+
+    def __init__(
+        self, app_name: str = "nexus-tpu", address: Optional[str] = None
+    ):
+        self.app_name = app_name
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._addr: Optional[Tuple[str, int]] = None
+        self.gauges: Dict[str, float] = {}
+        self.history: List[Tuple[str, float, Tuple[str, ...]]] = []
+        address = address or os.environ.get("NEXUS__STATSD_ADDRESS", "")
+        if address:
+            host, _, port = address.partition(":")
+            self._addr = (host, int(port or 8125))
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def gauge(
+        self, name: str, value: float, tags: Optional[List[str]] = None, rate: float = 1.0
+    ) -> None:
+        full = f"{self.app_name}.{name}"
+        with self._lock:
+            self.gauges[full] = value
+            self.history.append((full, value, tuple(tags or [])))
+            if len(self.history) > 10000:
+                self.history = self.history[-10000:]
+        if self._sock and self._addr:
+            tag_str = f"|#{','.join(tags)}" if tags else ""
+            payload = f"{full}:{value}|g|@{rate}{tag_str}".encode()
+            try:
+                self._sock.sendto(payload, self._addr)
+            except OSError:
+                pass
+
+    def gauge_duration(
+        self,
+        name: str,
+        since: float,
+        tags: Optional[List[str]] = None,
+        rate: float = 1.0,
+    ) -> None:
+        """Gauge of elapsed seconds since a ``time.monotonic()`` stamp
+        (GaugeDuration equivalent, reference controller.go:389)."""
+        self.gauge(name, time.monotonic() - since, tags=tags, rate=rate)
+
+
+_default_client: Optional[StatsdClient] = None
+_client_lock = threading.Lock()
+
+
+def with_statsd(app_name: str, address: Optional[str] = None) -> StatsdClient:
+    """Install the process-default client (WithStatsd equivalent)."""
+    global _default_client
+    with _client_lock:
+        _default_client = StatsdClient(app_name, address)
+        return _default_client
+
+
+def get_client() -> StatsdClient:
+    """Fetch the process-default client (GetClient equivalent)."""
+    global _default_client
+    with _client_lock:
+        if _default_client is None:
+            _default_client = StatsdClient()
+        return _default_client

@@ -1,0 +1,541 @@
+"""Reconciler scenarios — mirrors the reference test strategy Tier 1
+(SURVEY.md §4): hermetic fake clusters, sync handlers invoked directly,
+action-level assertions against the recorded store actions.
+
+Scenario parity with reference controller_test.go:
+  TestCreatesTemplate (:800), TestDetectsRogue (:846),
+  TestHandlesNotExistingResource (:889), TestSkipsInvalidTemplate (:912),
+  TestUpdatesTemplateSecretAndConfig (:942), TestCreatesSharedResources
+  (:1013), TestTakesOwnership (:1094), TestDeletesTemplate (:1143),
+  TestCreatesWorkgroup (:1193), TestUpdatesWorkgroup (:1234).
+"""
+
+import pytest
+
+from nexus_tpu.api.template import (
+    Container,
+    ComputeResources,
+    NexusAlgorithmSpec,
+    NexusAlgorithmTemplate,
+    RuntimeEnvironment,
+    WorkgroupRef,
+)
+from nexus_tpu.api.types import (
+    CONTROLLER_APP_NAME,
+    LABEL_CONFIGURATION_OWNER,
+    LABEL_CONTROLLER_APP,
+    ConfigMap,
+    EnvFromSource,
+    ObjectMeta,
+    OwnerReference,
+    Secret,
+)
+from nexus_tpu.api.workgroup import (
+    NexusAlgorithmWorkgroup,
+    NexusAlgorithmWorkgroupSpec,
+)
+from nexus_tpu.cluster.store import ClusterStore
+from nexus_tpu.controller.controller import Controller, SyncError
+from nexus_tpu.controller.events import (
+    REASON_ERR_RESOURCE_EXISTS,
+    REASON_ERR_RESOURCE_MISSING,
+    REASON_SYNCED,
+    FakeRecorder,
+)
+from nexus_tpu.shards.shard import Shard
+from nexus_tpu.utils.telemetry import StatsdClient
+
+NS = "nexus"
+ALIAS = "test-controller-cluster"
+
+
+def make_template(name="algo-1", secrets=(), config_maps=()):
+    mapped = [EnvFromSource(secret_ref=s) for s in secrets] + [
+        EnvFromSource(config_map_ref=c) for c in config_maps
+    ]
+    return NexusAlgorithmTemplate(
+        metadata=ObjectMeta(name=name, namespace=NS),
+        spec=NexusAlgorithmSpec(
+            container=Container(
+                image="algo", registry="ghcr.io/test", version_tag="v1.0.0",
+                service_account_name="nexus-sa",
+            ),
+            compute_resources=ComputeResources(cpu_limit="4", memory_limit="8Gi"),
+            workgroup_ref=WorkgroupRef(name="wg-1", group="science.sneaksanddata.com",
+                                       kind="NexusAlgorithmWorkgroup"),
+            command="python",
+            args=["run.py"],
+            runtime_environment=RuntimeEnvironment(mapped_environment_variables=mapped),
+        ),
+    )
+
+
+def make_secret(name="secret-1", data=None):
+    return Secret(metadata=ObjectMeta(name=name, namespace=NS),
+                  data=dict(data or {"key": "value"}))
+
+
+def make_config_map(name="cm-1", data=None):
+    return ConfigMap(metadata=ObjectMeta(name=name, namespace=NS),
+                     data=dict(data or {"cfg": "val"}))
+
+
+def make_workgroup(name="wg-1", description="test workgroup"):
+    return NexusAlgorithmWorkgroup(
+        metadata=ObjectMeta(name=name, namespace=NS),
+        spec=NexusAlgorithmWorkgroupSpec(
+            description=description,
+            capabilities={"tpu": True},
+            cluster="shard0",
+        ),
+    )
+
+
+class Fixture:
+    """Fake controller cluster + one fake shard cluster, listers seeded
+    directly (the reference fixture pattern, controller_test.go:506-576)."""
+
+    def __init__(self, n_shards=1):
+        self.controller_store = ClusterStore("controller")
+        self.shard_stores = [ClusterStore(f"shard{i}") for i in range(n_shards)]
+        self.shards = [
+            Shard(ALIAS, f"shard{i}", s) for i, s in enumerate(self.shard_stores)
+        ]
+        self.recorder = FakeRecorder()
+        self.controller = Controller(
+            self.controller_store,
+            self.shards,
+            recorder=self.recorder,
+            statsd=StatsdClient("test"),
+        )
+
+    @property
+    def shard_store(self):
+        return self.shard_stores[0]
+
+    @property
+    def shard(self):
+        return self.shards[0]
+
+    def seed_controller(self, *objs):
+        self.controller_store.seed(*objs)
+        self._refresh_controller_listers(objs)
+
+    def seed_shard(self, *objs, shard_idx=0):
+        self.shard_stores[shard_idx].seed(*objs)
+        self._refresh_shard_listers(objs, shard_idx)
+
+    def _refresh_controller_listers(self, objs):
+        c = self.controller
+        listers = {
+            NexusAlgorithmTemplate.KIND: c.template_lister,
+            NexusAlgorithmWorkgroup.KIND: c.workgroup_lister,
+            Secret.KIND: c.secret_lister,
+            ConfigMap.KIND: c.config_map_lister,
+        }
+        for obj in objs:
+            stored = self.controller_store.get(
+                obj.KIND, obj.metadata.namespace, obj.metadata.name
+            )
+            listers[obj.KIND].add(stored)
+
+    def _refresh_shard_listers(self, objs, shard_idx=0):
+        sh = self.shards[shard_idx]
+        listers = {
+            NexusAlgorithmTemplate.KIND: sh.template_lister,
+            NexusAlgorithmWorkgroup.KIND: sh.workgroup_lister,
+            Secret.KIND: sh.secret_lister,
+            ConfigMap.KIND: sh.config_map_lister,
+        }
+        for obj in objs:
+            stored = self.shard_stores[shard_idx].get(
+                obj.KIND, obj.metadata.namespace, obj.metadata.name
+            )
+            listers[obj.KIND].add(stored)
+
+    def resync_listers(self):
+        """Reload every lister from its store (post-write refresh, standing in
+        for the informer watch in these handler-direct tests)."""
+        for store, refresh in [
+            (self.controller_store, self._refresh_controller_listers),
+        ]:
+            for kind in (NexusAlgorithmTemplate.KIND, NexusAlgorithmWorkgroup.KIND,
+                         Secret.KIND, ConfigMap.KIND):
+                refresh(store.list(kind))
+        for i, store in enumerate(self.shard_stores):
+            for kind in (NexusAlgorithmTemplate.KIND, NexusAlgorithmWorkgroup.KIND,
+                         Secret.KIND, ConfigMap.KIND):
+                self._refresh_shard_listers(store.list(kind), i)
+
+    def clear_actions(self):
+        self.controller_store.clear_actions()
+        for s in self.shard_stores:
+            s.clear_actions()
+
+
+def expected_labels():
+    return {
+        LABEL_CONTROLLER_APP: CONTROLLER_APP_NAME,
+        LABEL_CONFIGURATION_OWNER: ALIAS,
+    }
+
+
+# --------------------------------------------------------------------- tests
+
+
+def test_creates_template():
+    f = Fixture()
+    f.seed_controller(make_template(secrets=["secret-1"], config_maps=["cm-1"]),
+                      make_secret(), make_config_map())
+
+    f.controller.template_sync_handler(NS, "algo-1")
+
+    # controller-cluster writes: init status, 2 adoptions, ready status
+    verbs = [(a.verb, a.kind, a.subresource) for a in f.controller_store.actions]
+    assert verbs == [
+        ("update", NexusAlgorithmTemplate.KIND, "status"),
+        ("update", Secret.KIND, ""),
+        ("update", ConfigMap.KIND, ""),
+        ("update", NexusAlgorithmTemplate.KIND, "status"),
+    ]
+    # shard writes: template, secret, configmap created
+    shard_verbs = [(a.verb, a.kind) for a in f.shard_store.actions]
+    assert shard_verbs == [
+        ("create", NexusAlgorithmTemplate.KIND),
+        ("create", Secret.KIND),
+        ("create", ConfigMap.KIND),
+    ]
+
+    # provenance labels stamped on every shard object
+    shard_tmpl = f.shard_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    shard_secret = f.shard_store.get(Secret.KIND, NS, "secret-1")
+    shard_cm = f.shard_store.get(ConfigMap.KIND, NS, "cm-1")
+    for obj in (shard_tmpl, shard_secret, shard_cm):
+        assert obj.metadata.labels == expected_labels()
+
+    # owner refs on shard dependents point at the SHARD-side template uid
+    assert shard_secret.metadata.owner_references[0].uid == shard_tmpl.metadata.uid
+    assert shard_cm.metadata.owner_references[0].uid == shard_tmpl.metadata.uid
+    ctrl_tmpl = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    assert shard_secret.metadata.owner_references[0].uid != ctrl_tmpl.metadata.uid
+
+    # spec replicated verbatim
+    assert shard_tmpl.spec.container.image == "algo"
+
+    # controller-side adoption: secret/cm now owned by the controller template
+    ctrl_secret = f.controller_store.get(Secret.KIND, NS, "secret-1")
+    assert ctrl_secret.metadata.owner_references[0].uid == ctrl_tmpl.metadata.uid
+
+    # status bookkeeping
+    assert ctrl_tmpl.status.synced_secrets == ["secret-1"]
+    assert ctrl_tmpl.status.synced_configurations == ["cm-1"]
+    assert ctrl_tmpl.status.synced_to_clusters == ["shard0"]
+    cond = ctrl_tmpl.status.conditions[0]
+    assert (cond.type, cond.status, cond.reason) == ("Ready", "True", "ready")
+
+    assert any(REASON_SYNCED in e for e in f.recorder.formatted())
+
+
+def test_sync_is_idempotent_no_writes_second_time():
+    f = Fixture()
+    f.seed_controller(make_template(secrets=["secret-1"], config_maps=["cm-1"]),
+                      make_secret(), make_config_map())
+    f.controller.template_sync_handler(NS, "algo-1")
+    f.resync_listers()
+    f.clear_actions()
+
+    f.controller.template_sync_handler(NS, "algo-1")
+
+    assert f.controller_store.actions == []  # DeepEqual guards held
+    assert f.shard_store.actions == []
+
+
+def test_detects_rogue_resource():
+    """A shard secret with zero owner references halts the sync
+    (reference: TestDetectsRogue, controller.go:484-502)."""
+    f = Fixture()
+    f.seed_controller(make_template(secrets=["secret-1"]), make_secret())
+    rogue = make_secret()  # no owner references
+    f.seed_shard(rogue)
+
+    with pytest.raises(SyncError):
+        f.controller.template_sync_handler(NS, "algo-1")
+
+    assert any(REASON_ERR_RESOURCE_EXISTS in e for e in f.recorder.formatted())
+    # the rogue secret was NOT touched
+    shard_secret = f.shard_store.get(Secret.KIND, NS, "secret-1")
+    assert shard_secret.metadata.owner_references == []
+    assert LABEL_CONTROLLER_APP not in shard_secret.metadata.labels
+
+
+def test_handles_not_existing_resource():
+    f = Fixture()
+    f.controller.template_sync_handler(NS, "nope")  # no raise
+    assert f.controller_store.actions == []
+    assert f.shard_store.actions == []
+
+
+def test_skips_invalid_template_missing_secret():
+    f = Fixture()
+    f.seed_controller(make_template(secrets=["missing-secret"]))
+
+    with pytest.raises(SyncError):
+        f.controller.template_sync_handler(NS, "algo-1")
+
+    assert any(REASON_ERR_RESOURCE_MISSING in e for e in f.recorder.formatted())
+    # nothing reached the shard
+    assert f.shard_store.actions == []
+
+
+def test_updates_template_secret_and_config_on_drift():
+    f = Fixture()
+    f.seed_controller(
+        make_template(secrets=["secret-1"], config_maps=["cm-1"]),
+        make_secret(data={"key": "NEW"}),
+        make_config_map(data={"cfg": "NEW"}),
+    )
+    # first sync creates everything on the shard
+    f.controller.template_sync_handler(NS, "algo-1")
+    f.resync_listers()
+    f.clear_actions()
+
+    # mutate source data in the controller cluster
+    sec = f.controller_store.get(Secret.KIND, NS, "secret-1")
+    sec.data = {"key": "NEWER"}
+    f.controller_store.update(sec)
+    cm = f.controller_store.get(ConfigMap.KIND, NS, "cm-1")
+    cm.data = {"cfg": "NEWER"}
+    f.controller_store.update(cm)
+    f.resync_listers()
+    f.clear_actions()
+
+    f.controller.template_sync_handler(NS, "algo-1")
+
+    shard_writes = [(a.verb, a.kind) for a in f.shard_store.actions]
+    assert ("update", Secret.KIND) in shard_writes
+    assert ("update", ConfigMap.KIND) in shard_writes
+    assert f.shard_store.get(Secret.KIND, NS, "secret-1").data == {"key": "NEWER"}
+    assert f.shard_store.get(ConfigMap.KIND, NS, "cm-1").data == {"cfg": "NEWER"}
+
+
+def test_template_spec_drift_repaired_on_shard():
+    f = Fixture()
+    f.seed_controller(make_template())
+    f.controller.template_sync_handler(NS, "algo-1")
+    f.resync_listers()
+
+    # someone edits the shard copy out-of-band
+    shard_tmpl = f.shard_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    shard_tmpl.spec.container.version_tag = "tampered"
+    f.shard_store.update(shard_tmpl)
+    f.resync_listers()
+    f.clear_actions()
+
+    f.controller.template_sync_handler(NS, "algo-1")
+
+    repaired = f.shard_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    assert repaired.spec.container.version_tag == "v1.0.0"
+    assert [(a.verb, a.kind) for a in f.shard_store.actions] == [
+        ("update", NexusAlgorithmTemplate.KIND)
+    ]
+
+
+def test_creates_shared_resources_multi_owner():
+    """Two templates referencing one secret → both appended as owners
+    (reference: TestCreatesSharedResources)."""
+    f = Fixture()
+    t1 = make_template("algo-1", secrets=["shared-secret"])
+    t2 = make_template("algo-2", secrets=["shared-secret"])
+    f.seed_controller(t1, t2, make_secret("shared-secret"))
+
+    f.controller.template_sync_handler(NS, "algo-1")
+    f.resync_listers()
+    f.controller.template_sync_handler(NS, "algo-2")
+    f.resync_listers()
+
+    ctrl_secret = f.controller_store.get(Secret.KIND, NS, "shared-secret")
+    owner_names = {r.name for r in ctrl_secret.metadata.owner_references}
+    assert owner_names == {"algo-1", "algo-2"}
+
+    shard_secret = f.shard_store.get(Secret.KIND, NS, "shared-secret")
+    shard_t1 = f.shard_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    shard_t2 = f.shard_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-2")
+    shard_owner_uids = {r.uid for r in shard_secret.metadata.owner_references}
+    assert shard_owner_uids == {shard_t1.metadata.uid, shard_t2.metadata.uid}
+
+
+def test_takes_ownership_of_foreign_owned_resource():
+    """A shard secret owned by a DIFFERENT template gets this template's
+    owner reference appended — adopt, not rogue (reference:
+    TestTakesOwnership)."""
+    f = Fixture()
+    f.seed_controller(make_template(secrets=["secret-1"]), make_secret())
+    foreign = make_secret()
+    foreign.metadata.owner_references = [
+        OwnerReference(
+            api_version="science.sneaksanddata.com/v1",
+            kind="NexusAlgorithmTemplate",
+            name="other-algo",
+            uid="uid-foreign",
+        )
+    ]
+    f.seed_shard(foreign)
+
+    f.controller.template_sync_handler(NS, "algo-1")
+
+    shard_secret = f.shard_store.get(Secret.KIND, NS, "secret-1")
+    shard_tmpl = f.shard_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    uids = {r.uid for r in shard_secret.metadata.owner_references}
+    assert uids == {"uid-foreign", shard_tmpl.metadata.uid}
+
+
+def test_deletes_template_fans_out_and_garbage_collects():
+    f = Fixture()
+    f.seed_controller(make_template(secrets=["secret-1"]), make_secret())
+    f.controller.template_sync_handler(NS, "algo-1")
+    f.resync_listers()
+    f.clear_actions()
+
+    tmpl = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    f.controller.handle_object_delete(tmpl)
+
+    # template deleted on the shard, and its owned secret garbage-collected
+    with pytest.raises(KeyError):
+        f.shard_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    with pytest.raises(KeyError):
+        f.shard_store.get(Secret.KIND, NS, "secret-1")
+
+
+def test_delete_fan_out_covers_all_shards():
+    f = Fixture(n_shards=3)
+    f.seed_controller(make_template())
+    f.controller.template_sync_handler(NS, "algo-1")
+    for i in range(3):
+        assert f.shard_stores[i].get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+
+    tmpl = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    f.controller.handle_object_delete(tmpl)
+    for i in range(3):
+        with pytest.raises(KeyError):
+            f.shard_stores[i].get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+
+
+def test_creates_workgroup():
+    f = Fixture()
+    f.seed_controller(make_workgroup())
+
+    f.controller.workgroup_sync_handler(NS, "wg-1")
+
+    assert [(a.verb, a.kind) for a in f.shard_store.actions] == [
+        ("create", NexusAlgorithmWorkgroup.KIND)
+    ]
+    shard_wg = f.shard_store.get(NexusAlgorithmWorkgroup.KIND, NS, "wg-1")
+    assert shard_wg.metadata.labels == expected_labels()
+    assert shard_wg.spec.description == "test workgroup"
+
+    ctrl_wg = f.controller_store.get(NexusAlgorithmWorkgroup.KIND, NS, "wg-1")
+    cond = ctrl_wg.status.conditions[0]
+    assert (cond.type, cond.status, cond.reason) == ("Ready", "True", "ready")
+
+
+def test_updates_workgroup_on_drift():
+    f = Fixture()
+    f.seed_controller(make_workgroup())
+    f.controller.workgroup_sync_handler(NS, "wg-1")
+    f.resync_listers()
+
+    wg = f.controller_store.get(NexusAlgorithmWorkgroup.KIND, NS, "wg-1")
+    wg.spec.description = "updated description"
+    f.controller_store.update(wg)
+    f.resync_listers()
+    f.clear_actions()
+
+    f.controller.workgroup_sync_handler(NS, "wg-1")
+
+    shard_wg = f.shard_store.get(NexusAlgorithmWorkgroup.KIND, NS, "wg-1")
+    assert shard_wg.spec.description == "updated description"
+    assert [(a.verb, a.kind) for a in f.shard_store.actions] == [
+        ("update", NexusAlgorithmWorkgroup.KIND)
+    ]
+
+
+def test_multi_shard_fan_out_syncs_everywhere():
+    f = Fixture(n_shards=3)
+    f.seed_controller(make_template(secrets=["secret-1"]), make_secret())
+
+    f.controller.template_sync_handler(NS, "algo-1")
+
+    for i in range(3):
+        tmpl = f.shard_stores[i].get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+        sec = f.shard_stores[i].get(Secret.KIND, NS, "secret-1")
+        assert tmpl.metadata.labels == expected_labels()
+        assert sec.metadata.owner_references[0].uid == tmpl.metadata.uid
+    ctrl = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    assert ctrl.status.synced_to_clusters == ["shard0", "shard1", "shard2"]
+
+
+def test_finalizer_delete_path():
+    """use_finalizers=True: delete marks deletion_timestamp, the sync handler
+    fans out shard deletes, clears the finalizer, and only then does the
+    object disappear (SURVEY.md §7 hard part (f))."""
+    f = Fixture()
+    f.controller.use_finalizers = True
+    f.seed_controller(make_template(secrets=["secret-1"]), make_secret())
+
+    f.controller.template_sync_handler(NS, "algo-1")
+    f.resync_listers()
+    tmpl = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    assert "science.sneaksanddata.com/shard-cleanup" in tmpl.metadata.finalizers
+
+    # delete: object is only MARKED (deletion pending), not removed
+    f.controller_store.delete(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    pending = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    assert pending.metadata.deletion_timestamp is not None
+    f.resync_listers()
+
+    # reconcile of the deletion-pending object finalizes it
+    f.controller.template_sync_handler(NS, "algo-1")
+    with pytest.raises(KeyError):
+        f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    with pytest.raises(KeyError):
+        f.shard_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+
+
+def test_finalizer_delete_retries_on_shard_failure():
+    """A shard failure during finalization keeps the finalizer (and the
+    object) so the delete is retried — the crash-safe property the
+    reference's inline fan-out lacks."""
+    f = Fixture(n_shards=2)
+    f.controller.use_finalizers = True
+    f.seed_controller(make_template())
+    f.controller.template_sync_handler(NS, "algo-1")
+    f.resync_listers()
+
+    fails = {"n": 1}
+    original = f.shards[1].delete_template
+
+    def flaky_delete(tmpl):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise RuntimeError("shard unreachable")
+        return original(tmpl)
+
+    f.shards[1].delete_template = flaky_delete
+
+    f.controller_store.delete(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    f.resync_listers()
+    with pytest.raises(RuntimeError):
+        f.controller.template_sync_handler(NS, "algo-1")
+
+    # finalizer still present → object survives for the retry
+    still = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    assert "science.sneaksanddata.com/shard-cleanup" in still.metadata.finalizers
+    f.resync_listers()
+
+    # retry succeeds: gone everywhere
+    f.controller.template_sync_handler(NS, "algo-1")
+    with pytest.raises(KeyError):
+        f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    for i in range(2):
+        with pytest.raises(KeyError):
+            f.shard_stores[i].get(NexusAlgorithmTemplate.KIND, NS, "algo-1")

@@ -1,0 +1,77 @@
+"""Informer/lister cache semantics: initial LIST, watch-driven updates,
+periodic resync re-delivery (reference resync contract: main.go:70-71 +
+RV-equality skip controller.go:322-328)."""
+
+import time
+
+from nexus_tpu.api.types import ObjectMeta, Secret
+from nexus_tpu.cluster.informer import Informer, InformerFactory
+from nexus_tpu.cluster.store import ClusterStore, NotFoundError
+
+
+def make_secret(name, data=None):
+    return Secret(metadata=ObjectMeta(name=name, namespace="ns"), data=data or {})
+
+
+def test_informer_initial_list_and_has_synced():
+    store = ClusterStore()
+    store.seed(make_secret("pre-existing"))
+    inf = Informer(store, Secret.KIND)
+    added = []
+    inf.add_event_handler(on_add=lambda o: added.append(o.metadata.name))
+    assert not inf.has_synced()
+    inf.start()
+    assert inf.has_synced()
+    assert added == ["pre-existing"]
+    assert inf.lister.get("ns", "pre-existing").metadata.name == "pre-existing"
+
+
+def test_informer_watch_add_update_delete():
+    store = ClusterStore()
+    inf = Informer(store, Secret.KIND)
+    events = []
+    inf.add_event_handler(
+        on_add=lambda o: events.append(("add", o.metadata.name)),
+        on_update=lambda old, new: events.append(("update", new.metadata.name)),
+        on_delete=lambda o: events.append(("delete", o.metadata.name)),
+    )
+    inf.start()
+
+    created = store.create(make_secret("s1", {"a": "1"}))
+    created.data = {"a": "2"}
+    store.update(created)
+    store.delete(Secret.KIND, "ns", "s1")
+
+    assert events == [("add", "s1"), ("update", "s1"), ("delete", "s1")]
+    try:
+        inf.lister.get("ns", "s1")
+        raise AssertionError("deleted object still in lister")
+    except NotFoundError:
+        pass
+
+
+def test_informer_resync_refires_updates_with_same_rv():
+    store = ClusterStore()
+    store.seed(make_secret("s1"))
+    inf = Informer(store, Secret.KIND, resync_period=0.05)
+    updates = []
+    inf.add_event_handler(
+        on_update=lambda old, new: updates.append(
+            old.metadata.resource_version == new.metadata.resource_version
+        )
+    )
+    inf.start()
+    time.sleep(0.2)
+    inf.stop()
+    assert len(updates) >= 2  # several resync rounds fired
+    assert all(updates)  # resync delivers old==new (same RV) — handlers skip
+
+
+def test_factory_shares_informers_per_kind():
+    store = ClusterStore()
+    factory = InformerFactory(store, resync_period=0)
+    a = factory.informer(Secret.KIND)
+    b = factory.informer(Secret.KIND)
+    assert a is b
+    factory.start()
+    assert factory.wait_for_cache_sync(1.0)

@@ -1,0 +1,67 @@
+"""Rate limiter semantics (reference defaults: 30ms→5s exponential per item,
+50/s burst 300 global bucket, combined via MaxOf — controller.go:257-260)."""
+
+import pytest
+
+from nexus_tpu.controller.ratelimit import (
+    BucketRateLimiter,
+    ItemExponentialFailureRateLimiter,
+    MaxOfRateLimiter,
+    default_controller_rate_limiter,
+)
+
+
+def test_exponential_backoff_doubles_and_caps():
+    rl = ItemExponentialFailureRateLimiter(base_delay=0.030, max_delay=5.0)
+    delays = [rl.when("a") for _ in range(12)]
+    assert delays[0] == pytest.approx(0.030)
+    assert delays[1] == pytest.approx(0.060)
+    assert delays[2] == pytest.approx(0.120)
+    assert delays[-1] == 5.0  # capped
+    assert rl.num_requeues("a") == 12
+
+
+def test_exponential_backoff_is_per_item():
+    rl = ItemExponentialFailureRateLimiter(base_delay=0.030, max_delay=5.0)
+    assert rl.when("a") == pytest.approx(0.030)
+    assert rl.when("a") == pytest.approx(0.060)
+    assert rl.when("b") == pytest.approx(0.030)
+
+
+def test_forget_resets_backoff():
+    rl = ItemExponentialFailureRateLimiter(base_delay=0.030, max_delay=5.0)
+    rl.when("a")
+    rl.when("a")
+    rl.forget("a")
+    assert rl.num_requeues("a") == 0
+    assert rl.when("a") == pytest.approx(0.030)
+
+
+def test_bucket_allows_burst_then_throttles():
+    rl = BucketRateLimiter(rate=10.0, burst=5)
+    delays = [rl.when("x") for _ in range(5)]
+    assert all(d == 0.0 for d in delays)
+    d6 = rl.when("x")
+    assert d6 > 0.0
+    d7 = rl.when("x")
+    assert d7 > d6  # reservations stack into the future
+
+
+def test_max_of_takes_worst_case():
+    exp = ItemExponentialFailureRateLimiter(base_delay=1.0, max_delay=100.0)
+    bucket = BucketRateLimiter(rate=1000.0, burst=1000)
+    combined = MaxOfRateLimiter([exp, bucket])
+    assert combined.when("a") == pytest.approx(1.0)  # exponential dominates
+    assert combined.when("a") == pytest.approx(2.0)
+    combined.forget("a")
+    assert combined.num_requeues("a") == 0
+
+
+def test_default_combination_matches_reference_defaults():
+    rl = default_controller_rate_limiter()
+    exp = rl.limiters[0]
+    bucket = rl.limiters[1]
+    assert exp.base_delay == pytest.approx(0.030)
+    assert exp.max_delay == pytest.approx(5.0)
+    assert bucket.rate == pytest.approx(50.0)
+    assert bucket.burst == 300

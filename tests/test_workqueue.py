@@ -1,0 +1,125 @@
+"""Workqueue semantics: dedup, per-key serialization, delayed/rate-limited
+adds, shutdown (the client-go contract, SURVEY.md §7 hard part (a))."""
+
+import threading
+import time
+
+from nexus_tpu.controller.ratelimit import ItemExponentialFailureRateLimiter
+from nexus_tpu.controller.workqueue import RateLimitingQueue, WorkQueue
+
+
+def test_add_dedups_waiting_items():
+    q = WorkQueue()
+    q.add("a")
+    q.add("a")
+    q.add("b")
+    assert len(q) == 2
+
+
+def test_per_key_serialization():
+    """A key being processed is never handed out again until done; re-adds
+    during processing are parked and re-queued on done."""
+    q = WorkQueue()
+    q.add("a")
+    item, shutdown = q.get()
+    assert item == "a" and not shutdown
+
+    q.add("a")  # re-add while processing → parked in dirty set
+    assert len(q) == 0  # NOT queued
+    got = q.get(timeout=0.05)
+    assert got == (None, False)  # nothing available
+
+    q.done("a")  # processing finished with dirty bit set → requeued
+    item2, _ = q.get()
+    assert item2 == "a"
+    q.done("a")
+    assert len(q) == 0
+
+
+def test_done_without_dirty_does_not_requeue():
+    q = WorkQueue()
+    q.add("a")
+    item, _ = q.get()
+    q.done(item)
+    assert len(q) == 0
+
+
+def test_add_after_delivers_later():
+    q = WorkQueue()
+    q.add_after("late", 0.08)
+    assert q.get(timeout=0.02) == (None, False)
+    item, _ = q.get(timeout=2.0)
+    assert item == "late"
+
+
+def test_add_after_zero_delay_is_immediate():
+    q = WorkQueue()
+    q.add_after("now", 0.0)
+    assert len(q) == 1
+
+
+def test_shutdown_unblocks_getters():
+    q = WorkQueue()
+    results = []
+
+    def worker():
+        results.append(q.get())
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.05)
+    q.shut_down()
+    t.join(timeout=2.0)
+    assert results == [(None, True)]
+    # adds after shutdown are no-ops
+    q.add("x")
+    assert len(q) == 0
+
+
+def test_rate_limited_requeue_backs_off_and_forget_resets():
+    q = RateLimitingQueue(ItemExponentialFailureRateLimiter(0.01, 1.0))
+    q.add_rate_limited("a")  # first failure: 10ms delay
+    assert q.num_requeues("a") == 1
+    item, _ = q.get(timeout=2.0)
+    assert item == "a"
+    q.forget("a")
+    q.done("a")
+    assert q.num_requeues("a") == 0
+
+
+def test_concurrent_workers_never_process_same_key():
+    q = WorkQueue()
+    in_flight = set()
+    overlaps = []
+    lock = threading.Lock()
+    processed = [0]
+
+    def worker():
+        while True:
+            item, shutdown = q.get()
+            if shutdown:
+                return
+            with lock:
+                if item in in_flight:
+                    overlaps.append(item)
+                in_flight.add(item)
+            time.sleep(0.001)
+            with lock:
+                in_flight.discard(item)
+                processed[0] += 1
+            q.done(item)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for i in range(200):
+        q.add(f"key-{i % 5}")  # heavy key contention
+        time.sleep(0.0002)
+    deadline = time.monotonic() + 5.0
+    while len(q) > 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    q.shut_down()
+    for t in threads:
+        t.join(timeout=2.0)
+    assert overlaps == []
+    assert processed[0] > 0
