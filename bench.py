@@ -1,0 +1,76 @@
+"""Benchmark: Llama training throughput + MFU on the attached accelerator.
+
+Runs the framework's own jax_xla runtime path (the same code a synced
+template executes) on a single chip and reports MFU against the BASELINE
+north-star gate (≥35% MFU, BASELINE.md config #4).
+
+Prints ONE JSON line:
+  {"metric": "llama_train_mfu", "value": <mfu>, "unit": "mfu_fraction",
+   "vs_baseline": <mfu/0.35>, ...detail...}
+
+Env knobs: NEXUS_BENCH_PRESET (default auto), NEXUS_BENCH_STEPS,
+NEXUS_BENCH_BATCH, NEXUS_BENCH_SEQ.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    import jax
+
+    from nexus_tpu.utils.hw import device_kind, is_tpu
+
+    on_tpu = is_tpu()
+    preset = os.environ.get("NEXUS_BENCH_PRESET") or ("400m" if on_tpu else "tiny")
+    steps = int(os.environ.get("NEXUS_BENCH_STEPS") or (20 if on_tpu else 6))
+    batch = int(os.environ.get("NEXUS_BENCH_BATCH") or (8 if on_tpu else 4))
+    seq = int(os.environ.get("NEXUS_BENCH_SEQ") or (2048 if on_tpu else 64))
+
+    from nexus_tpu.api.runtime_spec import (
+        JaxXlaRuntime,
+        ModelRef,
+        ParallelismSpec,
+        TpuSliceSpec,
+        TrainSpec,
+    )
+    from nexus_tpu.runtime.entrypoints import run_template_runtime
+
+    n_dev = len(jax.devices())
+    overrides = {"remat": True} if on_tpu else {"dtype": "float32"}
+    runtime = JaxXlaRuntime(
+        mode="train",
+        model=ModelRef(family="llama", preset=preset, overrides=overrides),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1", slice_count=1),
+        parallelism=ParallelismSpec(),
+        train=TrainSpec(
+            batch_size=batch, seq_len=seq, steps=steps, learning_rate=3e-4,
+        ),
+    )
+    metrics = run_template_runtime(runtime)
+
+    mfu = float(metrics.get("mfu") or 0.0)
+    result = {
+        "metric": "llama_train_mfu",
+        "value": round(mfu, 4),
+        "unit": "mfu_fraction",
+        "vs_baseline": round(mfu / 0.35, 4) if mfu else 0.0,
+        "tokens_per_sec_per_chip": round(metrics.get("tokens_per_sec_per_chip", 0.0), 1),
+        "preset": preset,
+        "param_count": metrics.get("param_count"),
+        "seq_len": seq,
+        "batch_size": batch,
+        "steps": steps,
+        "device": device_kind(),
+        "n_devices": n_dev,
+        "final_loss": metrics.get("final_loss"),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

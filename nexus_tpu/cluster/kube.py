@@ -56,6 +56,9 @@ class KubeClusterStore:
         self._watch_threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._lock = threading.Lock()
+        # per-kind mirror of last-observed objects, diffed on every re-list
+        # so watch-gap deletions surface as synthetic DELETED events
+        self._mirror: Dict[str, Dict[str, APIObject]] = {}
 
     # ------------------------------------------------------------- conversion
     def _to_wire(self, obj: APIObject) -> dict:
@@ -195,38 +198,101 @@ class KubeClusterStore:
     def close(self) -> None:
         self._stop.set()
 
+    def _dispatch(self, kind: str, ev: WatchEvent) -> None:
+        with self._lock:
+            cbs = list(self._watchers.get(kind, []))
+        for cb in cbs:
+            cb(ev)
+
+    def _reconcile_mirror(self, kind: str) -> str:
+        """LIST and diff against the local mirror, emitting synthetic
+        ADDED/MODIFIED/DELETED events — this is how deletions (and any other
+        changes) that happened while no watch stream was open are recovered.
+        Returns the list's resourceVersion to resume the watch from."""
+        ns = self.namespace
+        if kind == Secret.KIND:
+            out = self._core.list_namespaced_secret(ns)
+            rv = out.metadata.resource_version
+            items = out.items
+        elif kind == ConfigMap.KIND:
+            out = self._core.list_namespaced_config_map(ns)
+            rv = out.metadata.resource_version
+            items = out.items
+        else:
+            out = self._custom.list_namespaced_custom_object(
+                GROUP, VERSION, ns, _PLURALS[kind]
+            )
+            rv = (out.get("metadata") or {}).get("resourceVersion", "")
+            items = out.get("items", [])
+        fresh = {
+            obj.key(): obj
+            for obj in (self._from_wire(kind, i) for i in items)
+        }
+        mirror = self._mirror.setdefault(kind, {})
+        for key, obj in fresh.items():
+            prev = mirror.get(key)
+            if prev is None:
+                self._dispatch(kind, WatchEvent("ADDED", obj))
+            elif prev.metadata.resource_version != obj.metadata.resource_version:
+                self._dispatch(kind, WatchEvent("MODIFIED", obj))
+        for key, obj in list(mirror.items()):
+            if key not in fresh:
+                self._dispatch(kind, WatchEvent("DELETED", obj))
+        self._mirror[kind] = fresh
+        return rv or ""
+
     def _watch_loop(self, kind: str) -> None:
         ns = self.namespace
+        resource_version = ""
+        need_relist = True
         while not self._stop.is_set():
             try:
+                if need_relist:
+                    resource_version = self._reconcile_mirror(kind)
+                    need_relist = False
                 w = k8s_watch.Watch()
+                kwargs = dict(timeout_seconds=60)
+                if resource_version:
+                    kwargs["resource_version"] = resource_version
                 if kind == Secret.KIND:
                     stream = w.stream(
-                        self._core.list_namespaced_secret, ns, timeout_seconds=60
+                        self._core.list_namespaced_secret, ns, **kwargs
                     )
                 elif kind == ConfigMap.KIND:
                     stream = w.stream(
-                        self._core.list_namespaced_config_map, ns, timeout_seconds=60
+                        self._core.list_namespaced_config_map, ns, **kwargs
                     )
                 else:
                     stream = w.stream(
                         self._custom.list_namespaced_custom_object,
-                        GROUP, VERSION, ns, _PLURALS[kind], timeout_seconds=60,
+                        GROUP, VERSION, ns, _PLURALS[kind], **kwargs,
                     )
                 for event in stream:
                     if self._stop.is_set():
                         return
                     obj = self._from_wire(kind, event["object"])
-                    ev = WatchEvent(event["type"], obj)
-                    with self._lock:
-                        cbs = list(self._watchers.get(kind, []))
-                    for cb in cbs:
-                        cb(ev)
+                    resource_version = obj.metadata.resource_version or resource_version
+                    mirror = self._mirror.setdefault(kind, {})
+                    if event["type"] == "DELETED":
+                        mirror.pop(obj.key(), None)
+                    else:
+                        mirror[obj.key()] = obj
+                    self._dispatch(kind, WatchEvent(event["type"], obj))
+            except k8s_client.ApiException as e:
+                if e.status == 410:  # Gone: resourceVersion too old → re-list
+                    need_relist = True
+                    continue
+                logger.exception(
+                    "watch for %s on %s failed; re-listing in 1s", kind, self.name
+                )
+                need_relist = True
+                self._stop.wait(1.0)
             except Exception:
                 logger.exception(
                     "watch stream for %s on %s broke; re-listing in 1s",
                     kind, self.name,
                 )
+                need_relist = True
                 self._stop.wait(1.0)
 
     def clear_actions(self) -> None:

@@ -79,6 +79,13 @@ class ClusterStore:
         self.actions: List[Action] = []
         self._watchers: Dict[str, List[Callable[[WatchEvent], None]]] = {}
         self.record_reads = False
+        # watch events are enqueued under _lock (global commit order) and
+        # drained under _dispatch_lock, so concurrent writers can never
+        # deliver events out of order (e.g. a DELETED overtaking the ADDED of
+        # a re-created object would permanently desync informer caches)
+        self._pending_events: List[Tuple[str, WatchEvent]] = []
+        self._dispatch_lock = threading.RLock()
+        self._draining = threading.local()
 
     # ------------------------------------------------------------------ utils
     def _next_rv(self) -> str:
@@ -92,8 +99,28 @@ class ClusterStore:
         self.actions.append(action)
 
     def _notify(self, kind: str, event: WatchEvent) -> None:
-        for cb in list(self._watchers.get(kind, [])):
-            cb(event)
+        """Deliver a watch event in commit order.
+
+        The event is queued under the main lock by the mutator; whichever
+        thread holds the dispatch lock drains the queue, so ordering follows
+        the queue (= commit order), not thread scheduling."""
+        with self._lock:
+            self._pending_events.append((kind, event))
+        if getattr(self._draining, "active", False):
+            return  # a callback mutated the store: the outer drain delivers it
+        with self._dispatch_lock:
+            self._draining.active = True
+            try:
+                while True:
+                    with self._lock:
+                        if not self._pending_events:
+                            return
+                        k, ev = self._pending_events.pop(0)
+                        cbs = list(self._watchers.get(k, []))
+                    for cb in cbs:
+                        cb(ev)
+            finally:
+                self._draining.active = False
 
     def clear_actions(self) -> None:
         with self._lock:

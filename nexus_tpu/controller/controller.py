@@ -232,6 +232,11 @@ class Controller:
     def run(self, workers: int = 2, wait_cache_sync_timeout: float = 30.0) -> None:
         """Start informers, gate on cache sync, spawn worker threads
         (reference: controller.go:851-884)."""
+        if self.work_queue.shutting_down():
+            raise RuntimeError(
+                "controller cannot be restarted after stop(); construct a new "
+                "Controller"
+            )
         logger.info("starting nexus controller (%d workers)", workers)
         self.informers.start()
         for shard in self.shards:
@@ -259,6 +264,8 @@ class Controller:
             t.join(timeout=5.0)
         self._workers = []
         self.informers.stop()
+        for shard in self.shards:
+            shard.informers.stop()
 
     def _worker_loop(self) -> None:
         # wait.UntilWithContext semantics: crash-guard the loop, restart after 1s

@@ -1,0 +1,13 @@
+"""Model families runnable under the jax_xla runtime: mlp, llama, mixtral.
+
+All models are functional: ``init(key, cfg) -> params`` pytrees +
+``forward(params, cfg, tokens) -> logits`` pure functions, with
+``logical_axes(cfg)`` exposing the sharding annotation tree
+(nexus_tpu.parallel.sharding consumes it). Decoder layers are stacked and
+scanned (one compiled block regardless of depth — the XLA-friendly layout).
+"""
+
+from nexus_tpu.models import llama, mixtral, mlp
+from nexus_tpu.models.registry import get_family, list_families
+
+__all__ = ["llama", "mixtral", "mlp", "get_family", "list_families"]

@@ -1,0 +1,290 @@
+"""Llama-3-style decoder: RMSNorm, RoPE, GQA attention, SwiGLU MLP.
+
+The flagship model family (BASELINE configs #3/#4: Llama-3-8B inference and
+FSDP pretraining). TPU-first layout decisions:
+  * layer parameters are **stacked** along a leading layer dim and the block
+    is ``lax.scan``-ned — one compiled block for any depth, fast compiles,
+    and rematerialization applies per-block via ``jax.checkpoint``;
+  * matmuls run in bf16 with fp32 accumulation (MXU-native);
+  * attention dispatches to the Pallas flash kernel on TPU, the XLA einsum
+    path elsewhere, or ring attention when the sequence axis is sharded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nexus_tpu.ops.attention import attention
+from nexus_tpu.ops.norms import rms_norm
+from nexus_tpu.ops.ring_attention import ring_attention
+from nexus_tpu.ops.rope import apply_rope, rope_cos_sin
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 1408
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    dtype: Any = jnp.bfloat16
+    attn_impl: Optional[str] = None  # None=auto | 'xla' | 'flash' | 'ring'
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def param_count(self) -> int:
+        d, f, v = self.d_model, self.d_ff, self.vocab_size
+        hd = self.head_dim
+        attn = d * (self.n_heads * hd) + 2 * d * (self.n_kv_heads * hd) + (
+            self.n_heads * hd
+        ) * d
+        mlp = 3 * d * f
+        per_layer = attn + mlp + 2 * d
+        return v * d + self.n_layers * per_layer + d + d * v
+
+
+PRESETS: Dict[str, Dict[str, Any]] = {
+    # test-size
+    "tiny": dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                 n_kv_heads=2, d_ff=128, max_seq_len=512),
+    # single-chip bench scale (~415M params)
+    "400m": dict(vocab_size=32000, d_model=1024, n_layers=24, n_heads=16,
+                 n_kv_heads=8, d_ff=2816, max_seq_len=4096),
+    # ~1.2B
+    "1b": dict(vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
+               n_kv_heads=8, d_ff=5632, max_seq_len=4096),
+    # Llama-3-8B dims (public): vocab 128256, d 4096, L 32, H 32, KV 8, ff 14336
+    "8b": dict(vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+               n_kv_heads=8, d_ff=14336, rope_theta=500000.0, max_seq_len=8192),
+}
+
+
+def config(preset: str = "tiny", **overrides) -> LlamaConfig:
+    base = dict(PRESETS[preset])
+    base.update(overrides)
+    if isinstance(base.get("dtype"), str):
+        base["dtype"] = getattr(jnp, base["dtype"])
+    return LlamaConfig(**base)
+
+
+# ------------------------------------------------------------------ params
+
+
+def init(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
+    """Stacked-layer parameter pytree. Truncated-normal-free simple init:
+    scaled normal, 1/sqrt(fan_in), out-projections scaled by 1/sqrt(2L)."""
+    d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab_size
+    hq, hkv, hd, L = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.n_layers
+    k = iter(jax.random.split(key, 16))
+    dt = cfg.dtype
+
+    def norm_init(key, *shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
+
+    resid_scale = 1.0 / math.sqrt(2 * L)
+    return {
+        "embed": norm_init(next(k), v, d, scale=1.0),
+        "layers": {
+            "wq": norm_init(next(k), L, d, hq * hd, scale=d ** -0.5),
+            "wk": norm_init(next(k), L, d, hkv * hd, scale=d ** -0.5),
+            "wv": norm_init(next(k), L, d, hkv * hd, scale=d ** -0.5),
+            "wo": norm_init(next(k), L, hq * hd, d, scale=(hq * hd) ** -0.5 * resid_scale),
+            "w_gate": norm_init(next(k), L, d, f, scale=d ** -0.5),
+            "w_up": norm_init(next(k), L, d, f, scale=d ** -0.5),
+            "w_down": norm_init(next(k), L, f, d, scale=f ** -0.5 * resid_scale),
+            "ln_attn": jnp.ones((L, d), dt),
+            "ln_mlp": jnp.ones((L, d), dt),
+        },
+        "final_norm": jnp.ones((d,), dt),
+        "lm_head": norm_init(next(k), d, v, scale=d ** -0.5),
+    }
+
+
+def logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Sharding annotations: leading 'layer' dim on stacked params is never
+    sharded; matrices follow the FSDP+TP layout (parallel/sharding.py)."""
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "wq": (None, "embed", "qkv"),
+            "wk": (None, "embed", "qkv"),
+            "wv": (None, "embed", "qkv"),
+            "wo": (None, "qkv", "embed"),
+            "w_gate": (None, "embed", "mlp"),
+            "w_up": (None, "embed", "mlp"),
+            "w_down": (None, "mlp", "embed"),
+            "ln_attn": (None, None),
+            "ln_mlp": (None, None),
+        },
+        "final_norm": (None,),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+# ----------------------------------------------------------------- forward
+
+
+def _block(cfg: LlamaConfig, x: jnp.ndarray, layer: Dict[str, jnp.ndarray],
+           cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    b, s, d = x.shape
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+    q = (h @ layer["wq"]).reshape(b, s, hq, hd)
+    k = (h @ layer["wk"]).reshape(b, s, hkv, hd)
+    v = (h @ layer["wv"]).reshape(b, s, hkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if cfg.attn_impl == "ring":
+        attn = ring_attention(q, k, v, axis_name="sequence", causal=True)
+    else:
+        attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+    x = x + attn.reshape(b, s, hq * hd) @ layer["wo"]
+
+    h = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
+    gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
+    return x + gated @ layer["w_down"]
+
+
+def forward(params: Dict[str, Any], cfg: LlamaConfig,
+            tokens: jnp.ndarray, position_offset: int = 0) -> jnp.ndarray:
+    """tokens (B, S) int32 → logits (B, S, V) float32."""
+    b, s = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    cos, sin = rope_cos_sin(
+        s, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32,
+        position_offset=position_offset,
+    )
+
+    block = partial(_block, cfg)
+    if cfg.remat:
+        block = jax.checkpoint(block, static_argnums=())
+
+    def scan_body(x, layer_params):
+        return block(x, layer_params, cos, sin), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(params: Dict[str, Any], cfg: LlamaConfig,
+            batch: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """Next-token cross entropy. batch: {'tokens': (B, S+1)}."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, cfg, inputs)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    loss = -jnp.mean(ll)
+    return loss, {"loss": loss, "perplexity": jnp.exp(loss)}
+
+
+# ------------------------------------------------------------------ decode
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Dict[str, Any]:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def forward_decode(
+    params: Dict[str, Any], cfg: LlamaConfig,
+    tokens: jnp.ndarray, cache: Dict[str, Any],
+) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """Incremental decode: tokens (B, T) appended at cache['length'].
+
+    Returns logits for the new positions and the updated cache. Uses a
+    length-masked XLA attention over the full cache buffer (static shapes —
+    jit-stable across steps)."""
+    b, t = tokens.shape
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    max_len = cache["k"].shape[2]
+    start = cache["length"]
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    # rope tables for the whole buffer; slice at runtime positions
+    cos_full, sin_full = rope_cos_sin(max_len, hd, cfg.rope_theta)
+    cos = lax.dynamic_slice_in_dim(cos_full, start, t, axis=0)
+    sin = lax.dynamic_slice_in_dim(sin_full, start, t, axis=0)
+
+    new_k, new_v = [], []
+    mask_value = -0.7 * float(jnp.finfo(jnp.float32).max)
+    positions = jnp.arange(max_len)
+
+    for li in range(cfg.n_layers):
+        layer = jax.tree_util.tree_map(lambda p: p[li], params["layers"])
+        h = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+        q = apply_rope((h @ layer["wq"]).reshape(b, t, hq, hd), cos, sin)
+        k = apply_rope((h @ layer["wk"]).reshape(b, t, hkv, hd), cos, sin)
+        v = (h @ layer["wv"]).reshape(b, t, hkv, hd)
+        k_buf = lax.dynamic_update_slice_in_dim(cache["k"][li], k, start, axis=1)
+        v_buf = lax.dynamic_update_slice_in_dim(cache["v"][li], v, start, axis=1)
+        new_k.append(k_buf)
+        new_v.append(v_buf)
+
+        n_rep = hq // hkv
+        kr = jnp.repeat(k_buf, n_rep, axis=2)
+        vr = jnp.repeat(v_buf, n_rep, axis=2)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kr,
+                            preferred_element_type=jnp.float32) * hd ** -0.5
+        q_pos = start + jnp.arange(t)
+        visible = positions[None, :] <= q_pos[:, None]  # (t, max_len)
+        logits = jnp.where(visible[None, None], logits, mask_value)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
+        x = x + attn.reshape(b, t, hq * hd) @ layer["wo"]
+        h2 = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
+        x = x + (jax.nn.silu(h2 @ layer["w_gate"]) * (h2 @ layer["w_up"])) @ layer["w_down"]
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    new_cache = {
+        "k": jnp.stack(new_k),
+        "v": jnp.stack(new_v),
+        "length": start + t,
+    }
+    return logits, new_cache
+
+
+def generate(
+    params: Dict[str, Any], cfg: LlamaConfig, prompt: jnp.ndarray,
+    max_new_tokens: int, max_len: Optional[int] = None,
+) -> jnp.ndarray:
+    """Greedy decoding. prompt (B, P) → (B, P + max_new_tokens)."""
+    b, p = prompt.shape
+    max_len = max_len or min(cfg.max_seq_len, p + max_new_tokens)
+    cache = init_kv_cache(cfg, b, max_len)
+    logits, cache = forward_decode(params, cfg, prompt, cache)
+    next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+
+    def step(carry, _):
+        cache, tok = carry
+        logits, cache = forward_decode(params, cfg, tok[:, None], cache)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(tok.dtype)
+        return (cache, nxt), nxt
+
+    (_, _), toks = lax.scan(step, (cache, next_tok), None, length=max_new_tokens - 1)
+    out = jnp.concatenate(
+        [prompt, next_tok[:, None], toks.swapaxes(0, 1)], axis=1
+    )
+    return out

@@ -1,0 +1,192 @@
+"""Mixtral-style sparse MoE decoder: Llama block with the SwiGLU MLP replaced
+by a top-2 routed mixture of experts (BASELINE config #5).
+
+Expert weights carry a leading expert dim annotated with the ``expert``
+logical axis, so under an expert-parallel mesh the three dispatch einsums
+reshard token-major ↔ expert-major — XLA SPMD inserts the all_to_all over
+ICI (SURVEY.md §2c "EP").
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nexus_tpu.ops.attention import attention
+from nexus_tpu.ops.moe import (
+    default_capacity,
+    moe_combine_dense,
+    moe_dispatch_dense,
+    top_k_routing,
+)
+from nexus_tpu.ops.norms import rms_norm
+from nexus_tpu.ops.rope import apply_rope, rope_cos_sin
+
+
+@dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 1408
+    n_experts: int = 8
+    n_experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.02
+    rope_theta: float = 1000000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    dtype: Any = jnp.bfloat16
+    attn_impl: Optional[str] = None
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+PRESETS: Dict[str, Dict[str, Any]] = {
+    "tiny": dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                 n_kv_heads=2, d_ff=128, n_experts=4, max_seq_len=512),
+    # Mixtral-8x7B dims (public): d 4096, L 32, H 32, KV 8, ff 14336, E 8 top2
+    "8x7b": dict(vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+                 n_kv_heads=8, d_ff=14336, n_experts=8,
+                 n_experts_per_token=2, max_seq_len=32768),
+}
+
+
+def config(preset: str = "tiny", **overrides) -> MixtralConfig:
+    base = dict(PRESETS[preset])
+    base.update(overrides)
+    if isinstance(base.get("dtype"), str):
+        base["dtype"] = getattr(jnp, base["dtype"])
+    return MixtralConfig(**base)
+
+
+def init(key: jax.Array, cfg: MixtralConfig) -> Dict[str, Any]:
+    d, f, v, e = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_experts
+    hq, hkv, hd, L = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.n_layers
+    k = iter(jax.random.split(key, 16))
+    dt = cfg.dtype
+
+    def norm_init(key, *shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
+
+    resid = 1.0 / math.sqrt(2 * L)
+    return {
+        "embed": norm_init(next(k), v, d, scale=1.0),
+        "layers": {
+            "wq": norm_init(next(k), L, d, hq * hd, scale=d ** -0.5),
+            "wk": norm_init(next(k), L, d, hkv * hd, scale=d ** -0.5),
+            "wv": norm_init(next(k), L, d, hkv * hd, scale=d ** -0.5),
+            "wo": norm_init(next(k), L, hq * hd, d, scale=(hq * hd) ** -0.5 * resid),
+            # router stays fp32: routing decisions are precision-sensitive
+            "router": jax.random.normal(next(k), (L, d, e), jnp.float32) * d ** -0.5,
+            "w_gate": norm_init(next(k), L, e, d, f, scale=d ** -0.5),
+            "w_up": norm_init(next(k), L, e, d, f, scale=d ** -0.5),
+            "w_down": norm_init(next(k), L, e, f, d, scale=f ** -0.5 * resid),
+            "ln_attn": jnp.ones((L, d), dt),
+            "ln_mlp": jnp.ones((L, d), dt),
+        },
+        "final_norm": jnp.ones((d,), dt),
+        "lm_head": norm_init(next(k), d, v, scale=d ** -0.5),
+    }
+
+
+def logical_axes(cfg: MixtralConfig) -> Dict[str, Any]:
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "wq": (None, "embed", "qkv"),
+            "wk": (None, "embed", "qkv"),
+            "wv": (None, "embed", "qkv"),
+            "wo": (None, "qkv", "embed"),
+            "router": (None, "embed", None),
+            "w_gate": (None, "expert", "embed", "mlp"),
+            "w_up": (None, "expert", "embed", "mlp"),
+            "w_down": (None, "expert", "mlp", "embed"),
+            "ln_attn": (None, None),
+            "ln_mlp": (None, None),
+        },
+        "final_norm": (None,),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def _moe_ffn(cfg: MixtralConfig, x: jnp.ndarray,
+             layer: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x: (B, S, D) → (out, aux_loss)."""
+    b, s, d = x.shape
+    t = b * s
+    xf = x.reshape(t, d)
+    router_logits = xf.astype(jnp.float32) @ layer["router"]  # (T, E)
+    cap = default_capacity(t, cfg.n_experts, cfg.n_experts_per_token,
+                           cfg.capacity_factor)
+    routing = top_k_routing(router_logits, cfg.n_experts_per_token, cap)
+
+    expert_in = moe_dispatch_dense(xf, routing).astype(cfg.dtype)  # (E, C, D)
+    gated = jax.nn.silu(
+        jnp.einsum("ecd,edf->ecf", expert_in, layer["w_gate"])
+    ) * jnp.einsum("ecd,edf->ecf", expert_in, layer["w_up"])
+    expert_out = jnp.einsum("ecf,efd->ecd", gated, layer["w_down"])  # (E, C, D)
+    out = moe_combine_dense(expert_out, routing).reshape(b, s, d)
+    return out.astype(cfg.dtype), routing.aux_loss
+
+
+def _block(cfg: MixtralConfig, carry, layer, cos, sin):
+    x, aux = carry
+    b, s, d = x.shape
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+    q = apply_rope((h @ layer["wq"]).reshape(b, s, hq, hd), cos, sin)
+    k = apply_rope((h @ layer["wk"]).reshape(b, s, hkv, hd), cos, sin)
+    v = (h @ layer["wv"]).reshape(b, s, hkv, hd)
+    attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+    x = x + attn.reshape(b, s, hq * hd) @ layer["wo"]
+
+    h2 = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
+    moe_out, layer_aux = _moe_ffn(cfg, h2, layer)
+    return (x + moe_out, aux + layer_aux)
+
+
+def forward(params: Dict[str, Any], cfg: MixtralConfig,
+            tokens: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """tokens (B, S) → (logits (B, S, V) fp32, total_aux_loss)."""
+    b, s = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    cos, sin = rope_cos_sin(s, cfg.head_dim, cfg.rope_theta)
+
+    block = partial(_block, cfg)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(carry, layer_params):
+        return block(carry, layer_params, cos, sin), None
+
+    (x, aux), _ = lax.scan(
+        scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32), aux
+
+
+def loss_fn(params: Dict[str, Any], cfg: MixtralConfig,
+            batch: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits, aux = forward(params, cfg, inputs)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    ce = -jnp.mean(ll)
+    loss = ce + cfg.router_aux_weight * aux / cfg.n_layers
+    return loss, {"loss": loss, "ce": ce, "aux": aux,
+                  "perplexity": jnp.exp(ce)}

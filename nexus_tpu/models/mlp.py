@@ -1,0 +1,73 @@
+"""MLP "hello world" — the smallest thing a template can run end-to-end
+(BASELINE config #2: JAX-on-CPU MLP synced to 1 local shard and executed)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MlpConfig:
+    in_dim: int = 16
+    hidden_dim: int = 64
+    out_dim: int = 8
+    n_layers: int = 2
+    dtype: Any = jnp.float32
+
+
+PRESETS = {
+    "tiny": dict(in_dim=16, hidden_dim=64, out_dim=8, n_layers=2),
+    "small": dict(in_dim=64, hidden_dim=256, out_dim=32, n_layers=3),
+}
+
+
+def config(preset: str = "tiny", **overrides) -> MlpConfig:
+    base = dict(PRESETS[preset])
+    base.update(overrides)
+    if isinstance(base.get("dtype"), str):
+        base["dtype"] = getattr(jnp, base["dtype"])
+    return MlpConfig(**base)
+
+
+def init(key: jax.Array, cfg: MlpConfig) -> Dict[str, Any]:
+    dims = [cfg.in_dim] + [cfg.hidden_dim] * (cfg.n_layers - 1) + [cfg.out_dim]
+    keys = jax.random.split(key, len(dims) - 1)
+    return {
+        "layers": [
+            {
+                "w": (jax.random.normal(k, (di, do), jnp.float32) * di ** -0.5
+                      ).astype(cfg.dtype),
+                "b": jnp.zeros((do,), cfg.dtype),
+            }
+            for k, di, do in zip(keys, dims[:-1], dims[1:])
+        ]
+    }
+
+
+def logical_axes(cfg: MlpConfig) -> Dict[str, Any]:
+    return {
+        "layers": [
+            {"w": ("embed", "mlp"), "b": ("mlp",)}
+            for _ in range(cfg.n_layers)
+        ]
+    }
+
+
+def forward(params: Dict[str, Any], cfg: MlpConfig, x: jnp.ndarray) -> jnp.ndarray:
+    for i, layer in enumerate(params["layers"]):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params["layers"]) - 1:
+            x = jax.nn.gelu(x)
+    return x
+
+
+def loss_fn(params: Dict[str, Any], cfg: MlpConfig,
+            batch: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """Regression MSE. batch: {'x': (B, in_dim), 'y': (B, out_dim)}."""
+    pred = forward(params, cfg, batch["x"])
+    loss = jnp.mean(jnp.square(pred - batch["y"]))
+    return loss, {"loss": loss}

@@ -1,0 +1,29 @@
+"""Model family registry: the jax_xla runtime resolves ``ModelRef.family``
+here. Each family exposes the same functional surface:
+``config(preset, **overrides)``, ``init(key, cfg)``, ``forward``,
+``loss_fn(params, cfg, batch)``, ``logical_axes(cfg)``."""
+
+from __future__ import annotations
+
+from types import ModuleType
+from typing import Dict
+
+from nexus_tpu.models import llama, mixtral, mlp
+
+_FAMILIES: Dict[str, ModuleType] = {
+    "mlp": mlp,
+    "llama": llama,
+    "mixtral": mixtral,
+}
+
+
+def get_family(name: str) -> ModuleType:
+    if name not in _FAMILIES:
+        raise KeyError(
+            f"unknown model family {name!r}; available: {sorted(_FAMILIES)}"
+        )
+    return _FAMILIES[name]
+
+
+def list_families():
+    return sorted(_FAMILIES)

@@ -1,0 +1,24 @@
+"""TPU compute ops: norms, rotary embeddings, attention (XLA and Pallas
+flash kernels), ring attention for sequence parallelism, MoE routing.
+
+All ops are pure functions over jnp arrays, designed for jit/shard_map:
+static shapes, no data-dependent Python control flow (SURVEY.md §2c — this
+entire layer is NEW vs. the reference, which contains no model/attention
+code).
+"""
+
+from nexus_tpu.ops.norms import rms_norm
+from nexus_tpu.ops.rope import apply_rope, rope_cos_sin
+from nexus_tpu.ops.attention import attention
+from nexus_tpu.ops.ring_attention import ring_attention
+from nexus_tpu.ops.moe import top_k_routing, moe_dispatch_dense
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rope_cos_sin",
+    "attention",
+    "ring_attention",
+    "top_k_routing",
+    "moe_dispatch_dense",
+]

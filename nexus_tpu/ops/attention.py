@@ -1,0 +1,232 @@
+"""Causal attention: XLA einsum path + Pallas flash kernel.
+
+The XLA path is the always-correct reference (XLA already fuses
+softmax(QK^T)V reasonably); the Pallas kernel is the HBM-bandwidth-optimal
+flash-attention (online softmax, O(seq) memory) for the TPU hot path.
+``attention(...)`` picks the kernel on TPU when shapes are tile-friendly and
+falls back to XLA elsewhere (CPU tests run the kernel via interpret mode).
+
+GQA (n_q_heads > n_kv_heads) is supported everywhere; K/V heads are
+broadcast to query heads.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU too; guard only for safety
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """(B, S, Hkv, D) → (B, S, Hkv*n_rep, D) broadcasting each kv head."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(
+        k[:, :, :, None, :], (b, s, h, n_rep, d)
+    ).reshape(b, s, h * n_rep, d)
+
+
+def attention_xla(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    q_offset: int = 0,
+    mask_value: float = DEFAULT_MASK_VALUE,
+) -> jnp.ndarray:
+    """Reference attention. q: (B, Sq, Hq, D); k/v: (B, Skv, Hkv, D).
+
+    ``q_offset``: global position of q[0] relative to k[0] (decode-time
+    steps and sequence-parallel shards pass nonzero offsets)."""
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        rows = lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + q_offset
+        cols = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        logits = jnp.where(cols <= rows, logits, mask_value)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+# --------------------------------------------------------------------- pallas
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int, q_offset: int,
+):
+    i = pl.program_id(1)  # q block
+    j = pl.program_id(2)  # k block
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]  # (block_q, D)
+    k = k_ref[0]  # (block_k, D)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # (block_q, block_k)
+
+    if causal:
+        rows = lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * block_q + q_offset
+        cols = lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * block_k
+        s = jnp.where(cols <= rows, s, DEFAULT_MASK_VALUE)
+
+    m_prev = m_ref[:, :1]  # (block_q, 1)
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        # guard against fully-masked rows (padding): l == 0 → output 0
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    q_offset: int = 0,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Pallas flash attention. Same signature/semantics as attention_xla.
+
+    Differentiable: custom VJP with a flash forward and an XLA-recompute
+    backward (a dedicated Pallas backward kernel is a later optimization)."""
+    if interpret is None:
+        from nexus_tpu.utils.hw import is_tpu
+
+        interpret = not is_tpu()
+    return _flash(q, k, v, (causal, q_offset, block_q, block_k, interpret))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash(q, k, v, opts):
+    return _flash_impl(q, k, v, opts)
+
+
+def _flash_fwd_rule(q, k, v, opts):
+    return _flash_impl(q, k, v, opts), (q, k, v)
+
+
+def _flash_bwd_rule(opts, residuals, g):
+    causal, q_offset, _, _, _ = opts
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: attention_xla(q, k, v, causal=causal, q_offset=q_offset),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _flash_impl(q, k, v, opts):
+    causal, q_offset, block_q, block_k, interpret = opts
+    b, sq, hq, d = q.shape
+    n_rep = hq // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"flash_attention requires seq divisible by blocks: "
+            f"{sq}%{block_q}, {sk}%{block_k}"
+        )
+
+    # fold heads into the grid's batch dim: (B*H, S, D)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * hq, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * hq, sk, d)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=d ** -0.5,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        q_offset=q_offset,
+    )
+    grid = (b * hq, sq // block_q, sk // block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    q_offset: int = 0,
+    impl: Optional[str] = None,
+) -> jnp.ndarray:
+    """Dispatching entry point: impl in {None (auto), 'xla', 'flash'}."""
+    if impl is None:
+        from nexus_tpu.utils.hw import is_tpu
+
+        tile_ok = (
+            q.shape[1] % min(128, q.shape[1]) == 0
+            and k.shape[1] % min(128, k.shape[1]) == 0
+            and q.shape[-1] in (64, 128, 256)
+            and q.shape[1] >= 128
+        )
+        impl = "flash" if (is_tpu() and tile_ok) else "xla"
+    if impl == "flash":
+        return flash_attention(q, k, v, causal=causal, q_offset=q_offset)
+    return attention_xla(q, k, v, causal=causal, q_offset=q_offset)

@@ -1,0 +1,83 @@
+"""Mixture-of-Experts routing and dispatch.
+
+Capacity-based dispatch in the Mesh-TensorFlow/Switch style: static-shape
+(tokens, experts, capacity) dispatch/combine tensors, so the whole layer is
+three einsums — exactly what XLA SPMD shards cleanly when the expert dim
+lives on the ``expert`` mesh axis (the all_to_all materializes as the
+resharding between token-sharded and expert-sharded operands).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RoutingResult(NamedTuple):
+    combine: jnp.ndarray  # (T, E, C) float — combine weights
+    dispatch: jnp.ndarray  # (T, E, C) bool-as-float — dispatch mask
+    aux_loss: jnp.ndarray  # scalar load-balancing loss
+    router_probs: jnp.ndarray  # (T, E)
+
+
+def top_k_routing(
+    router_logits: jnp.ndarray,
+    num_selected: int,
+    capacity: int,
+) -> RoutingResult:
+    """Top-k token→expert assignment with per-expert capacity.
+
+    ``router_logits``: (T, E). Tokens overflowing an expert's capacity are
+    dropped for that expert (standard Switch behavior). Returns static-shape
+    dispatch/combine tensors plus the Switch load-balance aux loss."""
+    t, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)  # (T,E)
+
+    top_probs, top_idx = jax.lax.top_k(probs, num_selected)  # (T,k)
+    # renormalize selected probabilities (Mixtral convention)
+    top_probs = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
+
+    # Switch aux loss: E * Σ_e (fraction of tokens routed to e) * (mean prob e)
+    sel_mask = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)  # (T,k,E)
+    tokens_per_expert = jnp.mean(jnp.sum(sel_mask, axis=1), axis=0)  # (E,)
+    mean_probs = jnp.mean(probs, axis=0)  # (E,)
+    aux_loss = e * jnp.sum(tokens_per_expert * mean_probs)
+
+    # position of each (token, choice) within its expert's capacity
+    flat_mask = sel_mask.reshape(t * num_selected, e)  # row-major: token-major
+    positions = jnp.cumsum(flat_mask, axis=0) * flat_mask - 1.0  # (T*k, E)
+    positions = positions.reshape(t, num_selected, e)
+    in_capacity = (positions >= 0) & (positions < capacity)
+
+    pos_clamped = jnp.clip(positions, 0, capacity - 1).astype(jnp.int32)
+    cap_one_hot = jax.nn.one_hot(pos_clamped, capacity, dtype=jnp.float32)
+    # (T,k,E,C) — zero out overflow and non-selected entries
+    slot = sel_mask[..., None] * cap_one_hot * in_capacity[..., None]
+    dispatch = jnp.sum(slot, axis=1)  # (T,E,C)
+    combine = jnp.sum(slot * top_probs[:, :, None, None], axis=1)  # (T,E,C)
+    return RoutingResult(combine, dispatch, aux_loss, probs)
+
+
+def moe_dispatch_dense(
+    x: jnp.ndarray,
+    routing: RoutingResult,
+) -> jnp.ndarray:
+    """Token → expert buffers: (T, D) × (T, E, C) → (E, C, D)."""
+    return jnp.einsum("td,tec->ecd", x, routing.dispatch)
+
+
+def moe_combine_dense(
+    expert_out: jnp.ndarray,
+    routing: RoutingResult,
+) -> jnp.ndarray:
+    """Expert buffers → tokens: (E, C, D) × (T, E, C) → (T, D)."""
+    return jnp.einsum("ecd,tec->td", expert_out, routing.combine.astype(expert_out.dtype))
+
+
+def default_capacity(
+    tokens: int, num_experts: int, num_selected: int, capacity_factor: float = 1.25
+) -> int:
+    cap = int(tokens * num_selected * capacity_factor / num_experts)
+    return max(cap, num_selected)

@@ -1,0 +1,107 @@
+"""Ring attention: exact causal attention over a sequence-parallel mesh axis.
+
+Long-context strategy (SURVEY.md §2c "SP/CP"): the sequence dim is sharded
+over the ``sequence`` mesh axis; each device holds a (B, S/n, H, D) slice of
+Q/K/V. K/V blocks rotate around the ring via ``lax.ppermute`` (nearest-
+neighbor ICI hops) while each device folds every visiting block into an
+online-softmax accumulator — full-sequence attention with O(S/n) memory and
+communication that overlaps compute.
+
+Run inside shard_map/pjit with ``axis_name`` bound, e.g.::
+
+    shard_map(ring_attention_fn, mesh,
+              in_specs=(P(None, 'sequence', None, None),) * 3,
+              out_specs=P(None, 'sequence', None, None))
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nexus_tpu.ops.attention import DEFAULT_MASK_VALUE, _repeat_kv
+
+
+def _online_block(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    m: jnp.ndarray,
+    l: jnp.ndarray,
+    acc: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    k_positions: jnp.ndarray,
+    causal: bool,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fold one K/V block into the (m, l, acc) online-softmax state."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        mask = k_positions[None, None, None, :] <= q_positions[None, None, :, None]
+        s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)  # (B,H,Q,1)
+    m_new = jnp.maximum(m, m_cur)
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bhqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    acc_new = acc * alpha + pv
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = "sequence",
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Exact attention over sequence shards. q/k/v: (B, S_local, H|Hkv, D).
+
+    Must execute under a mapping (shard_map) that binds ``axis_name``."""
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, s_local, hq, d = q.shape
+    n_rep = hq // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+
+    q_positions = my_idx * s_local + jnp.arange(s_local)
+
+    m0 = jnp.full((b, hq, s_local, 1), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((b, hq, s_local, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((b, hq, s_local, d), dtype=jnp.float32)
+    # mark initial accumulators as device-varying over the ring axis so the
+    # scan carry types line up (shard_map varying-axis typing, jax >= 0.8)
+    pvary = getattr(lax, "pvary", None)
+    if pvary is not None:
+        m0, l0, acc0 = (pvary(x, (axis_name,)) for x in (m0, l0, acc0))
+
+    def step(carry, step_idx):
+        k_blk, v_blk, m, l, acc = carry
+        # the block currently held originated on shard (my_idx - step) mod n
+        src = (my_idx - step_idx) % n
+        k_positions = src * s_local + jnp.arange(s_local)
+        m, l, acc = _online_block(
+            q, k_blk, v_blk, m, l, acc, q_positions, k_positions, causal
+        )
+        # rotate: receive the next block from the previous rank in the ring
+        perm = [(r, (r + 1) % n) for r in range(n)]
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (k_next, v_next, m, l, acc), None
+
+    (k, v, m, l, acc), _ = lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n)
+    )
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / safe_l).astype(q.dtype)  # (B,H,Q,D)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,41 @@
+"""Rotary position embeddings (RoPE), Llama-3 convention."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(
+    seq_len: int,
+    head_dim: int,
+    theta: float = 500000.0,
+    dtype=jnp.float32,
+    position_offset: int = 0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Precompute cos/sin tables of shape (seq_len, head_dim/2).
+
+    ``position_offset`` supports decode-time caching (positions continue from
+    the cache length) and sequence-parallel shards (each shard's positions
+    start at its global offset)."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    positions = jnp.arange(seq_len, dtype=jnp.float32) + position_offset
+    angles = jnp.outer(positions, freqs)  # (seq, half)
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rope(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+) -> jnp.ndarray:
+    """Rotate pairs (x[..., :half], x[..., half:]) — x: (..., seq, heads, head_dim).
+
+    cos/sin: (seq, head_dim/2), broadcast over batch and heads."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    # broadcast tables to (..., seq, 1, half)
+    c = cos[:, None, :]
+    s = sin[:, None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,32 @@
+"""TPU parallelism layer: device meshes, sharding rules, sharded train steps.
+
+This layer is NEW relative to the reference (which has no accelerator code —
+SURVEY.md §2c) and implements the BASELINE north star the TPU way: pick a
+mesh, annotate shardings, let XLA insert collectives over ICI/DCN.
+"""
+
+from nexus_tpu.parallel.mesh import (
+    AXES,
+    MeshPlan,
+    build_mesh,
+    mesh_from_parallelism,
+    plan_for_devices,
+)
+from nexus_tpu.parallel.sharding import (
+    batch_spec,
+    logical_to_spec,
+    named_sharding,
+    shard_params,
+)
+
+__all__ = [
+    "AXES",
+    "MeshPlan",
+    "build_mesh",
+    "mesh_from_parallelism",
+    "plan_for_devices",
+    "batch_spec",
+    "logical_to_spec",
+    "named_sharding",
+    "shard_params",
+]

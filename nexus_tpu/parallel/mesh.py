@@ -1,0 +1,118 @@
+"""Device-mesh construction from TPU slice topology + parallelism axes.
+
+The mesh is the root object of the TPU execution model: every sharding in the
+framework is a PartitionSpec over these named axes, and XLA lowers the
+resulting communication onto ICI (within a slice) / DCN (across slices).
+
+Axis order is chosen for ICI locality: the most communication-intensive axes
+(``tensor``, then ``sequence``/``expert``) are placed innermost so their
+collectives ride neighboring ICI links; ``pipeline`` and ``data`` are
+outermost since their communication (activations between stages, gradient
+all-reduce) tolerates DCN hops in multislice deployments.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from nexus_tpu.api.runtime_spec import ParallelismSpec
+
+# Outer → inner. Keep in sync with ParallelismSpec fields.
+AXES: Tuple[str, ...] = ("pipeline", "data", "fsdp", "expert", "sequence", "tensor")
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """A concrete axis-size assignment (product == device count)."""
+
+    pipeline: int = 1
+    data: int = 1
+    fsdp: int = 1
+    expert: int = 1
+    sequence: int = 1
+    tensor: int = 1
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(getattr(self, a) for a in AXES)
+
+    def total(self) -> int:
+        return math.prod(self.shape)
+
+    @classmethod
+    def from_parallelism(cls, p: ParallelismSpec) -> "MeshPlan":
+        return cls(
+            pipeline=p.pipeline,
+            data=p.data,
+            fsdp=p.fsdp,
+            expert=p.expert,
+            sequence=p.sequence,
+            tensor=p.tensor,
+        )
+
+
+def build_mesh(plan: MeshPlan, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` with the framework's named axes.
+
+    ``devices`` defaults to ``jax.devices()``; its length must equal the
+    plan's axis product. Size-1 axes are kept in the mesh so PartitionSpecs
+    can always reference every logical axis."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if plan.total() != len(devices):
+        raise ValueError(
+            f"mesh plan {plan.shape} (product {plan.total()}) does not tile "
+            f"{len(devices)} devices"
+        )
+    dev_array = np.array(devices).reshape(plan.shape)
+    return Mesh(dev_array, AXES)
+
+
+def mesh_from_parallelism(
+    p: ParallelismSpec, devices: Optional[Sequence] = None
+) -> Mesh:
+    return build_mesh(MeshPlan.from_parallelism(p), devices)
+
+
+def plan_for_devices(
+    n: int,
+    prefer: Sequence[str] = ("fsdp", "tensor", "data"),
+    max_tensor: int = 8,
+) -> MeshPlan:
+    """Heuristic plan for ``n`` devices when the user gave none.
+
+    Factorizes ``n`` onto the preferred axes: tensor parallelism is capped
+    (TP beyond one host's ICI neighborhood wastes bandwidth), the remainder
+    goes to fsdp, then pure data parallelism."""
+    sizes = {a: 1 for a in AXES}
+    remaining = n
+    if "tensor" in prefer and remaining > 1:
+        t = math.gcd(remaining, max_tensor)
+        # largest power-of-two divisor of n, capped
+        t = 1
+        while t * 2 <= max_tensor and remaining % (t * 2) == 0:
+            t *= 2
+        sizes["tensor"] = t
+        remaining //= t
+    if "fsdp" in prefer and remaining > 1:
+        sizes["fsdp"] = remaining
+        remaining = 1
+    if remaining > 1:
+        sizes["data"] = remaining
+    return MeshPlan(**{a: sizes[a] for a in AXES})
+
+
+def validate_plan_against_topology(plan: MeshPlan, chips: int) -> List[str]:
+    errs = []
+    if plan.total() != chips:
+        errs.append(
+            f"mesh plan product {plan.total()} != slice chip count {chips}"
+        )
+    return errs

@@ -1,0 +1,78 @@
+"""Sharding rules: logical tensor dimensions → mesh PartitionSpecs.
+
+Models annotate parameters with *logical* dimension names ("vocab", "embed",
+"mlp", "heads", …); this module maps them onto physical mesh axes. The map
+implements the standard FSDP+TP layout (How-to-Scale-Your-Model recipe):
+
+  * weight matrices split their input/output dims over ``tensor`` (megatron
+    TP) and shard the remaining dim over ``fsdp`` (ZeRO-3 parameter
+    sharding — XLA all-gathers just-in-time and reduce-scatters gradients);
+  * activations shard batch over ``(data, fsdp)`` (+ ``expert`` when it is a
+    pure-data axis for non-MoE tensors), sequence over ``sequence``
+    (context parallelism), and attention heads / mlp features over
+    ``tensor``;
+  * MoE expert weights put their leading expert dim on ``expert``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical dim name → physical mesh axis (or tuple of axes)
+DEFAULT_LOGICAL_RULES: Dict[str, Any] = {
+    "batch": ("data", "fsdp"),
+    "seq": "sequence",
+    "vocab": "tensor",
+    "embed": "fsdp",
+    "mlp": "tensor",
+    "heads": "tensor",
+    "kv_heads": "tensor",
+    "head_dim": None,
+    "qkv": "tensor",
+    "expert": "expert",
+    "norm": None,
+    None: None,
+}
+
+
+def logical_to_spec(
+    logical_dims: Tuple[Optional[str], ...],
+    rules: Optional[Dict[str, Any]] = None,
+) -> P:
+    """("vocab", "embed") → PartitionSpec('tensor', 'fsdp')."""
+    rules = rules or DEFAULT_LOGICAL_RULES
+    return P(*(rules.get(d) for d in logical_dims))
+
+
+def named_sharding(mesh: Mesh, *dims: Optional[str], rules=None) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(dims, rules))
+
+
+def batch_spec(sequence_sharded: bool = False) -> P:
+    """Activation sharding for a (batch, seq, ...) tensor."""
+    return P(("data", "fsdp"), "sequence" if sequence_sharded else None)
+
+
+def shard_params(params, logical_tree, mesh: Mesh, rules=None):
+    """Device-put a parameter pytree according to its logical-dims pytree.
+
+    ``logical_tree`` mirrors ``params`` with tuples of logical dim names at
+    the leaves (each model family exposes ``logical_axes(config)``)."""
+    def _place(p, dims):
+        return jax.device_put(p, NamedSharding(mesh, logical_to_spec(dims, rules)))
+
+    return jax.tree_util.tree_map(
+        _place, params, logical_tree, is_leaf=lambda x: x is None
+    )
+
+
+def sharding_tree(logical_tree, mesh: Mesh, rules=None):
+    """Logical-dims pytree → NamedSharding pytree (for jit in/out_shardings)."""
+    return jax.tree_util.tree_map(
+        lambda dims: NamedSharding(mesh, logical_to_spec(dims, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) or x is None,
+    )

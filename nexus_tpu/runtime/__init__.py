@@ -1,0 +1,15 @@
+"""jax_xla workload runtime: template → TPU Job materialization → execution.
+
+This is the plane that makes synced templates *run* (BASELINE north star):
+the materializer turns a template's runtime block into a Kubernetes Job
+manifest with ``google.com/tpu`` resources and ``gke-tpu-*`` nodeSelectors;
+the launcher watches a shard for runnable templates and executes them (in
+process for local shards, via the cluster API for real ones); entrypoints
+build the mesh/model/trainer from the spec.
+"""
+
+from nexus_tpu.runtime.materializer import materialize_job
+from nexus_tpu.runtime.entrypoints import run_template_runtime
+from nexus_tpu.runtime.launcher import LocalLauncher
+
+__all__ = ["materialize_job", "run_template_runtime", "LocalLauncher"]

@@ -1,0 +1,185 @@
+"""Runtime entrypoints: execute a template's jax_xla block on this process's
+devices. This is what runs inside the launched TPU pod (and, for local
+shards, inside the LocalLauncher thread).
+
+Flow: resolve model family → build mesh (declared parallelism when it tiles
+the local device count, otherwise re-planned for the available devices — the
+local/dry-run case) → init sharded train state → train or infer → return a
+metrics dict (tokens/sec, MFU, loss history, …).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from nexus_tpu.api.runtime_spec import JaxXlaRuntime
+from nexus_tpu.models.registry import get_family
+from nexus_tpu.parallel.mesh import (
+    MeshPlan,
+    build_mesh,
+    plan_for_devices,
+)
+from nexus_tpu.train.checkpoint import Checkpointer
+from nexus_tpu.train.data import synthetic_lm_batches, synthetic_mlp_batches
+from nexus_tpu.train.metrics import (
+    detect_peak_flops_per_chip,
+    llama_flops_per_token,
+    mfu,
+)
+from nexus_tpu.train.trainer import (
+    Trainer,
+    build_optimizer,
+    init_train_state,
+    make_train_step,
+)
+
+logger = logging.getLogger("nexus_tpu.runtime")
+
+
+def _resolve_mesh(runtime: JaxXlaRuntime, devices: Optional[Sequence] = None):
+    devices = list(devices) if devices is not None else jax.devices()
+    plan = MeshPlan.from_parallelism(runtime.parallelism)
+    if plan.total() != len(devices):
+        logger.info(
+            "declared parallelism %s targets %d chips but %d devices are "
+            "local; re-planning for local execution",
+            plan.shape, plan.total(), len(devices),
+        )
+        plan = plan_for_devices(len(devices))
+    return build_mesh(plan, devices)
+
+
+def run_template_runtime(
+    runtime: JaxXlaRuntime,
+    devices: Optional[Sequence] = None,
+    max_steps: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Execute a runtime block; returns a JSON-serializable metrics dict."""
+    family = get_family(runtime.model.family)
+    cfg = family.config(runtime.model.preset, **runtime.model.overrides)
+    mesh = _resolve_mesh(runtime, devices)
+    n_devices = mesh.devices.size
+
+    if runtime.mode == "infer":
+        return _run_infer(runtime, family, cfg, mesh)
+    return _run_train(runtime, family, cfg, mesh, n_devices, max_steps)
+
+
+def _run_train(runtime, family, cfg, mesh, n_devices, max_steps):
+    tr = runtime.train
+    steps = min(tr.steps, max_steps) if max_steps else tr.steps
+    optimizer = build_optimizer(
+        learning_rate=tr.learning_rate,
+        warmup_steps=tr.warmup_steps,
+        total_steps=steps,
+        weight_decay=tr.weight_decay,
+    )
+    key = jax.random.PRNGKey(tr.seed)
+
+    with mesh:
+        state = init_train_state(
+            lambda: family.init(key, cfg),
+            optimizer,
+            mesh=mesh,
+            logical_tree=family.logical_axes(cfg),
+        )
+        loss_fn = lambda params, batch: family.loss_fn(params, cfg, batch)
+        step_fn = make_train_step(
+            loss_fn, optimizer, mesh=mesh, grad_accum=tr.gradient_accumulation
+        )
+
+        if runtime.model.family == "mlp":
+            data = synthetic_mlp_batches(
+                tr.batch_size, cfg.in_dim, cfg.out_dim, seed=tr.seed
+            )
+            tokens_per_batch = 0
+        else:
+            data = synthetic_lm_batches(
+                tr.batch_size, tr.seq_len, cfg.vocab_size, seed=tr.seed
+            )
+            tokens_per_batch = tr.batch_size * tr.seq_len
+
+        checkpointer = None
+        start_step = 0
+        if runtime.checkpoint.enabled and runtime.checkpoint.directory:
+            checkpointer = Checkpointer(
+                runtime.checkpoint.directory, keep=runtime.checkpoint.keep
+            )
+            if runtime.checkpoint.resume and checkpointer.latest_step() is not None:
+                state = checkpointer.restore(state)
+                start_step = int(state.step)
+                logger.info("resumed from checkpoint step %d", start_step)
+
+        trainer = Trainer(
+            step_fn,
+            state,
+            data,
+            tokens_per_batch=tokens_per_batch,
+            checkpointer=checkpointer,
+            checkpoint_interval=runtime.checkpoint.interval_steps
+            if checkpointer
+            else 0,
+        )
+        result = trainer.run(max(steps - start_step, 1))
+        if checkpointer is not None:
+            checkpointer.save(trainer.state, wait=True)
+            checkpointer.close()
+
+    metrics: Dict[str, Any] = {
+        "mode": "train",
+        "family": runtime.model.family,
+        "preset": runtime.model.preset,
+        "steps": result.steps,
+        "final_loss": result.final_metrics.get("loss"),
+        "loss_history": result.loss_history[:64],
+        "steps_per_sec": result.steps_per_sec,
+        "tokens_per_sec": result.tokens_per_sec,
+        "n_devices": n_devices,
+        "resumed_from_step": start_step,
+    }
+    if hasattr(cfg, "param_count"):
+        fpt = llama_flops_per_token(cfg, tr.seq_len)
+        metrics["param_count"] = cfg.param_count()
+        metrics["tokens_per_sec_per_chip"] = result.tokens_per_sec / n_devices
+        metrics["model_flops_per_token"] = fpt
+        metrics["mfu"] = mfu(result.tokens_per_sec, fpt, n_chips=n_devices)
+    return metrics
+
+
+def _run_infer(runtime, family, cfg, mesh):
+    if runtime.model.family == "mlp":
+        raise ValueError("infer mode is for autoregressive families")
+    import time
+
+    tr = runtime.train  # batch/seq knobs reused for inference shapes
+    key = jax.random.PRNGKey(tr.seed)
+    with mesh:
+        params = jax.jit(lambda: family.init(key, cfg))()
+        prompt = jax.random.randint(
+            key, (tr.batch_size, min(32, tr.seq_len)), 0, cfg.vocab_size,
+            dtype=jnp.int32,
+        )
+        gen = family.generate  # llama-style families expose generate()
+        max_new = min(64, cfg.max_seq_len - prompt.shape[1])
+        out = gen(params, cfg, prompt, max_new)  # compile + run
+        jax.block_until_ready(out)
+        t0 = time.monotonic()
+        out = gen(params, cfg, prompt, max_new)
+        jax.block_until_ready(out)
+        dt = time.monotonic() - t0
+    new_tokens = tr.batch_size * max_new
+    return {
+        "mode": "infer",
+        "family": runtime.model.family,
+        "preset": runtime.model.preset,
+        "decode_tokens_per_sec": new_tokens / dt,
+        "batch_size": tr.batch_size,
+        "new_tokens": max_new,
+        "n_devices": mesh.devices.size,
+    }

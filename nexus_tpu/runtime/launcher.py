@@ -1,0 +1,162 @@
+"""LocalLauncher: the shard-side executor for in-process shards.
+
+On a real GKE shard, the synced template's Job manifests (materializer.py)
+are applied to the cluster and kubelet+GKE do the rest. On a *local* shard
+(tests, single-host deployments, BASELINE config #2), this launcher plays
+the role of the cluster's job machinery: it watches the shard store for
+templates carrying a jax_xla runtime, materializes the Job manifest (same
+code path as production), executes the runtime in a worker thread, and
+records the outcome as a ConfigMap ``<template>-result`` plus Events —
+proving template → running-JAX-job end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import traceback
+from typing import Any, Dict, Optional
+
+from nexus_tpu.api.template import NexusAlgorithmTemplate
+from nexus_tpu.api.types import ConfigMap, ObjectMeta
+from nexus_tpu.cluster.store import ClusterStore, NotFoundError, WatchEvent
+from nexus_tpu.controller.events import EventRecorder, EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING
+from nexus_tpu.runtime.entrypoints import run_template_runtime
+from nexus_tpu.runtime.materializer import materialize_job
+
+logger = logging.getLogger("nexus_tpu.launcher")
+
+RESULT_SUFFIX = "-result"
+REASON_JOB_STARTED = "JobStarted"
+REASON_JOB_COMPLETED = "JobCompleted"
+REASON_JOB_FAILED = "JobFailed"
+
+
+class LocalLauncher:
+    """Watches one shard store and executes runnable templates."""
+
+    def __init__(
+        self,
+        store: ClusterStore,
+        recorder: Optional[EventRecorder] = None,
+        max_steps: Optional[int] = None,
+        devices=None,
+    ):
+        self.store = store
+        self.recorder = recorder or EventRecorder(component="nexus-local-launcher")
+        self.max_steps = max_steps
+        self.devices = devices
+        self._seen_generations: Dict[str, int] = {}
+        self._threads: Dict[str, threading.Thread] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    # ---------------------------------------------------------------- control
+    def start(self) -> None:
+        self.store.subscribe(NexusAlgorithmTemplate.KIND, self._on_event)
+        for tmpl in self.store.list(NexusAlgorithmTemplate.KIND):
+            self._maybe_launch(tmpl)
+
+    def stop(self, wait: bool = True) -> None:
+        self._stop.set()
+        self.store.unsubscribe(NexusAlgorithmTemplate.KIND, self._on_event)
+        if wait:
+            with self._lock:
+                threads = list(self._threads.values())
+            for t in threads:
+                t.join(timeout=60.0)
+
+    def wait_idle(self, timeout: float = 120.0) -> bool:
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not any(t.is_alive() for t in self._threads.values()):
+                    return True
+            time.sleep(0.05)
+        return False
+
+    # ----------------------------------------------------------------- events
+    def _on_event(self, event: WatchEvent) -> None:
+        if self._stop.is_set():
+            return
+        if event.type in ("ADDED", "MODIFIED"):
+            self._maybe_launch(event.obj)
+
+    def _maybe_launch(self, tmpl: NexusAlgorithmTemplate) -> None:
+        if tmpl.spec.runtime is None:
+            return
+        key = tmpl.key()
+        gen = tmpl.metadata.generation
+        with self._lock:
+            if self._seen_generations.get(key) == gen:
+                return  # this spec generation already ran/running
+            running = self._threads.get(key)
+            if running is not None and running.is_alive():
+                return  # one job per template at a time
+            self._seen_generations[key] = gen
+            t = threading.Thread(
+                target=self._execute, args=(tmpl,), daemon=True,
+                name=f"nexus-job-{tmpl.metadata.name}",
+            )
+            self._threads[key] = t
+        t.start()
+
+    # -------------------------------------------------------------- execution
+    def _execute(self, tmpl: NexusAlgorithmTemplate) -> None:
+        name = tmpl.metadata.name
+        try:
+            # production code path: manifest materialization must succeed
+            jobs = materialize_job(tmpl, shard_name=self.store.name)
+            self.recorder.event(
+                tmpl, EVENT_TYPE_NORMAL, REASON_JOB_STARTED,
+                f"Launching {len(jobs)} job(s) for template {name!r} "
+                f"({tmpl.spec.runtime.mode} {tmpl.spec.runtime.model.family})",
+            )
+            metrics = run_template_runtime(
+                tmpl.spec.runtime, devices=self.devices, max_steps=self.max_steps
+            )
+            self._write_result(tmpl, "Succeeded", metrics, jobs)
+            self.recorder.event(
+                tmpl, EVENT_TYPE_NORMAL, REASON_JOB_COMPLETED,
+                f"Template {name!r} completed: "
+                + json.dumps({k: metrics[k] for k in sorted(metrics) if not isinstance(metrics[k], list)}, default=str)[:512],
+            )
+        except Exception as e:
+            logger.exception("job for template %s failed", name)
+            self._write_result(
+                tmpl, "Failed", {"error": str(e), "traceback": traceback.format_exc()[-2000:]}, []
+            )
+            self.recorder.event(
+                tmpl, EVENT_TYPE_WARNING, REASON_JOB_FAILED,
+                f"Template {name!r} failed: {e}",
+            )
+
+    def _write_result(
+        self, tmpl: NexusAlgorithmTemplate, phase: str, metrics: Dict[str, Any],
+        jobs,
+    ) -> None:
+        result = ConfigMap(
+            metadata=ObjectMeta(
+                name=tmpl.metadata.name + RESULT_SUFFIX,
+                namespace=tmpl.metadata.namespace,
+                labels={"app": "nexus-local-launcher"},
+            ),
+            data={
+                "phase": phase,
+                "metrics": json.dumps(metrics, default=str),
+                "jobManifest": json.dumps(jobs[0], default=str) if jobs else "",
+                "generation": str(tmpl.metadata.generation),
+            },
+        )
+        try:
+            existing = self.store.get(
+                ConfigMap.KIND, result.metadata.namespace, result.metadata.name
+            )
+            result.metadata = existing.metadata
+            result.metadata.labels = {"app": "nexus-local-launcher"}
+            self.store.update(result)
+        except NotFoundError:
+            self.store.create(result)

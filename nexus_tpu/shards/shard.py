@@ -160,24 +160,42 @@ class Shard:
         updated.metadata.labels.update(self.provenance_labels())
         return self.store.update(updated, field_manager=field_manager)  # type: ignore[return-value]
 
-    # ---------------------------------------------------------------- secrets
+    # ----------------------------------------------------- secrets/configmaps
+    def _create_dependent(self, owner, source, field_manager):
+        """Shared secret/configmap create: fresh shard copy with provenance
+        labels + shard-side owner reference."""
+        shard_obj = source.deepcopy()
+        shard_obj.metadata = ObjectMeta(
+            name=source.metadata.name,
+            namespace=source.metadata.namespace,
+            labels=self.provenance_labels(),
+            owner_references=[self._template_owner_ref(owner)],
+        )
+        return self.store.create(shard_obj, field_manager=field_manager)
+
+    def _update_dependent(self, obj, data, owner, field_manager):
+        """Shared secret/configmap update: ``data=None`` keeps existing data;
+        when ``owner`` is given, append its owner reference (the adoption
+        write — reference: controller.go:541,552). Owner dedup is by uid —
+        the same identity the controller's ownership check uses — so a stale
+        same-name/different-uid ref can't block adoption from converging."""
+        updated = obj.deepcopy()
+        if data is not None:
+            updated.data = dict(data)
+        updated.metadata.labels.update(self.provenance_labels())
+        if owner is not None:
+            ref = self._template_owner_ref(owner)
+            if not any(r.uid == ref.uid for r in updated.metadata.owner_references):
+                updated.metadata.owner_references.append(ref)
+        return self.store.update(updated, field_manager=field_manager)
+
     def create_secret(
         self,
         owner: NexusAlgorithmTemplate,
         secret: Secret,
         field_manager: str = "",
     ) -> Secret:
-        shard_secret = Secret(
-            metadata=ObjectMeta(
-                name=secret.metadata.name,
-                namespace=secret.metadata.namespace,
-                labels=self.provenance_labels(),
-                owner_references=[self._template_owner_ref(owner)],
-            ),
-            data=dict(secret.data),
-            type=secret.type,
-        )
-        return self.store.create(shard_secret, field_manager=field_manager)  # type: ignore[return-value]
+        return self._create_dependent(owner, secret, field_manager)  # type: ignore[return-value]
 
     def update_secret(
         self,
@@ -186,39 +204,15 @@ class Shard:
         owner: Optional[NexusAlgorithmTemplate] = None,
         field_manager: str = "",
     ) -> Secret:
-        """Update shard secret data (``data=None`` keeps existing data); when
-        ``owner`` is given, additionally append the owner reference (the
-        adoption write — reference: controller.go:541,552)."""
-        updated = secret.deepcopy()
-        if data is not None:
-            updated.data = dict(data)
-        updated.metadata.labels.update(self.provenance_labels())
-        if owner is not None:
-            ref = self._template_owner_ref(owner)
-            # dedup by uid — the same identity the controller's ownership
-            # check uses — so a stale same-name/different-uid ref can't
-            # block adoption from ever converging
-            if not any(r.uid == ref.uid for r in updated.metadata.owner_references):
-                updated.metadata.owner_references.append(ref)
-        return self.store.update(updated, field_manager=field_manager)  # type: ignore[return-value]
+        return self._update_dependent(secret, data, owner, field_manager)  # type: ignore[return-value]
 
-    # ------------------------------------------------------------- configmaps
     def create_config_map(
         self,
         owner: NexusAlgorithmTemplate,
         config_map: ConfigMap,
         field_manager: str = "",
     ) -> ConfigMap:
-        shard_cm = ConfigMap(
-            metadata=ObjectMeta(
-                name=config_map.metadata.name,
-                namespace=config_map.metadata.namespace,
-                labels=self.provenance_labels(),
-                owner_references=[self._template_owner_ref(owner)],
-            ),
-            data=dict(config_map.data),
-        )
-        return self.store.create(shard_cm, field_manager=field_manager)  # type: ignore[return-value]
+        return self._create_dependent(owner, config_map, field_manager)  # type: ignore[return-value]
 
     def update_config_map(
         self,
@@ -227,15 +221,7 @@ class Shard:
         owner: Optional[NexusAlgorithmTemplate] = None,
         field_manager: str = "",
     ) -> ConfigMap:
-        updated = config_map.deepcopy()
-        if data is not None:
-            updated.data = dict(data)
-        updated.metadata.labels.update(self.provenance_labels())
-        if owner is not None:
-            ref = self._template_owner_ref(owner)
-            if not any(r.uid == ref.uid for r in updated.metadata.owner_references):
-                updated.metadata.owner_references.append(ref)
-        return self.store.update(updated, field_manager=field_manager)  # type: ignore[return-value]
+        return self._update_dependent(config_map, data, owner, field_manager)  # type: ignore[return-value]
 
     # ------------------------------------------------------------------- misc
     def start(self) -> None:

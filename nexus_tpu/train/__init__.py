@@ -1,0 +1,16 @@
+"""Training/inference runtime: sharded train steps, optimizer, data,
+checkpointing, MFU/throughput metrics."""
+
+from nexus_tpu.train.trainer import TrainState, Trainer, make_train_step
+from nexus_tpu.train.metrics import llama_flops_per_token, mfu
+from nexus_tpu.train.data import synthetic_lm_batches, synthetic_mlp_batches
+
+__all__ = [
+    "TrainState",
+    "Trainer",
+    "make_train_step",
+    "llama_flops_per_token",
+    "mfu",
+    "synthetic_lm_batches",
+    "synthetic_mlp_batches",
+]

@@ -1,0 +1,53 @@
+"""Checkpoint/resume via Orbax (sharding-aware, async-capable).
+
+The reference has no checkpoint subsystem (all its state lives in the
+Kubernetes API — SURVEY.md §5); in the TPU build, checkpointing is a
+workload concern: train state (params + optimizer + step) is saved with its
+shardings and restored onto the same or a different mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class Checkpointer:
+    """Thin wrapper over orbax CheckpointManager for TrainState pytrees."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True
+            ),
+        )
+
+    def save(self, state, step: Optional[int] = None, wait: bool = False) -> int:
+        step = int(state.step) if step is None else step
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+        return step
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, abstract_state: Any, step: Optional[int] = None):
+        """Restore into the structure/shardings of ``abstract_state`` (pass a
+        concrete state or a jax.eval_shape result with shardings)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state)
+        )
+
+    def close(self):
+        self._mgr.wait_until_finished()
+        self._mgr.close()

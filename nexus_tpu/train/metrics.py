@@ -1,0 +1,48 @@
+"""Throughput + MFU accounting (the north-star metric: ≥35% MFU for
+Llama-3-8B pretraining, BASELINE.md)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def llama_flops_per_token(cfg, seq_len: Optional[int] = None) -> float:
+    """Training FLOPs/token: 6·N_params plus the attention quadratic term
+    (12·L·d·s accounting for QK^T and PV in fwd+bwd)."""
+    n = cfg.param_count() if hasattr(cfg, "param_count") else None
+    if n is None:
+        raise ValueError("config lacks param_count()")
+    s = seq_len or cfg.max_seq_len
+    attn_flops = 12 * cfg.n_layers * cfg.d_model * s
+    return 6.0 * n + attn_flops
+
+
+def detect_peak_flops_per_chip(default: float = 275e12) -> float:
+    """Peak bf16 FLOP/s of the attached accelerator (by device_kind)."""
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return default
+    table = {
+        "v4": 275e12,
+        "v5 lite": 197e12, "v5e": 197e12,
+        "v5p": 459e12, "v5": 459e12,
+        "v6 lite": 918e12, "v6e": 918e12, "trillium": 918e12,
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return default
+
+
+def mfu(
+    tokens_per_sec: float,
+    flops_per_token: float,
+    n_chips: int = 1,
+    peak_flops_per_chip: Optional[float] = None,
+) -> float:
+    peak = peak_flops_per_chip or detect_peak_flops_per_chip()
+    achieved = tokens_per_sec * flops_per_token
+    return achieved / (peak * n_chips)

@@ -1,0 +1,219 @@
+"""Sharded training loop.
+
+The train step is a single jitted function over a named mesh: parameters and
+optimizer state carry NamedShardings from the model's logical axes (FSDP/TP),
+the batch is sharded over (data, fsdp), and XLA SPMD inserts every collective
+(gradient reduce-scatter/all-gather over ``fsdp``, activation all-reduce over
+``tensor``) — no hand-written communication (SURVEY.md §2c).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nexus_tpu.parallel.sharding import logical_to_spec
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def build_optimizer(
+    learning_rate: float = 3e-4,
+    warmup_steps: int = 0,
+    total_steps: int = 10000,
+    weight_decay: float = 0.1,
+    grad_clip: float = 1.0,
+    b1: float = 0.9,
+    b2: float = 0.95,
+) -> optax.GradientTransformation:
+    if warmup_steps > 0:
+        schedule = optax.warmup_cosine_decay_schedule(
+            0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1)
+        )
+    else:
+        schedule = learning_rate
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+
+
+def make_train_step(
+    loss_fn: Callable[[Any, Dict[str, jnp.ndarray]], Tuple[jnp.ndarray, Dict]],
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    batch_spec: P = P(("data", "fsdp")),
+    grad_accum: int = 1,
+    donate: bool = True,
+):
+    """Build a jitted ``step(state, batch) -> (state, metrics)``.
+
+    With a mesh, the batch is pinned to data-parallel sharding; the state
+    keeps the (FSDP/TP) shardings it was created with (init_train_state) and
+    XLA SPMD propagates them through the whole step. ``grad_accum > 1`` runs
+    a lax.scan over microbatches (batch's leading dim must be divisible)."""
+
+    def compute_grads(params, batch):
+        if grad_accum == 1:
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch
+            )
+            return grads, metrics
+        micro = jax.tree_util.tree_map(
+            lambda x: x.reshape((grad_accum, x.shape[0] // grad_accum) + x.shape[1:]),
+            batch,
+        )
+
+        def accum(carry, mb):
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, mb
+            )
+            carry = jax.tree_util.tree_map(jnp.add, carry, grads)
+            return carry, metrics
+
+        zero = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        grads, metrics = jax.lax.scan(accum, zero, micro)
+        grads = jax.tree_util.tree_map(lambda g: g / grad_accum, grads)
+        metrics = jax.tree_util.tree_map(lambda m: m[-1], metrics)
+        return grads, metrics
+
+    def step(state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        grads, metrics = compute_grads(state.params, batch)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(params, opt_state, state.step + 1)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return new_state, metrics
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    batch_sharding = NamedSharding(mesh, batch_spec)
+    return jax.jit(
+        step,
+        in_shardings=(None, batch_sharding),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def init_train_state(
+    init_params_fn: Callable[[], Any],
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+    logical_tree: Any = None,
+    rules: Optional[Dict[str, Any]] = None,
+) -> TrainState:
+    """Initialize params/opt state, sharded at creation under a mesh so no
+    host ever materializes the full model (jit + out_shardings)."""
+    if mesh is None:
+        params = init_params_fn()
+        return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+
+    spec_tree = jax.tree_util.tree_map(
+        lambda dims: NamedSharding(mesh, logical_to_spec(dims, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) or x is None,
+    )
+    params = jax.jit(init_params_fn, out_shardings=spec_tree)()
+    opt_state = jax.jit(
+        optimizer.init,
+    )(params)  # moments inherit param shardings via input shardings
+    return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+
+
+@dataclass
+class TrainerResult:
+    steps: int
+    final_metrics: Dict[str, float]
+    wall_time_s: float
+    tokens_per_sec: float
+    steps_per_sec: float
+    loss_history: Any
+
+
+class Trainer:
+    """Drives step(state, batch) over a data iterator with throughput
+    accounting and optional checkpointing (the jax_xla runtime's train
+    loop)."""
+
+    def __init__(
+        self,
+        step_fn,
+        state: TrainState,
+        data_iter: Iterator[Dict],
+        tokens_per_batch: int = 0,
+        checkpointer=None,
+        checkpoint_interval: int = 0,
+        telemetry=None,
+    ):
+        self.step_fn = step_fn
+        self.state = state
+        self.data_iter = data_iter
+        self.tokens_per_batch = tokens_per_batch
+        self.checkpointer = checkpointer
+        self.checkpoint_interval = checkpoint_interval
+        self.telemetry = telemetry
+
+    def run(self, num_steps: int, warmup_steps: int = 1) -> TrainerResult:
+        metrics: Dict[str, Any] = {}
+        losses = []
+        # warmup (compile) steps excluded from timing
+        for _ in range(min(warmup_steps, num_steps)):
+            batch = next(self.data_iter)
+            self.state, metrics = self.step_fn(self.state, batch)
+        jax.block_until_ready(metrics)
+
+        timed_steps = num_steps - min(warmup_steps, num_steps)
+        t0 = time.monotonic()
+        for i in range(timed_steps):
+            batch = next(self.data_iter)
+            self.state, metrics = self.step_fn(self.state, batch)
+            if "loss" in metrics:
+                losses.append(metrics["loss"])
+            if (
+                self.checkpointer is not None
+                and self.checkpoint_interval > 0
+                and (i + 1) % self.checkpoint_interval == 0
+            ):
+                jax.block_until_ready(self.state)
+                self.checkpointer.save(self.state)
+        jax.block_until_ready(metrics)
+        dt = max(time.monotonic() - t0, 1e-9)
+
+        final = {
+            k: float(v)
+            for k, v in metrics.items()
+            if jnp.ndim(v) == 0
+        }
+        sps = timed_steps / dt if timed_steps else 0.0
+        tps = sps * self.tokens_per_batch
+        if self.telemetry is not None:
+            self.telemetry.gauge("train_steps_per_sec", sps)
+            if tps:
+                self.telemetry.gauge("train_tokens_per_sec", tps)
+        return TrainerResult(
+            steps=num_steps,
+            final_metrics=final,
+            wall_time_s=dt,
+            tokens_per_sec=tps,
+            steps_per_sec=sps,
+            loss_history=[float(l) for l in losses],
+        )

@@ -78,10 +78,22 @@ def load_config(
         if key.startswith(ENV_PREFIX):
             values[key[len(ENV_PREFIX) :].lower()] = value
 
+    # resolve string annotations (`from __future__ import annotations` makes
+    # f.type a string) so coercion follows the declared type, not the default
+    try:
+        import typing
+
+        hints = typing.get_type_hints(cls)
+    except Exception:
+        hints = {}
+
     kwargs: Dict[str, Any] = {}
     for f in fields(cls):  # type: ignore[arg-type]
         if f.name in values:
-            kwargs[f.name] = _coerce(values[f.name], f.type if isinstance(f.type, type) else type(f.default))
+            target = hints.get(f.name)
+            if not isinstance(target, type):
+                target = type(f.default) if f.default is not None else str
+            kwargs[f.name] = _coerce(values[f.name], target)
     return cls(**kwargs)  # type: ignore[call-arg]
 
 

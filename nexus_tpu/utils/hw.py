@@ -1,0 +1,24 @@
+"""Accelerator detection helpers.
+
+The TPU may be attached through a PJRT plugin whose backend name is not
+"tpu" (e.g. the tunneled platform in this environment), so feature dispatch
+keys off device_kind, not backend name.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def is_tpu() -> bool:
+    try:
+        return "tpu" in jax.devices()[0].device_kind.lower()
+    except Exception:
+        return False
+
+
+def device_kind() -> str:
+    try:
+        return jax.devices()[0].device_kind
+    except Exception:
+        return "unknown"

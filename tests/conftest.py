@@ -8,11 +8,21 @@ JAX is first imported anywhere.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard override: the ambient environment points JAX at the real TPU tunnel
+# (JAX_PLATFORMS=axon); tests always run on the virtual 8-device CPU mesh.
+# The sitecustomize imports jax before this file runs, so updating os.environ
+# alone is not enough — update jax.config too (backends are initialized
+# lazily, at first device use, so this still takes effect).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,77 @@
+"""Mesh construction + sharding rules on the virtual 8-device CPU mesh."""
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from nexus_tpu.api.runtime_spec import ParallelismSpec, TpuSliceSpec
+from nexus_tpu.parallel.mesh import (
+    AXES,
+    MeshPlan,
+    build_mesh,
+    mesh_from_parallelism,
+    plan_for_devices,
+)
+from nexus_tpu.parallel.sharding import logical_to_spec, shard_params
+
+
+def test_axes_order_puts_tensor_innermost():
+    assert AXES[-1] == "tensor"
+    assert AXES[0] == "pipeline"
+
+
+def test_build_mesh_8_devices():
+    mesh = build_mesh(MeshPlan(data=2, fsdp=2, tensor=2))
+    assert mesh.devices.size == 8
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["fsdp"] == 2
+    assert mesh.shape["tensor"] == 2
+    assert mesh.shape["sequence"] == 1
+
+
+def test_build_mesh_rejects_wrong_product():
+    with pytest.raises(ValueError):
+        build_mesh(MeshPlan(data=3))  # 3 does not tile 8 devices
+
+
+def test_mesh_from_parallelism_spec():
+    p = ParallelismSpec(fsdp=4, tensor=2)
+    mesh = mesh_from_parallelism(p)
+    assert mesh.shape["fsdp"] == 4
+    assert mesh.shape["tensor"] == 2
+
+
+def test_plan_for_devices_factorizes():
+    plan = plan_for_devices(8)
+    assert plan.total() == 8
+    assert plan.tensor <= 8
+    plan1 = plan_for_devices(1)
+    assert plan1.total() == 1
+
+
+def test_tpu_slice_spec_math():
+    tpu = TpuSliceSpec(accelerator="v5p", topology="4x4x4", slice_count=2)
+    assert tpu.chips_per_slice == 64
+    assert tpu.total_chips == 128
+    assert tpu.hosts_per_slice == 16
+    assert tpu.gke_accelerator == "tpu-v5p-slice"
+
+
+def test_logical_to_spec_rules():
+    assert logical_to_spec(("vocab", "embed")) == P("tensor", "fsdp")
+    assert logical_to_spec(("batch", "seq")) == P(("data", "fsdp"), "sequence")
+    assert logical_to_spec((None, "embed", "qkv")) == P(None, "fsdp", "tensor")
+
+
+def test_shard_params_places_on_mesh():
+    import jax.numpy as jnp
+
+    mesh = build_mesh(MeshPlan(fsdp=2, tensor=4))
+    params = {"w": jnp.zeros((8, 16)), "b": jnp.zeros((16,))}
+    logical = {"w": ("embed", "mlp"), "b": ("mlp",)}
+    sharded = shard_params(params, logical, mesh)
+    # w: embed→fsdp (2-way on dim0), mlp→tensor (4-way on dim1)
+    assert sharded["w"].sharding.spec == P("fsdp", "tensor")
+    assert sharded["b"].sharding.spec == P("tensor")
+    # addressable shard of w is (8/2, 16/4)
+    assert sharded["w"].addressable_shards[0].data.shape == (4, 4)

@@ -1,0 +1,243 @@
+"""jax_xla runtime: materializer manifests, entrypoint execution, and the
+full BASELINE config #2 e2e — a template with a runtime block synced by the
+controller to a local shard and *executed* there (template → running JAX job).
+"""
+
+import json
+import time
+
+import jax
+import pytest
+
+from nexus_tpu.api.runtime_spec import (
+    JaxXlaRuntime,
+    ModelRef,
+    ParallelismSpec,
+    TpuSliceSpec,
+    TrainSpec,
+)
+from nexus_tpu.api.template import NexusAlgorithmTemplate
+from nexus_tpu.api.types import ConfigMap, ObjectMeta, Secret
+from nexus_tpu.cluster.store import ClusterStore, NotFoundError
+from nexus_tpu.controller.controller import Controller
+from nexus_tpu.runtime.entrypoints import run_template_runtime
+from nexus_tpu.runtime.launcher import LocalLauncher
+from nexus_tpu.runtime.materializer import materialize_job
+from nexus_tpu.shards.shard import Shard
+from nexus_tpu.utils.telemetry import StatsdClient
+from tests.test_controller_sync import NS, make_template
+
+
+def runtime_block(**kw):
+    defaults = dict(
+        mode="train",
+        model=ModelRef(family="mlp", preset="tiny"),
+        tpu=TpuSliceSpec(accelerator="v5p", topology="2x2x2", slice_count=1),
+        parallelism=ParallelismSpec(data=2, fsdp=2, tensor=2),
+        train=TrainSpec(batch_size=32, steps=12, learning_rate=1e-2),
+    )
+    defaults.update(kw)
+    return JaxXlaRuntime(**defaults)
+
+
+def template_with_runtime(name="tpu-algo", **kw):
+    tmpl = make_template(name)
+    tmpl.spec.runtime = runtime_block(**kw)
+    return tmpl
+
+
+# ----------------------------------------------------------------- manifests
+
+
+def test_materializer_emits_tpu_scheduling():
+    tmpl = template_with_runtime()
+    tmpl.metadata.uid = "uid-test"
+    jobs = materialize_job(tmpl, shard_name="shard0")
+    assert len(jobs) == 1
+    job = jobs[0]
+
+    pod = job["spec"]["template"]["spec"]
+    # the north-star assertions: TPU selectors + google.com/tpu, no GPU/NCCL
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2x2"
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5p-slice"
+    res = pod["containers"][0]["resources"]["limits"]
+    assert res["google.com/tpu"] == "4"  # chips per host
+    assert "nvidia.com/gpu" not in res
+    env_names = {e["name"] for e in pod["containers"][0]["env"]}
+    assert "NEXUS_RUNTIME_SPEC" in env_names
+    assert "JAX_COORDINATOR_ADDRESS" in env_names
+    assert not any("NCCL" in n for n in env_names)
+
+    # one indexed completion per host: 8 chips / 4 per host = 2
+    assert job["spec"]["completions"] == 2
+    assert job["spec"]["parallelism"] == 2
+    assert job["spec"]["completionMode"] == "Indexed"
+    # job owned by the template (GC linkage)
+    assert job["metadata"]["ownerReferences"][0]["uid"] == "uid-test"
+
+
+def test_materializer_multislice_emits_one_job_per_slice():
+    tmpl = template_with_runtime(
+        tpu=TpuSliceSpec(accelerator="v5e", topology="2x2", slice_count=2),
+        parallelism=ParallelismSpec(data=2, fsdp=2, tensor=2),
+    )
+    jobs = materialize_job(tmpl)
+    assert len(jobs) == 2
+    assert jobs[0]["metadata"]["name"] == "tpu-algo-s0"
+    assert jobs[1]["metadata"]["name"] == "tpu-algo-s1"
+
+
+def test_materializer_rejects_invalid_runtime():
+    tmpl = template_with_runtime(
+        parallelism=ParallelismSpec(data=3)  # 3 != 8 chips
+    )
+    with pytest.raises(ValueError, match="parallelism axes product"):
+        materialize_job(tmpl)
+
+
+def test_materializer_requires_runtime():
+    with pytest.raises(ValueError, match="no jax_xla runtime"):
+        materialize_job(make_template())
+
+
+# ---------------------------------------------------------------- entrypoint
+
+
+def test_run_template_runtime_mlp_train():
+    metrics = run_template_runtime(runtime_block())
+    assert metrics["mode"] == "train"
+    assert metrics["final_loss"] is not None
+    assert metrics["final_loss"] < 1.0
+    assert metrics["n_devices"] == 8
+    assert metrics["steps_per_sec"] > 0
+
+
+def test_run_template_runtime_llama_train_reports_mfu():
+    metrics = run_template_runtime(
+        runtime_block(
+            model=ModelRef(family="llama", preset="tiny",
+                           overrides={"dtype": "float32"}),
+            train=TrainSpec(batch_size=8, seq_len=32, steps=4),
+        )
+    )
+    assert metrics["tokens_per_sec"] > 0
+    assert metrics["tokens_per_sec_per_chip"] > 0
+    assert 0 <= metrics["mfu"] < 1
+    assert metrics["param_count"] > 0
+
+
+# ------------------------------------------------------- the config #2 e2e
+
+
+def wait_for(pred, timeout=90.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if pred():
+                return True
+        except NotFoundError:
+            pass
+        time.sleep(interval)
+    return False
+
+
+def test_e2e_template_synced_and_executed():
+    """BASELINE config #2: declare a template with a jax_xla MLP runtime in
+    the controller cluster → controller syncs it to the local shard → the
+    shard's launcher materializes + executes it → result recorded."""
+    controller_store = ClusterStore("controller")
+    shard_store = ClusterStore("shard0")
+    shard = Shard("e2e", "shard0", shard_store)
+    controller = Controller(
+        controller_store, [shard], statsd=StatsdClient("test"), resync_period=1.0
+    )
+    launcher = LocalLauncher(shard_store)
+    controller.run(workers=2)
+    launcher.start()
+    try:
+        controller_store.create(template_with_runtime())
+
+        # template lands on the shard via the controller
+        assert wait_for(
+            lambda: shard_store.get(NexusAlgorithmTemplate.KIND, NS, "tpu-algo")
+            is not None
+        ), "template never synced to shard"
+
+        # launcher executes it and records the result
+        assert wait_for(
+            lambda: json.loads(
+                shard_store.get(ConfigMap.KIND, NS, "tpu-algo-result").data["metrics"]
+            )["final_loss"] is not None
+        ), "job never completed on shard"
+
+        result = shard_store.get(ConfigMap.KIND, NS, "tpu-algo-result")
+        assert result.data["phase"] == "Succeeded"
+        metrics = json.loads(result.data["metrics"])
+        assert metrics["final_loss"] < 1.0
+        manifest = json.loads(result.data["jobManifest"])
+        assert (
+            manifest["spec"]["template"]["spec"]["nodeSelector"][
+                "cloud.google.com/gke-tpu-topology"
+            ]
+            == "2x2x2"
+        )
+        # completion event emitted
+        assert any(
+            e.reason == "JobCompleted" for e in launcher.recorder.events
+        )
+    finally:
+        launcher.stop()
+        controller.stop()
+
+
+def test_launcher_reruns_on_spec_change_only():
+    store = ClusterStore("shard")
+    launcher = LocalLauncher(store)
+    launcher.start()
+    try:
+        tmpl = template_with_runtime()
+        created = store.create(tmpl)
+        assert wait_for(
+            lambda: store.get(ConfigMap.KIND, NS, "tpu-algo-result").data["phase"]
+            == "Succeeded"
+        )
+        gen1 = store.get(ConfigMap.KIND, NS, "tpu-algo-result").data["generation"]
+
+        # status-only touch: no re-run
+        store.update_status(store.get(NexusAlgorithmTemplate.KIND, NS, "tpu-algo"))
+        launcher.wait_idle()
+        assert (
+            store.get(ConfigMap.KIND, NS, "tpu-algo-result").data["generation"]
+            == gen1
+        )
+
+        # spec change: re-run with new generation
+        fresh = store.get(NexusAlgorithmTemplate.KIND, NS, "tpu-algo")
+        fresh.spec.runtime.train.steps = 5
+        store.update(fresh)
+        assert wait_for(
+            lambda: store.get(ConfigMap.KIND, NS, "tpu-algo-result").data[
+                "generation"
+            ]
+            != gen1
+        ), "spec change never triggered a re-run"
+    finally:
+        launcher.stop()
+
+
+def test_launcher_records_failure():
+    store = ClusterStore("shard")
+    launcher = LocalLauncher(store)
+    launcher.start()
+    try:
+        tmpl = template_with_runtime(
+            model=ModelRef(family="nonexistent-family", preset="tiny")
+        )
+        store.create(tmpl)
+        assert wait_for(
+            lambda: store.get(ConfigMap.KIND, NS, "tpu-algo-result").data["phase"]
+            == "Failed"
+        )
+        assert any(e.reason == "JobFailed" for e in launcher.recorder.events)
+    finally:
+        launcher.stop()

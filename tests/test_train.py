@@ -1,0 +1,169 @@
+"""Sharded training: FSDP+TP train step on the 8-device mesh, grad-accum
+equivalence, checkpoint save/restore roundtrip."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from nexus_tpu.models import llama
+from nexus_tpu.parallel.mesh import MeshPlan, build_mesh
+from nexus_tpu.train.data import synthetic_lm_batches
+from nexus_tpu.train.trainer import (
+    TrainState,
+    Trainer,
+    build_optimizer,
+    init_train_state,
+    make_train_step,
+)
+
+
+def tiny_cfg():
+    return llama.config("tiny", dtype=jnp.float32)
+
+
+def test_sharded_fsdp_tp_train_step():
+    """Full train step jitted over a (data=2, fsdp=2, tensor=2) mesh: params
+    actually sharded (per-device shards smaller than global), loss finite,
+    and a few steps reduce it."""
+    cfg = tiny_cfg()
+    mesh = build_mesh(MeshPlan(data=2, fsdp=2, tensor=2))
+    opt = build_optimizer(learning_rate=1e-2, grad_clip=1.0)
+    key = jax.random.PRNGKey(0)
+
+    with mesh:
+        state = init_train_state(
+            lambda: llama.init(key, cfg), opt, mesh=mesh,
+            logical_tree=llama.logical_axes(cfg),
+        )
+        # FSDP+TP sharding is real: embed (vocab×d) is split over tensor(vocab)
+        # and fsdp(embed) → each device holds 1/4 of it
+        embed = state.params["embed"]
+        assert embed.sharding.spec == P("tensor", "fsdp")
+        shard_shape = embed.addressable_shards[0].data.shape
+        assert shard_shape == (cfg.vocab_size // 2, cfg.d_model // 2)
+
+        step = make_train_step(
+            lambda p, b: llama.loss_fn(p, cfg, b), opt, mesh=mesh
+        )
+        data = synthetic_lm_batches(8, 32, cfg.vocab_size, seed=0)
+        losses = []
+        for _ in range(10):
+            state, metrics = step(state, next(data))
+            losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_sharded_matches_single_device():
+    """The sharded step computes the same math as the unsharded step."""
+    cfg = tiny_cfg()
+    opt = optax.sgd(1e-2)  # deterministic, no moments
+    key = jax.random.PRNGKey(0)
+    data = synthetic_lm_batches(8, 16, cfg.vocab_size, seed=3)
+    batch = next(data)
+
+    params = llama.init(key, cfg)
+    state_single = TrainState(params, opt.init(params), jnp.zeros((), jnp.int32))
+    step_single = make_train_step(lambda p, b: llama.loss_fn(p, cfg, b), opt)
+    _, m_single = step_single(state_single, batch)
+
+    mesh = build_mesh(MeshPlan(data=2, fsdp=2, tensor=2))
+    with mesh:
+        state_sharded = init_train_state(
+            lambda: llama.init(key, cfg), opt, mesh=mesh,
+            logical_tree=llama.logical_axes(cfg),
+        )
+        step_sharded = make_train_step(
+            lambda p, b: llama.loss_fn(p, cfg, b), opt, mesh=mesh
+        )
+        _, m_sharded = step_sharded(state_sharded, batch)
+
+    np.testing.assert_allclose(
+        float(m_single["loss"]), float(m_sharded["loss"]), rtol=1e-4
+    )
+
+
+def test_grad_accum_equivalent_to_large_batch():
+    cfg = tiny_cfg()
+    opt = optax.sgd(1e-2)
+    key = jax.random.PRNGKey(0)
+    batch = next(synthetic_lm_batches(8, 16, cfg.vocab_size, seed=1))
+
+    params = llama.init(key, cfg)
+
+    def run(grad_accum):
+        state = TrainState(params, opt.init(params), jnp.zeros((), jnp.int32))
+        step = make_train_step(
+            lambda p, b: llama.loss_fn(p, cfg, b), opt, grad_accum=grad_accum,
+            donate=False,
+        )
+        new_state, _ = step(state, batch)
+        return new_state.params
+
+    p1 = run(1)
+    p4 = run(4)
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p4)):
+        np.testing.assert_allclose(np.array(a), np.array(b), rtol=2e-4, atol=2e-5)
+
+
+def test_trainer_reports_throughput():
+    cfg = tiny_cfg()
+    opt = build_optimizer(learning_rate=1e-2)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    state = TrainState(params, opt.init(params), jnp.zeros((), jnp.int32))
+    step = make_train_step(lambda p, b: llama.loss_fn(p, cfg, b), opt)
+    trainer = Trainer(
+        step, state, synthetic_lm_batches(4, 32, cfg.vocab_size),
+        tokens_per_batch=4 * 32,
+    )
+    result = trainer.run(5)
+    assert result.steps == 5
+    assert result.tokens_per_sec > 0
+    assert result.final_metrics["loss"] > 0
+    assert len(result.loss_history) == 4  # first step is warmup
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from nexus_tpu.train.checkpoint import Checkpointer
+
+    cfg = tiny_cfg()
+    opt = optax.adam(1e-3)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    state = TrainState(params, opt.init(params), jnp.asarray(7, jnp.int32))
+
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), keep=2)
+    ckpt.save(state, wait=True)
+    assert ckpt.latest_step() == 7
+
+    # restore into zeros-shaped state
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, state)
+    restored = ckpt.restore(zeros)
+    ckpt.close()
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state), jax.tree_util.tree_leaves(restored)
+    ):
+        np.testing.assert_array_equal(np.array(a), np.array(b))
+
+
+def test_checkpoint_resume_continues_step(tmp_path):
+    from nexus_tpu.train.checkpoint import Checkpointer
+
+    cfg = tiny_cfg()
+    opt = optax.adam(1e-3)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    state = TrainState(params, opt.init(params), jnp.asarray(0, jnp.int32))
+    step = make_train_step(
+        lambda p, b: llama.loss_fn(p, cfg, b), opt, donate=False
+    )
+    data = synthetic_lm_batches(4, 16, cfg.vocab_size)
+    for _ in range(3):
+        state, _ = step(state, next(data))
+
+    ckpt = Checkpointer(str(tmp_path / "ckpt"))
+    ckpt.save(state, wait=True)
+    restored = ckpt.restore(jax.tree_util.tree_map(jnp.zeros_like, state))
+    ckpt.close()
+    assert int(restored.step) == 3
