@@ -1,0 +1,25 @@
+# Developer entry points.
+NATIVE_SRC := nexus_tpu/native/src/nexus_core.cpp
+NATIVE_LIB := nexus_tpu/native/libnexus_core.so
+
+.PHONY: all native test bench clean lint
+
+all: native
+
+native: $(NATIVE_LIB)
+
+$(NATIVE_LIB): $(NATIVE_SRC)
+	g++ -std=c++17 -O2 -fPIC -shared -pthread -o $@ $<
+
+test: native
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+lint:
+	ruff check nexus_tpu tests || true
+
+clean:
+	rm -f $(NATIVE_LIB)
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,0 +1,18 @@
+{{- define "nexus-tpu.name" -}}
+{{- .Chart.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "nexus-tpu.labels" -}}
+app.kubernetes.io/name: {{ include "nexus-tpu.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}
+
+{{- define "nexus-tpu.serviceAccountName" -}}
+{{- if .Values.serviceAccount.create -}}
+{{- default (include "nexus-tpu.name" .) .Values.serviceAccount.name -}}
+{{- else -}}
+{{- .Values.serviceAccount.name -}}
+{{- end -}}
+{{- end -}}

@@ -98,14 +98,19 @@ class ClusterStore:
     def _record(self, action: Action) -> None:
         self.actions.append(action)
 
-    def _notify(self, kind: str, event: WatchEvent) -> None:
-        """Deliver a watch event in commit order.
+    def _enqueue_event(self, kind: str, event: WatchEvent) -> None:
+        """Queue a watch event. MUST be called while still holding ``_lock``
+        in the same critical section as the mutation it describes — that is
+        what makes queue order equal commit order. (Enqueueing after
+        releasing the lock reintroduces the DELETED-overtakes-ADDED desync:
+        a preempted creator could append its ADDED after a later deleter's
+        DELETED.)"""
+        self._pending_events.append((kind, event))
 
-        The event is queued under the main lock by the mutator; whichever
-        thread holds the dispatch lock drains the queue, so ordering follows
+    def _drain_events(self) -> None:
+        """Deliver queued events. Called after releasing ``_lock``; whichever
+        thread holds the dispatch lock drains the queue, so delivery follows
         the queue (= commit order), not thread scheduling."""
-        with self._lock:
-            self._pending_events.append((kind, event))
         if getattr(self._draining, "active", False):
             return  # a callback mutated the store: the outer drain delivers it
         with self._dispatch_lock:
@@ -157,7 +162,8 @@ class ClusterStore:
                 )
             )
             out = stored.deepcopy()
-        self._notify(kind, WatchEvent("ADDED", out.deepcopy()))
+            self._enqueue_event(kind, WatchEvent("ADDED", out.deepcopy()))
+        self._drain_events()
         return out
 
     def get(self, kind: str, namespace: str, name: str) -> APIObject:
@@ -234,11 +240,14 @@ class ClusterStore:
                     )
                 )
             out = stored.deepcopy()
+            self._enqueue_event(
+                kind,
+                WatchEvent("DELETED" if finalize_now else "MODIFIED",
+                           out.deepcopy()),
+            )
+        self._drain_events()
         if finalize_now:
-            self._notify(kind, WatchEvent("DELETED", out.deepcopy()))
             self._garbage_collect(out)
-            return out
-        self._notify(kind, WatchEvent("MODIFIED", out.deepcopy()))
         return out
 
     def update_status(
@@ -269,7 +278,8 @@ class ClusterStore:
                 )
             )
             out = stored.deepcopy()
-        self._notify(kind, WatchEvent("MODIFIED", out.deepcopy()))
+            self._enqueue_event(kind, WatchEvent("MODIFIED", out.deepcopy()))
+        self._drain_events()
         return out
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -290,16 +300,16 @@ class ClusterStore:
                     current.metadata.resource_version = self._next_rv()
                     self._record(Action("delete", kind, namespace, name))
                     pending = current.deepcopy()
+                    self._enqueue_event(kind, WatchEvent("MODIFIED", pending))
                 # else: delete already pending; no-op
             else:
                 gone = bucket.pop(name)
                 self._record(Action("delete", kind, namespace, name))
                 out = gone.deepcopy()
-        if pending is not None:
-            self._notify(kind, WatchEvent("MODIFIED", pending))
+                self._enqueue_event(kind, WatchEvent("DELETED", gone.deepcopy()))
+        self._drain_events()
         if out is None:
             return
-        self._notify(kind, WatchEvent("DELETED", out))
         # Kubernetes-style cascading GC: children owned (by uid) by the
         # deleted object are collected. The reference leans on shard-local
         # ownerReference GC for synced secrets/configmaps (SURVEY §3.3 note).

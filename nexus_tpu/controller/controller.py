@@ -61,8 +61,6 @@ from nexus_tpu.controller.events import (
     REASON_SYNCED,
     EventRecorder,
 )
-from nexus_tpu.controller.ratelimit import default_controller_rate_limiter
-from nexus_tpu.controller.workqueue import RateLimitingQueue
 from nexus_tpu.shards.shard import Shard
 from nexus_tpu.utils.telemetry import (
     METRIC_RECONCILE_LATENCY,
@@ -109,6 +107,7 @@ class Controller:
         rate_limit_elements_burst: int = 300,
         use_finalizers: bool = False,
         resync_period: float = 30.0,
+        queue_backend: str = "auto",
     ):
         self.store = controller_store
         self.shards = list(shards)
@@ -119,13 +118,16 @@ class Controller:
         self.statsd = statsd or get_client()
         self.use_finalizers = use_finalizers
 
-        self.work_queue = RateLimitingQueue(
-            default_controller_rate_limiter(
-                base_delay=failure_rate_base_delay,
-                max_delay=failure_rate_max_delay,
-                rate=rate_limit_elements_per_second,
-                burst=rate_limit_elements_burst,
-            )
+        # native (C++) queue when it builds/loads; Python otherwise — both
+        # implement the same client-go contract (see nexus_tpu/native).
+        from nexus_tpu.native import make_queue
+
+        self.work_queue = make_queue(
+            base_delay=failure_rate_base_delay,
+            max_delay=failure_rate_max_delay,
+            rate=rate_limit_elements_per_second,
+            burst=rate_limit_elements_burst,
+            backend=queue_backend,
         )
 
         self.template_informer = self.informers.informer(NexusAlgorithmTemplate.KIND)

@@ -50,6 +50,7 @@ def build_controller(config: AppConfig, controller_store: Optional[ClusterStore]
         rate_limit_elements_burst=config.rate_limit_elements_burst,
         use_finalizers=config.use_finalizers,
         resync_period=config.resync_period_seconds,
+        queue_backend=config.queue_backend,
     )
 
 

@@ -1,0 +1,249 @@
+"""Native (C++) controller runtime core.
+
+The reference ships its runtime as a native static Go binary
+(reference: .container/Dockerfile:14, CGO_ENABLED=0); here the equivalent
+hot-path primitives — the rate-limited work queue and the
+MaxOf(exponential, token-bucket) rate limiter (reference:
+controller.go:123-128, :257-260) — are implemented in C++
+(``src/nexus_core.cpp``), compiled on demand with ``g++``, and bound via
+``ctypes``. The pure-Python implementations in
+``nexus_tpu.controller.workqueue`` remain as a fallback; both pass the
+same semantics test suite.
+
+``load()`` returns the ctypes library or ``None`` (never raises);
+``NativeRateLimitingQueue`` mirrors the Python ``RateLimitingQueue`` API
+and maps arbitrary hashable items onto stable string keys.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "nexus_core.cpp")
+_LIB = os.path.join(_HERE, "libnexus_core.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    """Compile the shared library if missing or stale. Returns success."""
+    try:
+        if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(
+            _SRC
+        ):
+            return True
+        tmp = f"{_LIB}.{os.getpid()}.tmp"  # unique per process: two
+        # concurrent builders must not interleave g++ output in one file
+        cmd = [
+            "g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+            "-o", tmp, _SRC,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except Exception:
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None on any failure."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        if os.environ.get("NEXUS_NATIVE", "1") in ("0", "false", "no"):
+            _load_failed = True
+            return None
+        if not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.ncq_new.restype = ctypes.c_void_p
+        lib.ncq_new.argtypes = [
+            ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_int,
+        ]
+        lib.ncq_free.argtypes = [ctypes.c_void_p]
+        lib.ncq_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ncq_get.restype = ctypes.c_int
+        lib.ncq_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_double, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.ncq_done.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ncq_add_after.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double,
+        ]
+        lib.ncq_add_rate_limited.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ncq_forget.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ncq_num_requeues.restype = ctypes.c_int
+        lib.ncq_num_requeues.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ncq_len.restype = ctypes.c_int
+        lib.ncq_len.argtypes = [ctypes.c_void_p]
+        lib.ncq_tracked.restype = ctypes.c_int
+        lib.ncq_tracked.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ncq_shut_down.argtypes = [ctypes.c_void_p]
+        lib.ncq_shutting_down.restype = ctypes.c_int
+        lib.ncq_shutting_down.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+_KEY_BUF_LEN = 4096
+
+
+class NativeRateLimitingQueue:
+    """ctypes front-end over the C++ queue; API-compatible with
+    ``nexus_tpu.controller.workqueue.RateLimitingQueue``.
+
+    Item contract: items must have a **value-based, injective** ``repr``
+    consistent with their ``__eq__``/``__hash__`` — true for strings and
+    frozen dataclasses of strings (the controller's ``Element``). Items whose
+    repr carries a memory address (default ``object.__repr__``) or exceeds
+    the key buffer are rejected, because they would break the dedup /
+    per-key-serialization contract. The key->object map is pruned whenever
+    the native queue reports a key fully untracked.
+    """
+
+    def __init__(
+        self,
+        base_delay: float = 0.030,
+        max_delay: float = 5.0,
+        rate: float = 50.0,
+        burst: int = 300,
+    ):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native nexus_core library unavailable")
+        self._lib = lib
+        self._q = lib.ncq_new(base_delay, max_delay, rate, burst)
+        self._items: Dict[bytes, Any] = {}
+        self._items_lock = threading.Lock()
+
+    # ------------------------------------------------------------- key codec
+    def _encode(self, item: Any) -> bytes:
+        r = repr(item)
+        if r.startswith("<") and " object at 0x" in r:
+            raise TypeError(
+                f"item {type(item).__name__} has an identity-based repr; "
+                "native queue items need a value-based repr (string or "
+                "frozen dataclass)"
+            )
+        key = r.encode()
+        if len(key) >= _KEY_BUF_LEN:
+            raise ValueError(
+                f"item repr exceeds {_KEY_BUF_LEN - 1} bytes; cannot key it"
+            )
+        return key
+
+    def _prune_locked(self, key: bytes) -> None:
+        if not self._lib.ncq_tracked(self._q, key):
+            self._items.pop(key, None)
+
+    # ------------------------------------------------------------------ API
+    # Every map-insert is atomic with the native call that makes the key
+    # tracked, and every prune is atomic with its untracked-check; so a get()
+    # that returns a key always finds its mapping (a key handed out is in
+    # processing_, hence tracked, hence never pruned concurrently).
+    def add(self, item: Any) -> None:
+        key = self._encode(item)
+        with self._items_lock:
+            self._items[key] = item
+            self._lib.ncq_add(self._q, key)
+
+    def get(self, timeout: Optional[float] = None) -> Tuple[Any, bool]:
+        # ncq_get blocks — must NOT hold the map lock here.
+        buf = ctypes.create_string_buffer(_KEY_BUF_LEN)
+        rc = self._lib.ncq_get(
+            self._q, -1.0 if timeout is None else float(timeout), buf,
+            _KEY_BUF_LEN,
+        )
+        if rc == 1:
+            return None, False  # timeout
+        if rc == 2:
+            return None, True  # shutdown
+        with self._items_lock:
+            item = self._items[buf.value]
+        return item, False
+
+    def done(self, item: Any) -> None:
+        key = self._encode(item)
+        with self._items_lock:
+            self._items[key] = item
+            self._lib.ncq_done(self._q, key)
+            self._prune_locked(key)
+
+    def add_after(self, item: Any, delay: float) -> None:
+        key = self._encode(item)
+        with self._items_lock:
+            self._items[key] = item
+            self._lib.ncq_add_after(self._q, key, float(delay))
+
+    def add_rate_limited(self, item: Any) -> None:
+        key = self._encode(item)
+        with self._items_lock:
+            self._items[key] = item
+            self._lib.ncq_add_rate_limited(self._q, key)
+
+    def forget(self, item: Any) -> None:
+        key = self._encode(item)
+        with self._items_lock:
+            self._lib.ncq_forget(self._q, key)
+            self._prune_locked(key)
+
+    def num_requeues(self, item: Any) -> int:
+        return int(self._lib.ncq_num_requeues(self._q, self._encode(item)))
+
+    def __len__(self) -> int:
+        return int(self._lib.ncq_len(self._q))
+
+    def shutting_down(self) -> bool:
+        return bool(self._lib.ncq_shutting_down(self._q))
+
+    def shut_down(self) -> None:
+        self._lib.ncq_shut_down(self._q)
+
+    def __del__(self):
+        try:
+            self._lib.ncq_free(self._q)
+        except Exception:
+            pass
+
+
+def make_queue(
+    base_delay: float = 0.030,
+    max_delay: float = 5.0,
+    rate: float = 50.0,
+    burst: int = 300,
+    backend: str = "auto",
+):
+    """Construct the best available rate-limited queue.
+
+    ``backend``: ``auto`` (native if it builds/loads, else Python),
+    ``native`` (raise if unavailable), ``python``.
+    """
+    if backend not in ("auto", "native", "python"):
+        raise ValueError(f"unknown queue backend {backend!r}")
+    if backend == "native" or (backend == "auto" and available()):
+        return NativeRateLimitingQueue(base_delay, max_delay, rate, burst)
+    from nexus_tpu.controller.ratelimit import default_controller_rate_limiter
+    from nexus_tpu.controller.workqueue import RateLimitingQueue
+
+    return RateLimitingQueue(
+        default_controller_rate_limiter(base_delay, max_delay, rate, burst)
+    )

@@ -1,0 +1,326 @@
+// nexus_core — native controller runtime core.
+//
+// C++ re-implementation of the hot controller-runtime primitives whose
+// semantics the reference gets from Go client-go (reference:
+// controller.go:123-128 workqueue contract; controller.go:257-260 rate
+// limiter construction; defaults .helm/values.yaml:159-169):
+//
+//   * rate-limited work queue: dedup of waiting keys, per-key
+//     serialization (a key being processed is never handed to a second
+//     worker; re-adds park in the dirty set and requeue on done), delayed
+//     adds, shutdown draining blocked getters;
+//   * MaxOf(per-item-exponential-backoff, global-token-bucket) rate
+//     limiter with Forget/NumRequeues.
+//
+// Exposed as a flat extern "C" API consumed from Python via ctypes
+// (nexus_tpu/native/__init__.py). Items are opaque NUL-terminated string
+// keys; the Python wrapper owns the key<->object mapping.
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <tuple>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+double now_s() {
+  return std::chrono::duration<double>(Clock::now().time_since_epoch()).count();
+}
+
+// ---------------------------------------------------------------- rate limit
+
+// Per-item exponential backoff: base * 2^failures, capped at max.
+class ItemExponentialLimiter {
+ public:
+  ItemExponentialLimiter(double base_delay, double max_delay)
+      : base_(base_delay), max_(max_delay) {}
+
+  double when(const std::string& key) {
+    int exp;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      exp = failures_[key]++;
+    }
+    double delay = base_;
+    for (int i = 0; i < exp; ++i) {
+      delay *= 2.0;
+      if (delay >= max_) return max_;
+    }
+    return delay < max_ ? delay : max_;
+  }
+
+  void forget(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    failures_.erase(key);
+  }
+
+  int num_requeues(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = failures_.find(key);
+    return it == failures_.end() ? 0 : it->second;
+  }
+
+ private:
+  double base_, max_;
+  std::mutex mu_;
+  std::unordered_map<std::string, int> failures_;
+};
+
+// Global token bucket with reservation semantics: always admits, returns the
+// wait for the (possibly future-borrowed) token — golang.org/x/time/rate
+// Reserve().Delay() behavior.
+class BucketLimiter {
+ public:
+  BucketLimiter(double rate, int burst)
+      : rate_(rate), burst_(burst), tokens_(burst), last_(now_s()) {}
+
+  double when() {
+    std::lock_guard<std::mutex> g(mu_);
+    double now = now_s();
+    tokens_ += (now - last_) * rate_;
+    if (tokens_ > burst_) tokens_ = burst_;
+    last_ = now;
+    tokens_ -= 1.0;
+    if (tokens_ >= 0) return 0.0;
+    return -tokens_ / rate_;
+  }
+
+ private:
+  double rate_;
+  double burst_;
+  double tokens_;
+  double last_;
+  std::mutex mu_;
+};
+
+// ----------------------------------------------------------------- workqueue
+
+// Rate-limited work queue (client-go workqueue.Type +
+// TypedRateLimitingInterface, combined).
+class WorkQueue {
+ public:
+  WorkQueue(double base_delay, double max_delay, double rate, int burst)
+      : item_limiter_(base_delay, max_delay), bucket_(rate, burst) {
+    delay_thread_ = std::thread([this] { delay_loop(); });
+  }
+
+  ~WorkQueue() {
+    shut_down();
+    if (delay_thread_.joinable()) delay_thread_.join();
+  }
+
+  void add(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    add_locked(key);
+  }
+
+  // 0 = item written to out, 1 = timeout, 2 = shutdown.
+  int get(double timeout_s, char* out, int out_len) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [this] { return !queue_.empty() || shutting_down_; };
+    if (timeout_s < 0) {
+      cv_.wait(lk, pred);
+    } else if (!cv_.wait_for(lk, std::chrono::duration<double>(timeout_s),
+                             pred)) {
+      return 1;
+    }
+    if (queue_.empty()) return 2;  // shutdown drained
+    std::string key = std::move(queue_.front());
+    queue_.pop_front();
+    processing_.insert(key);
+    dirty_.erase(key);
+    std::snprintf(out, out_len, "%s", key.c_str());
+    return 0;
+  }
+
+  void done(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    processing_.erase(key);
+    if (dirty_.count(key)) {
+      queue_.push_back(key);
+      cv_.notify_one();
+    }
+  }
+
+  void add_after(const std::string& key, double delay_s) {
+    if (delay_s <= 0) {
+      add(key);
+      return;
+    }
+    std::lock_guard<std::mutex> g(mu_);
+    if (shutting_down_) return;
+    delay_heap_.emplace(now_s() + delay_s, seq_++, key);
+    delayed_count_[key]++;
+    delay_cv_.notify_one();  // wake the delay loop to re-evaluate its deadline
+  }
+
+  // True while the queue still references the key in any state (waiting,
+  // processing, or pending delayed delivery). Lets the caller garbage-collect
+  // its key->object map. Queued keys are always in dirty_, so dirty_ covers
+  // the waiting state.
+  bool tracked(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    return dirty_.count(key) || processing_.count(key) ||
+           delayed_count_.count(key);
+  }
+
+  void add_rate_limited(const std::string& key) {
+    double d1 = item_limiter_.when(key);
+    double d2 = bucket_.when();
+    add_after(key, d1 > d2 ? d1 : d2);  // MaxOf combination
+  }
+
+  void forget(const std::string& key) { item_limiter_.forget(key); }
+
+  int num_requeues(const std::string& key) {
+    return item_limiter_.num_requeues(key);
+  }
+
+  int len() {
+    std::lock_guard<std::mutex> g(mu_);
+    return static_cast<int>(queue_.size());
+  }
+
+  bool shutting_down() {
+    std::lock_guard<std::mutex> g(mu_);
+    return shutting_down_;
+  }
+
+  void shut_down() {
+    std::lock_guard<std::mutex> g(mu_);
+    shutting_down_ = true;
+    cv_.notify_all();
+    delay_cv_.notify_all();
+  }
+
+ private:
+  void add_locked(const std::string& key) {
+    if (shutting_down_) return;
+    if (dirty_.count(key)) return;  // dedup waiting keys
+    dirty_.insert(key);
+    if (processing_.count(key)) return;  // park until done()
+    queue_.push_back(key);
+    cv_.notify_one();
+  }
+
+  void delay_loop() {
+    // Waits on its own condvar so getter-bound notify_one calls on cv_ are
+    // never consumed here (lost-wakeup hazard).
+    std::unique_lock<std::mutex> lk(mu_);
+    while (!shutting_down_) {
+      if (delay_heap_.empty()) {
+        delay_cv_.wait(
+            lk, [this] { return shutting_down_ || !delay_heap_.empty(); });
+        continue;
+      }
+      const auto& top = delay_heap_.top();
+      double ready_at = std::get<0>(top);
+      double now = now_s();
+      if (ready_at <= now) {
+        std::string key = std::get<2>(top);
+        delay_heap_.pop();
+        auto it = delayed_count_.find(key);
+        if (it != delayed_count_.end() && --it->second <= 0)
+          delayed_count_.erase(it);
+        add_locked(key);
+      } else {
+        delay_cv_.wait_for(lk, std::chrono::duration<double>(ready_at - now));
+      }
+    }
+  }
+
+  struct HeapCmp {
+    // min-heap by (ready_at, seq)
+    bool operator()(const std::tuple<double, uint64_t, std::string>& a,
+                    const std::tuple<double, uint64_t, std::string>& b) const {
+      if (std::get<0>(a) != std::get<0>(b))
+        return std::get<0>(a) > std::get<0>(b);
+      return std::get<1>(a) > std::get<1>(b);
+    }
+  };
+
+  std::mutex mu_;
+  std::condition_variable cv_;        // getters
+  std::condition_variable delay_cv_;  // delay-delivery thread
+  std::deque<std::string> queue_;
+  std::unordered_set<std::string> dirty_;
+  std::unordered_set<std::string> processing_;
+  std::priority_queue<std::tuple<double, uint64_t, std::string>,
+                      std::vector<std::tuple<double, uint64_t, std::string>>,
+                      HeapCmp>
+      delay_heap_;
+  std::unordered_map<std::string, int> delayed_count_;
+  uint64_t seq_ = 0;
+  bool shutting_down_ = false;
+  std::thread delay_thread_;
+
+  ItemExponentialLimiter item_limiter_;
+  BucketLimiter bucket_;
+};
+
+}  // namespace
+
+// ------------------------------------------------------------------- C API
+
+extern "C" {
+
+void* ncq_new(double base_delay, double max_delay, double rate, int burst) {
+  return new WorkQueue(base_delay, max_delay, rate, burst);
+}
+
+void ncq_free(void* q) { delete static_cast<WorkQueue*>(q); }
+
+void ncq_add(void* q, const char* key) {
+  static_cast<WorkQueue*>(q)->add(key);
+}
+
+int ncq_get(void* q, double timeout_s, char* out, int out_len) {
+  return static_cast<WorkQueue*>(q)->get(timeout_s, out, out_len);
+}
+
+void ncq_done(void* q, const char* key) {
+  static_cast<WorkQueue*>(q)->done(key);
+}
+
+void ncq_add_after(void* q, const char* key, double delay_s) {
+  static_cast<WorkQueue*>(q)->add_after(key, delay_s);
+}
+
+void ncq_add_rate_limited(void* q, const char* key) {
+  static_cast<WorkQueue*>(q)->add_rate_limited(key);
+}
+
+void ncq_forget(void* q, const char* key) {
+  static_cast<WorkQueue*>(q)->forget(key);
+}
+
+int ncq_num_requeues(void* q, const char* key) {
+  return static_cast<WorkQueue*>(q)->num_requeues(key);
+}
+
+int ncq_len(void* q) { return static_cast<WorkQueue*>(q)->len(); }
+
+int ncq_tracked(void* q, const char* key) {
+  return static_cast<WorkQueue*>(q)->tracked(key) ? 1 : 0;
+}
+
+void ncq_shut_down(void* q) { static_cast<WorkQueue*>(q)->shut_down(); }
+
+int ncq_shutting_down(void* q) {
+  return static_cast<WorkQueue*>(q)->shutting_down() ? 1 : 0;
+}
+
+int ncq_abi_version() { return 1; }
+
+}  // extern "C"

@@ -94,7 +94,6 @@ def plan_for_devices(
     sizes = {a: 1 for a in AXES}
     remaining = n
     if "tensor" in prefer and remaining > 1:
-        t = math.gcd(remaining, max_tensor)
         # largest power-of-two divisor of n, capped
         t = 1
         while t * 2 <= max_tensor and remaining % (t * 2) == 0:

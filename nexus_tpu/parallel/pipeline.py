@@ -1,0 +1,188 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over the
+``pipeline`` mesh axis.
+
+NOT PRESENT in the reference (SURVEY.md §2c — no model code at all); built
+TPU-first rather than translated: the model's stacked-layer parameter layout
+(models/llama.py) means a "stage" is just a contiguous slice of the stacked
+layer dim, so sharding that dim with ``P('pipeline')`` inside ``shard_map``
+gives each device its stage's weights with zero reshuffling. The schedule is
+the classic bubble-filled GPipe loop:
+
+    ticks t = 0 .. M + S - 2   (M microbatches, S stages)
+      * stage 0 injects microbatch t (while t < M);
+      * every stage applies its layer slice to its current activation;
+      * activations hop stage→stage+1 via ``lax.ppermute`` (ICI/DCN
+        neighbor hop — this is why 'pipeline' is the outermost mesh axis,
+        parallel/mesh.py);
+      * the last stage emits outputs for ticks t >= S-1.
+
+All stages run identical SPMD code (shard_map requirement); stage identity
+comes from ``lax.axis_index``. Autodiff flows through ppermute + scan, so
+the same forward drives pipelined training (full-activation GPipe; no 1F1B
+yet). Output is returned sharded ``P('pipeline')`` on a leading per-stage
+dim — reading ``[-1]`` pulls only the last stage's shard, no collective.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+
+def _pipeline_body(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    axis: str,
+    local_params: Any,
+    x_mb: jnp.ndarray,
+) -> jnp.ndarray:
+    """Per-device pipeline schedule. ``x_mb``: (M, ...) microbatched
+    activations (replicated across the pipeline axis); returns (1, M, ...)
+    — this stage's row of the per-stage output array."""
+    n_stages = lax.axis_size(axis)
+    stage = lax.axis_index(axis)
+    m = x_mb.shape[0]
+    n_ticks = m + n_stages - 1
+
+    buf0 = jnp.zeros_like(x_mb[0])
+    out0 = jnp.zeros_like(x_mb)
+
+    def tick(carry, t):
+        buf, out = carry
+        # stage 0 injects microbatch t (clamped index; masked past M)
+        inject = lax.dynamic_index_in_dim(
+            x_mb, jnp.minimum(t, m - 1), axis=0, keepdims=False
+        )
+        live = (t < m).astype(x_mb.dtype)
+        x_in = jnp.where(stage == 0, inject * live, buf)
+
+        y = stage_fn(local_params, x_in)
+
+        # last stage records its result at slot t-(S-1) (clamped; ticks
+        # before the pipeline fills write into slot 0 and are overwritten
+        # by the real slot-0 result at t = S-1)
+        slot = jnp.clip(t - (n_stages - 1), 0, m - 1)
+        record = jnp.logical_and(stage == n_stages - 1, t >= n_stages - 1)
+        cur = lax.dynamic_index_in_dim(out, slot, axis=0, keepdims=False)
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(record, y, cur), slot, axis=0
+        )
+
+        # hop to the next stage (wrap-around hop into stage 0 is ignored —
+        # stage 0 always injects)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        buf = lax.ppermute(y, axis, perm)
+        return (buf, out), None
+
+    (_, out), _ = lax.scan(tick, (buf0, out0), jnp.arange(n_ticks))
+    return out[None]  # (1, M, ...) — per-stage leading dim
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    params: Any,
+    x_mb: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    axis: str = "pipeline",
+    params_spec: Any = None,
+    x_spec: P = None,
+) -> jnp.ndarray:
+    """Run microbatched activations through pipeline stages.
+
+    ``params`` must have a leading stacked-layer dim divisible by the
+    pipeline axis size; it is sharded ``P('pipeline')`` so each device holds
+    its stage's contiguous layer slice. ``x_mb`` is (M, ...) microbatches.
+    Returns (M, ...) outputs of the final stage (lazily read from the last
+    stage's shard)."""
+    n_stages = mesh.shape[axis]
+    layer_spec = params_spec or jax.tree_util.tree_map(
+        lambda _: P(axis), params
+    )
+    in_x_spec = x_spec or P()
+    kwargs = dict(
+        mesh=mesh,
+        in_specs=(layer_spec, in_x_spec),
+        out_specs=P(axis, *([None] * (x_mb.ndim))),
+    )
+    # replication checking is off: output is intentionally stage-varying
+    # (kwarg renamed check_rep → check_vma across jax versions)
+    import inspect
+
+    if "check_vma" in inspect.signature(shard_map).parameters:
+        kwargs["check_vma"] = False
+    else:
+        kwargs["check_rep"] = False
+    fn = shard_map(functools.partial(_pipeline_body, stage_fn, axis), **kwargs)
+    staged = fn(params, x_mb)  # (S, M, ...)
+    return staged[n_stages - 1]
+
+
+# ----------------------------------------------------- llama integration
+
+
+def llama_pipeline_forward(
+    params: Dict[str, Any],
+    cfg,
+    tokens: jnp.ndarray,
+    mesh: Mesh,
+    n_microbatches: int,
+) -> jnp.ndarray:
+    """Llama forward with layers pipelined over the 'pipeline' mesh axis.
+
+    Embedding and the LM head are replicated (cheap vs the layer stack);
+    the (B, S) batch is split into M microbatches along batch."""
+    from nexus_tpu.models.llama import _block  # stacked-layer block
+    from nexus_tpu.ops.norms import rms_norm
+    from nexus_tpu.ops.rope import rope_cos_sin
+
+    b, s = tokens.shape
+    if b % n_microbatches:
+        raise ValueError(f"batch {b} not divisible by microbatches {n_microbatches}")
+    n_stages = mesh.shape["pipeline"]
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by {n_stages} stages"
+        )
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x_mb = x.reshape(n_microbatches, b // n_microbatches, s, cfg.d_model)
+    cos, sin = rope_cos_sin(s, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32)
+
+    def stage_fn(layers_local, h):
+        def body(h, layer):
+            return _block(cfg, h, layer, cos, sin), None
+
+        h, _ = lax.scan(body, h, layers_local)
+        return h
+
+    layer_spec = jax.tree_util.tree_map(lambda _: P("pipeline"), params["layers"])
+    y_mb = pipeline_apply(
+        stage_fn, params["layers"], x_mb, mesh,
+        params_spec=layer_spec, x_spec=P(),
+    )
+    y = y_mb.reshape(b, s, cfg.d_model)
+    y = rms_norm(y, params["final_norm"], cfg.norm_eps)
+    return (y @ params["lm_head"]).astype(jnp.float32)
+
+
+def llama_pipeline_loss(
+    params: Dict[str, Any], cfg, batch: Dict[str, jnp.ndarray],
+    mesh: Mesh, n_microbatches: int,
+) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = llama_pipeline_forward(params, cfg, inputs, mesh, n_microbatches)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    loss = -jnp.mean(ll)
+    return loss, {"loss": loss, "perplexity": jnp.exp(loss)}

@@ -153,8 +153,12 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps):
 
 
 def _run_infer(runtime, family, cfg, mesh):
-    if runtime.model.family == "mlp":
-        raise ValueError("infer mode is for autoregressive families")
+    gen = getattr(family, "generate", None)
+    if gen is None:
+        raise ValueError(
+            f"model family {runtime.model.family!r} does not support "
+            "mode='infer' (no generate()); use mode='train'"
+        )
     import time
 
     tr = runtime.train  # batch/seq knobs reused for inference shapes
@@ -165,7 +169,6 @@ def _run_infer(runtime, family, cfg, mesh):
             key, (tr.batch_size, min(32, tr.seq_len)), 0, cfg.vocab_size,
             dtype=jnp.int32,
         )
-        gen = family.generate  # llama-style families expose generate()
         max_new = min(64, cfg.max_seq_len - prompt.shape[1])
         out = gen(params, cfg, prompt, max_new)  # compile + run
         jax.block_until_ready(out)

@@ -49,6 +49,9 @@ class LocalLauncher:
         self.devices = devices
         self._seen_generations: Dict[str, int] = {}
         self._threads: Dict[str, threading.Thread] = {}
+        # newest template revision that arrived while its job was running;
+        # re-launched when the running job finishes
+        self._pending: Dict[str, NexusAlgorithmTemplate] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
 
@@ -62,10 +65,17 @@ class LocalLauncher:
         self._stop.set()
         self.store.unsubscribe(NexusAlgorithmTemplate.KIND, self._on_event)
         if wait:
-            with self._lock:
-                threads = list(self._threads.values())
-            for t in threads:
-                t.join(timeout=60.0)
+            # loop: a deferred pending-relaunch racing _stop may insert one
+            # more thread after the first snapshot; re-snapshot until quiet
+            while True:
+                with self._lock:
+                    threads = [
+                        t for t in self._threads.values() if t.is_alive()
+                    ]
+                if not threads:
+                    return
+                for t in threads:
+                    t.join(timeout=60.0)
 
     def wait_idle(self, timeout: float = 120.0) -> bool:
         import time
@@ -88,14 +98,23 @@ class LocalLauncher:
     def _maybe_launch(self, tmpl: NexusAlgorithmTemplate) -> None:
         if tmpl.spec.runtime is None:
             return
+        if self._stop.is_set():
+            return
         key = tmpl.key()
         gen = tmpl.metadata.generation
         with self._lock:
-            if self._seen_generations.get(key) == gen:
-                return  # this spec generation already ran/running
+            if self._seen_generations.get(key, -1) >= gen:
+                return  # this (or a newer) spec generation already ran/running
             running = self._threads.get(key)
             if running is not None and running.is_alive():
-                return  # one job per template at a time
+                # one job per template at a time — park the NEWEST revision
+                # (generation-ordered: a deferred relaunch of an older
+                # revision must not clobber a newer parked one);
+                # _execute re-launches it when the running job finishes
+                parked = self._pending.get(key)
+                if parked is None or parked.metadata.generation < gen:
+                    self._pending[key] = tmpl
+                return
             self._seen_generations[key] = gen
             t = threading.Thread(
                 target=self._execute, args=(tmpl,), daemon=True,
@@ -106,6 +125,18 @@ class LocalLauncher:
 
     # -------------------------------------------------------------- execution
     def _execute(self, tmpl: NexusAlgorithmTemplate) -> None:
+        try:
+            self._execute_inner(tmpl)
+        finally:
+            key = tmpl.key()
+            with self._lock:
+                if self._threads.get(key) is threading.current_thread():
+                    del self._threads[key]
+                pending = self._pending.pop(key, None)
+            if pending is not None and not self._stop.is_set():
+                self._maybe_launch(pending)
+
+    def _execute_inner(self, tmpl: NexusAlgorithmTemplate) -> None:
         name = tmpl.metadata.name
         try:
             # production code path: manifest materialization must succeed

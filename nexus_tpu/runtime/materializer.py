@@ -24,6 +24,17 @@ LABEL_SLICE_INDEX = f"{GROUP}/slice-index"
 ANNOTATION_RUNTIME = f"{GROUP}/runtime"
 
 
+def _slice_job_name(template: NexusAlgorithmTemplate, slice_count: int,
+                    slice_idx: int) -> str:
+    """Canonical per-slice Job name — also the pods' subdomain and the
+    headless-Service name, so coordinator DNS ('<job>-0.<job>') resolves.
+    Single source of truth: materialize_job, the coordinator address, and
+    materialize_headless_service must all agree."""
+    return template.metadata.name + (
+        f"-s{slice_idx}" if slice_count > 1 else ""
+    )
+
+
 def materialize_job(
     template: NexusAlgorithmTemplate,
     workgroup: Optional[NexusAlgorithmWorkgroup] = None,
@@ -67,14 +78,15 @@ def materialize_job(
 
     jobs: List[Dict[str, Any]] = []
     for slice_idx in range(tpu.slice_count):
-        job_name = template.metadata.name + (
-            f"-s{slice_idx}" if tpu.slice_count > 1 else ""
-        )
-        coordinator = (
-            f"{template.metadata.name}-s0-0.{template.metadata.name}"
-            if tpu.slice_count > 1
-            else f"{job_name}-0.{job_name}"
-        )
+        job_name = _slice_job_name(template, tpu.slice_count, slice_idx)
+        # Indexed-Job pods are hostnamed "<job>-<index>" under the pod
+        # subdomain "<job>" (a headless Service with that name must exist —
+        # materialize_headless_service). The coordinator is pod 0 of slice 0,
+        # whose job is "<template>-s0" in multislice, so its FQDN component is
+        # "<template>-s0-0.<template>-s0" — NOT "<template>-s0-0.<template>"
+        # (that subdomain has no DNS record).
+        slice0_job = _slice_job_name(template, tpu.slice_count, 0)
+        coordinator = f"{slice0_job}-0.{slice0_job}"
         runtime_env = env + [
             {"name": "NEXUS_RUNTIME_SPEC", "value": _compact_json(rt.to_dict())},
             {"name": "NEXUS_SLICE_INDEX", "value": str(slice_idx)},
@@ -147,6 +159,55 @@ def materialize_job(
         }
         jobs.append(job)
     return jobs
+
+
+def materialize_headless_service(
+    template: NexusAlgorithmTemplate,
+) -> List[Dict[str, Any]]:
+    """Headless Services backing the per-slice pod subdomains.
+
+    Pod-subdomain DNS records only exist when a headless Service with the
+    subdomain's name selects the pods; real-cluster appliers must apply these
+    alongside the Jobs from :func:`materialize_job`."""
+    rt = template.spec.runtime
+    if rt is None:
+        return []
+    names = [
+        _slice_job_name(template, rt.tpu.slice_count, i)
+        for i in range(rt.tpu.slice_count)
+    ]
+    return [
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": n,
+                "namespace": template.metadata.namespace,
+                "labels": {
+                    LABEL_CONTROLLER_APP: CONTROLLER_APP_NAME,
+                    LABEL_TEMPLATE: template.metadata.name,
+                },
+                "ownerReferences": [
+                    {
+                        "apiVersion": f"{GROUP}/v1",
+                        "kind": template.KIND,
+                        "name": template.metadata.name,
+                        "uid": template.metadata.uid,
+                    }
+                ],
+            },
+            "spec": {
+                "clusterIP": "None",
+                # publish hostname records before pods pass readiness: all
+                # slice pods start together and workers must resolve the
+                # coordinator during startup (the JobSet pattern)
+                "publishNotReadyAddresses": True,
+                "selector": {LABEL_TEMPLATE: template.metadata.name},
+                "ports": [{"port": 8476, "name": "jax-coordinator"}],
+            },
+        }
+        for n in names
+    ]
 
 
 def _resources(template: NexusAlgorithmTemplate, tpu) -> Dict[str, str]:

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from nexus_tpu.parallel.sharding import logical_to_spec
+from nexus_tpu.parallel.sharding import logical_to_spec, sharding_tree
 
 
 @jax.tree_util.register_dataclass
@@ -127,11 +127,7 @@ def init_train_state(
         params = init_params_fn()
         return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
 
-    spec_tree = jax.tree_util.tree_map(
-        lambda dims: NamedSharding(mesh, logical_to_spec(dims, rules)),
-        logical_tree,
-        is_leaf=lambda x: isinstance(x, tuple) or x is None,
-    )
+    spec_tree = sharding_tree(logical_tree, mesh, rules)
     params = jax.jit(init_params_fn, out_shardings=spec_tree)()
     opt_state = jax.jit(
         optimizer.init,

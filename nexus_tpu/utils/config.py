@@ -40,6 +40,7 @@ class AppConfig:
     statsd_address: str = ""
     use_finalizers: bool = False
     resync_period_seconds: float = 30.0
+    queue_backend: str = "auto"  # auto | native (C++) | python
 
 
 def _coerce(value: Any, target_type: Any) -> Any:

@@ -1,0 +1,86 @@
+"""Tier-2 e2e against real Kubernetes clusters (reference:
+Test_ControllerMain, controller_test.go:1287-1336).
+
+Requires two reachable clusters with the CRDs installed (CI provisions kind
+clusters — .github/workflows/build.yaml "kind-e2e" job) and env:
+  NEXUS__CONTROLLER_CONFIG_PATH  kubeconfig of the controller cluster
+  NEXUS__SHARD_CONFIG_PATH       dir of <name>.kubeconfig shard files
+Skipped entirely when the env (or the kubernetes package) is absent, so the
+hermetic suite stays runnable everywhere.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+kubernetes = pytest.importorskip("kubernetes")
+
+CONTROLLER_KUBECONFIG = os.environ.get("NEXUS__CONTROLLER_CONFIG_PATH", "")
+SHARD_DIR = os.environ.get("NEXUS__SHARD_CONFIG_PATH", "")
+
+pytestmark = pytest.mark.skipif(
+    not (CONTROLLER_KUBECONFIG and os.path.isfile(CONTROLLER_KUBECONFIG)),
+    reason="no controller kubeconfig (set NEXUS__CONTROLLER_CONFIG_PATH)",
+)
+
+
+def wait_for(pred, timeout=30.0, interval=0.25):
+    deadline = time.monotonic() + timeout
+    last_err = None
+    while time.monotonic() < deadline:
+        try:
+            if pred():
+                return True
+        except Exception as e:  # noqa: BLE001 — remote API hiccups retry
+            last_err = e
+        time.sleep(interval)
+    if last_err:
+        raise last_err
+    return False
+
+
+def test_template_propagates_to_shard_cluster():
+    from nexus_tpu.api.template import NexusAlgorithmTemplate
+    from nexus_tpu.api.types import ObjectMeta
+    from nexus_tpu.cluster.kube import KubeClusterStore
+    from nexus_tpu.main import build_controller
+    from nexus_tpu.utils.config import AppConfig, load_config
+
+    config = load_config(AppConfig)
+    ns = config.controller_namespace or "default"
+    controller_store = KubeClusterStore("controller", CONTROLLER_KUBECONFIG, ns)
+    controller = build_controller(config, controller_store=controller_store)
+    assert controller.shards, "no shard kubeconfigs found"
+    shard_store = controller.shards[0].store
+
+    name = f"e2e-{int(time.time())}"
+    tmpl = NexusAlgorithmTemplate(metadata=ObjectMeta(name=name, namespace=ns))
+    tmpl.spec.container.image = "algo"
+    tmpl.spec.container.version_tag = "v1"
+
+    controller.run(workers=2)
+    try:
+        controller_store.create(tmpl)
+        assert wait_for(
+            lambda: shard_store.get(NexusAlgorithmTemplate.KIND, ns, name)
+            is not None
+        ), "template never appeared on shard cluster"
+
+        # spec update propagates
+        fresh = controller_store.get(NexusAlgorithmTemplate.KIND, ns, name)
+        fresh.spec.container.version_tag = "v2"
+        controller_store.update(fresh)
+        assert wait_for(
+            lambda: shard_store.get(
+                NexusAlgorithmTemplate.KIND, ns, name
+            ).spec.container.version_tag
+            == "v2"
+        ), "spec update never propagated"
+    finally:
+        try:
+            controller_store.delete(NexusAlgorithmTemplate.KIND, ns, name)
+        except Exception:
+            pass
+        controller.stop()

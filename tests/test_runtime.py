@@ -241,3 +241,53 @@ def test_launcher_records_failure():
         assert any(e.reason == "JobFailed" for e in launcher.recorder.events)
     finally:
         launcher.stop()
+
+
+def test_materializer_multislice_coordinator_resolves():
+    """Regression: every slice's JAX_COORDINATOR_ADDRESS must point at pod 0
+    of slice 0 under slice 0's OWN subdomain (pod-subdomain DNS records only
+    exist under the pod's job-named subdomain)."""
+    tmpl = template_with_runtime(
+        tpu=TpuSliceSpec(accelerator="v5e", topology="2x2", slice_count=2),
+        parallelism=ParallelismSpec(data=2, fsdp=2, tensor=2),
+    )
+    jobs = materialize_job(tmpl)
+    for job in jobs:
+        env = {
+            e["name"]: e["value"]
+            for e in job["spec"]["template"]["spec"]["containers"][0]["env"]
+        }
+        assert env["JAX_COORDINATOR_ADDRESS"] == "tpu-algo-s0-0.tpu-algo-s0:8476"
+    # the subdomains need headless Services to get DNS records
+    from nexus_tpu.runtime.materializer import materialize_headless_service
+
+    svcs = materialize_headless_service(tmpl)
+    assert [s["metadata"]["name"] for s in svcs] == ["tpu-algo-s0", "tpu-algo-s1"]
+    assert all(s["spec"]["clusterIP"] == "None" for s in svcs)
+
+
+def test_launcher_update_during_running_job_not_dropped():
+    """Regression: a spec update arriving while the previous job is still
+    running must be executed once that job finishes (not silently dropped)."""
+    store = ClusterStore("shard")
+    launcher = LocalLauncher(store)
+    launcher.start()
+    try:
+        tmpl = template_with_runtime(
+            train=TrainSpec(batch_size=256, steps=60, learning_rate=1e-2)
+        )
+        store.create(tmpl)
+        # immediately update the spec — the first job is still running
+        fresh = store.get(NexusAlgorithmTemplate.KIND, NS, "tpu-algo")
+        fresh.spec.runtime.train.steps = 3
+        updated = store.update(fresh)
+        final_gen = str(updated.metadata.generation)
+        assert wait_for(
+            lambda: store.get(ConfigMap.KIND, NS, "tpu-algo-result").data[
+                "generation"
+            ]
+            == final_gen,
+            timeout=120.0,
+        ), "updated generation never ran"
+    finally:
+        launcher.stop()

@@ -1,25 +1,83 @@
 """Workqueue semantics: dedup, per-key serialization, delayed/rate-limited
-adds, shutdown (the client-go contract, SURVEY.md §7 hard part (a))."""
+adds, shutdown (the client-go contract, SURVEY.md §7 hard part (a)).
+
+Runs the full suite against BOTH backends — the pure-Python queue and the
+native C++ queue (nexus_tpu/native/src/nexus_core.cpp) — so they stay in
+semantic lockstep.
+"""
 
 import threading
 import time
 
+import pytest
+
+from nexus_tpu import native
 from nexus_tpu.controller.ratelimit import ItemExponentialFailureRateLimiter
-from nexus_tpu.controller.workqueue import RateLimitingQueue, WorkQueue
+from nexus_tpu.controller.workqueue import RateLimitingQueue
 
 
-def test_add_dedups_waiting_items():
-    q = WorkQueue()
+def _make(backend, base_delay=0.030, max_delay=5.0):
+    if backend == "python":
+        return RateLimitingQueue(
+            ItemExponentialFailureRateLimiter(base_delay, max_delay)
+        )
+    if not native.available():
+        pytest.skip("native nexus_core unavailable (no g++?)")
+    return native.NativeRateLimitingQueue(base_delay, max_delay)
+
+
+@pytest.fixture(params=["python", "native"])
+def q(request):
+    return _make(request.param)
+
+
+def test_native_backend_builds_and_loads():
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ — Python fallback is the supported mode here")
+    assert native.available(), "C++ core must build when g++ is present"
+    assert isinstance(native.make_queue(), native.NativeRateLimitingQueue)
+
+
+def test_native_key_map_is_pruned():
+    """The key->object map must not grow monotonically in a long-running
+    controller (items are pruned once the native queue drops the key)."""
+    if not native.available():
+        pytest.skip("native nexus_core unavailable")
+    q = native.NativeRateLimitingQueue()
+    for i in range(50):
+        q.add(f"item-{i}")
+        item, _ = q.get(timeout=1.0)
+        q.forget(item)
+        q.done(item)
+    assert len(q._items) == 0
+
+
+def test_native_rejects_identity_repr_items():
+    if not native.available():
+        pytest.skip("native nexus_core unavailable")
+
+    class Opaque:
+        pass
+
+    q = native.NativeRateLimitingQueue()
+    with pytest.raises(TypeError):
+        q.add(Opaque())
+    with pytest.raises(ValueError):
+        q.add("x" * 5000)
+
+
+def test_add_dedups_waiting_items(q):
     q.add("a")
     q.add("a")
     q.add("b")
     assert len(q) == 2
 
 
-def test_per_key_serialization():
+def test_per_key_serialization(q):
     """A key being processed is never handed out again until done; re-adds
     during processing are parked and re-queued on done."""
-    q = WorkQueue()
     q.add("a")
     item, shutdown = q.get()
     assert item == "a" and not shutdown
@@ -36,30 +94,26 @@ def test_per_key_serialization():
     assert len(q) == 0
 
 
-def test_done_without_dirty_does_not_requeue():
-    q = WorkQueue()
+def test_done_without_dirty_does_not_requeue(q):
     q.add("a")
     item, _ = q.get()
     q.done(item)
     assert len(q) == 0
 
 
-def test_add_after_delivers_later():
-    q = WorkQueue()
+def test_add_after_delivers_later(q):
     q.add_after("late", 0.08)
     assert q.get(timeout=0.02) == (None, False)
     item, _ = q.get(timeout=2.0)
     assert item == "late"
 
 
-def test_add_after_zero_delay_is_immediate():
-    q = WorkQueue()
+def test_add_after_zero_delay_is_immediate(q):
     q.add_after("now", 0.0)
     assert len(q) == 1
 
 
-def test_shutdown_unblocks_getters():
-    q = WorkQueue()
+def test_shutdown_unblocks_getters(q):
     results = []
 
     def worker():
@@ -76,8 +130,9 @@ def test_shutdown_unblocks_getters():
     assert len(q) == 0
 
 
-def test_rate_limited_requeue_backs_off_and_forget_resets():
-    q = RateLimitingQueue(ItemExponentialFailureRateLimiter(0.01, 1.0))
+@pytest.mark.parametrize("backend", ["python", "native"])
+def test_rate_limited_requeue_backs_off_and_forget_resets(backend):
+    q = _make(backend, base_delay=0.01, max_delay=1.0)
     q.add_rate_limited("a")  # first failure: 10ms delay
     assert q.num_requeues("a") == 1
     item, _ = q.get(timeout=2.0)
@@ -87,8 +142,33 @@ def test_rate_limited_requeue_backs_off_and_forget_resets():
     assert q.num_requeues("a") == 0
 
 
-def test_concurrent_workers_never_process_same_key():
-    q = WorkQueue()
+@pytest.mark.parametrize("backend", ["python", "native"])
+def test_exponential_backoff_grows_per_item(backend):
+    q = _make(backend, base_delay=0.02, max_delay=5.0)
+    start = time.monotonic()
+    q.add_rate_limited("k")  # 20ms
+    q.get(timeout=2.0)
+    q.done("k")
+    q.add_rate_limited("k")  # 40ms
+    q.get(timeout=2.0)
+    q.done("k")
+    elapsed = time.monotonic() - start
+    assert elapsed >= 0.055  # 20ms + 40ms minus scheduling slack
+    assert q.num_requeues("k") == 2
+
+
+def test_non_string_items_round_trip(q):
+    """Controller enqueues frozen-dataclass Elements, not strings."""
+    from nexus_tpu.controller.controller import Element
+
+    e = Element("ns", "name", "template")
+    q.add(e)
+    item, _ = q.get(timeout=1.0)
+    assert item == e and item.obj_type == "template"
+    q.done(e)
+
+
+def test_concurrent_workers_never_process_same_key(q):
     in_flight = set()
     overlaps = []
     lock = threading.Lock()
