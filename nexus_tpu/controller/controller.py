@@ -37,6 +37,8 @@ from typing import List, Optional, Sequence, Tuple
 from nexus_tpu.api.template import NexusAlgorithmTemplate
 from nexus_tpu.api.types import (
     API_VERSION,
+    CONTROLLER_APP_NAME,
+    LABEL_CONTROLLER_APP,
     ConfigMap,
     OwnerReference,
     Secret,
@@ -152,8 +154,11 @@ class Controller:
             on_delete=self.handle_object_delete,
         )
         self.workgroup_informer.add_event_handler(
-            on_add=self.enqueue_resource,
-            on_update=lambda old, new: self.enqueue_resource(new),
+            on_add=self._handle_workgroup_event,
+            on_update=lambda old, new: self._handle_workgroup_event(new),
+            # deletion widens placement back to all shards — re-place
+            # referencing templates immediately, same as add/update
+            on_delete=self._handle_workgroup_event,
         )
         # Dependent resources: owner-resolution enqueue, with the
         # resourceVersion-equality resync skip (reference:
@@ -164,6 +169,16 @@ class Controller:
                 on_update=self._handle_dependent_update,
                 on_delete=self.handle_object,
             )
+
+    def _handle_workgroup_event(self, workgroup) -> None:
+        """Enqueue the workgroup itself plus every template whose
+        ``workgroup_ref`` names it — a workgroup appearing or changing its
+        cluster/capabilities must re-place referencing templates immediately,
+        not on the next resync."""
+        self.enqueue_resource(workgroup)
+        for template in self.template_lister.list(workgroup.metadata.namespace):
+            if template.spec.workgroup_ref.name == workgroup.metadata.name:
+                self.enqueue_resource(template)
 
     def _handle_dependent_update(self, old, new) -> None:
         if (
@@ -538,8 +553,35 @@ class Controller:
                 shard_lister._set(shard_obj)
 
     # ------------------------------------------------------------ sync handlers
-    def shard_names(self) -> List[str]:
-        return [s.name for s in self.shards]
+    def _resolve_placement(self, template: NexusAlgorithmTemplate) -> List[Shard]:
+        """Shards that should receive this template.
+
+        Reference parity: no resolvable workgroup → every shard
+        (controller.go:790). TPU extension (BASELINE config #5): a resolved
+        workgroup's cluster/capabilities select the matching slice pools;
+        unsatisfiable constraints are a warning event + SyncError (requeue).
+        """
+        from nexus_tpu.controller.placement import PlacementError, select_shards
+
+        ref = template.spec.workgroup_ref
+        workgroup = None
+        if ref.name:
+            try:
+                workgroup = self.workgroup_lister.get(
+                    template.namespace, ref.name
+                )
+            except NotFoundError:
+                workgroup = None
+        try:
+            return select_shards(template, workgroup, self.shards)
+        except PlacementError as e:
+            self.recorder.event(
+                template,
+                EVENT_TYPE_WARNING,
+                REASON_ERR_RESOURCE_SYNC,
+                str(e),
+            )
+            raise SyncError(str(e)) from e
 
     def template_sync_handler(self, namespace: str, name: str) -> None:
         """Core reconcile (reference: controller.go:761-845)."""
@@ -564,7 +606,9 @@ class Controller:
         template = self._report_template_init_condition(template)
         self._adopt_references(template)
 
-        for shard in self.shards:
+        placed_shards = self._resolve_placement(template)
+
+        for shard in placed_shards:
             shard_template: Optional[NexusAlgorithmTemplate]
             try:
                 shard_template = shard.template_lister.get(namespace, name)  # type: ignore[assignment]
@@ -607,11 +651,13 @@ class Controller:
                 shard,
             )
 
+        self._remove_from_unselected_shards(template, placed_shards)
+
         template = self._report_template_synced_condition(
             template,
             template.get_secret_names(),
             template.get_config_map_names(),
-            self.shard_names(),
+            [s.name for s in placed_shards],
         )
         self.recorder.event(
             template,
@@ -619,6 +665,37 @@ class Controller:
             REASON_SYNCED,
             MSG_RESOURCE_SYNCED.format(NexusAlgorithmTemplate.KIND),
         )
+
+    def _remove_from_unselected_shards(
+        self, template: NexusAlgorithmTemplate, placed_shards: List[Shard]
+    ) -> None:
+        """Delete this controller's copies of the template from shards that
+        placement no longer selects (e.g. the template fanned out everywhere
+        before its workgroup synced, then the workgroup narrowed placement).
+        Only copies stamped with our provenance label are touched — foreign
+        templates sharing the name are left alone."""
+        placed_names = {s.name for s in placed_shards}
+        for shard in self.shards:
+            if shard.name in placed_names:
+                continue
+            try:
+                stale = shard.template_lister.get(
+                    template.namespace, template.name
+                )
+            except NotFoundError:
+                continue
+            labels = stale.metadata.labels or {}
+            if labels.get(LABEL_CONTROLLER_APP) != CONTROLLER_APP_NAME:
+                continue
+            logger.info(
+                "removing template %s from shard %s (no longer selected by "
+                "placement)", template.key(), shard.name,
+            )
+            try:
+                shard.delete_template(stale)
+            except NotFoundError:
+                pass
+            shard.template_lister._delete(stale)
 
     def workgroup_sync_handler(self, namespace: str, name: str) -> None:
         """Workgroup reconcile: same shape, no dependents (reference:

@@ -1,0 +1,71 @@
+"""Topology-aware shard placement (BASELINE config #5).
+
+The reference syncs every template to every shard unconditionally
+(controller.go:790 — ``for _, shard := range c.nexusShards``); its
+``WorkgroupRef`` is carried on the spec but never consulted for placement.
+This build keeps that behavior as the default (no workgroup resolvable → all
+shards) and adds the TPU-native extension the north star asks for: a
+template's ``workgroup_ref`` resolves to a ``NexusAlgorithmWorkgroup`` whose
+``cluster`` / ``capabilities`` select the subset of shard clusters (TPU slice
+pools) that should receive the template.
+
+Matching rules, applied in order:
+  1. workgroup is None (no ref, or referenced workgroup not found in the
+     controller cluster) → all shards (reference parity).
+  2. ``spec.cluster`` non-empty → only shards whose name equals it.
+  3. ``spec.capabilities`` entries with value True → only shards advertising
+     every required capability (``Shard.capabilities``).
+  4. Constraints that match no connected shard are a placement error — the
+     sync fails and requeues until a matching shard connects, rather than
+     silently running the workload on the wrong pool.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from nexus_tpu.api.template import NexusAlgorithmTemplate
+from nexus_tpu.api.workgroup import NexusAlgorithmWorkgroup
+from nexus_tpu.shards.shard import Shard
+
+
+class PlacementError(RuntimeError):
+    """Workgroup constraints matched zero connected shards."""
+
+
+def required_capabilities(workgroup: NexusAlgorithmWorkgroup) -> List[str]:
+    return sorted(k for k, v in workgroup.spec.capabilities.items() if v)
+
+
+def select_shards(
+    template: NexusAlgorithmTemplate,
+    workgroup: Optional[NexusAlgorithmWorkgroup],
+    shards: Sequence[Shard],
+) -> List[Shard]:
+    """Shards that should receive ``template`` given its resolved workgroup."""
+    selected = list(shards)
+    if workgroup is None:
+        return selected
+
+    cluster = workgroup.spec.cluster
+    if cluster:
+        selected = [s for s in selected if s.name == cluster]
+        if not selected:
+            raise PlacementError(
+                f"workgroup {workgroup.name!r} pins cluster {cluster!r} "
+                f"but no connected shard has that name"
+            )
+
+    required = required_capabilities(workgroup)
+    if required:
+        selected = [
+            s
+            for s in selected
+            if all(s.capabilities.get(c, False) for c in required)
+        ]
+        if not selected:
+            raise PlacementError(
+                f"workgroup {workgroup.name!r} requires capabilities "
+                f"{required} but no connected shard advertises all of them"
+            )
+    return selected

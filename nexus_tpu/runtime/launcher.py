@@ -61,12 +61,16 @@ class LocalLauncher:
         for tmpl in self.store.list(NexusAlgorithmTemplate.KIND):
             self._maybe_launch(tmpl)
 
-    def stop(self, wait: bool = True) -> None:
+    def stop(self, wait: bool = True, timeout: float = 60.0) -> None:
+        import time
+
         self._stop.set()
         self.store.unsubscribe(NexusAlgorithmTemplate.KIND, self._on_event)
         if wait:
             # loop: a deferred pending-relaunch racing _stop may insert one
-            # more thread after the first snapshot; re-snapshot until quiet
+            # more thread after the first snapshot; re-snapshot until quiet,
+            # but bound the whole wait so one wedged job can't hang shutdown
+            deadline = time.monotonic() + timeout
             while True:
                 with self._lock:
                     threads = [
@@ -74,8 +78,15 @@ class LocalLauncher:
                     ]
                 if not threads:
                     return
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    logger.warning(
+                        "launcher stop: %d job thread(s) still running after "
+                        "%.0fs; abandoning wait", len(threads), timeout
+                    )
+                    return
                 for t in threads:
-                    t.join(timeout=60.0)
+                    t.join(timeout=max(0.05, remaining / len(threads)))
 
     def wait_idle(self, timeout: float = 120.0) -> bool:
         import time

@@ -151,7 +151,10 @@ def materialize_job(
                 "activeDeadlineSeconds": template.spec.runtime_environment.deadline_seconds,
                 "template": {
                     "metadata": {
-                        "labels": {LABEL_TEMPLATE: template.metadata.name}
+                        "labels": {
+                            LABEL_TEMPLATE: template.metadata.name,
+                            LABEL_SLICE_INDEX: str(slice_idx),
+                        }
                     },
                     "spec": pod_spec,
                 },
@@ -202,11 +205,16 @@ def materialize_headless_service(
                 # slice pods start together and workers must resolve the
                 # coordinator during startup (the JobSet pattern)
                 "publishNotReadyAddresses": True,
-                "selector": {LABEL_TEMPLATE: template.metadata.name},
+                # scope each subdomain to its own slice's pods — selecting on
+                # the template label alone would resolve cross-slice
+                "selector": {
+                    LABEL_TEMPLATE: template.metadata.name,
+                    LABEL_SLICE_INDEX: str(i),
+                },
                 "ports": [{"port": 8476, "name": "jax-coordinator"}],
             },
         }
-        for n in names
+        for i, n in enumerate(names)
     ]
 
 

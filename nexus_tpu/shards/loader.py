@@ -68,7 +68,30 @@ def load_shards(
 
 def _load_local_shard(alias: str, shard_name: str, path: str) -> Shard:
     store = get_local_store(shard_name)
-    return Shard(alias, shard_name, store)
+    return Shard(
+        alias, shard_name, store, capabilities=_read_capabilities(path)
+    )
+
+
+def _read_capabilities(path: str) -> Dict[str, bool]:
+    """Parse an optional ``capabilities:`` block from a shard config file.
+
+    The file is YAML; only a flat ``capabilities: {name: bool}`` mapping is
+    consulted. Anything unparseable degrades to no advertised capabilities.
+    """
+    try:
+        import yaml  # noqa: PLC0415
+
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+        caps = doc.get("capabilities") or {}
+        return {str(k): bool(v) for k, v in caps.items()}
+    except Exception as e:
+        logger.warning(
+            "could not read capabilities from %s (%s); shard will advertise "
+            "no capabilities", path, e,
+        )
+        return {}
 
 
 def _load_kube_shard(
@@ -83,4 +106,10 @@ def _load_kube_shard(
             f"configs ({e})"
         ) from e
     store = KubeClusterStore(shard_name, kubeconfig_path, namespace)
-    return Shard(alias, shard_name, store)
+    # Optional capabilities sidecar: <name>.capabilities.yaml next to the
+    # kubeconfig (a kubeconfig itself has no room for shard metadata).
+    sidecar = os.path.join(
+        os.path.dirname(kubeconfig_path), f"{shard_name}.capabilities.yaml"
+    )
+    caps = _read_capabilities(sidecar) if os.path.isfile(sidecar) else {}
+    return Shard(alias, shard_name, store, capabilities=caps)

@@ -45,10 +45,16 @@ class Shard:
         name: str,
         store: ClusterStore,
         informer_factory: Optional[InformerFactory] = None,
+        capabilities: Optional[Dict[str, bool]] = None,
     ):
         self.source_cluster_alias = source_cluster_alias
         self.name = name
         self.store = store
+        # Advertised capabilities of this shard cluster (e.g. accelerator
+        # generation / topology of its TPU slice pools); consulted by
+        # controller.placement when a template's workgroup constrains
+        # placement (BASELINE config #5).
+        self.capabilities: Dict[str, bool] = dict(capabilities or {})
         self.informers = informer_factory or InformerFactory(store)
 
         self.template_informer = self.informers.informer(NexusAlgorithmTemplate.KIND)

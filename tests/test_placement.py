@@ -1,0 +1,260 @@
+"""Topology-aware placement (BASELINE config #5).
+
+A template's workgroup_ref resolves to a workgroup whose cluster /
+capabilities select which shard clusters (TPU slice pools) receive the
+template. No resolvable workgroup → all shards (reference parity with
+controller.go:790's unconditional fan-out).
+"""
+
+import pytest
+
+from nexus_tpu.api.template import (
+    Container,
+    NexusAlgorithmSpec,
+    NexusAlgorithmTemplate,
+    WorkgroupRef,
+)
+from nexus_tpu.api.types import ObjectMeta
+from nexus_tpu.api.workgroup import (
+    NexusAlgorithmWorkgroup,
+    NexusAlgorithmWorkgroupSpec,
+)
+from nexus_tpu.cluster.store import ClusterStore
+from nexus_tpu.controller.controller import Controller, SyncError
+from nexus_tpu.controller.events import REASON_ERR_RESOURCE_SYNC, FakeRecorder
+from nexus_tpu.controller.placement import PlacementError, select_shards
+from nexus_tpu.shards.shard import Shard
+from nexus_tpu.utils.telemetry import StatsdClient
+
+NS = "nexus"
+ALIAS = "test-controller-cluster"
+
+SHARD_CAPS = {
+    "pool-v5e": {"tpu-v5e": True},
+    "pool-v5p-a": {"tpu-v5p": True, "moe": True},
+    "pool-v5p-b": {"tpu-v5p": True, "moe": True},
+}
+
+
+def make_template(name="algo-1", workgroup=""):
+    return NexusAlgorithmTemplate(
+        metadata=ObjectMeta(name=name, namespace=NS),
+        spec=NexusAlgorithmSpec(
+            container=Container(image="algo", registry="r", version_tag="v1"),
+            workgroup_ref=WorkgroupRef(
+                name=workgroup,
+                group="science.sneaksanddata.com",
+                kind="NexusAlgorithmWorkgroup",
+            ),
+        ),
+    )
+
+
+def make_workgroup(name, cluster="", capabilities=None):
+    return NexusAlgorithmWorkgroup(
+        metadata=ObjectMeta(name=name, namespace=NS),
+        spec=NexusAlgorithmWorkgroupSpec(
+            description="pool",
+            cluster=cluster,
+            capabilities=dict(capabilities or {}),
+        ),
+    )
+
+
+class Fixture:
+    def __init__(self):
+        self.controller_store = ClusterStore("controller")
+        self.shard_stores = {n: ClusterStore(n) for n in SHARD_CAPS}
+        self.shards = [
+            Shard(ALIAS, n, s, capabilities=SHARD_CAPS[n])
+            for n, s in self.shard_stores.items()
+        ]
+        self.recorder = FakeRecorder()
+        self.controller = Controller(
+            self.controller_store,
+            self.shards,
+            recorder=self.recorder,
+            statsd=StatsdClient("test"),
+        )
+
+    def seed(self, *objs):
+        self.controller_store.seed(*objs)
+        listers = {
+            NexusAlgorithmTemplate.KIND: self.controller.template_lister,
+            NexusAlgorithmWorkgroup.KIND: self.controller.workgroup_lister,
+        }
+        for obj in objs:
+            stored = self.controller_store.get(
+                obj.KIND, obj.metadata.namespace, obj.metadata.name
+            )
+            listers[obj.KIND].add(stored)
+
+    def placed_on(self, name):
+        """Shard names whose store holds template ``name``."""
+        return sorted(
+            n
+            for n, s in self.shard_stores.items()
+            if s.list(NexusAlgorithmTemplate.KIND)
+            and any(
+                t.metadata.name == name
+                for t in s.list(NexusAlgorithmTemplate.KIND)
+            )
+        )
+
+
+# ------------------------------------------------------------ unit: selector
+
+
+def test_select_all_without_workgroup():
+    f = Fixture()
+    assert select_shards(make_template(), None, f.shards) == f.shards
+
+
+def test_select_by_cluster():
+    f = Fixture()
+    wg = make_workgroup("wg", cluster="pool-v5p-a")
+    assert [s.name for s in select_shards(make_template(), wg, f.shards)] == [
+        "pool-v5p-a"
+    ]
+
+
+def test_select_by_capabilities():
+    f = Fixture()
+    wg = make_workgroup("wg", capabilities={"tpu-v5p": True, "moe": True})
+    assert [s.name for s in select_shards(make_template(), wg, f.shards)] == [
+        "pool-v5p-a",
+        "pool-v5p-b",
+    ]
+
+
+def test_false_capabilities_are_not_required():
+    f = Fixture()
+    wg = make_workgroup("wg", capabilities={"tpu-v5e": True, "moe": False})
+    assert [s.name for s in select_shards(make_template(), wg, f.shards)] == [
+        "pool-v5e"
+    ]
+
+
+def test_unsatisfiable_cluster_raises():
+    f = Fixture()
+    wg = make_workgroup("wg", cluster="no-such-pool")
+    with pytest.raises(PlacementError):
+        select_shards(make_template(), wg, f.shards)
+
+
+def test_unsatisfiable_capabilities_raises():
+    f = Fixture()
+    wg = make_workgroup("wg", capabilities={"tpu-v7x": True})
+    with pytest.raises(PlacementError):
+        select_shards(make_template(), wg, f.shards)
+
+
+# ----------------------------------------------------- integration: reconcile
+
+
+def test_template_without_workgroup_fans_out_everywhere():
+    f = Fixture()
+    f.seed(make_template("algo-all"))
+    f.controller.template_sync_handler(NS, "algo-all")
+    assert f.placed_on("algo-all") == sorted(SHARD_CAPS)
+
+
+def test_moe_template_placed_on_two_matching_pools():
+    """The config #5 scenario: MoE fan-out across exactly the two v5p pools."""
+    f = Fixture()
+    f.seed(
+        make_workgroup("moe-pool", capabilities={"tpu-v5p": True, "moe": True}),
+        make_template("mixtral", workgroup="moe-pool"),
+    )
+    f.controller.template_sync_handler(NS, "mixtral")
+    assert f.placed_on("mixtral") == ["pool-v5p-a", "pool-v5p-b"]
+
+    tmpl = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "mixtral")
+    assert tmpl.status.synced_to_clusters == ["pool-v5p-a", "pool-v5p-b"]
+
+
+def test_cluster_pinned_template_lands_on_one_pool():
+    f = Fixture()
+    f.seed(
+        make_workgroup("edge", cluster="pool-v5e"),
+        make_template("serving", workgroup="edge"),
+    )
+    f.controller.template_sync_handler(NS, "serving")
+    assert f.placed_on("serving") == ["pool-v5e"]
+
+
+def test_missing_workgroup_falls_back_to_all_shards():
+    f = Fixture()
+    f.seed(make_template("algo-x", workgroup="not-synced-yet"))
+    f.controller.template_sync_handler(NS, "algo-x")
+    assert f.placed_on("algo-x") == sorted(SHARD_CAPS)
+
+
+def test_narrowing_placement_removes_stale_copies():
+    """Template fans out everywhere before its workgroup syncs; when the
+    workgroup appears and narrows placement, stale copies on unselected
+    shards are deleted (only our own provenance-labelled copies)."""
+    f = Fixture()
+    f.seed(make_template("mixtral", workgroup="moe-pool"))
+    f.controller.template_sync_handler(NS, "mixtral")
+    assert f.placed_on("mixtral") == sorted(SHARD_CAPS)
+
+    f.seed(make_workgroup("moe-pool", capabilities={"moe": True}))
+    f.controller.template_sync_handler(NS, "mixtral")
+    assert f.placed_on("mixtral") == ["pool-v5p-a", "pool-v5p-b"]
+    tmpl = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "mixtral")
+    assert tmpl.status.synced_to_clusters == ["pool-v5p-a", "pool-v5p-b"]
+
+
+def test_narrowing_leaves_foreign_templates_alone():
+    """A same-named template on an unselected shard that we did NOT write
+    (no provenance label) must not be deleted."""
+    f = Fixture()
+    foreign = make_template("mixtral")
+    f.shard_stores["pool-v5e"].seed(foreign)
+    f.shards[0].template_lister.add(
+        f.shard_stores["pool-v5e"].get(NexusAlgorithmTemplate.KIND, NS, "mixtral")
+    )
+    f.seed(
+        make_workgroup("moe-pool", capabilities={"moe": True}),
+        make_template("mixtral", workgroup="moe-pool"),
+    )
+    f.controller.template_sync_handler(NS, "mixtral")
+    assert "pool-v5e" in f.placed_on("mixtral")  # foreign copy untouched
+    assert f.placed_on("mixtral") == sorted(SHARD_CAPS)
+    tmpl = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "mixtral")
+    assert tmpl.status.synced_to_clusters == ["pool-v5p-a", "pool-v5p-b"]
+
+
+def test_workgroup_event_reenqueues_referencing_templates():
+    f = Fixture()
+    f.seed(
+        make_template("mixtral", workgroup="moe-pool"),
+        make_template("other", workgroup="different-pool"),
+    )
+    wg = make_workgroup("moe-pool", capabilities={"moe": True})
+    f.controller._handle_workgroup_event(wg)
+    queued = set()
+    while True:
+        item, shutdown = f.controller.work_queue.get(timeout=0.1)
+        if item is None or shutdown:
+            break
+        queued.add((item.name, item.obj_type))
+        f.controller.work_queue.done(item)
+    assert ("moe-pool", "workgroup") in queued
+    assert ("mixtral", "template") in queued
+    assert ("other", "template") not in queued
+
+
+def test_unsatisfiable_placement_errors_and_requeues():
+    f = Fixture()
+    f.seed(
+        make_workgroup("ghost", cluster="gone-pool"),
+        make_template("algo-g", workgroup="ghost"),
+    )
+    with pytest.raises(SyncError):
+        f.controller.template_sync_handler(NS, "algo-g")
+    assert f.placed_on("algo-g") == []
+    assert any(
+        e.reason == REASON_ERR_RESOURCE_SYNC for e in f.recorder.events
+    ), f.recorder.events
