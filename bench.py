@@ -22,9 +22,46 @@ import sys
 def main() -> int:
     import jax
 
-    from nexus_tpu.utils.hw import device_kind, is_tpu
+    from nexus_tpu.utils.hw import device_kind, honor_env_platforms, is_tpu
 
+    honor_env_platforms()
+
+    def progress(msg: str) -> None:
+        _stage[0] = msg
+        print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+    # Watchdog: the TPU tunnel can wedge (backend init or compile never
+    # returns). If the bench hasn't finished by the deadline, emit a
+    # fallback JSON line so the driver records *something*, then exit.
+    import threading
+
+    _stage = ["startup"]
+    deadline_s = float(os.environ.get("NEXUS_BENCH_DEADLINE_S") or 1500)
+
+    def _watchdog():
+        print(
+            json.dumps(
+                {
+                    "metric": "llama_train_mfu",
+                    "value": 0.0,
+                    "unit": "mfu_fraction",
+                    "vs_baseline": 0.0,
+                    "error": f"deadline {deadline_s}s exceeded at stage: "
+                    f"{_stage[0]}",
+                }
+            ),
+            flush=True,
+        )
+        print(f"[bench] WATCHDOG fired at stage: {_stage[0]}", file=sys.stderr, flush=True)
+        os._exit(0)
+
+    timer = threading.Timer(deadline_s, _watchdog)
+    timer.daemon = True
+    timer.start()
+
+    progress("initializing backend")
     on_tpu = is_tpu()
+    progress(f"backend up: {device_kind()} x{len(jax.devices())}")
     preset = os.environ.get("NEXUS_BENCH_PRESET") or ("400m" if on_tpu else "tiny")
     steps = int(os.environ.get("NEXUS_BENCH_STEPS") or (20 if on_tpu else 6))
     batch = int(os.environ.get("NEXUS_BENCH_BATCH") or (8 if on_tpu else 4))
@@ -50,7 +87,13 @@ def main() -> int:
             batch_size=batch, seq_len=seq, steps=steps, learning_rate=3e-4,
         ),
     )
+    progress(
+        f"running train bench: preset={preset} steps={steps} "
+        f"batch={batch} seq={seq}"
+    )
     metrics = run_template_runtime(runtime)
+    timer.cancel()
+    progress("train bench done")
 
     mfu = float(metrics.get("mfu") or 0.0)
     result = {

@@ -155,7 +155,7 @@ class Controller:
         )
         self.workgroup_informer.add_event_handler(
             on_add=self._handle_workgroup_event,
-            on_update=lambda old, new: self._handle_workgroup_event(new),
+            on_update=self._handle_workgroup_update,
             # deletion widens placement back to all shards — re-place
             # referencing templates immediately, same as add/update
             on_delete=self._handle_workgroup_event,
@@ -179,6 +179,19 @@ class Controller:
         for template in self.template_lister.list(workgroup.metadata.namespace):
             if template.spec.workgroup_ref.name == workgroup.metadata.name:
                 self.enqueue_resource(template)
+
+    def _handle_workgroup_update(self, old, new) -> None:
+        """Real spec changes fan out to referencing templates; periodic
+        resyncs (old is new / unchanged resourceVersion) only re-enqueue the
+        workgroup itself — templates already get their own level-triggered
+        resync, and W×M fan-out every resync period is pure churn."""
+        if (
+            old is not None
+            and old.metadata.resource_version == new.metadata.resource_version
+        ):
+            self.enqueue_resource(new)
+            return
+        self._handle_workgroup_event(new)
 
     def _handle_dependent_update(self, old, new) -> None:
         if (

@@ -64,8 +64,12 @@ def select_shards(
             if all(s.capabilities.get(c, False) for c in required)
         ]
         if not selected:
+            scope = (
+                f"pinned cluster {cluster!r}" if cluster else "connected shards"
+            )
             raise PlacementError(
                 f"workgroup {workgroup.name!r} requires capabilities "
-                f"{required} but no connected shard advertises all of them"
+                f"{required} but no shard among the {scope} advertises "
+                "all of them"
             )
     return selected

@@ -7,7 +7,40 @@ keys off device_kind, not backend name.
 
 from __future__ import annotations
 
+import os
+from typing import Optional
+
 import jax
+
+
+def force_platform(platform: str, num_cpu_devices: Optional[int] = None) -> None:
+    """Point JAX at ``platform`` before the first backend initialization.
+
+    The axon/TPU sitecustomize sets ``jax_platforms="axon,cpu"`` via
+    jax.config, which silently overrides a ``JAX_PLATFORMS`` env var — so
+    selecting CPU (e.g. for the driver's virtual-device dry run) requires
+    re-applying the choice through jax.config. No-op (best-effort) if the
+    backend is already initialized.
+    """
+    try:
+        jax.config.update("jax_platforms", platform)
+    except Exception:
+        pass
+    if num_cpu_devices and "xla_force_host_platform_device_count" not in (
+        os.environ.get("XLA_FLAGS", "")
+    ):
+        try:
+            jax.config.update("jax_num_cpu_devices", num_cpu_devices)
+        except Exception:
+            pass
+
+
+def honor_env_platforms() -> None:
+    """Re-apply an explicit ``JAX_PLATFORMS`` env choice over sitecustomize's
+    jax.config override. Leaves the ambient axon/TPU default alone."""
+    env_plat = os.environ.get("JAX_PLATFORMS", "").strip()
+    if env_plat and env_plat != "axon":
+        force_platform(env_plat)
 
 
 def is_tpu() -> bool:
