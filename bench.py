@@ -36,28 +36,41 @@ def main() -> int:
     import threading
 
     _stage = ["startup"]
+    _done = [False]
+    _print_lock = threading.Lock()
     deadline_s = float(os.environ.get("NEXUS_BENCH_DEADLINE_S") or 1500)
 
     def _watchdog():
-        print(
-            json.dumps(
-                {
-                    "metric": "llama_train_mfu",
-                    "value": 0.0,
-                    "unit": "mfu_fraction",
-                    "vs_baseline": 0.0,
-                    "error": f"deadline {deadline_s}s exceeded at stage: "
-                    f"{_stage[0]}",
-                }
-            ),
-            flush=True,
-        )
-        print(f"[bench] WATCHDOG fired at stage: {_stage[0]}", file=sys.stderr, flush=True)
-        os._exit(0)
+        # single-JSON-line contract: the lock + _done flag make the fallback
+        # and the real result mutually exclusive even if the timer fires
+        # exactly as the bench finishes
+        with _print_lock:
+            if _done[0]:
+                return
+            print(
+                json.dumps(
+                    {
+                        "metric": "llama_train_mfu",
+                        "value": 0.0,
+                        "unit": "mfu_fraction",
+                        "vs_baseline": 0.0,
+                        "error": f"deadline {deadline_s}s exceeded at stage: "
+                        f"{_stage[0]}",
+                    }
+                ),
+                flush=True,
+            )
+            print(
+                f"[bench] WATCHDOG fired at stage: {_stage[0]}",
+                file=sys.stderr, flush=True,
+            )
+            os._exit(0)
 
-    timer = threading.Timer(deadline_s, _watchdog)
-    timer.daemon = True
-    timer.start()
+    timer = None
+    if deadline_s > 0:
+        timer = threading.Timer(deadline_s, _watchdog)
+        timer.daemon = True
+        timer.start()
 
     progress("initializing backend")
     on_tpu = is_tpu()
@@ -92,7 +105,10 @@ def main() -> int:
         f"batch={batch} seq={seq}"
     )
     metrics = run_template_runtime(runtime)
-    timer.cancel()
+    with _print_lock:
+        _done[0] = True
+    if timer is not None:
+        timer.cancel()
     progress("train bench done")
 
     mfu = float(metrics.get("mfu") or 0.0)

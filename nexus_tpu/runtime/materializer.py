@@ -107,7 +107,17 @@ def materialize_job(
                 {
                     "name": "jax-worker",
                     "image": template.spec.container.full_image,
-                    "command": [template.spec.command] if template.spec.command else None,
+                    # default to the framework's pod entrypoint (worker.py —
+                    # the NEXUS_RUNTIME_SPEC consumer) only when the template
+                    # specifies neither command nor args; args without a
+                    # command target the image's own ENTRYPOINT
+                    "command": [template.spec.command]
+                    if template.spec.command
+                    else (
+                        None
+                        if template.spec.args
+                        else ["python", "-m", "nexus_tpu.runtime.worker"]
+                    ),
                     "args": list(template.spec.args) or None,
                     "env": runtime_env,
                     "envFrom": env_from or None,

@@ -1,0 +1,157 @@
+"""Pod-side worker entrypoint: ``python -m nexus_tpu.runtime.worker``.
+
+This is what actually runs inside a materialized Job's container (the
+launched TPU pod). The materializer (materializer.py) wires the contract as
+env vars; this module is their single consumer:
+
+  NEXUS_RUNTIME_SPEC       — compact-JSON JaxXlaRuntime block
+  NEXUS_SLICE_INDEX        — which slice this Job serves (multislice)
+  NEXUS_SLICE_COUNT        — total slices
+  NEXUS_SHARD_NAME         — provenance, echoed into the result
+  JAX_COORDINATOR_ADDRESS  — pod 0 of slice 0 (host:port)
+  JOB_COMPLETION_INDEX     — Indexed-Job host index within this slice
+  NEXUS_RESULT_PATH        — optional path to also write the metrics JSON
+
+Flow (SURVEY.md §7.2): derive (process_id, num_processes) from the slice /
+host indices → ``jax.distributed.initialize`` when multi-process → build the
+mesh and execute the runtime (entrypoints.py) → emit ONE metrics JSON line
+on stdout. The reference has no workload plane at all (SURVEY.md §2c); this
+file is the TPU-native addition that turns a synced template into a running
+JAX job.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from nexus_tpu.api.runtime_spec import JaxXlaRuntime
+
+logger = logging.getLogger("nexus_tpu.worker")
+
+
+@dataclass(frozen=True)
+class WorkerIdentity:
+    """Where this process sits in the (slice, host) grid."""
+
+    slice_index: int
+    slice_count: int
+    host_index: int
+    hosts_per_slice: int
+
+    @property
+    def process_id(self) -> int:
+        """Global JAX process id: slices are contiguous blocks of hosts, so
+        coordinator (slice 0, host 0) is always process 0."""
+        return self.slice_index * self.hosts_per_slice + self.host_index
+
+    @property
+    def num_processes(self) -> int:
+        return self.slice_count * self.hosts_per_slice
+
+
+def identity_from_env(
+    runtime: JaxXlaRuntime, environ: Optional[Dict[str, str]] = None
+) -> WorkerIdentity:
+    env = os.environ if environ is None else environ
+    return WorkerIdentity(
+        slice_index=int(env.get("NEXUS_SLICE_INDEX", "0") or 0),
+        slice_count=int(
+            env.get("NEXUS_SLICE_COUNT", "") or runtime.tpu.slice_count
+        ),
+        host_index=int(env.get("JOB_COMPLETION_INDEX", "0") or 0),
+        hosts_per_slice=runtime.tpu.hosts_per_slice,
+    )
+
+
+def maybe_initialize_distributed(
+    identity: WorkerIdentity, environ: Optional[Dict[str, str]] = None
+) -> bool:
+    """Call ``jax.distributed.initialize`` iff this is a multi-process job.
+
+    Single-process jobs (1 host × 1 slice — incl. every local/test run) skip
+    initialization entirely: jax.distributed requires a coordinator service
+    that a lone process has no use for. Returns True if initialized.
+    """
+    if identity.num_processes <= 1:
+        return False
+    env = os.environ if environ is None else environ
+    coordinator = env.get("JAX_COORDINATOR_ADDRESS", "")
+    if not coordinator:
+        raise RuntimeError(
+            "multi-process runtime but JAX_COORDINATOR_ADDRESS is not set "
+            "(materializer wires it on every pod — see materializer.py)"
+        )
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=identity.num_processes,
+        process_id=identity.process_id,
+    )
+    logger.info(
+        "jax.distributed initialized: process %d/%d (slice %d host %d) "
+        "coordinator=%s",
+        identity.process_id, identity.num_processes,
+        identity.slice_index, identity.host_index, coordinator,
+    )
+    return True
+
+
+def run_from_env(environ: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """Parse the materializer contract from env and execute the runtime."""
+    env = os.environ if environ is None else environ
+    spec_json = env.get("NEXUS_RUNTIME_SPEC", "")
+    if not spec_json:
+        raise RuntimeError(
+            "NEXUS_RUNTIME_SPEC is not set — this entrypoint only runs "
+            "inside a materialized Job (or with the env contract replicated)"
+        )
+    runtime = JaxXlaRuntime.from_dict(json.loads(spec_json))
+    errs = runtime.validate()
+    if errs:
+        raise RuntimeError(f"invalid runtime spec: {'; '.join(errs)}")
+
+    from nexus_tpu.utils.hw import honor_env_platforms
+
+    honor_env_platforms()
+
+    identity = identity_from_env(runtime, env)
+    distributed = maybe_initialize_distributed(identity, env)
+
+    from nexus_tpu.runtime.entrypoints import run_template_runtime
+
+    metrics = run_template_runtime(runtime)
+    metrics["shard"] = env.get("NEXUS_SHARD_NAME", "")
+    metrics["process_id"] = identity.process_id
+    metrics["num_processes"] = identity.num_processes
+    metrics["distributed"] = distributed
+    return metrics
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=logging.INFO, stream=sys.stderr,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    try:
+        metrics = run_from_env()
+    except Exception as e:  # noqa: BLE001 — the Job's backoffLimit handles retry
+        logger.exception("worker failed")
+        print(json.dumps({"phase": "Failed", "error": str(e)}), flush=True)
+        return 1
+    line = json.dumps({"phase": "Succeeded", **metrics}, default=str)
+    print(line, flush=True)
+    result_path = os.environ.get("NEXUS_RESULT_PATH", "")
+    if result_path:
+        with open(result_path, "w") as f:
+            f.write(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

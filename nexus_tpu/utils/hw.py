@@ -26,13 +26,20 @@ def force_platform(platform: str, num_cpu_devices: Optional[int] = None) -> None
         jax.config.update("jax_platforms", platform)
     except Exception:
         pass
-    if num_cpu_devices and "xla_force_host_platform_device_count" not in (
-        os.environ.get("XLA_FLAGS", "")
-    ):
-        try:
-            jax.config.update("jax_num_cpu_devices", num_cpu_devices)
-        except Exception:
-            pass
+    if num_cpu_devices:
+        # skip only when XLA_FLAGS already forces at least as many host
+        # devices (setting both can conflict in some JAX versions)
+        import re
+
+        m = re.search(
+            r"xla_force_host_platform_device_count=(\d+)",
+            os.environ.get("XLA_FLAGS", ""),
+        )
+        if m is None or int(m.group(1)) < num_cpu_devices:
+            try:
+                jax.config.update("jax_num_cpu_devices", num_cpu_devices)
+            except Exception:
+                pass
 
 
 def honor_env_platforms() -> None:

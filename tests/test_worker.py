@@ -1,0 +1,114 @@
+"""Pod-side worker entrypoint (runtime/worker.py): process-identity math,
+env-contract parsing, single-process execution, and the materializer's
+default command wiring."""
+
+import json
+
+import pytest
+
+from nexus_tpu.api.runtime_spec import (
+    JaxXlaRuntime,
+    ModelRef,
+    ParallelismSpec,
+    TpuSliceSpec,
+    TrainSpec,
+)
+from nexus_tpu.runtime.materializer import materialize_job
+from nexus_tpu.runtime.worker import (
+    WorkerIdentity,
+    identity_from_env,
+    maybe_initialize_distributed,
+    run_from_env,
+)
+from tests.test_runtime import template_with_runtime
+
+
+def test_process_identity_grid():
+    # 2 slices × 4 hosts: coordinator is (0,0) → process 0; slices are
+    # contiguous host blocks
+    ids = [
+        WorkerIdentity(s, 2, h, 4).process_id for s in range(2) for h in range(4)
+    ]
+    assert ids == list(range(8))
+    assert WorkerIdentity(1, 2, 3, 4).num_processes == 8
+
+
+def test_identity_from_env_derives_from_indexed_job():
+    rt = JaxXlaRuntime(
+        tpu=TpuSliceSpec(accelerator="v5p", topology="2x2x4", slice_count=2)
+    )  # 16 chips/slice, 4 chips/host → 4 hosts/slice
+    env = {
+        "NEXUS_SLICE_INDEX": "1",
+        "NEXUS_SLICE_COUNT": "2",
+        "JOB_COMPLETION_INDEX": "2",
+    }
+    ident = identity_from_env(rt, env)
+    assert ident.hosts_per_slice == 4
+    assert ident.process_id == 6
+    assert ident.num_processes == 8
+
+
+def test_single_process_skips_distributed_init():
+    ident = WorkerIdentity(0, 1, 0, 1)
+    assert maybe_initialize_distributed(ident, {}) is False
+
+
+def test_multi_process_requires_coordinator():
+    ident = WorkerIdentity(0, 2, 0, 4)
+    with pytest.raises(RuntimeError, match="JAX_COORDINATOR_ADDRESS"):
+        maybe_initialize_distributed(ident, {})
+
+
+def test_run_from_env_requires_spec():
+    with pytest.raises(RuntimeError, match="NEXUS_RUNTIME_SPEC"):
+        run_from_env({})
+
+
+def test_run_from_env_executes_runtime():
+    rt = JaxXlaRuntime(
+        mode="train",
+        model=ModelRef(family="mlp", preset="tiny"),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1", slice_count=1),
+        parallelism=ParallelismSpec(),
+        train=TrainSpec(batch_size=8, steps=2, learning_rate=1e-2),
+    )
+    env = {
+        "NEXUS_RUNTIME_SPEC": json.dumps(rt.to_dict()),
+        "NEXUS_SHARD_NAME": "shard-a",
+    }
+    metrics = run_from_env(env)
+    assert metrics["mode"] == "train"
+    assert metrics["steps"] == 2
+    assert metrics["shard"] == "shard-a"
+    assert metrics["process_id"] == 0
+    assert metrics["distributed"] is False
+
+
+def test_run_from_env_rejects_invalid_spec():
+    rt = JaxXlaRuntime(
+        parallelism=ParallelismSpec(data=3),  # 3 != 1 chip
+        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1"),
+    )
+    with pytest.raises(RuntimeError, match="invalid runtime spec"):
+        run_from_env({"NEXUS_RUNTIME_SPEC": json.dumps(rt.to_dict())})
+
+
+def test_materializer_defaults_command_to_worker_module():
+    def command_of(tmpl):
+        job = materialize_job(tmpl)[0]
+        return job["spec"]["template"]["spec"]["containers"][0]["command"]
+
+    tmpl = template_with_runtime()
+    tmpl.spec.command = ""
+    tmpl.spec.args = []
+    assert command_of(tmpl) == ["python", "-m", "nexus_tpu.runtime.worker"]
+
+    tmpl2 = template_with_runtime()
+    tmpl2.spec.command = "/custom/entrypoint"
+    assert command_of(tmpl2) == ["/custom/entrypoint"]
+
+    # args without command target the image's own ENTRYPOINT — no default
+    tmpl3 = template_with_runtime()
+    tmpl3.spec.command = ""
+    tmpl3.spec.args = ["--my-flag"]
+    assert command_of(tmpl3) is None
