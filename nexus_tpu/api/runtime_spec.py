@@ -240,6 +240,40 @@ class CheckpointSpec:
 
 
 @dataclass
+class ProfileSpec:
+    """JAX profiler capture window: trace ``num_steps`` steps starting at
+    ``start_step`` (post-compile) into ``directory`` (TensorBoard/XPlane
+    format). The reference has no tracing subsystem at all (SURVEY.md §5);
+    this is the workload-side profiler the TPU build adds."""
+
+    enabled: bool = False
+    directory: str = ""
+    start_step: int = 2
+    num_steps: int = 3
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "enabled": self.enabled,
+            "directory": self.directory,
+            "startStep": self.start_step,
+            "numSteps": self.num_steps,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ProfileSpec":
+        # no falsy-coercion here: startStep=0 (trace from the first timed
+        # step) is a legitimate value
+        start = d.get("startStep")
+        num = d.get("numSteps")
+        return cls(
+            enabled=bool(d.get("enabled", False)),
+            directory=d.get("directory", ""),
+            start_step=2 if start is None else int(start),
+            num_steps=3 if num is None else int(num),
+        )
+
+
+@dataclass
 class JaxXlaRuntime:
     """The full TPU-native runtime declaration carried by a template.
 
@@ -256,6 +290,7 @@ class JaxXlaRuntime:
     parallelism: ParallelismSpec = field(default_factory=ParallelismSpec)
     train: TrainSpec = field(default_factory=TrainSpec)
     checkpoint: CheckpointSpec = field(default_factory=CheckpointSpec)
+    profile: ProfileSpec = field(default_factory=ProfileSpec)
 
     def validate(self) -> List[str]:
         """Static validation: mesh must tile the slice exactly."""
@@ -285,6 +320,7 @@ class JaxXlaRuntime:
             "parallelism": self.parallelism.to_dict(),
             "train": self.train.to_dict(),
             "checkpoint": self.checkpoint.to_dict(),
+            "profile": self.profile.to_dict(),
         }
 
     @classmethod
@@ -300,4 +336,5 @@ class JaxXlaRuntime:
             parallelism=ParallelismSpec.from_dict(d.get("parallelism") or {}),
             train=TrainSpec.from_dict(d.get("train") or {}),
             checkpoint=CheckpointSpec.from_dict(d.get("checkpoint") or {}),
+            profile=ProfileSpec.from_dict(d.get("profile") or {}),
         )

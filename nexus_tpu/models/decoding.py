@@ -1,0 +1,79 @@
+"""Shared autoregressive decode driver for the decoder model families.
+
+Each family supplies its ``forward_decode(params, cfg, tokens, cache)``;
+the KV-cache layout ((L, B, S, Hkv, D) ring-free append buffer) and the
+prefill + ``lax.scan`` greedy/sampled generation loop are identical across
+families and live here once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nexus_tpu.ops.sampling import sample_logits
+
+
+def init_kv_cache(
+    n_layers: int, n_kv_heads: int, head_dim: int, dtype,
+    batch: int, max_len: int,
+) -> Dict[str, Any]:
+    shape = (n_layers, batch, max_len, n_kv_heads, head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def autoregressive_generate(
+    forward_decode: Callable,
+    params: Dict[str, Any],
+    cfg: Any,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    max_len: Optional[int] = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    key: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """prompt (B, P) → (B, P + max_new_tokens).
+
+    Greedy by default; ``temperature > 0`` samples (requires ``key``),
+    optionally restricted by top_k / top_p (ops/sampling.py)."""
+    if temperature > 0.0 and key is None:
+        raise ValueError(
+            "temperature > 0 requires an explicit PRNG key — a silent "
+            "fixed seed would make 'stochastic' sampling deterministic"
+        )
+    b, p = prompt.shape
+    max_len = max_len or min(cfg.max_seq_len, p + max_new_tokens)
+    cache = init_kv_cache(
+        cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype, b, max_len
+    )
+
+    def pick(logits, step_idx):
+        k = None if key is None else jax.random.fold_in(key, step_idx)
+        return sample_logits(
+            logits, key=k, temperature=temperature, top_k=top_k, top_p=top_p
+        ).astype(prompt.dtype)
+
+    logits, cache = forward_decode(params, cfg, prompt, cache)
+    next_tok = pick(logits[:, -1], 0)
+
+    def step(carry, step_idx):
+        cache, tok = carry
+        logits, cache = forward_decode(params, cfg, tok[:, None], cache)
+        nxt = pick(logits[:, -1], step_idx)
+        return (cache, nxt), nxt
+
+    (_, _), toks = lax.scan(
+        step, (cache, next_tok), jnp.arange(1, max_new_tokens)
+    )
+    return jnp.concatenate(
+        [prompt, next_tok[:, None], toks.swapaxes(0, 1)], axis=1
+    )

@@ -199,12 +199,35 @@ def loss_fn(params: Dict[str, Any], cfg: LlamaConfig,
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Dict[str, Any]:
-    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
-    return {
-        "k": jnp.zeros(shape, cfg.dtype),
-        "v": jnp.zeros(shape, cfg.dtype),
-        "length": jnp.zeros((), jnp.int32),
-    }
+    from nexus_tpu.models.decoding import init_kv_cache as _init
+
+    return _init(
+        cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype, batch, max_len
+    )
+
+
+def _decode_attention(
+    q: jnp.ndarray, k_buf: jnp.ndarray, v_buf: jnp.ndarray,
+    start: jnp.ndarray, t: int,
+) -> jnp.ndarray:
+    """Length-masked attention of t new queries over the full cache buffer.
+
+    Static shapes (the mask, not a slice, hides unwritten cache tail) — one
+    compiled program regardless of decode position."""
+    hd = q.shape[-1]
+    max_len = k_buf.shape[1]
+    n_rep = q.shape[2] // k_buf.shape[2]
+    kr = jnp.repeat(k_buf, n_rep, axis=2)
+    vr = jnp.repeat(v_buf, n_rep, axis=2)
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, kr, preferred_element_type=jnp.float32
+    ) * hd ** -0.5
+    q_pos = start + jnp.arange(t)
+    visible = jnp.arange(max_len)[None, :] <= q_pos[:, None]  # (t, max_len)
+    mask_value = -0.7 * float(jnp.finfo(jnp.float32).max)
+    logits = jnp.where(visible[None, None], logits, mask_value)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v_buf.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
 
 
 def forward_decode(
@@ -213,9 +236,9 @@ def forward_decode(
 ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
     """Incremental decode: tokens (B, T) appended at cache['length'].
 
-    Returns logits for the new positions and the updated cache. Uses a
-    length-masked XLA attention over the full cache buffer (static shapes —
-    jit-stable across steps)."""
+    Returns logits for the new positions and the updated cache. The layer
+    stack is ``lax.scan``-ned over the stacked params + cache (one compiled
+    block for any depth — same trace-once strategy as forward())."""
     b, t = tokens.shape
     hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     max_len = cache["k"].shape[2]
@@ -227,64 +250,39 @@ def forward_decode(
     cos = lax.dynamic_slice_in_dim(cos_full, start, t, axis=0)
     sin = lax.dynamic_slice_in_dim(sin_full, start, t, axis=0)
 
-    new_k, new_v = [], []
-    mask_value = -0.7 * float(jnp.finfo(jnp.float32).max)
-    positions = jnp.arange(max_len)
-
-    for li in range(cfg.n_layers):
-        layer = jax.tree_util.tree_map(lambda p: p[li], params["layers"])
+    def layer_step(x, scanned):
+        layer, k_cache, v_cache = scanned
         h = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
         q = apply_rope((h @ layer["wq"]).reshape(b, t, hq, hd), cos, sin)
         k = apply_rope((h @ layer["wk"]).reshape(b, t, hkv, hd), cos, sin)
         v = (h @ layer["wv"]).reshape(b, t, hkv, hd)
-        k_buf = lax.dynamic_update_slice_in_dim(cache["k"][li], k, start, axis=1)
-        v_buf = lax.dynamic_update_slice_in_dim(cache["v"][li], v, start, axis=1)
-        new_k.append(k_buf)
-        new_v.append(v_buf)
-
-        n_rep = hq // hkv
-        kr = jnp.repeat(k_buf, n_rep, axis=2)
-        vr = jnp.repeat(v_buf, n_rep, axis=2)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kr,
-                            preferred_element_type=jnp.float32) * hd ** -0.5
-        q_pos = start + jnp.arange(t)
-        visible = positions[None, :] <= q_pos[:, None]  # (t, max_len)
-        logits = jnp.where(visible[None, None], logits, mask_value)
-        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
+        k_buf = lax.dynamic_update_slice_in_dim(k_cache, k, start, axis=1)
+        v_buf = lax.dynamic_update_slice_in_dim(v_cache, v, start, axis=1)
+        attn = _decode_attention(q, k_buf, v_buf, start, t)
         x = x + attn.reshape(b, t, hq * hd) @ layer["wo"]
         h2 = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
-        x = x + (jax.nn.silu(h2 @ layer["w_gate"]) * (h2 @ layer["w_up"])) @ layer["w_down"]
+        x = x + (
+            jax.nn.silu(h2 @ layer["w_gate"]) * (h2 @ layer["w_up"])
+        ) @ layer["w_down"]
+        return x, (k_buf, v_buf)
 
+    x, (new_k, new_v) = lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"])
+    )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
-    new_cache = {
-        "k": jnp.stack(new_k),
-        "v": jnp.stack(new_v),
-        "length": start + t,
-    }
+    new_cache = {"k": new_k, "v": new_v, "length": start + t}
     return logits, new_cache
 
 
 def generate(
     params: Dict[str, Any], cfg: LlamaConfig, prompt: jnp.ndarray,
-    max_new_tokens: int, max_len: Optional[int] = None,
+    max_new_tokens: int, **sampling,
 ) -> jnp.ndarray:
-    """Greedy decoding. prompt (B, P) → (B, P + max_new_tokens)."""
-    b, p = prompt.shape
-    max_len = max_len or min(cfg.max_seq_len, p + max_new_tokens)
-    cache = init_kv_cache(cfg, b, max_len)
-    logits, cache = forward_decode(params, cfg, prompt, cache)
-    next_tok = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+    """Autoregressive decoding. prompt (B, P) → (B, P + max_new_tokens).
+    Sampling knobs (temperature/top_k/top_p/key): models/decoding.py."""
+    from nexus_tpu.models.decoding import autoregressive_generate
 
-    def step(carry, _):
-        cache, tok = carry
-        logits, cache = forward_decode(params, cfg, tok[:, None], cache)
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(tok.dtype)
-        return (cache, nxt), nxt
-
-    (_, _), toks = lax.scan(step, (cache, next_tok), None, length=max_new_tokens - 1)
-    out = jnp.concatenate(
-        [prompt, next_tok[:, None], toks.swapaxes(0, 1)], axis=1
+    return autoregressive_generate(
+        forward_decode, params, cfg, prompt, max_new_tokens, **sampling
     )
-    return out

@@ -80,9 +80,15 @@ def ring_attention(
     l0 = jnp.zeros((b, hq, s_local, 1), dtype=jnp.float32)
     acc0 = jnp.zeros((b, hq, s_local, d), dtype=jnp.float32)
     # mark initial accumulators as device-varying over the ring axis so the
-    # scan carry types line up (shard_map varying-axis typing, jax >= 0.8)
+    # scan carry types line up (shard_map varying-axis typing, jax >= 0.8);
+    # pcast replaces the deprecated pvary, keep the fallback for older jax
+    pcast = getattr(lax, "pcast", None)
     pvary = getattr(lax, "pvary", None)
-    if pvary is not None:
+    if pcast is not None:
+        m0, l0, acc0 = (
+            pcast(x, axis_name, to="varying") for x in (m0, l0, acc0)
+        )
+    elif pvary is not None:  # pragma: no cover — older jax
         m0, l0, acc0 = (pvary(x, (axis_name,)) for x in (m0, l0, acc0))
 
     def step(carry, step_idx):

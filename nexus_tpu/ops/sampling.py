@@ -1,0 +1,56 @@
+"""Token sampling for autoregressive decode.
+
+Static-shape, jit-stable transforms of a (B, V) logits slab: temperature,
+top-k (lax.top_k — no dynamic shapes), and nucleus/top-p via sorted-CDF
+masking. ``temperature == 0`` short-circuits to greedy argmax. All masking
+uses finfo.min rather than -inf so a fully-masked row can't NaN the softmax.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sample_logits(
+    logits: jnp.ndarray,
+    key: Optional[jax.Array] = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jnp.ndarray:
+    """logits (B, V) float → token ids (B,) int32.
+
+    temperature == 0 (or no key): greedy. top_k > 0: restrict to the k
+    highest logits. top_p < 1: restrict to the smallest prefix of the
+    sorted distribution with cumulative mass >= top_p.
+    """
+    if temperature <= 0.0 or key is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    logits = logits.astype(jnp.float32) / temperature
+    neg = jnp.finfo(jnp.float32).min
+
+    if top_k and top_k > 0 and top_k < logits.shape[-1]:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]  # (B, 1)
+        logits = jnp.where(logits < kth, neg, logits)
+
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cdf = jnp.cumsum(probs, axis=-1)
+        # keep every position whose *preceding* mass is < top_p (always
+        # keeps the argmax even when its probability alone exceeds top_p)
+        keep_sorted = (cdf - probs) < top_p
+        # threshold = smallest kept logit (kept entries are a prefix of the
+        # descending sort); everything below it is masked
+        cutoff = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf),
+            axis=-1, keepdims=True,
+        )
+        logits = jnp.where(logits < cutoff, neg, logits)
+
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

@@ -116,6 +116,7 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps):
                 start_step = int(state.step)
                 logger.info("resumed from checkpoint step %d", start_step)
 
+        prof = runtime.profile
         trainer = Trainer(
             step_fn,
             state,
@@ -125,6 +126,9 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps):
             checkpoint_interval=runtime.checkpoint.interval_steps
             if checkpointer
             else 0,
+            profile_dir=prof.directory if prof.enabled else "",
+            profile_start=prof.start_step,
+            profile_steps=prof.num_steps,
         )
         result = trainer.run(max(steps - start_step, 1))
         if checkpointer is not None:
@@ -143,6 +147,14 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps):
         "n_devices": n_devices,
         "resumed_from_step": start_step,
     }
+    if result.profiled:
+        metrics["profile_dir"] = runtime.profile.directory
+    elif runtime.profile.enabled and runtime.profile.directory:
+        logger.warning(
+            "profiling was enabled but the capture window never opened "
+            "(start_step=%d >= %d timed steps)",
+            runtime.profile.start_step, max(steps - 1, 0),
+        )
     if hasattr(cfg, "param_count"):
         fpt = llama_flops_per_token(cfg, tr.seq_len)
         metrics["param_count"] = cfg.param_count()

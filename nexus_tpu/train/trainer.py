@@ -143,6 +143,7 @@ class TrainerResult:
     tokens_per_sec: float
     steps_per_sec: float
     loss_history: Any
+    profiled: bool = False  # did the profiler capture window actually open
 
 
 class Trainer:
@@ -159,6 +160,9 @@ class Trainer:
         checkpointer=None,
         checkpoint_interval: int = 0,
         telemetry=None,
+        profile_dir: str = "",
+        profile_start: int = 2,
+        profile_steps: int = 3,
     ):
         self.step_fn = step_fn
         self.state = state
@@ -167,6 +171,9 @@ class Trainer:
         self.checkpointer = checkpointer
         self.checkpoint_interval = checkpoint_interval
         self.telemetry = telemetry
+        self.profile_dir = profile_dir
+        self.profile_start = profile_start
+        self.profile_steps = profile_steps
 
     def run(self, num_steps: int, warmup_steps: int = 1) -> TrainerResult:
         metrics: Dict[str, Any] = {}
@@ -178,12 +185,22 @@ class Trainer:
         jax.block_until_ready(metrics)
 
         timed_steps = num_steps - min(warmup_steps, num_steps)
+        profiling = False
+        ever_profiled = False
         t0 = time.monotonic()
         for i in range(timed_steps):
+            if self.profile_dir and i == self.profile_start:
+                jax.block_until_ready(self.state)
+                jax.profiler.start_trace(self.profile_dir)
+                profiling = ever_profiled = True
             batch = next(self.data_iter)
             self.state, metrics = self.step_fn(self.state, batch)
             if "loss" in metrics:
                 losses.append(metrics["loss"])
+            if profiling and i + 1 == self.profile_start + self.profile_steps:
+                jax.block_until_ready(self.state)
+                jax.profiler.stop_trace()
+                profiling = False
             if (
                 self.checkpointer is not None
                 and self.checkpoint_interval > 0
@@ -192,6 +209,8 @@ class Trainer:
                 jax.block_until_ready(self.state)
                 self.checkpointer.save(self.state)
         jax.block_until_ready(metrics)
+        if profiling:  # window extended past the end of the run
+            jax.profiler.stop_trace()
         dt = max(time.monotonic() - t0, 1e-9)
 
         final = {
@@ -212,4 +231,5 @@ class Trainer:
             tokens_per_sec=tps,
             steps_per_sec=sps,
             loss_history=[float(l) for l in losses],
+            profiled=ever_profiled,
         )

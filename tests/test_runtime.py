@@ -234,9 +234,15 @@ def test_launcher_records_failure():
             model=ModelRef(family="nonexistent-family", preset="tiny")
         )
         store.create(tmpl)
+        # the job thread is registered synchronously by create()'s watch
+        # dispatch — wait for it to finish rather than racing a fixed
+        # deadline against machine load (this test flaked under full-suite
+        # CPU contention)
+        assert launcher.wait_idle(timeout=180), "job thread never finished"
         assert wait_for(
             lambda: store.get(ConfigMap.KIND, NS, "tpu-algo-result").data["phase"]
-            == "Failed"
+            == "Failed",
+            timeout=10,
         )
         assert any(e.reason == "JobFailed" for e in launcher.recorder.events)
     finally:

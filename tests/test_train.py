@@ -167,3 +167,40 @@ def test_checkpoint_resume_continues_step(tmp_path):
     restored = ckpt.restore(jax.tree_util.tree_map(jnp.zeros_like, state))
     ckpt.close()
     assert int(restored.step) == 3
+
+
+def test_trainer_profile_capture(tmp_path):
+    """ProfileSpec window produces an XPlane trace dump."""
+    import os
+
+    from nexus_tpu.api.runtime_spec import (
+        JaxXlaRuntime, ModelRef, ParallelismSpec, ProfileSpec, TpuSliceSpec,
+        TrainSpec,
+    )
+    from nexus_tpu.runtime.entrypoints import run_template_runtime
+
+    prof_dir = str(tmp_path / "trace")
+    rt = JaxXlaRuntime(
+        mode="train",
+        model=ModelRef(family="mlp", preset="tiny"),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1", slice_count=1),
+        parallelism=ParallelismSpec(),
+        train=TrainSpec(batch_size=8, steps=8, learning_rate=1e-2),
+        profile=ProfileSpec(enabled=True, directory=prof_dir, start_step=1,
+                            num_steps=2),
+    )
+    metrics = run_template_runtime(rt)
+    assert metrics["profile_dir"] == prof_dir
+    dumped = []
+    for root, _, files in os.walk(prof_dir):
+        dumped += [f for f in files if f.endswith(".xplane.pb")]
+    assert dumped, f"no xplane trace written under {prof_dir}"
+
+
+def test_runtime_spec_profile_roundtrip():
+    from nexus_tpu.api.runtime_spec import JaxXlaRuntime, ProfileSpec
+
+    rt = JaxXlaRuntime(profile=ProfileSpec(enabled=True, directory="/x",
+                                           start_step=5, num_steps=7))
+    rt2 = JaxXlaRuntime.from_dict(rt.to_dict())
+    assert rt2.profile == rt.profile
