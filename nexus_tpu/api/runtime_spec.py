@@ -308,6 +308,13 @@ class JaxXlaRuntime:
             )
         if self.tpu.accelerator not in TPU_GENERATIONS:
             errs.append(f"unknown accelerator {self.tpu.accelerator!r}")
+        if self.profile.enabled:
+            if not self.profile.directory:
+                errs.append("profile.enabled requires profile.directory")
+            if self.profile.num_steps < 1:
+                errs.append(
+                    f"profile.numSteps must be >= 1, got {self.profile.num_steps}"
+                )
         return errs
 
     def to_dict(self) -> Dict[str, Any]:

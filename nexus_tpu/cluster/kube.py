@@ -295,6 +295,42 @@ class KubeClusterStore:
                 need_relist = True
                 self._stop.wait(1.0)
 
+    # ------------------------------------------------------------------ events
+    def create_event(self, obj: APIObject, event) -> None:
+        """Post a v1 Event against ``obj`` (the reference's broadcaster →
+        EventSink wiring, controller.go:252-256; RBAC grants events create,
+        cluster-role-secret-editor.yaml:27)."""
+        import datetime
+
+        meta = obj.metadata
+        now = datetime.datetime.now(datetime.timezone.utc)
+        api_version = (
+            "v1" if obj.KIND in (Secret.KIND, ConfigMap.KIND)
+            else f"{GROUP}/{VERSION}"
+        )
+        body = k8s_client.CoreV1Event(
+            metadata=k8s_client.V1ObjectMeta(
+                generate_name=f"{meta.name}.", namespace=meta.namespace
+            ),
+            involved_object=k8s_client.V1ObjectReference(
+                api_version=api_version,
+                kind=obj.KIND,
+                name=meta.name,
+                namespace=meta.namespace,
+                uid=meta.uid or None,
+            ),
+            type=event.type,
+            reason=event.reason,
+            message=event.message,
+            source=k8s_client.V1EventSource(component=event.component or None)
+            if getattr(event, "component", "")
+            else None,
+            count=1,
+            first_timestamp=now,
+            last_timestamp=now,
+        )
+        self._core.create_namespaced_event(meta.namespace, body)
+
     def clear_actions(self) -> None:
         self.actions = []
 

@@ -116,7 +116,12 @@ class Controller:
         self.informers = informer_factory or InformerFactory(
             controller_store, resync_period=resync_period
         )
-        self.recorder = recorder or EventRecorder()
+        if recorder is None:
+            # real-cluster stores post v1 Events (reference broadcaster →
+            # EventSink, controller.go:252-256); in-process stores just log
+            sink = getattr(controller_store, "create_event", None)
+            recorder = EventRecorder(sink=sink)
+        self.recorder = recorder
         self.statsd = statsd or get_client()
         self.use_finalizers = use_finalizers
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 import logging
 import threading
 from dataclasses import dataclass, field
-from typing import Any, List, Optional
+from typing import Any, Callable, List, Optional
 
 logger = logging.getLogger("nexus_tpu.events")
 
@@ -51,13 +51,24 @@ class Event:
     object_kind: str = ""
     object_name: str = ""
     object_namespace: str = ""
+    component: str = ""
 
 
 class EventRecorder:
-    """Records events against objects; logs them and keeps a bounded list."""
+    """Records events against objects; logs them and keeps a bounded list.
 
-    def __init__(self, component: str = "nexus-configuration-controller"):
+    ``sink(obj, event)`` — optional callable posting the event to an
+    external system (the Kubernetes Events API on real clusters, mirroring
+    the reference's broadcaster wiring, controller.go:252-256). Sink errors
+    are swallowed: event delivery must never fail a reconcile."""
+
+    def __init__(
+        self,
+        component: str = "nexus-configuration-controller",
+        sink: Optional[Callable[[Any, Event], None]] = None,
+    ):
         self.component = component
+        self.sink = sink
         self._lock = threading.Lock()
         self.events: List[Event] = []
 
@@ -70,6 +81,7 @@ class EventRecorder:
             object_kind=getattr(obj, "KIND", ""),
             object_name=getattr(meta, "name", "") if meta else "",
             object_namespace=getattr(meta, "namespace", "") if meta else "",
+            component=self.component,
         )
         with self._lock:
             self.events.append(ev)
@@ -85,6 +97,11 @@ class EventRecorder:
             reason,
             message,
         )
+        if self.sink is not None:
+            try:
+                self.sink(obj, ev)
+            except Exception:
+                logger.exception("event sink failed (event already recorded)")
 
 
 class FakeRecorder(EventRecorder):

@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from nexus_tpu.ops.norms import rms_norm
+from nexus_tpu.ops.rope import apply_rope, rope_cos_sin
 from nexus_tpu.ops.sampling import sample_logits
 
 
@@ -27,6 +29,75 @@ def init_kv_cache(
         "v": jnp.zeros(shape, dtype),
         "length": jnp.zeros((), jnp.int32),
     }
+
+
+def _decode_attention(
+    q: jnp.ndarray, k_buf: jnp.ndarray, v_buf: jnp.ndarray,
+    start: jnp.ndarray, t: int,
+) -> jnp.ndarray:
+    """Length-masked attention of t new queries over the full cache buffer.
+
+    Static shapes (the mask, not a slice, hides unwritten cache tail) — one
+    compiled program regardless of decode position."""
+    hd = q.shape[-1]
+    max_len = k_buf.shape[1]
+    n_rep = q.shape[2] // k_buf.shape[2]
+    kr = jnp.repeat(k_buf, n_rep, axis=2)
+    vr = jnp.repeat(v_buf, n_rep, axis=2)
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, kr, preferred_element_type=jnp.float32
+    ) * hd ** -0.5
+    q_pos = start + jnp.arange(t)
+    visible = jnp.arange(max_len)[None, :] <= q_pos[:, None]  # (t, max_len)
+    mask_value = -0.7 * float(jnp.finfo(jnp.float32).max)
+    logits = jnp.where(visible[None, None], logits, mask_value)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v_buf.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
+
+
+def scanned_forward_decode(
+    params: Dict[str, Any],
+    cfg: Any,
+    tokens: jnp.ndarray,
+    cache: Dict[str, Any],
+    ffn: Callable[[Any, jnp.ndarray, Dict[str, jnp.ndarray]], jnp.ndarray],
+):
+    """Shared incremental-decode scaffold: embed → rope slice → lax.scan
+    over (stacked layer params, cache) → final norm → lm head. The per-layer
+    FFN is the only family-specific piece (``ffn(cfg, h, layer) → delta``).
+
+    One compiled block at any depth — same trace-once strategy as the
+    families' forward()."""
+    b, t = tokens.shape
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    max_len = cache["k"].shape[2]
+    start = cache["length"]
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    # rope tables for the whole buffer; slice at runtime positions
+    cos_full, sin_full = rope_cos_sin(max_len, hd, cfg.rope_theta)
+    cos = lax.dynamic_slice_in_dim(cos_full, start, t, axis=0)
+    sin = lax.dynamic_slice_in_dim(sin_full, start, t, axis=0)
+
+    def layer_step(x, scanned):
+        layer, k_cache, v_cache = scanned
+        h = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+        q = apply_rope((h @ layer["wq"]).reshape(b, t, hq, hd), cos, sin)
+        k = apply_rope((h @ layer["wk"]).reshape(b, t, hkv, hd), cos, sin)
+        v = (h @ layer["wv"]).reshape(b, t, hkv, hd)
+        k_buf = lax.dynamic_update_slice_in_dim(k_cache, k, start, axis=1)
+        v_buf = lax.dynamic_update_slice_in_dim(v_cache, v, start, axis=1)
+        attn = _decode_attention(q, k_buf, v_buf, start, t)
+        x = x + attn.reshape(b, t, hq * hd) @ layer["wo"]
+        h2 = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
+        return x + ffn(cfg, h2, layer), (k_buf, v_buf)
+
+    x, (new_k, new_v) = lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v, "length": start + t}
 
 
 def autoregressive_generate(
@@ -51,7 +122,17 @@ def autoregressive_generate(
             "fixed seed would make 'stochastic' sampling deterministic"
         )
     b, p = prompt.shape
-    max_len = max_len or min(cfg.max_seq_len, p + max_new_tokens)
+    needed = p + max_new_tokens
+    if max_len is None:
+        max_len = needed
+    if max_len < needed or needed > cfg.max_seq_len:
+        # a too-small cache would silently clamp dynamic_update_slice and
+        # overwrite the last slot — corrupt output, not an error
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) needs "
+            f"{needed} cache slots but max_len={max_len}, "
+            f"cfg.max_seq_len={cfg.max_seq_len}"
+        )
     cache = init_kv_cache(
         cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype, b, max_len
     )

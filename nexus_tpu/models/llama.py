@@ -206,28 +206,11 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Dict[str, Any]:
     )
 
 
-def _decode_attention(
-    q: jnp.ndarray, k_buf: jnp.ndarray, v_buf: jnp.ndarray,
-    start: jnp.ndarray, t: int,
-) -> jnp.ndarray:
-    """Length-masked attention of t new queries over the full cache buffer.
-
-    Static shapes (the mask, not a slice, hides unwritten cache tail) — one
-    compiled program regardless of decode position."""
-    hd = q.shape[-1]
-    max_len = k_buf.shape[1]
-    n_rep = q.shape[2] // k_buf.shape[2]
-    kr = jnp.repeat(k_buf, n_rep, axis=2)
-    vr = jnp.repeat(v_buf, n_rep, axis=2)
-    logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, kr, preferred_element_type=jnp.float32
-    ) * hd ** -0.5
-    q_pos = start + jnp.arange(t)
-    visible = jnp.arange(max_len)[None, :] <= q_pos[:, None]  # (t, max_len)
-    mask_value = -0.7 * float(jnp.finfo(jnp.float32).max)
-    logits = jnp.where(visible[None, None], logits, mask_value)
-    probs = jax.nn.softmax(logits, axis=-1).astype(v_buf.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
+def _swiglu_ffn(cfg: LlamaConfig, h: jnp.ndarray,
+                layer: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    return (
+        jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
+    ) @ layer["w_down"]
 
 
 def forward_decode(
@@ -235,44 +218,11 @@ def forward_decode(
     tokens: jnp.ndarray, cache: Dict[str, Any],
 ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
     """Incremental decode: tokens (B, T) appended at cache['length'].
+    Scaffold (scanned stacked layers, length-masked cache attention):
+    models/decoding.py."""
+    from nexus_tpu.models.decoding import scanned_forward_decode
 
-    Returns logits for the new positions and the updated cache. The layer
-    stack is ``lax.scan``-ned over the stacked params + cache (one compiled
-    block for any depth — same trace-once strategy as forward())."""
-    b, t = tokens.shape
-    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    max_len = cache["k"].shape[2]
-    start = cache["length"]
-
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    # rope tables for the whole buffer; slice at runtime positions
-    cos_full, sin_full = rope_cos_sin(max_len, hd, cfg.rope_theta)
-    cos = lax.dynamic_slice_in_dim(cos_full, start, t, axis=0)
-    sin = lax.dynamic_slice_in_dim(sin_full, start, t, axis=0)
-
-    def layer_step(x, scanned):
-        layer, k_cache, v_cache = scanned
-        h = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
-        q = apply_rope((h @ layer["wq"]).reshape(b, t, hq, hd), cos, sin)
-        k = apply_rope((h @ layer["wk"]).reshape(b, t, hkv, hd), cos, sin)
-        v = (h @ layer["wv"]).reshape(b, t, hkv, hd)
-        k_buf = lax.dynamic_update_slice_in_dim(k_cache, k, start, axis=1)
-        v_buf = lax.dynamic_update_slice_in_dim(v_cache, v, start, axis=1)
-        attn = _decode_attention(q, k_buf, v_buf, start, t)
-        x = x + attn.reshape(b, t, hq * hd) @ layer["wo"]
-        h2 = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
-        x = x + (
-            jax.nn.silu(h2 @ layer["w_gate"]) * (h2 @ layer["w_up"])
-        ) @ layer["w_down"]
-        return x, (k_buf, v_buf)
-
-    x, (new_k, new_v) = lax.scan(
-        layer_step, x, (params["layers"], cache["k"], cache["v"])
-    )
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
-    new_cache = {"k": new_k, "v": new_v, "length": start + t}
-    return logits, new_cache
+    return scanned_forward_decode(params, cfg, tokens, cache, _swiglu_ffn)
 
 
 def generate(

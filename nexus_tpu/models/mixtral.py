@@ -208,39 +208,14 @@ def forward_decode(
     tokens: jnp.ndarray, cache: Dict[str, Any],
 ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
     """Incremental decode with routed-MoE FFN (aux loss irrelevant at
-    inference). Same scanned-stacked-layer strategy as llama.forward_decode."""
-    from nexus_tpu.models.llama import _decode_attention
+    inference). Scaffold: models/decoding.py."""
+    from nexus_tpu.models.decoding import scanned_forward_decode
 
-    b, t = tokens.shape
-    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    max_len = cache["k"].shape[2]
-    start = cache["length"]
+    def moe_ffn(cfg, h, layer):
+        out, _ = _moe_ffn(cfg, h, layer)
+        return out
 
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    cos_full, sin_full = rope_cos_sin(max_len, hd, cfg.rope_theta)
-    cos = lax.dynamic_slice_in_dim(cos_full, start, t, axis=0)
-    sin = lax.dynamic_slice_in_dim(sin_full, start, t, axis=0)
-
-    def layer_step(x, scanned):
-        layer, k_cache, v_cache = scanned
-        h = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
-        q = apply_rope((h @ layer["wq"]).reshape(b, t, hq, hd), cos, sin)
-        k = apply_rope((h @ layer["wk"]).reshape(b, t, hkv, hd), cos, sin)
-        v = (h @ layer["wv"]).reshape(b, t, hkv, hd)
-        k_buf = lax.dynamic_update_slice_in_dim(k_cache, k, start, axis=1)
-        v_buf = lax.dynamic_update_slice_in_dim(v_cache, v, start, axis=1)
-        attn = _decode_attention(q, k_buf, v_buf, start, t)
-        x = x + attn.reshape(b, t, hq * hd) @ layer["wo"]
-        h2 = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
-        moe_out, _ = _moe_ffn(cfg, h2, layer)
-        return x + moe_out, (k_buf, v_buf)
-
-    x, (new_k, new_v) = lax.scan(
-        layer_step, x, (params["layers"], cache["k"], cache["v"])
-    )
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
-    return logits, {"k": new_k, "v": new_v, "length": start + t}
+    return scanned_forward_decode(params, cfg, tokens, cache, moe_ffn)
 
 
 def generate(

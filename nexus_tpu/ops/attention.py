@@ -70,7 +70,7 @@ def attention_xla(
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, scale: float, causal: bool, block_q: int, block_k: int, q_offset: int,
 ):
     i = pl.program_id(1)  # q block
@@ -114,6 +114,8 @@ def _flash_kernel(
         l = l_ref[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        # logsumexp per q row, saved for the backward recompute of P
+        lse_ref[0, :] = (m_ref[:, 0] + jnp.log(safe_l[:, 0]))
 
 
 def flash_attention(
@@ -128,8 +130,9 @@ def flash_attention(
 ) -> jnp.ndarray:
     """Pallas flash attention. Same signature/semantics as attention_xla.
 
-    Differentiable: custom VJP with a flash forward and an XLA-recompute
-    backward (a dedicated Pallas backward kernel is a later optimization)."""
+    Differentiable: custom VJP — flash forward saves (O, logsumexp), and
+    dedicated Pallas dq / dk+dv kernels recompute P blockwise on the
+    backward pass (no S×S materialization; see _flash_bwd_impl)."""
     if interpret is None:
         from nexus_tpu.utils.hw import is_tpu
 
@@ -139,24 +142,27 @@ def flash_attention(
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash(q, k, v, opts):
-    return _flash_impl(q, k, v, opts)
+    out, _ = _flash_impl(q, k, v, opts)
+    return out
 
 
 def _flash_fwd_rule(q, k, v, opts):
-    return _flash_impl(q, k, v, opts), (q, k, v)
+    out, lse = _flash_impl(q, k, v, opts)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(opts, residuals, g):
-    causal, q_offset, _, _, _ = opts
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q, k, v: attention_xla(q, k, v, causal=causal, q_offset=q_offset),
-        q, k, v,
-    )
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return _flash_bwd_impl(q, k, v, out, lse, g, opts)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _fold_heads(x):
+    """(B, S, H, D) → (B*H, S, D)."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
 
 def _flash_impl(q, k, v, opts):
@@ -175,9 +181,7 @@ def _flash_impl(q, k, v, opts):
         )
 
     # fold heads into the grid's batch dim: (B*H, S, D)
-    qf = q.transpose(0, 2, 1, 3).reshape(b * hq, sq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * hq, sk, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * hq, sk, d)
+    qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
 
     kernel = functools.partial(
         _flash_kernel,
@@ -188,7 +192,7 @@ def _flash_impl(q, k, v, opts):
         q_offset=q_offset,
     )
     grid = (b * hq, sq // block_q, sk // block_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -196,8 +200,14 @@ def _flash_impl(q, k, v, opts):
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * hq, sq), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -205,7 +215,180 @@ def _flash_impl(q, k, v, opts):
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, hq, sq, d).transpose(0, 2, 1, 3), lse
+
+
+# ------------------------------------------------------------ flash backward
+#
+# Standard flash-attention backward (Dao 2022): with S = scale·QKᵀ,
+# P = softmax(S) recomputed blockwise from the saved logsumexp,
+#   D  = rowsum(dO ⊙ O)
+#   dP = dO Vᵀ
+#   dS = P ⊙ (dP − D)
+#   dQ = scale · dS K      (kernel 1: grid over q blocks, scan k blocks)
+#   dK = scale · dSᵀ Q     (kernel 2: grid over k blocks, scan q blocks)
+#   dV = Pᵀ dO             (kernel 2)
+# Both kernels recompute P from (q, k, lse) — O(S/block) memory, no S×S
+# materialization (the previous backward fell back to the XLA einsum path).
+
+
+def _flash_bwd_p(q, k, lse, *, scale, causal, i, j, block_q, block_k, q_offset):
+    """Recompute the (block_q, block_k) probability tile."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    p = jnp.exp(s - lse[:, None])
+    if causal:
+        rows = lax.broadcasted_iota(jnp.int32, p.shape, 0) + i * block_q + q_offset
+        cols = lax.broadcasted_iota(jnp.int32, p.shape, 1) + j * block_k
+        p = jnp.where(cols <= rows, p, 0.0)
+    return p
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
+    *, scale, causal, block_q, block_k, q_offset,
+):
+    i = pl.program_id(1)  # q block (parallel)
+    j = pl.program_id(2)  # k block (sequential accumulation)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    lse, delta = lse_ref[0, :], delta_ref[0, :]
+    p = _flash_bwd_p(
+        q, k, lse, scale=scale, causal=causal, i=i, j=j,
+        block_q=block_q, block_k=block_k, q_offset=q_offset,
+    )
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (bq, bk)
+    ds = p * (dp - delta[:, None])  # (bq, bk) f32
+    acc_ref[:] += scale * jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc_ref, dv_acc_ref,
+    *, scale, causal, block_q, block_k, q_offset,
+):
+    j = pl.program_id(1)  # k block (parallel)
+    i = pl.program_id(2)  # q block (sequential accumulation)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    lse, delta = lse_ref[0, :], delta_ref[0, :]
+    p = _flash_bwd_p(
+        q, k, lse, scale=scale, causal=causal, i=i, j=j,
+        block_q=block_q, block_k=block_k, q_offset=q_offset,
+    )
+    dv_acc_ref[:] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # Pᵀ dO: (bk, d)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta[:, None])
+    dk_acc_ref[:] += scale * jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # dSᵀ Q: (bk, d)
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, out, lse, g, opts):
+    causal, q_offset, block_q, block_k, interpret = opts
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    n_rep = hq // hkv
+    kr = _repeat_kv(k, n_rep)
+    vr = _repeat_kv(v, n_rep)
+    sk = kr.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+
+    qf, kf, vf = _fold_heads(q), _fold_heads(kr), _fold_heads(vr)
+    dof, of = _fold_heads(g), _fold_heads(out)
+    bh = b * hq
+
+    # D = rowsum(dO ⊙ O) — cheap elementwise+reduce; plain XLA
+    delta = jnp.sum(
+        dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1
+    )  # (BH, Sq)
+
+    common = dict(
+        scale=d ** -0.5, causal=causal,
+        block_q=block_q, block_k=block_k, q_offset=q_offset,
+    )
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
+    row_spec = pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(bh, sq // block_q, sk // block_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    # dk/dv: swap the roles — grid's parallel dim walks k blocks, inner
+    # sequential dim walks q blocks (index maps receive (bh, j, i))
+    qT_spec = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
+    kT_spec = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
+    rowT_spec = pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common),
+        grid=(bh, sk // block_k, sq // block_q),
+        in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    def _unfold(x, s):
+        return x.reshape(b, hq, s, d).transpose(0, 2, 1, 3)
+
+    dq = _unfold(dq, sq)
+    dk = _unfold(dk, sk)
+    dv = _unfold(dv, sk)
+    if n_rep > 1:
+        # sum the broadcast query-head groups back onto each kv head
+        dk = dk.reshape(b, sk, hkv, n_rep, d).sum(axis=3)
+        dv = dv.reshape(b, sk, hkv, n_rep, d).sum(axis=3)
+    return dq, dk, dv
 
 
 def attention(

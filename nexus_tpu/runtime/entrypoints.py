@@ -150,10 +150,11 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps):
     if result.profiled:
         metrics["profile_dir"] = runtime.profile.directory
     elif runtime.profile.enabled and runtime.profile.directory:
+        steps_run = max(steps - start_step, 1)  # what trainer.run() received
         logger.warning(
             "profiling was enabled but the capture window never opened "
-            "(start_step=%d >= %d timed steps)",
-            runtime.profile.start_step, max(steps - 1, 0),
+            "(start_step=%d >= %d timed steps this run)",
+            runtime.profile.start_step, max(steps_run - 1, 0),
         )
     if hasattr(cfg, "param_count"):
         fpt = llama_flops_per_token(cfg, tr.seq_len)

@@ -197,7 +197,7 @@ class Trainer:
             self.state, metrics = self.step_fn(self.state, batch)
             if "loss" in metrics:
                 losses.append(metrics["loss"])
-            if profiling and i + 1 == self.profile_start + self.profile_steps:
+            if profiling and i + 1 >= self.profile_start + self.profile_steps:
                 jax.block_until_ready(self.state)
                 jax.profiler.stop_trace()
                 profiling = False

@@ -539,3 +539,30 @@ def test_finalizer_delete_retries_on_shard_failure():
     for i in range(2):
         with pytest.raises(KeyError):
             f.shard_stores[i].get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+
+
+def test_event_recorder_sink_receives_events():
+    """Real-cluster stores expose create_event; the controller wires it as
+    the recorder sink (reference broadcaster wiring, controller.go:252-256)."""
+    from nexus_tpu.controller.events import (
+        EVENT_TYPE_NORMAL,
+        EventRecorder,
+    )
+
+    posted = []
+
+    def sink(obj, ev):
+        posted.append((obj.metadata.name, ev.reason, ev.component))
+
+    rec = EventRecorder(component="test-comp", sink=sink)
+    tmpl = make_template("evt-tmpl")
+    rec.event(tmpl, EVENT_TYPE_NORMAL, "Synced", "ok")
+    assert posted == [("evt-tmpl", "Synced", "test-comp")]
+
+    # sink errors never propagate
+    def bad_sink(obj, ev):
+        raise RuntimeError("api down")
+
+    rec2 = EventRecorder(sink=bad_sink)
+    rec2.event(tmpl, EVENT_TYPE_NORMAL, "Synced", "ok")
+    assert rec2.events[-1].reason == "Synced"

@@ -2,6 +2,7 @@
 decode-cache consistency."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -211,3 +212,13 @@ def test_sampling_ops():
         )
         for b in range(4):
             assert toks[b] in topk_sets[b]
+
+
+def test_generate_rejects_overlong_request():
+    from nexus_tpu.models import llama as L
+
+    cfg = tiny_llama()  # max_seq_len bounded
+    params = L.init(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+    with pytest.raises(ValueError, match="cache slots"):
+        L.generate(params, cfg, prompt, max_new_tokens=cfg.max_seq_len)
