@@ -212,6 +212,37 @@ class TrainSpec:
 
 
 @dataclass
+class DataSpec:
+    """Training corpus: deterministic synthetic stream (default) or a flat
+    binary token file read via memmap with host-disjoint sampling
+    (train/data.py). ``prefetch`` is the background-prefetch queue depth
+    (0 disables the prefetch thread)."""
+
+    kind: str = "synthetic"  # synthetic | tokens
+    path: str = ""
+    dtype: str = "int32"
+    prefetch: int = 2
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "path": self.path,
+            "dtype": self.dtype,
+            "prefetch": self.prefetch,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "DataSpec":
+        prefetch = d.get("prefetch")
+        return cls(
+            kind=d.get("kind", "synthetic"),
+            path=d.get("path", ""),
+            dtype=d.get("dtype", "int32"),
+            prefetch=2 if prefetch is None else int(prefetch),
+        )
+
+
+@dataclass
 class CheckpointSpec:
     enabled: bool = False
     directory: str = ""
@@ -289,6 +320,7 @@ class JaxXlaRuntime:
     tpu: TpuSliceSpec = field(default_factory=TpuSliceSpec)
     parallelism: ParallelismSpec = field(default_factory=ParallelismSpec)
     train: TrainSpec = field(default_factory=TrainSpec)
+    data: DataSpec = field(default_factory=DataSpec)
     checkpoint: CheckpointSpec = field(default_factory=CheckpointSpec)
     profile: ProfileSpec = field(default_factory=ProfileSpec)
 
@@ -315,6 +347,13 @@ class JaxXlaRuntime:
                 errs.append(
                     f"profile.numSteps must be >= 1, got {self.profile.num_steps}"
                 )
+        if self.data.kind not in ("synthetic", "tokens"):
+            errs.append(f"unknown data.kind {self.data.kind!r}")
+        elif self.data.kind == "tokens":
+            if not self.data.path:
+                errs.append("data.kind='tokens' requires data.path")
+            if self.data.dtype not in ("int32", "uint16", "int16"):
+                errs.append(f"unsupported data.dtype {self.data.dtype!r}")
         return errs
 
     def to_dict(self) -> Dict[str, Any]:
@@ -326,6 +365,7 @@ class JaxXlaRuntime:
             "tpu": self.tpu.to_dict(),
             "parallelism": self.parallelism.to_dict(),
             "train": self.train.to_dict(),
+            "data": self.data.to_dict(),
             "checkpoint": self.checkpoint.to_dict(),
             "profile": self.profile.to_dict(),
         }
@@ -342,6 +382,7 @@ class JaxXlaRuntime:
             tpu=TpuSliceSpec.from_dict(d.get("tpu") or {}),
             parallelism=ParallelismSpec.from_dict(d.get("parallelism") or {}),
             train=TrainSpec.from_dict(d.get("train") or {}),
+            data=DataSpec.from_dict(d.get("data") or {}),
             checkpoint=CheckpointSpec.from_dict(d.get("checkpoint") or {}),
             profile=ProfileSpec.from_dict(d.get("profile") or {}),
         )

@@ -26,7 +26,12 @@ from nexus_tpu.parallel.mesh import (
     plan_for_devices,
 )
 from nexus_tpu.train.checkpoint import Checkpointer
-from nexus_tpu.train.data import synthetic_lm_batches, synthetic_mlp_batches
+from nexus_tpu.train.data import (
+    Prefetcher,
+    synthetic_lm_batches,
+    synthetic_mlp_batches,
+    token_file_batches,
+)
 from nexus_tpu.train.metrics import (
     detect_peak_flops_per_chip,
     llama_flops_per_token,
@@ -99,11 +104,31 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps):
                 tr.batch_size, cfg.in_dim, cfg.out_dim, seed=tr.seed
             )
             tokens_per_batch = 0
+        elif runtime.data.kind == "tokens":
+            data = token_file_batches(
+                runtime.data.path,
+                tr.batch_size,
+                tr.seq_len,
+                dtype=runtime.data.dtype,
+                seed=tr.seed,
+                shard_index=jax.process_index(),
+                num_shards=jax.process_count(),
+                vocab_size=cfg.vocab_size,
+            )
+            tokens_per_batch = tr.batch_size * tr.seq_len
         else:
             data = synthetic_lm_batches(
                 tr.batch_size, tr.seq_len, cfg.vocab_size, seed=tr.seed
             )
             tokens_per_batch = tr.batch_size * tr.seq_len
+        prefetcher = None
+        if runtime.data.prefetch > 0:
+            # device_put in the prefetch thread overlaps H2D transfer with
+            # the device step; sharding matches make_train_step's batch spec
+            batch_sharding = NamedSharding(mesh, P(("data", "fsdp")))
+            data = prefetcher = Prefetcher(
+                data, depth=runtime.data.prefetch, sharding=batch_sharding
+            )
 
         checkpointer = None
         start_step = 0
@@ -130,7 +155,11 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps):
             profile_start=prof.start_step,
             profile_steps=prof.num_steps,
         )
-        result = trainer.run(max(steps - start_step, 1))
+        try:
+            result = trainer.run(max(steps - start_step, 1))
+        finally:
+            if prefetcher is not None:
+                prefetcher.close()
         if checkpointer is not None:
             checkpointer.save(trainer.state, wait=True)
             checkpointer.close()

@@ -130,6 +130,40 @@ def materialize_job(
             ],
         }
         backoff = template.spec.runtime_environment.maximum_retries
+        # ErrorHandlingBehaviour → Kubernetes podFailurePolicy: fatal exit
+        # codes fail the whole Job immediately; transient codes don't count
+        # against backoffLimit (the pod is simply retried). This executes
+        # the CRD's declared retry semantics in-cluster — the reference
+        # carries the same fields but defers execution to its ecosystem
+        # (reference shape: controller_test.go:318-321).
+        eh = template.spec.error_handling_behaviour
+        failure_rules = []
+        # exit code 0 is success — the apiserver rejects it in onExitCodes
+        # values (operator In), which would fail creation of the whole Job
+        fatal = sorted({c for c in eh.fatal_exit_codes if c != 0})
+        transient = sorted({c for c in eh.transient_exit_codes if c != 0})
+        if fatal:
+            failure_rules.append(
+                {
+                    "action": "FailJob",
+                    "onExitCodes": {
+                        "containerName": "jax-worker",
+                        "operator": "In",
+                        "values": fatal,
+                    },
+                }
+            )
+        if transient:
+            failure_rules.append(
+                {
+                    "action": "Ignore",
+                    "onExitCodes": {
+                        "containerName": "jax-worker",
+                        "operator": "In",
+                        "values": transient,
+                    },
+                }
+            )
         job = {
             "apiVersion": "batch/v1",
             "kind": "Job",
@@ -158,6 +192,9 @@ def materialize_job(
                 "parallelism": tpu.hosts_per_slice,
                 "completionMode": "Indexed",
                 "backoffLimit": backoff if backoff is not None else 3,
+                "podFailurePolicy": {"rules": failure_rules}
+                if failure_rules
+                else None,
                 "activeDeadlineSeconds": template.spec.runtime_environment.deadline_seconds,
                 "template": {
                     "metadata": {

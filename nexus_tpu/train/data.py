@@ -1,12 +1,18 @@
-"""Synthetic data pipelines (deterministic, host-side numpy).
+"""Data pipelines: deterministic synthetic streams + memory-mapped token
+corpora with background prefetch.
 
-Real corpora are a deployment concern; the framework ships deterministic
-synthetic streams so training/benchmarks are reproducible and the input
-pipeline never bottlenecks the chip (generation is O(batch) int sampling)."""
+Synthetic streams keep training/benchmarks reproducible with a provably
+non-bottlenecking input path. For real corpora, ``token_file_batches`` reads
+a flat binary token file via ``np.memmap`` (zero-copy, page-cache backed),
+shards sampling across hosts, and ``Prefetcher`` overlaps host batch
+assembly + H2D transfer with the device step — the input-pipeline overlap
+that MFU accounting assumes."""
 
 from __future__ import annotations
 
-from typing import Dict, Iterator
+import queue
+import threading
+from typing import Dict, Iterator, Optional
 
 import numpy as np
 
@@ -44,3 +50,133 @@ def synthetic_mlp_batches(
         x = rng.randn(batch_size, in_dim).astype(np.float32)
         y = x @ w + 0.01 * rng.randn(batch_size, out_dim).astype(np.float32)
         yield {"x": x, "y": y}
+
+
+# ------------------------------------------------------------- token corpora
+
+TOKEN_DTYPES = {"int32": np.int32, "uint16": np.uint16, "int16": np.int16}
+
+
+def write_token_file(path: str, tokens: np.ndarray, dtype: str = "int32") -> None:
+    """Write a flat binary token file (the corpus format token_file_batches
+    reads). Tooling/test helper."""
+    np.asarray(tokens, dtype=TOKEN_DTYPES[dtype]).tofile(path)
+
+
+def token_file_batches(
+    path: str,
+    batch_size: int,
+    seq_len: int,
+    dtype: str = "int32",
+    seed: int = 0,
+    shard_index: int = 0,
+    num_shards: int = 1,
+    vocab_size: Optional[int] = None,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Random-crop batches from a flat binary token corpus.
+
+    The file is memory-mapped (no load into RAM); each batch row is a random
+    (seq_len + 1)-token window — (inputs, next-token targets) come from the
+    same row, matching loss_fn's ``tokens[:, :-1] / [:, 1:]`` split. With
+    ``num_shards > 1`` the corpus is partitioned into contiguous disjoint
+    regions, one per host, so multi-host data parallelism never duplicates
+    rows (each shard also gets its own RNG stream)."""
+    data = np.memmap(path, dtype=TOKEN_DTYPES[dtype], mode="r")
+    window = seq_len + 1
+    if not 0 <= shard_index < num_shards:
+        raise ValueError(f"shard_index {shard_index} not in [0, {num_shards})")
+    region = data.shape[0] // num_shards
+    lo = shard_index * region
+    hi = lo + region - window + 1
+    if hi <= lo:
+        raise ValueError(
+            f"corpus {path} shard {shard_index}/{num_shards} has {region} "
+            f"tokens; need >= {window} (seq_len + 1)"
+        )
+    rng = np.random.RandomState((seed * 1_000_003 + shard_index) % (2**31 - 1))
+    while True:
+        starts = rng.randint(lo, hi, size=batch_size)
+        rows = np.stack([data[s:s + window] for s in starts])
+        if vocab_size is not None and rows.max() >= vocab_size:
+            # jax's embedding gather silently clamps out-of-range ids —
+            # that corrupts training with no error, so fail loudly here
+            raise ValueError(
+                f"corpus {path} contains token id {int(rows.max())} >= "
+                f"model vocab_size {vocab_size}"
+            )
+        yield {"tokens": rows.astype(np.int32)}
+
+
+class Prefetcher:
+    """Background-thread prefetch of a host batch iterator.
+
+    Keeps up to ``depth`` batches ready (optionally already ``jax.device_put``
+    with a target sharding), so the host assembles batch N+1 while the device
+    runs step N. Iterate it like the wrapped iterator; call ``close()`` (or
+    exhaust it) to stop the thread."""
+
+    _SENTINEL = object()
+
+    def __init__(self, it: Iterator, depth: int = 2, sharding=None):
+        self._it = it
+        self._sharding = sharding
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._fill, daemon=True, name="nexus-data-prefetch"
+        )
+        self._thread.start()
+
+    def _fill(self) -> None:
+        try:
+            for item in self._it:
+                if self._stop.is_set():
+                    return
+                if self._sharding is not None:
+                    import jax
+
+                    item = jax.device_put(item, self._sharding)
+                # bounded put, re-checking stop so close() can't deadlock
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # noqa: BLE001 — re-raised to the consumer
+            self._error = e
+        finally:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(self._SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._SENTINEL:
+            if self._error is not None:
+                # surface the data pipeline's real failure, not a bare
+                # StopIteration out of the trainer loop
+                raise self._error
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        # drain so a blocked put wakes up
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        # unblock any consumer already waiting in __next__
+        try:
+            self._q.put_nowait(self._SENTINEL)
+        except queue.Full:
+            pass
