@@ -1,5 +1,5 @@
 # Developer entry points.
-NATIVE_SRC := nexus_tpu/native/src/nexus_core.cpp
+NATIVE_SRC := nexus_tpu/native/src/nexus_core.cpp nexus_tpu/native/src/nexus_data.cpp
 NATIVE_LIB := nexus_tpu/native/libnexus_core.so
 
 .PHONY: all native test bench clean lint

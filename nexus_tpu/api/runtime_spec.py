@@ -354,6 +354,11 @@ class JaxXlaRuntime:
                 errs.append("data.kind='tokens' requires data.path")
             if self.data.dtype not in ("int32", "uint16", "int16"):
                 errs.append(f"unsupported data.dtype {self.data.dtype!r}")
+            if self.model.family == "mlp":
+                errs.append(
+                    "data.kind='tokens' is for LM families; the mlp family "
+                    "trains on its synthetic regression stream"
+                )
         return errs
 
     def to_dict(self) -> Dict[str, Any]:

@@ -24,7 +24,10 @@ import threading
 from typing import Any, Dict, Optional, Tuple
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "src", "nexus_core.cpp")
+_SRCS = [
+    os.path.join(_HERE, "src", "nexus_core.cpp"),
+    os.path.join(_HERE, "src", "nexus_data.cpp"),
+]
 _LIB = os.path.join(_HERE, "libnexus_core.so")
 
 _lock = threading.Lock()
@@ -35,15 +38,15 @@ _load_failed = False
 def _build() -> bool:
     """Compile the shared library if missing or stale. Returns success."""
     try:
-        if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(
-            _SRC
+        if os.path.exists(_LIB) and all(
+            os.path.getmtime(_LIB) >= os.path.getmtime(s) for s in _SRCS
         ):
             return True
         tmp = f"{_LIB}.{os.getpid()}.tmp"  # unique per process: two
         # concurrent builders must not interleave g++ output in one file
         cmd = [
             "g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
-            "-o", tmp, _SRC,
+            "-o", tmp, *_SRCS,
         ]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _LIB)
@@ -68,36 +71,64 @@ def load() -> Optional[ctypes.CDLL]:
             return None
         try:
             lib = ctypes.CDLL(_LIB)
-        except OSError:
-            _load_failed = True
-            return None
-        lib.ncq_new.restype = ctypes.c_void_p
-        lib.ncq_new.argtypes = [
-            ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_int,
-        ]
-        lib.ncq_free.argtypes = [ctypes.c_void_p]
-        lib.ncq_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.ncq_get.restype = ctypes.c_int
-        lib.ncq_get.argtypes = [
-            ctypes.c_void_p, ctypes.c_double, ctypes.c_char_p, ctypes.c_int,
-        ]
-        lib.ncq_done.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.ncq_add_after.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double,
-        ]
-        lib.ncq_add_rate_limited.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.ncq_forget.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.ncq_num_requeues.restype = ctypes.c_int
-        lib.ncq_num_requeues.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.ncq_len.restype = ctypes.c_int
-        lib.ncq_len.argtypes = [ctypes.c_void_p]
-        lib.ncq_tracked.restype = ctypes.c_int
-        lib.ncq_tracked.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-        lib.ncq_shut_down.argtypes = [ctypes.c_void_p]
-        lib.ncq_shutting_down.restype = ctypes.c_int
-        lib.ncq_shutting_down.argtypes = [ctypes.c_void_p]
+            _bind(lib)
+        except (OSError, AttributeError):
+            # AttributeError: a stale prebuilt .so (fresh mtime, old symbol
+            # set — e.g. restored from a cache) — rebuild once from source
+            try:
+                os.remove(_LIB)
+                if _build():
+                    lib = ctypes.CDLL(_LIB)
+                    _bind(lib)
+                else:
+                    raise OSError("rebuild failed")
+            except Exception:
+                _load_failed = True
+                return None
         _lib = lib
         return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    """Declare ctypes signatures; raises AttributeError on missing symbols."""
+    lib.ncq_new.restype = ctypes.c_void_p
+    lib.ncq_new.argtypes = [
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_int,
+    ]
+    lib.ncq_free.argtypes = [ctypes.c_void_p]
+    lib.ncq_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ncq_get.restype = ctypes.c_int
+    lib.ncq_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_double, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.ncq_done.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ncq_add_after.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double,
+    ]
+    lib.ncq_add_rate_limited.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ncq_forget.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ncq_num_requeues.restype = ctypes.c_int
+    lib.ncq_num_requeues.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ncq_len.restype = ctypes.c_int
+    lib.ncq_len.argtypes = [ctypes.c_void_p]
+    lib.ncq_tracked.restype = ctypes.c_int
+    lib.ncq_tracked.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ncq_shut_down.argtypes = [ctypes.c_void_p]
+    lib.ncq_shutting_down.restype = ctypes.c_int
+    lib.ncq_shutting_down.argtypes = [ctypes.c_void_p]
+    # token-corpus loader (nexus_data.cpp)
+    lib.ncd_open.restype = ctypes.c_void_p
+    lib.ncd_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_longlong,
+        ctypes.c_longlong, ctypes.c_longlong, ctypes.c_ulonglong,
+    ]
+    lib.ncd_next_batch.restype = ctypes.c_longlong
+    lib.ncd_next_batch.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_longlong,
+    ]
+    lib.ncd_num_tokens.restype = ctypes.c_longlong
+    lib.ncd_num_tokens.argtypes = [ctypes.c_void_p]
+    lib.ncd_close.argtypes = [ctypes.c_void_p]
 
 
 def available() -> bool:
@@ -247,3 +278,83 @@ def make_queue(
     return RateLimitingQueue(
         default_controller_rate_limiter(base_delay, max_delay, rate, burst)
     )
+
+
+_DTYPE_CODES = {"int32": 0, "uint16": 1, "int16": 2}
+
+
+class NativeTokenLoader:
+    """ctypes front-end over the C++ mmap corpus reader (nexus_data.cpp).
+
+    Same sampling contract as the Python ``token_file_batches`` (contiguous
+    host-disjoint regions, (seq_len+1)-token windows) with batch assembly
+    outside the GIL; RNG streams differ from the Python path (xorshift vs
+    numpy) — both are deterministic per (seed, shard)."""
+
+    def __init__(
+        self,
+        path: str,
+        batch_size: int,
+        seq_len: int,
+        dtype: str = "int32",
+        seed: int = 0,
+        shard_index: int = 0,
+        num_shards: int = 1,
+        vocab_size: Optional[int] = None,
+    ):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        if dtype not in _DTYPE_CODES:
+            raise ValueError(f"unsupported dtype {dtype!r}")
+        self._lib = lib
+        self._batch = batch_size
+        self._window = seq_len + 1
+        self._vocab = vocab_size
+        self._path = path
+        self._handle = lib.ncd_open(
+            path.encode(), _DTYPE_CODES[dtype], seq_len,
+            shard_index, num_shards, seed,
+        )
+        if not self._handle:
+            raise ValueError(
+                f"ncd_open failed for {path!r} (missing file, or shard "
+                f"{shard_index}/{num_shards} smaller than seq_len+1)"
+            )
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import numpy as np
+
+        out = np.empty((self._batch, self._window), dtype=np.int32)
+        max_tok = self._lib.ncd_next_batch(
+            self._handle,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            self._batch,
+        )
+        if max_tok == -2:
+            raise ValueError(
+                f"corpus {self._path} contains a negative token id "
+                "(corrupt corpus / wrong dtype)"
+            )
+        if max_tok < 0:
+            raise RuntimeError("ncd_next_batch failed")
+        if self._vocab is not None and max_tok >= self._vocab:
+            raise ValueError(
+                f"corpus {self._path} contains token id {max_tok} >= "
+                f"model vocab_size {self._vocab}"
+            )
+        return {"tokens": out}
+
+    def close(self) -> None:
+        h, self._handle = self._handle, None
+        if h:
+            self._lib.ncd_close(h)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,144 @@
+// Native token-corpus reader: mmap + random-crop batch assembly.
+//
+// The C++ counterpart of nexus_tpu/train/data.py::token_file_batches — same
+// contract (flat binary token file, (seq_len+1)-token windows, host-disjoint
+// contiguous shard regions, int32 output) assembled without the GIL: the
+// ctypes call releases it, so batch assembly genuinely overlaps the device
+// step even before the Prefetcher thread is layered on top.
+//
+// Flat extern "C" API (ncd_*), consumed via ctypes (no pybind11 in image).
+
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+enum DType : int { kInt32 = 0, kUint16 = 1, kInt16 = 2 };
+
+struct Loader {
+  void* map = nullptr;
+  size_t map_bytes = 0;
+  int dtype = kInt32;
+  int64_t n_tokens = 0;      // tokens in the whole file
+  int64_t window = 0;        // seq_len + 1
+  int64_t lo = 0, hi = 0;    // valid start range [lo, hi) for this shard
+  uint64_t rng = 0x9e3779b97f4a7c15ull;
+};
+
+inline uint64_t next_rand(Loader* l) {
+  // xorshift64* — deterministic per (seed, shard) stream
+  uint64_t x = l->rng;
+  x ^= x >> 12;
+  x ^= x << 25;
+  x ^= x >> 27;
+  l->rng = x;
+  return x * 0x2545F4914F6CDD1Dull;
+}
+
+inline int64_t token_at(const Loader* l, int64_t i) {
+  switch (l->dtype) {
+    case kUint16:
+      return static_cast<const uint16_t*>(l->map)[i];
+    case kInt16:
+      return static_cast<const int16_t*>(l->map)[i];
+    default:
+      return static_cast<const int32_t*>(l->map)[i];
+  }
+}
+
+inline size_t dtype_size(int dtype) {
+  return dtype == kInt32 ? 4 : 2;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns nullptr on any failure (missing file, shard too small, bad args).
+void* ncd_open(const char* path, int dtype, long long seq_len,
+               long long shard_index, long long num_shards,
+               unsigned long long seed) {
+  if (seq_len < 1 || num_shards < 1 || shard_index < 0 ||
+      shard_index >= num_shards) {
+    return nullptr;
+  }
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size <= 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  size_t tok_bytes = dtype_size(dtype);
+  auto* l = new Loader();
+  l->dtype = dtype;
+  l->map_bytes = static_cast<size_t>(st.st_size);
+  l->n_tokens = st.st_size / static_cast<int64_t>(tok_bytes);
+  l->window = seq_len + 1;
+  l->map = mmap(nullptr, l->map_bytes, PROT_READ, MAP_PRIVATE, fd, 0);
+  ::close(fd);
+  if (l->map == MAP_FAILED) {
+    delete l;
+    return nullptr;
+  }
+  int64_t region = l->n_tokens / num_shards;
+  l->lo = shard_index * region;
+  l->hi = l->lo + region - l->window + 1;
+  if (l->hi <= l->lo) {
+    munmap(l->map, l->map_bytes);
+    delete l;
+    return nullptr;
+  }
+  l->rng = seed * 0x9e3779b97f4a7c15ull + shard_index * 0xbf58476d1ce4e5b9ull + 1;
+  return l;
+}
+
+// Fills out[batch * (seq_len+1)] int32. Returns the max token id seen (for
+// the caller's vocab guard), -1 on bad args, or -2 if any token id is
+// negative (corrupt corpus — the embedding gather would silently clamp it).
+long long ncd_next_batch(void* handle, int* out, long long batch) {
+  auto* l = static_cast<Loader*>(handle);
+  if (l == nullptr || out == nullptr || batch < 1) return -1;
+  int64_t max_tok = 0;
+  bool negative = false;
+  const int64_t span = l->hi - l->lo;
+  for (int64_t b = 0; b < batch; ++b) {
+    int64_t start = l->lo + static_cast<int64_t>(next_rand(l) % span);
+    int* row = out + b * l->window;
+    if (l->dtype == kInt32) {
+      std::memcpy(row, static_cast<const int32_t*>(l->map) + start,
+                  l->window * sizeof(int32_t));
+      for (int64_t i = 0; i < l->window; ++i) {
+        if (row[i] > max_tok) max_tok = row[i];
+        if (row[i] < 0) negative = true;
+      }
+    } else {
+      for (int64_t i = 0; i < l->window; ++i) {
+        int64_t t = token_at(l, start + i);
+        row[i] = static_cast<int32_t>(t);
+        if (t > max_tok) max_tok = t;
+        if (t < 0) negative = true;
+      }
+    }
+  }
+  return negative ? -2 : max_tok;
+}
+
+long long ncd_num_tokens(void* handle) {
+  auto* l = static_cast<Loader*>(handle);
+  return l == nullptr ? -1 : l->n_tokens;
+}
+
+void ncd_close(void* handle) {
+  auto* l = static_cast<Loader*>(handle);
+  if (l == nullptr) return;
+  if (l->map != nullptr && l->map != MAP_FAILED) munmap(l->map, l->map_bytes);
+  delete l;
+}
+
+}  // extern "C"

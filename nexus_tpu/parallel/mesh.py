@@ -57,12 +57,44 @@ class MeshPlan:
         )
 
 
+def split_dcn_axes(
+    plan_shape: Sequence[int], n_slices: int
+) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """Factor a mesh shape into (ici_shape, dcn_shape) for multislice.
+
+    ``dcn_shape`` absorbs the slice count on the outermost axes possible
+    (axis order is outer→inner, so pipeline/data — the DCN-tolerant axes —
+    are preferred), with ``ici_i * dcn_i == plan_i`` per axis and
+    ``prod(dcn) == n_slices``. Raises if the plan can't split that way
+    (e.g. all parallelism on an inner axis smaller than the slice count)."""
+    remaining = n_slices
+    dcn: List[int] = []
+    for size in plan_shape:
+        g = math.gcd(size, remaining)
+        dcn.append(g)
+        remaining //= g
+    if remaining != 1:
+        raise ValueError(
+            f"cannot place {n_slices} slices onto mesh shape "
+            f"{tuple(plan_shape)}: outer axes only absorb "
+            f"{n_slices // remaining}; give the data/fsdp/pipeline axes a "
+            f"multiple of the slice count"
+        )
+    ici = tuple(s // d for s, d in zip(plan_shape, dcn))
+    return ici, tuple(dcn)
+
+
 def build_mesh(plan: MeshPlan, devices: Optional[Sequence] = None) -> Mesh:
     """Build a ``jax.sharding.Mesh`` with the framework's named axes.
 
     ``devices`` defaults to ``jax.devices()``; its length must equal the
     plan's axis product. Size-1 axes are kept in the mesh so PartitionSpecs
-    can always reference every logical axis."""
+    can always reference every logical axis.
+
+    Multislice: when the devices span multiple slices (``slice_index``
+    attribute), the mesh is built with ``mesh_utils.create_hybrid_device_mesh``
+    so slice boundaries land on the outermost (DCN-tolerant) axes and
+    intra-slice neighbors stay adjacent on the inner (ICI) axes."""
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
@@ -71,6 +103,15 @@ def build_mesh(plan: MeshPlan, devices: Optional[Sequence] = None) -> Mesh:
             f"mesh plan {plan.shape} (product {plan.total()}) does not tile "
             f"{len(devices)} devices"
         )
+    slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+    if len(slice_ids) > 1:
+        from jax.experimental import mesh_utils
+
+        ici, dcn = split_dcn_axes(plan.shape, len(slice_ids))
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici, dcn, devices, allow_split_physical_axes=True
+        )
+        return Mesh(dev_array, AXES)
     dev_array = np.array(devices).reshape(plan.shape)
     return Mesh(dev_array, AXES)
 

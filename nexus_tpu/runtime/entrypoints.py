@@ -25,12 +25,13 @@ from nexus_tpu.parallel.mesh import (
     build_mesh,
     plan_for_devices,
 )
+from nexus_tpu.parallel.sharding import batch_spec
 from nexus_tpu.train.checkpoint import Checkpointer
 from nexus_tpu.train.data import (
     Prefetcher,
+    corpus_batches,
     synthetic_lm_batches,
     synthetic_mlp_batches,
-    token_file_batches,
 )
 from nexus_tpu.train.metrics import (
     detect_peak_flops_per_chip,
@@ -105,7 +106,7 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps):
             )
             tokens_per_batch = 0
         elif runtime.data.kind == "tokens":
-            data = token_file_batches(
+            data = corpus_batches(
                 runtime.data.path,
                 tr.batch_size,
                 tr.seq_len,
@@ -125,7 +126,7 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps):
         if runtime.data.prefetch > 0:
             # device_put in the prefetch thread overlaps H2D transfer with
             # the device step; sharding matches make_train_step's batch spec
-            batch_sharding = NamedSharding(mesh, P(("data", "fsdp")))
+            batch_sharding = NamedSharding(mesh, batch_spec())
             data = prefetcher = Prefetcher(
                 data, depth=runtime.data.prefetch, sharding=batch_sharding
             )

@@ -97,12 +97,14 @@ def token_file_batches(
     while True:
         starts = rng.randint(lo, hi, size=batch_size)
         rows = np.stack([data[s:s + window] for s in starts])
-        if vocab_size is not None and rows.max() >= vocab_size:
+        if vocab_size is not None and (
+            rows.max() >= vocab_size or rows.min() < 0
+        ):
             # jax's embedding gather silently clamps out-of-range ids —
             # that corrupts training with no error, so fail loudly here
             raise ValueError(
-                f"corpus {path} contains token id {int(rows.max())} >= "
-                f"model vocab_size {vocab_size}"
+                f"corpus {path} contains token id outside [0, {vocab_size}): "
+                f"min {int(rows.min())}, max {int(rows.max())}"
             )
         yield {"tokens": rows.astype(np.int32)}
 
@@ -136,7 +138,17 @@ class Prefetcher:
                 if self._sharding is not None:
                     import jax
 
-                    item = jax.device_put(item, self._sharding)
+                    if jax.process_count() > 1:
+                        # multi-host: each process holds only its local rows;
+                        # assemble the global sharded array from local data
+                        item = jax.tree_util.tree_map(
+                            lambda x: jax.make_array_from_process_local_data(
+                                self._sharding, np.asarray(x)
+                            ),
+                            item,
+                        )
+                    else:
+                        item = jax.device_put(item, self._sharding)
                 # bounded put, re-checking stop so close() can't deadlock
                 while not self._stop.is_set():
                     try:
@@ -180,3 +192,39 @@ class Prefetcher:
             self._q.put_nowait(self._SENTINEL)
         except queue.Full:
             pass
+
+
+def corpus_batches(
+    path: str,
+    batch_size: int,
+    seq_len: int,
+    dtype: str = "int32",
+    seed: int = 0,
+    shard_index: int = 0,
+    num_shards: int = 1,
+    vocab_size: Optional[int] = None,
+    backend: str = "auto",
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Token-corpus batches via the native C++ mmap reader when available
+    (GIL-free assembly; nexus_tpu/native/src/nexus_data.cpp), else the
+    numpy memmap generator. Same sampling contract either way; RNG streams
+    differ between backends (both deterministic per (seed, shard))."""
+    if backend not in ("auto", "native", "python"):
+        raise ValueError(f"unknown data backend {backend!r}")
+    if backend in ("auto", "native"):
+        try:
+            from nexus_tpu.native import NativeTokenLoader, available
+
+            if backend == "native" or available():
+                return NativeTokenLoader(
+                    path, batch_size, seq_len, dtype=dtype, seed=seed,
+                    shard_index=shard_index, num_shards=num_shards,
+                    vocab_size=vocab_size,
+                )
+        except (RuntimeError, ValueError):
+            if backend == "native":
+                raise
+    return token_file_batches(
+        path, batch_size, seq_len, dtype=dtype, seed=seed,
+        shard_index=shard_index, num_shards=num_shards, vocab_size=vocab_size,
+    )

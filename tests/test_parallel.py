@@ -141,3 +141,24 @@ def test_pipeline_train_step_runs_and_descends():
             params, opt_state, loss = step(params, opt_state, batch)
             losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_split_dcn_axes():
+    from nexus_tpu.parallel.mesh import split_dcn_axes
+
+    # 2 slices absorbed by the outer data axis
+    ici, dcn = split_dcn_axes((1, 2, 16, 1, 1, 2), 2)
+    assert dcn == (1, 2, 1, 1, 1, 1)
+    assert ici == (1, 1, 16, 1, 1, 2)
+    # 4 slices split across pipeline(2) and data(2)
+    ici, dcn = split_dcn_axes((2, 2, 8, 1, 1, 1), 4)
+    assert dcn == (2, 2, 1, 1, 1, 1)
+    assert ici == (1, 1, 8, 1, 1, 1)
+    # product invariants
+    import math
+    assert math.prod(dcn) == 4
+    assert all(i * d for i, d in zip(ici, dcn))
+    # unplaceable: inner-only parallelism smaller than slice count
+    import pytest
+    with pytest.raises(ValueError, match="cannot place"):
+        split_dcn_axes((1, 1, 1, 1, 1, 3), 2)
