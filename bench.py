@@ -91,6 +91,10 @@ def main() -> int:
 
     n_dev = len(jax.devices())
     overrides = {"remat": True} if on_tpu else {"dtype": "float32"}
+    # NEXUS_BENCH_ATTN: 'xla' (default — validated on the axon tunnel) or
+    # 'flash' (pallas kernels; opt in once validated on the target chip)
+    attn = os.environ.get("NEXUS_BENCH_ATTN", "xla")
+    overrides["attn_impl"] = attn
     runtime = JaxXlaRuntime(
         mode="train",
         model=ModelRef(family="llama", preset=preset, overrides=overrides),

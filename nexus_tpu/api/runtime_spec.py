@@ -14,6 +14,12 @@ import math
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+# Exit code for a SIGTERM-interrupted (preempted) worker run. The worker
+# exits with it only when a resumable checkpoint exists; the materializer
+# adds a standing podFailurePolicy Ignore rule for it so the rescheduled
+# pod resumes without burning backoffLimit.
+EXIT_PREEMPTED = 75  # EX_TEMPFAIL
+
 # Known TPU generations with chips-per-host and per-chip peak bf16 FLOP/s.
 # (Public figures: v4 275e12, v5e 197e12, v5p 459e12, v6e "Trillium" 918e12.)
 TPU_GENERATIONS: Dict[str, Dict[str, Any]] = {

@@ -52,6 +52,25 @@ class MixtralConfig:
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    def param_count(self) -> int:
+        """Total parameters (all experts)."""
+        d, f, v, e = self.d_model, self.d_ff, self.vocab_size, self.n_experts
+        hd = self.head_dim
+        attn = d * (self.n_heads * hd) + 2 * d * (self.n_kv_heads * hd) + (
+            self.n_heads * hd
+        ) * d
+        moe = e * 3 * d * f + d * e  # experts + router
+        per_layer = attn + moe + 2 * d
+        return v * d + self.n_layers * per_layer + d + d * v
+
+    def active_param_count(self) -> int:
+        """Parameters touched per token (top-k experts) — the FLOPs basis.
+        Identical to param_count() minus the unrouted experts' FFN weights."""
+        inactive = self.n_experts - self.n_experts_per_token
+        return self.param_count() - self.n_layers * inactive * (
+            3 * self.d_model * self.d_ff
+        )
+
 
 PRESETS: Dict[str, Dict[str, Any]] = {
     "tiny": dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,

@@ -56,6 +56,29 @@ def batch_spec(sequence_sharded: bool = False) -> P:
     return P(("data", "fsdp"), "sequence" if sequence_sharded else None)
 
 
+def repin_tree(tree, template):
+    """device_put every leaf whose sharding differs from the template's.
+
+    ``template`` mirrors ``tree`` with either arrays (their ``.sharding`` is
+    the target) or ``jax.sharding.Sharding`` objects at the leaves. Used to
+    normalize device assignments: checkpoint restores can bring replicated
+    scalars back single-device, and freshly-created optimizer leaves can
+    land off-mesh — a jitted step rejects such mixed states."""
+    import jax
+
+    def _one(x, t):
+        target = (
+            t
+            if isinstance(t, jax.sharding.Sharding)
+            else getattr(t, "sharding", None)
+        )
+        if target is not None and getattr(x, "sharding", None) != target:
+            return jax.device_put(x, target)
+        return x
+
+    return jax.tree_util.tree_map(_one, tree, template)
+
+
 def shard_params(params, logical_tree, mesh: Mesh, rules=None):
     """Device-put a parameter pytree according to its logical-dims pytree.
 

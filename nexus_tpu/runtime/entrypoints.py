@@ -35,8 +35,8 @@ from nexus_tpu.train.data import (
 )
 from nexus_tpu.train.metrics import (
     detect_peak_flops_per_chip,
-    llama_flops_per_token,
     mfu,
+    model_flops_per_token,
 )
 from nexus_tpu.train.trainer import (
     Trainer,
@@ -65,8 +65,13 @@ def run_template_runtime(
     runtime: JaxXlaRuntime,
     devices: Optional[Sequence] = None,
     max_steps: Optional[int] = None,
+    cancel=None,
 ) -> Dict[str, Any]:
-    """Execute a runtime block; returns a JSON-serializable metrics dict."""
+    """Execute a runtime block; returns a JSON-serializable metrics dict.
+
+    ``cancel``: a utils.signals.CancelToken — set on SIGTERM (slice
+    preemption); training stops at the next step boundary with a final
+    checkpoint so the requeued job resumes."""
     family = get_family(runtime.model.family)
     cfg = family.config(runtime.model.preset, **runtime.model.overrides)
     mesh = _resolve_mesh(runtime, devices)
@@ -74,10 +79,10 @@ def run_template_runtime(
 
     if runtime.mode == "infer":
         return _run_infer(runtime, family, cfg, mesh)
-    return _run_train(runtime, family, cfg, mesh, n_devices, max_steps)
+    return _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel)
 
 
-def _run_train(runtime, family, cfg, mesh, n_devices, max_steps):
+def _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel=None):
     tr = runtime.train
     steps = min(tr.steps, max_steps) if max_steps else tr.steps
     optimizer = build_optimizer(
@@ -155,15 +160,21 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps):
             profile_dir=prof.directory if prof.enabled else "",
             profile_start=prof.start_step,
             profile_steps=prof.num_steps,
+            cancel=cancel,
         )
         try:
             result = trainer.run(max(steps - start_step, 1))
         finally:
             if prefetcher is not None:
                 prefetcher.close()
+        checkpoint_saved = False
         if checkpointer is not None:
+            # final save — doubles as the preemption save when the run was
+            # interrupted (resume point for the rescheduled pod)
+            jax.block_until_ready(trainer.state)
             checkpointer.save(trainer.state, wait=True)
             checkpointer.close()
+            checkpoint_saved = True
 
     metrics: Dict[str, Any] = {
         "mode": "train",
@@ -176,6 +187,8 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps):
         "tokens_per_sec": result.tokens_per_sec,
         "n_devices": n_devices,
         "resumed_from_step": start_step,
+        "interrupted": result.interrupted,
+        "checkpoint_saved": checkpoint_saved,
     }
     if result.profiled:
         metrics["profile_dir"] = runtime.profile.directory
@@ -187,7 +200,7 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps):
             runtime.profile.start_step, max(steps_run - 1, 0),
         )
     if hasattr(cfg, "param_count"):
-        fpt = llama_flops_per_token(cfg, tr.seq_len)
+        fpt = model_flops_per_token(cfg, tr.seq_len)
         metrics["param_count"] = cfg.param_count()
         metrics["tokens_per_sec_per_chip"] = result.tokens_per_sec / n_devices
         metrics["model_flops_per_token"] = fpt

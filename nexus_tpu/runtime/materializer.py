@@ -139,9 +139,18 @@ def materialize_job(
         eh = template.spec.error_handling_behaviour
         failure_rules = []
         # exit code 0 is success — the apiserver rejects it in onExitCodes
-        # values (operator In), which would fail creation of the whole Job
+        # values (operator In), which would fail creation of the whole Job.
+        # EXIT_PREEMPTED (worker.py) is always transient: a SIGTERM-
+        # interrupted run checkpoints and must be rescheduled without
+        # burning backoffLimit (fatal wins if a template lists it there).
+        from nexus_tpu.api.runtime_spec import EXIT_PREEMPTED
+
         fatal = sorted({c for c in eh.fatal_exit_codes if c != 0})
-        transient = sorted({c for c in eh.transient_exit_codes if c != 0})
+        transient = sorted(
+            ({c for c in eh.transient_exit_codes} | {EXIT_PREEMPTED})
+            - set(fatal)
+            - {0}
+        )
         if fatal:
             failure_rules.append(
                 {

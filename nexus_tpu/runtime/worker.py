@@ -124,8 +124,16 @@ def run_from_env(environ: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
     distributed = maybe_initialize_distributed(identity, env)
 
     from nexus_tpu.runtime.entrypoints import run_template_runtime
+    from nexus_tpu.utils.signals import setup_signal_handler
 
-    metrics = run_template_runtime(runtime)
+    # SIGTERM (slice preemption / node drain) → graceful stop + final
+    # checkpoint, so the Job's retry resumes instead of restarting
+    try:
+        cancel = setup_signal_handler()
+    except ValueError:  # not on the main thread (tests drive run_from_env)
+        cancel = None
+
+    metrics = run_template_runtime(runtime, cancel=cancel)
     metrics["shard"] = env.get("NEXUS_SHARD_NAME", "")
     metrics["process_id"] = identity.process_id
     metrics["num_processes"] = identity.num_processes
@@ -144,13 +152,22 @@ def main() -> int:
         logger.exception("worker failed")
         print(json.dumps({"phase": "Failed", "error": str(e)}), flush=True)
         return 1
-    line = json.dumps({"phase": "Succeeded", **metrics}, default=str)
+    from nexus_tpu.api.runtime_spec import EXIT_PREEMPTED
+
+    # The preemption exit code (→ reschedule via the standing Ignore rule)
+    # is only legitimate when a rerun can actually resume — otherwise an
+    # unkillable zero-progress loop: reschedule, restart from 0, repeat.
+    preempted = bool(metrics.get("interrupted")) and bool(
+        metrics.get("checkpoint_saved")
+    )
+    phase = "Preempted" if preempted else "Succeeded"
+    line = json.dumps({"phase": phase, **metrics}, default=str)
     print(line, flush=True)
     result_path = os.environ.get("NEXUS_RESULT_PATH", "")
     if result_path:
         with open(result_path, "w") as f:
             f.write(line)
-    return 0
+    return EXIT_PREEMPTED if preempted else 0
 
 
 if __name__ == "__main__":

@@ -30,6 +30,8 @@ class Checkpointer:
 
     def save(self, state, step: Optional[int] = None, wait: bool = False) -> int:
         step = int(state.step) if step is None else step
+        if step in (self._mgr.all_steps() or []):
+            return step  # already saved (e.g. preemption save + final save)
         self._mgr.save(step, args=ocp.args.StandardSave(state))
         if wait:
             self._mgr.wait_until_finished()
@@ -44,9 +46,15 @@ class Checkpointer:
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
-        return self._mgr.restore(
+        restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(abstract_state)
         )
+        # Re-pin to the template's shardings: orbax can bring replicated
+        # scalars (e.g. optimizer step counts) back on a single device, and
+        # a jitted step then rejects the mixed-device state.
+        from nexus_tpu.parallel.sharding import repin_tree
+
+        return repin_tree(restored, abstract_state)
 
     def close(self):
         self._mgr.wait_until_finished()

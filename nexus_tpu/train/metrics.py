@@ -8,15 +8,25 @@ from typing import Optional
 import jax
 
 
-def llama_flops_per_token(cfg, seq_len: Optional[int] = None) -> float:
-    """Training FLOPs/token: 6·N_params plus the attention quadratic term
-    (12·L·d·s accounting for QK^T and PV in fwd+bwd)."""
-    n = cfg.param_count() if hasattr(cfg, "param_count") else None
-    if n is None:
+def model_flops_per_token(cfg, seq_len: Optional[int] = None) -> float:
+    """Training FLOPs/token: 6·N plus the attention quadratic term
+    (12·L·d·s accounting for QK^T and PV in fwd+bwd).
+
+    For MoE configs N is the *active* parameter count (top-k experts per
+    token), the standard FLOPs basis for sparse models."""
+    if hasattr(cfg, "active_param_count"):
+        n = cfg.active_param_count()
+    elif hasattr(cfg, "param_count"):
+        n = cfg.param_count()
+    else:
         raise ValueError("config lacks param_count()")
     s = seq_len or cfg.max_seq_len
     attn_flops = 12 * cfg.n_layers * cfg.d_model * s
     return 6.0 * n + attn_flops
+
+
+# Backwards-compatible alias (pre-MoE name).
+llama_flops_per_token = model_flops_per_token
 
 
 def detect_peak_flops_per_chip(default: float = 275e12) -> float:

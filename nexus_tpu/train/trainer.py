@@ -132,7 +132,23 @@ def init_train_state(
     opt_state = jax.jit(
         optimizer.init,
     )(params)  # moments inherit param shardings via input shardings
-    return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+    # …but leaves created fresh inside init (adam step counts, schedule
+    # state) land on a single device; pin them to the mesh replicated so the
+    # whole state shares one device assignment (jit rejects mixed states
+    # after checkpoint restore otherwise)
+    from nexus_tpu.parallel.sharding import repin_tree
+
+    mesh_devices = set(mesh.devices.flat)
+    replicated = NamedSharding(mesh, P())
+    targets = jax.tree_util.tree_map(
+        lambda x: x.sharding
+        if set(x.sharding.device_set) == mesh_devices
+        else replicated,
+        opt_state,
+    )
+    opt_state = repin_tree(opt_state, targets)
+    step0 = jax.device_put(jnp.zeros((), jnp.int32), replicated)
+    return TrainState(params, opt_state, step0)
 
 
 @dataclass
@@ -144,6 +160,7 @@ class TrainerResult:
     steps_per_sec: float
     loss_history: Any
     profiled: bool = False  # did the profiler capture window actually open
+    interrupted: bool = False  # stopped early by the cancel token (preemption)
 
 
 class Trainer:
@@ -163,6 +180,7 @@ class Trainer:
         profile_dir: str = "",
         profile_start: int = 2,
         profile_steps: int = 3,
+        cancel=None,
     ):
         self.step_fn = step_fn
         self.state = state
@@ -174,6 +192,10 @@ class Trainer:
         self.profile_dir = profile_dir
         self.profile_start = profile_start
         self.profile_steps = profile_steps
+        # CancelToken (utils/signals.py): set on SIGTERM — the slice
+        # preemption path. The loop stops at the next step boundary and
+        # saves a final checkpoint so the requeued job resumes, not restarts.
+        self.cancel = cancel
 
     def run(self, num_steps: int, warmup_steps: int = 1) -> TrainerResult:
         metrics: Dict[str, Any] = {}
@@ -187,14 +209,27 @@ class Trainer:
         timed_steps = num_steps - min(warmup_steps, num_steps)
         profiling = False
         ever_profiled = False
+        interrupted = False
+        completed = min(warmup_steps, num_steps)
         t0 = time.monotonic()
         for i in range(timed_steps):
+            if self.cancel is not None and self.cancel.cancelled():
+                interrupted = True
+                break
             if self.profile_dir and i == self.profile_start:
                 jax.block_until_ready(self.state)
                 jax.profiler.start_trace(self.profile_dir)
                 profiling = ever_profiled = True
             batch = next(self.data_iter)
+            prev_metrics = metrics
             self.state, metrics = self.step_fn(self.state, batch)
+            # bound async run-ahead to one in-flight step: unbounded dispatch
+            # lets several executions of the collective-bearing step run
+            # concurrently, which deadlocks XLA's in-process CPU communicator
+            # (and on TPU just queues) — blocking on the *previous* step keeps
+            # the device busy while the host readies the next batch
+            jax.block_until_ready(prev_metrics)
+            completed += 1
             if "loss" in metrics:
                 losses.append(metrics["loss"])
             if profiling and i + 1 >= self.profile_start + self.profile_steps:
@@ -212,24 +247,25 @@ class Trainer:
         if profiling:  # window extended past the end of the run
             jax.profiler.stop_trace()
         dt = max(time.monotonic() - t0, 1e-9)
-
         final = {
             k: float(v)
             for k, v in metrics.items()
             if jnp.ndim(v) == 0
         }
-        sps = timed_steps / dt if timed_steps else 0.0
+        timed_completed = completed - min(warmup_steps, num_steps)
+        sps = timed_completed / dt if timed_completed else 0.0
         tps = sps * self.tokens_per_batch
         if self.telemetry is not None:
             self.telemetry.gauge("train_steps_per_sec", sps)
             if tps:
                 self.telemetry.gauge("train_tokens_per_sec", tps)
         return TrainerResult(
-            steps=num_steps,
+            steps=completed,
             final_metrics=final,
             wall_time_s=dt,
             tokens_per_sec=tps,
             steps_per_sec=sps,
             loss_history=[float(l) for l in losses],
             profiled=ever_profiled,
+            interrupted=interrupted,
         )

@@ -119,11 +119,22 @@ def test_materializer_pod_failure_policy():
     assert rules[0]["action"] == "FailJob"
     assert rules[0]["onExitCodes"]["values"] == [7, 13]
     assert rules[1]["action"] == "Ignore"
-    assert rules[1]["onExitCodes"]["values"] == [42]
+    # 75 (EXIT_PREEMPTED) is always transient
+    assert rules[1]["onExitCodes"]["values"] == [42, 75]
 
+    # with no declared codes, the preemption rule still exists
     tmpl2 = template_with_runtime()
     job2 = materialize_job(tmpl2)[0]
-    assert job2["spec"]["podFailurePolicy"] is None
+    rules2 = job2["spec"]["podFailurePolicy"]["rules"]
+    assert len(rules2) == 1 and rules2[0]["onExitCodes"]["values"] == [75]
+
+    # a template may declare 75 fatal; fatal wins
+    tmpl3 = template_with_runtime()
+    tmpl3.spec.error_handling_behaviour.fatal_exit_codes = [75]
+    rules3 = materialize_job(tmpl3)[0]["spec"]["podFailurePolicy"]["rules"]
+    assert rules3[0]["action"] == "FailJob"
+    assert rules3[0]["onExitCodes"]["values"] == [75]
+    assert len(rules3) == 1
 
 
 def test_prefetcher_surfaces_pipeline_errors(tmp_path):
@@ -148,7 +159,9 @@ def test_materializer_filters_exit_code_zero():
     tmpl = template_with_runtime()
     tmpl.spec.error_handling_behaviour.fatal_exit_codes = [0]
     job = materialize_job(tmpl)[0]
-    assert job["spec"]["podFailurePolicy"] is None
+    rules = job["spec"]["podFailurePolicy"]["rules"]
+    # 0 filtered out of fatal; only the standing preemption rule remains
+    assert len(rules) == 1 and rules[0]["action"] == "Ignore"
 
 
 def test_native_token_loader_contract(tmp_path):

@@ -204,3 +204,57 @@ def test_runtime_spec_profile_roundtrip():
                                            start_step=5, num_steps=7))
     rt2 = JaxXlaRuntime.from_dict(rt.to_dict())
     assert rt2.profile == rt.profile
+
+
+def test_preemption_checkpoints_and_resumes(tmp_path):
+    """SIGTERM-style cancellation mid-run saves a checkpoint; the rerun
+    resumes from it (the slice-preemption elasticity path)."""
+    import threading
+
+    from nexus_tpu.api.runtime_spec import (
+        CheckpointSpec, JaxXlaRuntime, ModelRef, ParallelismSpec,
+        TpuSliceSpec, TrainSpec,
+    )
+    from nexus_tpu.runtime.entrypoints import run_template_runtime
+    from nexus_tpu.utils.signals import CancelToken
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    base = dict(
+        mode="train",
+        model=ModelRef(family="mlp", preset="tiny"),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1", slice_count=1),
+        parallelism=ParallelismSpec(),
+        checkpoint=CheckpointSpec(enabled=True, directory=ckpt_dir,
+                                  interval_steps=1000, resume=True),
+    )
+    rt = JaxXlaRuntime(
+        train=TrainSpec(batch_size=8, steps=10**6, learning_rate=1e-2), **base
+    )
+
+    cancel = CancelToken()
+    results = {}
+
+    def run():
+        results["m"] = run_template_runtime(rt, cancel=cancel)
+
+    t = threading.Thread(target=run)
+    t.start()
+    import time
+
+    time.sleep(6)  # let a few steps run (includes compile)
+    cancel.cancel()
+    t.join(timeout=120)
+    assert not t.is_alive()
+    m = results["m"]
+    assert m["interrupted"] is True
+    assert m["steps"] < 10**6
+
+    # rerun without cancellation: resumes from the preemption checkpoint
+    rt2 = JaxXlaRuntime(
+        train=TrainSpec(batch_size=8, steps=m["steps"] + 3,
+                        learning_rate=1e-2),
+        **base,
+    )
+    m2 = run_template_runtime(rt2)
+    assert m2["resumed_from_step"] >= 1
+    assert m2["interrupted"] is False
