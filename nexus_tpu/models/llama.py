@@ -139,6 +139,38 @@ def logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
 # ----------------------------------------------------------------- forward
 
 
+def _ring_attention_sharded(q, k, v):
+    """Ring attention over the active mesh's ``sequence`` axis.
+
+    Wraps the ring op in a shard_map nested inside the surrounding jit
+    (sequence/context parallelism, SURVEY.md §2c "SP/CP"): each device holds
+    an S/n sequence shard of Q/K/V and K/V blocks rotate via ppermute over
+    ICI. Requires an active Mesh context (``with mesh:``) whose ``sequence``
+    axis matches the batch's sequence sharding (parallel/sharding.batch_spec
+    with sequence_sharded=True)."""
+    from jax.interpreters.pxla import thread_resources
+    from jax.sharding import PartitionSpec as P
+
+    mesh = thread_resources.env.physical_mesh
+    if mesh.empty or mesh.shape.get("sequence", 1) == 1:
+        # no sequence axis to shard over — plain attention is exact
+        return attention(q, k, v, causal=True, impl=None)
+    try:
+        smap = jax.shard_map
+    except AttributeError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map as smap
+
+    # heads carry the tensor axis (qkv projections are TP-sharded)
+    spec = P(("data", "fsdp"), "sequence", "tensor", None)
+    ring = smap(
+        partial(ring_attention, axis_name="sequence", causal=True),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return ring(q, k, v)
+
+
 def _block(cfg: LlamaConfig, x: jnp.ndarray, layer: Dict[str, jnp.ndarray],
            cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
     b, s, d = x.shape
@@ -151,7 +183,7 @@ def _block(cfg: LlamaConfig, x: jnp.ndarray, layer: Dict[str, jnp.ndarray],
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     if cfg.attn_impl == "ring":
-        attn = ring_attention(q, k, v, axis_name="sequence", causal=True)
+        attn = _ring_attention_sharded(q, k, v)
     else:
         attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
     x = x + attn.reshape(b, s, hq * hd) @ layer["wo"]

@@ -79,17 +79,23 @@ def ring_attention(
     m0 = jnp.full((b, hq, s_local, 1), -jnp.inf, dtype=jnp.float32)
     l0 = jnp.zeros((b, hq, s_local, 1), dtype=jnp.float32)
     acc0 = jnp.zeros((b, hq, s_local, d), dtype=jnp.float32)
-    # mark initial accumulators as device-varying over the ring axis so the
-    # scan carry types line up (shard_map varying-axis typing, jax >= 0.8);
-    # pcast replaces the deprecated pvary, keep the fallback for older jax
+    # mark initial accumulators as device-varying so the scan carry types
+    # line up (shard_map varying-axis typing, jax >= 0.8): the body mixes
+    # them with q/k/v, so they must carry q's FULL varying-axis set — the
+    # enclosing shard_map may be manual over more axes than the ring axis
+    # (e.g. data/fsdp/tensor when nested inside a jitted train step).
     pcast = getattr(lax, "pcast", None)
     pvary = getattr(lax, "pvary", None)
+    try:
+        vma = tuple(sorted(jax.typeof(q).vma))
+    except Exception:
+        vma = (axis_name,)
+    if not vma:
+        vma = (axis_name,)
     if pcast is not None:
-        m0, l0, acc0 = (
-            pcast(x, axis_name, to="varying") for x in (m0, l0, acc0)
-        )
+        m0, l0, acc0 = (pcast(x, vma, to="varying") for x in (m0, l0, acc0))
     elif pvary is not None:  # pragma: no cover — older jax
-        m0, l0, acc0 = (pvary(x, (axis_name,)) for x in (m0, l0, acc0))
+        m0, l0, acc0 = (pvary(x, vma) for x in (m0, l0, acc0))
 
     def step(carry, step_idx):
         k_blk, v_blk, m, l, acc = carry

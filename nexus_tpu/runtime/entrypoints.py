@@ -73,8 +73,17 @@ def run_template_runtime(
     preemption); training stops at the next step boundary with a final
     checkpoint so the requeued job resumes."""
     family = get_family(runtime.model.family)
-    cfg = family.config(runtime.model.preset, **runtime.model.overrides)
+    overrides = dict(runtime.model.overrides)
     mesh = _resolve_mesh(runtime, devices)
+    if (
+        dict(mesh.shape).get("sequence", 1) > 1
+        and runtime.model.family != "mlp"
+        and "attn_impl" not in overrides
+    ):
+        # a sequence mesh axis means context parallelism: attention must be
+        # the ring kernel (exact over sequence shards) unless overridden
+        overrides["attn_impl"] = "ring"
+    cfg = family.config(runtime.model.preset, **overrides)
     n_devices = mesh.devices.size
 
     if runtime.mode == "infer":
@@ -100,6 +109,9 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel=None):
             mesh=mesh,
             logical_tree=family.logical_axes(cfg),
         )
+        # NOTE: the (B, S+1) token batch itself stays unsharded on the
+        # sequence axis (S+1 doesn't tile it); with attn_impl="ring" the
+        # per-layer shard_map in_specs reshard activations onto it
         loss_fn = lambda params, batch: family.loss_fn(params, cfg, batch)
         step_fn = make_train_step(
             loss_fn, optimizer, mesh=mesh, grad_accum=tr.gradient_accumulation
