@@ -120,6 +120,9 @@ class ParallelismSpec:
     sequence: int = 1
     expert: int = 1
     pipeline: int = 1
+    # GPipe microbatches per step when pipeline > 1; 0 = auto (2× stages
+    # when that divides the batch, else the stage count). Not a mesh axis.
+    pipeline_microbatches: int = 0
 
     def total(self) -> int:
         return (
@@ -139,6 +142,7 @@ class ParallelismSpec:
             "sequence": self.sequence,
             "expert": self.expert,
             "pipeline": self.pipeline,
+            "pipelineMicrobatches": self.pipeline_microbatches,
         }
 
     @classmethod
@@ -150,6 +154,7 @@ class ParallelismSpec:
             sequence=int(d.get("sequence", 1) or 1),
             expert=int(d.get("expert", 1) or 1),
             pipeline=int(d.get("pipeline", 1) or 1),
+            pipeline_microbatches=int(d.get("pipelineMicrobatches", 0) or 0),
         )
 
 

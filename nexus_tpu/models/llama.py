@@ -116,20 +116,22 @@ def init(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
 
 
 def logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
-    """Sharding annotations: leading 'layer' dim on stacked params is never
-    sharded; matrices follow the FSDP+TP layout (parallel/sharding.py)."""
+    """Sharding annotations: the leading 'layer' dim on stacked params is
+    unsharded by default, and remapped to the 'pipeline' mesh axis by the
+    runtime when pipeline parallelism is active (runtime/entrypoints.py);
+    matrices follow the FSDP+TP layout (parallel/sharding.py)."""
     return {
         "embed": ("vocab", "embed"),
         "layers": {
-            "wq": (None, "embed", "qkv"),
-            "wk": (None, "embed", "qkv"),
-            "wv": (None, "embed", "qkv"),
-            "wo": (None, "qkv", "embed"),
-            "w_gate": (None, "embed", "mlp"),
-            "w_up": (None, "embed", "mlp"),
-            "w_down": (None, "mlp", "embed"),
-            "ln_attn": (None, None),
-            "ln_mlp": (None, None),
+            "wq": ("layer", "embed", "qkv"),
+            "wk": ("layer", "embed", "qkv"),
+            "wv": ("layer", "embed", "qkv"),
+            "wo": ("layer", "qkv", "embed"),
+            "w_gate": ("layer", "embed", "mlp"),
+            "w_up": ("layer", "embed", "mlp"),
+            "w_down": ("layer", "mlp", "embed"),
+            "ln_attn": ("layer", None),
+            "ln_mlp": ("layer", None),
         },
         "final_norm": (None,),
         "lm_head": ("embed", "vocab"),

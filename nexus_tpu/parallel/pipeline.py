@@ -109,10 +109,13 @@ def pipeline_apply(
         lambda _: P(axis), params
     )
     in_x_spec = x_spec or P()
+    # the per-stage output keeps whatever sharding the activations carry
+    # (e.g. batch over (data, fsdp)), with the stage dim prepended
+    x_entries = tuple(in_x_spec) + (None,) * (x_mb.ndim - len(tuple(in_x_spec)))
     kwargs = dict(
         mesh=mesh,
         in_specs=(layer_spec, in_x_spec),
-        out_specs=P(axis, *([None] * (x_mb.ndim))),
+        out_specs=P(axis, *x_entries),
     )
     # replication checking is off: output is intentionally stage-varying
     # (kwarg renamed check_rep → check_vma across jax versions)
@@ -166,9 +169,11 @@ def llama_pipeline_forward(
         return h
 
     layer_spec = jax.tree_util.tree_map(lambda _: P("pipeline"), params["layers"])
+    # microbatch dim replicated; per-microbatch batch dim keeps the data
+    # sharding so the data axis parallelizes within each pipeline stage
     y_mb = pipeline_apply(
         stage_fn, params["layers"], x_mb, mesh,
-        params_spec=layer_spec, x_spec=P(),
+        params_spec=layer_spec, x_spec=P(None, ("data", "fsdp")),
     )
     y = y_mb.reshape(b, s, cfg.d_model)
     y = rms_norm(y, params["final_norm"], cfg.norm_eps)

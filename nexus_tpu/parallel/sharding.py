@@ -34,6 +34,10 @@ DEFAULT_LOGICAL_RULES: Dict[str, Any] = {
     "qkv": "tensor",
     "expert": "expert",
     "norm": None,
+    # stacked-layer leading dim: unsharded by default; the runtime remaps it
+    # to the 'pipeline' mesh axis when pipeline parallelism is active, so
+    # each stage holds only its contiguous layer slice from init onward
+    "layer": None,
     None: None,
 }
 

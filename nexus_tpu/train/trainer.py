@@ -89,7 +89,12 @@ def make_train_step(
         )
         grads, metrics = jax.lax.scan(accum, zero, micro)
         grads = jax.tree_util.tree_map(lambda g: g / grad_accum, grads)
-        metrics = jax.tree_util.tree_map(lambda m: m[-1], metrics)
+        # average scalar metrics over the microbatch scan so loss reflects
+        # the whole batch; perplexity is re-derived from the mean loss
+        # (mean(exp(l_i)) != exp(mean(l_i)))
+        metrics = jax.tree_util.tree_map(lambda m: jnp.mean(m, axis=0), metrics)
+        if "perplexity" in metrics and "loss" in metrics:
+            metrics["perplexity"] = jnp.exp(metrics["loss"])
         return grads, metrics
 
     def step(state: TrainState, batch) -> Tuple[TrainState, Dict]:

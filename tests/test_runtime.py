@@ -126,6 +126,55 @@ def test_run_template_runtime_llama_train_reports_mfu():
     assert metrics["param_count"] > 0
 
 
+def test_run_template_runtime_pipeline_parallel_matches_plain():
+    """VERDICT r1 item 3: a template with pipeline=2 must actually train
+    through the GPipe path, with loss parity vs the non-PP path."""
+    common = dict(
+        model=ModelRef(family="llama", preset="tiny",
+                       overrides={"dtype": "float32", "attn_impl": "xla"}),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="2x4", slice_count=1),
+        train=TrainSpec(batch_size=8, seq_len=32, steps=3),
+    )
+    pp = run_template_runtime(
+        runtime_block(
+            parallelism=ParallelismSpec(pipeline=2, data=4), **common
+        )
+    )
+    plain = run_template_runtime(
+        runtime_block(parallelism=ParallelismSpec(data=4, fsdp=2), **common)
+    )
+    assert pp["final_loss"] is not None
+    # identical init (same seed) + identical data stream → first-step loss
+    # must agree across schedules up to float reassociation
+    assert abs(pp["loss_history"][0] - plain["loss_history"][0]) < 1e-3, (
+        pp["loss_history"],
+        plain["loss_history"],
+    )
+
+
+def test_run_template_runtime_pipeline_rejects_unsupported():
+    with pytest.raises(ValueError, match="llama family only"):
+        run_template_runtime(
+            runtime_block(
+                model=ModelRef(family="mlp", preset="tiny"),
+                tpu=TpuSliceSpec(accelerator="v5e", topology="2x4"),
+                parallelism=ParallelismSpec(pipeline=2, data=4),
+            )
+        )
+    with pytest.raises(ValueError, match="not divisible"):
+        run_template_runtime(
+            runtime_block(
+                model=ModelRef(
+                    family="llama", preset="tiny",
+                    overrides={"dtype": "float32", "n_layers": 3},
+                ),
+                tpu=TpuSliceSpec(accelerator="v5e", topology="2x4"),
+                parallelism=ParallelismSpec(pipeline=2, data=4),
+                train=TrainSpec(batch_size=8, seq_len=32, steps=2),
+            )
+        )
+
+
 # ------------------------------------------------------- the config #2 e2e
 
 
