@@ -2,14 +2,19 @@
 
 Runs the framework's own jax_xla runtime path (the same code a synced
 template executes) on a single chip and reports MFU against the BASELINE
-north-star gate (≥35% MFU, BASELINE.md config #4).
+north-star gate (>=35% MFU, BASELINE.md config #4).
 
-Prints ONE JSON line:
-  {"metric": "llama_train_mfu", "value": <mfu>, "unit": "mfu_fraction",
-   "vs_baseline": <mfu/0.35>, ...detail...}
+Strategy (round 2): the Pallas flash kernels are validated ON THIS CHIP
+first (fwd + bwd numerics vs the XLA path on a small shape); if they match,
+the sweep includes flash configs, else it falls back to XLA attention.
+A small config sweep (attention impl x remat policy x batch) then picks the
+best operating point — each candidate is budgeted, and OOM/compile failures
+just eliminate the candidate. Prints ONE JSON line at the end.
 
 Env knobs: NEXUS_BENCH_PRESET (default auto), NEXUS_BENCH_STEPS,
-NEXUS_BENCH_BATCH, NEXUS_BENCH_SEQ.
+NEXUS_BENCH_BATCH (pins batch; disables the batch sweep), NEXUS_BENCH_SEQ,
+NEXUS_BENCH_ATTN (pins attention impl), NEXUS_BENCH_REMAT
+('none'|'full'|'dots' pins remat), NEXUS_BENCH_DEADLINE_S.
 """
 
 from __future__ import annotations
@@ -17,6 +22,101 @@ from __future__ import annotations
 import json
 import os
 import sys
+
+
+def _validate_flash_on_chip() -> bool:
+    """Compare the Pallas flash kernels (fwd + custom-VJP bwd) against the
+    XLA reference on-chip at a small shape. Any numeric or compile problem
+    disqualifies flash for this run."""
+    import jax
+    import jax.numpy as jnp
+
+    from nexus_tpu.ops.attention import attention_xla, flash_attention
+
+    try:
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        b, s, hq, hkv, d = 2, 256, 4, 2, 128
+        q = jax.random.normal(ks[0], (b, s, hq, d), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (b, s, hkv, d), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (b, s, hkv, d), jnp.bfloat16)
+
+        def loss_ref(q, k, v):
+            return (attention_xla(q, k, v).astype(jnp.float32) ** 2).sum()
+
+        def loss_fl(q, k, v):
+            return (
+                flash_attention(q, k, v, interpret=False).astype(jnp.float32) ** 2
+            ).sum()
+
+        out_ref = attention_xla(q, k, v).astype(jnp.float32)
+        out_fl = flash_attention(q, k, v, interpret=False).astype(jnp.float32)
+        gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        gf = jax.jit(jax.grad(loss_fl, argnums=(0, 1, 2)))(q, k, v)
+        jax.block_until_ready((out_ref, out_fl, gr, gf))
+
+        def close(a, b):
+            a32, b32 = a.astype(jnp.float32), b.astype(jnp.float32)
+            scale = float(jnp.max(jnp.abs(a32))) or 1.0
+            return float(jnp.max(jnp.abs(a32 - b32))) / scale < 2e-2
+
+        ok = close(out_ref, out_fl) and all(close(a, b) for a, b in zip(gr, gf))
+        print(f"[bench] flash on-chip validation: {'PASS' if ok else 'FAIL'}",
+              file=sys.stderr, flush=True)
+        return ok
+    except Exception as e:  # noqa: BLE001 — any failure just disables flash
+        print(f"[bench] flash on-chip validation errored: {e}",
+              file=sys.stderr, flush=True)
+        return False
+
+
+def _run_candidate(preset, steps, batch, seq, attn, remat, progress):
+    """One sweep candidate → (mfu, metrics) or None on failure/OOM."""
+    from nexus_tpu.api.runtime_spec import (
+        JaxXlaRuntime,
+        ModelRef,
+        ParallelismSpec,
+        TpuSliceSpec,
+        TrainSpec,
+    )
+    from nexus_tpu.runtime.entrypoints import run_template_runtime
+
+    from nexus_tpu.utils.hw import is_tpu
+
+    overrides = {"attn_impl": attn}
+    if not is_tpu():
+        overrides["dtype"] = "float32"  # CPU smoke: bf16 is emulated + noisy
+    if remat == "none":
+        overrides["remat"] = False
+    else:
+        overrides["remat"] = True
+        overrides["remat_policy"] = remat
+    runtime = JaxXlaRuntime(
+        mode="train",
+        model=ModelRef(family="llama", preset=preset, overrides=overrides),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1", slice_count=1),
+        parallelism=ParallelismSpec(),
+        train=TrainSpec(
+            batch_size=batch, seq_len=seq, steps=steps, learning_rate=3e-4,
+        ),
+    )
+    label = f"attn={attn} remat={remat} batch={batch}"
+    progress(f"candidate {label}: running {steps} steps")
+    try:
+        metrics = run_template_runtime(runtime)
+    except Exception as e:  # noqa: BLE001 — OOM / compile failure: skip
+        progress(f"candidate {label} failed: {type(e).__name__}: {str(e)[:200]}")
+        return None
+    mfu = float(metrics.get("mfu") or 0.0)
+    loss = metrics.get("final_loss")
+    if loss is None or not (loss == loss):  # NaN guard
+        progress(f"candidate {label} produced invalid loss {loss}; rejected")
+        return None
+    progress(f"candidate {label}: MFU={mfu:.4f} "
+             f"tok/s/chip={metrics.get('tokens_per_sec_per_chip', 0):.0f}")
+    metrics["attn_impl"] = attn
+    metrics["remat"] = remat
+    metrics["batch_size"] = batch
+    return mfu, metrics
 
 
 def main() -> int:
@@ -31,39 +131,64 @@ def main() -> int:
         print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
     # Watchdog: the TPU tunnel can wedge (backend init or compile never
-    # returns). If the bench hasn't finished by the deadline, emit a
-    # fallback JSON line so the driver records *something*, then exit.
+    # returns). If the bench hasn't finished by the deadline, emit the best
+    # result so far (or a zero fallback) so the driver records *something*.
     import threading
 
     _stage = ["startup"]
     _done = [False]
+    _best = [None]  # best (mfu, metrics) observed so far
+    _seq = [None]  # benchmarked sequence length, once parsed
     _print_lock = threading.Lock()
     deadline_s = float(os.environ.get("NEXUS_BENCH_DEADLINE_S") or 1500)
 
+    def _emit(result) -> None:
+        print(json.dumps(result), flush=True)
+
+    def _result_from(best) -> dict:
+        mfu, metrics = best
+        return {
+            "metric": "llama_train_mfu",
+            "value": round(mfu, 4),
+            "unit": "mfu_fraction",
+            "vs_baseline": round(mfu / 0.35, 4) if mfu else 0.0,
+            "tokens_per_sec_per_chip": round(
+                metrics.get("tokens_per_sec_per_chip", 0.0), 1
+            ),
+            "preset": metrics.get("preset"),
+            "param_count": metrics.get("param_count"),
+            "seq_len": _seq[0],
+            "batch_size": metrics.get("batch_size"),
+            "attn_impl": metrics.get("attn_impl"),
+            "remat": metrics.get("remat"),
+            "steps": metrics.get("steps"),
+            "device": device_kind(),
+            "n_devices": len(jax.devices()),
+            "final_loss": metrics.get("final_loss"),
+        }
+
     def _watchdog():
-        # single-JSON-line contract: the lock + _done flag make the fallback
-        # and the real result mutually exclusive even if the timer fires
-        # exactly as the bench finishes
         with _print_lock:
             if _done[0]:
                 return
-            print(
-                json.dumps(
-                    {
-                        "metric": "llama_train_mfu",
-                        "value": 0.0,
-                        "unit": "mfu_fraction",
-                        "vs_baseline": 0.0,
-                        "error": f"deadline {deadline_s}s exceeded at stage: "
-                        f"{_stage[0]}",
-                    }
-                ),
-                flush=True,
-            )
-            print(
-                f"[bench] WATCHDOG fired at stage: {_stage[0]}",
-                file=sys.stderr, flush=True,
-            )
+            if _best[0] is not None:
+                result = _result_from(_best[0])
+                result["note"] = (
+                    f"deadline {deadline_s}s hit at stage: {_stage[0]}; "
+                    "reporting best completed candidate"
+                )
+            else:
+                result = {
+                    "metric": "llama_train_mfu",
+                    "value": 0.0,
+                    "unit": "mfu_fraction",
+                    "vs_baseline": 0.0,
+                    "error": f"deadline {deadline_s}s exceeded at stage: "
+                    f"{_stage[0]}",
+                }
+            _emit(result)
+            print(f"[bench] WATCHDOG fired at stage: {_stage[0]}",
+                  file=sys.stderr, flush=True)
             os._exit(0)
 
     timer = None
@@ -76,62 +201,67 @@ def main() -> int:
     on_tpu = is_tpu()
     progress(f"backend up: {device_kind()} x{len(jax.devices())}")
     preset = os.environ.get("NEXUS_BENCH_PRESET") or ("400m" if on_tpu else "tiny")
-    steps = int(os.environ.get("NEXUS_BENCH_STEPS") or (20 if on_tpu else 6))
-    batch = int(os.environ.get("NEXUS_BENCH_BATCH") or (8 if on_tpu else 4))
+    steps = int(os.environ.get("NEXUS_BENCH_STEPS") or (15 if on_tpu else 6))
     seq = int(os.environ.get("NEXUS_BENCH_SEQ") or (2048 if on_tpu else 64))
+    _seq[0] = seq
+    pinned_batch = os.environ.get("NEXUS_BENCH_BATCH")
+    pinned_attn = os.environ.get("NEXUS_BENCH_ATTN")
+    pinned_remat = os.environ.get("NEXUS_BENCH_REMAT")
 
-    from nexus_tpu.api.runtime_spec import (
-        JaxXlaRuntime,
-        ModelRef,
-        ParallelismSpec,
-        TpuSliceSpec,
-        TrainSpec,
-    )
-    from nexus_tpu.runtime.entrypoints import run_template_runtime
+    if not on_tpu:
+        # CPU smoke: one tiny candidate, no sweep
+        candidates = [("xla", "none", int(pinned_batch or 4))]
+    else:
+        flash_ok = False
+        if pinned_attn in (None, "", "flash"):
+            progress("validating flash kernels on-chip")
+            flash_ok = _validate_flash_on_chip()
+        # a pinned NEXUS_BENCH_ATTN deliberately overrides failed validation
+        attn = pinned_attn or ("flash" if flash_ok else "xla")
+        # Sweep order: most promising first so a watchdog cut still reports
+        # a strong configuration. v5e-16GB at 400m/seq2048: bs8 no-remat is
+        # borderline; 'dots' keeps matmul outputs only and usually fits bs8.
+        if pinned_batch:
+            b = int(pinned_batch)
+            batches = [b]
+        else:
+            batches = [8, 16]
+        remats = [pinned_remat] if pinned_remat else ["dots", "none", "full"]
+        candidates = []
+        for b in batches:
+            for r in remats:
+                candidates.append((attn, r, b))
+        # cap sweep size: compile time on the tunnel dominates
+        candidates = candidates[:4]
 
-    n_dev = len(jax.devices())
-    overrides = {"remat": True} if on_tpu else {"dtype": "float32"}
-    # NEXUS_BENCH_ATTN: 'xla' (default — validated on the axon tunnel) or
-    # 'flash' (pallas kernels; opt in once validated on the target chip)
-    attn = os.environ.get("NEXUS_BENCH_ATTN", "xla")
-    overrides["attn_impl"] = attn
-    runtime = JaxXlaRuntime(
-        mode="train",
-        model=ModelRef(family="llama", preset=preset, overrides=overrides),
-        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1", slice_count=1),
-        parallelism=ParallelismSpec(),
-        train=TrainSpec(
-            batch_size=batch, seq_len=seq, steps=steps, learning_rate=3e-4,
-        ),
-    )
-    progress(
-        f"running train bench: preset={preset} steps={steps} "
-        f"batch={batch} seq={seq}"
-    )
-    metrics = run_template_runtime(runtime)
+    best = None
+    for attn, remat, batch in candidates:
+        res = _run_candidate(preset, steps, batch, seq, attn, remat, progress)
+        if res is not None and (best is None or res[0] > best[0]):
+            best = res
+            _best[0] = res
+
+    if best is None and on_tpu:
+        progress("all sweep candidates failed; trying conservative fallback")
+        best = _run_candidate(preset, steps, 4, seq, "xla", "full", progress)
+        _best[0] = best
+
     with _print_lock:
         _done[0] = True
     if timer is not None:
         timer.cancel()
-    progress("train bench done")
 
-    mfu = float(metrics.get("mfu") or 0.0)
-    result = {
-        "metric": "llama_train_mfu",
-        "value": round(mfu, 4),
-        "unit": "mfu_fraction",
-        "vs_baseline": round(mfu / 0.35, 4) if mfu else 0.0,
-        "tokens_per_sec_per_chip": round(metrics.get("tokens_per_sec_per_chip", 0.0), 1),
-        "preset": preset,
-        "param_count": metrics.get("param_count"),
-        "seq_len": seq,
-        "batch_size": batch,
-        "steps": steps,
-        "device": device_kind(),
-        "n_devices": n_dev,
-        "final_loss": metrics.get("final_loss"),
-    }
-    print(json.dumps(result))
+    if best is None:
+        _emit({
+            "metric": "llama_train_mfu",
+            "value": 0.0,
+            "unit": "mfu_fraction",
+            "vs_baseline": 0.0,
+            "error": "no benchmark candidate completed",
+        })
+        return 1
+    result = _result_from(best)
+    _emit(result)
     return 0
 
 

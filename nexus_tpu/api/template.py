@@ -237,6 +237,12 @@ class NexusAlgorithmStatus:
     synced_configurations: List[str] = field(default_factory=list)
     synced_to_clusters: List[str] = field(default_factory=list)
     conditions: List[Condition] = field(default_factory=list)
+    # TPU-native extension: observed workload state of the materialized Jobs,
+    # per shard and aggregated (Pending | Running | Succeeded | Failed).
+    # Absent in the reference (it never launches workloads); this is how
+    # template-to-running latency becomes observable (BASELINE config #3).
+    workload_phases: Dict[str, str] = field(default_factory=dict)
+    workload_phase: str = ""
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -244,6 +250,8 @@ class NexusAlgorithmStatus:
             "syncedConfigurations": list(self.synced_configurations),
             "syncedToClusters": list(self.synced_to_clusters),
             "conditions": [c.to_dict() for c in self.conditions],
+            "workloadPhases": dict(self.workload_phases),
+            "workloadPhase": self.workload_phase,
         }
 
     @classmethod
@@ -253,6 +261,8 @@ class NexusAlgorithmStatus:
             synced_configurations=list(d.get("syncedConfigurations") or []),
             synced_to_clusters=list(d.get("syncedToClusters") or []),
             conditions=[Condition.from_dict(c) for c in (d.get("conditions") or [])],
+            workload_phases=dict(d.get("workloadPhases") or {}),
+            workload_phase=d.get("workloadPhase", ""),
         )
 
 

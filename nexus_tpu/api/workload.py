@@ -1,0 +1,143 @@
+"""Workload kinds: batch/v1 Job and core/v1 Service.
+
+These are the objects the workload plane writes to *shard* clusters when a
+synced template carries a ``jax_xla`` runtime — the TPU-native extension of
+the reference's fan-out (the reference only replicates CRDs + secrets/
+configmaps, controller.go:790-831; this framework's north star also launches
+the declared JAX job on the shard's TPU pool).
+
+``spec`` is carried as the raw manifest dict (the materializer's output,
+runtime/materializer.py) rather than a full typed model of batch/v1 — the
+controller only needs create/update/drift-diff on it, while ``status`` is
+typed because the controller *reads* it (workload phase back-propagation).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from nexus_tpu.api.types import APIObject, Condition, ObjectMeta
+
+
+@dataclass
+class JobStatus:
+    """batch/v1 JobStatus subset the controller consumes."""
+
+    active: int = 0
+    ready: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    start_time: Optional[str] = None
+    completion_time: Optional[str] = None
+    conditions: List[Condition] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "active": self.active,
+            "ready": self.ready,
+            "succeeded": self.succeeded,
+            "failed": self.failed,
+            "startTime": self.start_time,
+            "completionTime": self.completion_time,
+            "conditions": [c.to_dict() for c in self.conditions],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "JobStatus":
+        return cls(
+            active=int(d.get("active") or 0),
+            ready=int(d.get("ready") or 0),
+            succeeded=int(d.get("succeeded") or 0),
+            failed=int(d.get("failed") or 0),
+            start_time=d.get("startTime"),
+            completion_time=d.get("completionTime"),
+            conditions=[
+                Condition.from_dict(c) for c in (d.get("conditions") or [])
+            ],
+        )
+
+    def has_condition(self, cond_type: str) -> bool:
+        return any(
+            c.type == cond_type and c.status == "True" for c in self.conditions
+        )
+
+
+@dataclass
+class Job(APIObject):
+    KIND = "Job"
+    API_VERSION = "batch/v1"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: Dict[str, Any] = field(default_factory=dict)
+    status: JobStatus = field(default_factory=JobStatus)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": self.API_VERSION,
+            "kind": self.KIND,
+            "metadata": self.metadata.to_dict(),
+            "spec": dict(self.spec),
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Job":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=dict(d.get("spec") or {}),
+            status=JobStatus.from_dict(d.get("status") or {}),
+        )
+
+    # manifest == to_dict shape, so the materializer's output loads directly
+    from_manifest = from_dict
+
+    def phase(self) -> str:
+        """Collapse JobStatus into a workload phase:
+        Pending | Running | Succeeded | Failed."""
+        if self.status.has_condition("Failed"):
+            return "Failed"
+        if self.status.has_condition("Complete"):
+            return "Succeeded"
+        completions = int(self.spec.get("completions") or 1)
+        if self.status.succeeded >= completions and completions > 0:
+            return "Succeeded"
+        if self.status.active > 0 or self.status.ready > 0:
+            return "Running"
+        return "Pending"
+
+
+@dataclass
+class Service(APIObject):
+    KIND = "Service"
+    API_VERSION = "v1"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": self.API_VERSION,
+            "kind": self.KIND,
+            "metadata": self.metadata.to_dict(),
+            "spec": dict(self.spec),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Service":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=dict(d.get("spec") or {}),
+        )
+
+    from_manifest = from_dict
+
+
+def aggregate_phase(phases: List[str]) -> str:
+    """Worst-first aggregation over per-slice (or per-shard) phases."""
+    if not phases:
+        return ""
+    for p in ("Failed", "Pending", "Running"):
+        if p in phases:
+            return p
+    return "Succeeded"

@@ -66,6 +66,8 @@ from nexus_tpu.controller.events import (
 from nexus_tpu.shards.shard import Shard
 from nexus_tpu.utils.telemetry import (
     METRIC_RECONCILE_LATENCY,
+    METRIC_TEMPLATE_TO_RUNNING,
+    METRIC_TEMPLATE_TO_RUNNING_P50,
     METRIC_WORKQUEUE_LENGTH,
     StatsdClient,
     get_client,
@@ -150,6 +152,11 @@ class Controller:
         self._register_handlers()
         self._workers: List[threading.Thread] = []
         self._stop = threading.Event()
+        # template-to-running latency bookkeeping (BASELINE config #3):
+        # first-Running timestamps by template uid + rolling samples for p50
+        self._t2r_lock = threading.Lock()
+        self._t2r_emitted: set = set()
+        self._t2r_samples: List[float] = []
 
     # ------------------------------------------------------------ registration
     def _register_handlers(self) -> None:
@@ -173,6 +180,15 @@ class Controller:
                 on_add=self.handle_object,
                 on_update=self._handle_dependent_update,
                 on_delete=self.handle_object,
+            )
+        # Workload plane: shard-side Job events (status transitions written
+        # by the shard's kubelet / local launcher) re-enqueue the owning
+        # template so workload phase back-propagates into template status.
+        for shard in self.shards:
+            shard.job_informer.add_event_handler(
+                on_add=self._handle_shard_job_event,
+                on_update=lambda old, new: self._handle_shard_job_event(new),
+                on_delete=self._handle_shard_job_event,
             )
 
     def _handle_workgroup_event(self, workgroup) -> None:
@@ -238,6 +254,20 @@ class Controller:
                 )
                 continue
             self.enqueue_resource(template)
+
+    def _handle_shard_job_event(self, job) -> None:
+        """A materialized Job changed on a shard: enqueue the owning template
+        (resolved via the template label the materializer stamps)."""
+        from nexus_tpu.runtime.materializer import LABEL_TEMPLATE
+
+        name = (job.metadata.labels or {}).get(LABEL_TEMPLATE, "")
+        if not name:
+            return
+        try:
+            template = self.template_lister.get(job.metadata.namespace, name)
+        except NotFoundError:
+            return
+        self.enqueue_resource(template)
 
     def handle_object_delete(self, obj) -> None:
         """Template deletion: fan the delete out to every shard (reference
@@ -397,11 +427,14 @@ class Controller:
         synced_secrets: List[str],
         synced_config_maps: List[str],
         shard_names: List[str],
+        workload_phases: Optional[dict] = None,
     ) -> NexusAlgorithmTemplate:
         """Ready=True + sync bookkeeping, guarded by status DeepEqual so
         no-op reconciles don't write (reference: controller.go:463-480 — the
         new condition first reuses the previous LastTransitionTime so
         DeepEqual sees only real changes)."""
+        from nexus_tpu.api.workload import aggregate_phase
+
         updated = template.deepcopy()
         prev_ltt = updated.status.conditions[0].last_transition_time
         updated.status.conditions[0] = new_resource_ready_condition(
@@ -410,6 +443,12 @@ class Controller:
         updated.status.synced_secrets = list(synced_secrets)
         updated.status.synced_configurations = list(synced_config_maps)
         updated.status.synced_to_clusters = list(shard_names)
+        if workload_phases is not None:
+            # {} (runtime block absent) clears any stale workload status
+            updated.status.workload_phases = dict(workload_phases)
+            updated.status.workload_phase = aggregate_phase(
+                list(workload_phases.values())
+            )
         if not deep_equal(template.status, updated.status):
             updated.status.conditions[0].last_transition_time = utcnow()
             return self.store.update_status(updated, field_manager=FIELD_MANAGER)  # type: ignore[return-value]
@@ -626,6 +665,16 @@ class Controller:
 
         placed_shards = self._resolve_placement(template)
 
+        workgroup = None
+        if template.spec.workgroup_ref.name:
+            try:
+                workgroup = self.workgroup_lister.get(
+                    template.namespace, template.spec.workgroup_ref.name
+                )
+            except NotFoundError:
+                workgroup = None
+
+        workload_phases: dict = {}
         for shard in placed_shards:
             shard_template: Optional[NexusAlgorithmTemplate]
             try:
@@ -669,13 +718,27 @@ class Controller:
                 shard,
             )
 
+            if template.spec.runtime is not None:
+                workload_phases[shard.name] = self._sync_workload_to_shard(
+                    template, shard_template, shard, workgroup
+                )
+            else:
+                # runtime block removed: stop + clean up previously
+                # materialized workloads (they'd otherwise burn TPU until the
+                # template itself is deleted)
+                self._remove_workload_from_shard(template, shard)
+
         self._remove_from_unselected_shards(template, placed_shards)
+
+        if template.spec.runtime is not None:
+            self._observe_template_to_running(template, workload_phases)
 
         template = self._report_template_synced_condition(
             template,
             template.get_secret_names(),
             template.get_config_map_names(),
             [s.name for s in placed_shards],
+            workload_phases,
         )
         self.recorder.event(
             template,
@@ -683,6 +746,153 @@ class Controller:
             REASON_SYNCED,
             MSG_RESOURCE_SYNCED.format(NexusAlgorithmTemplate.KIND),
         )
+
+    def _sync_workload_to_shard(
+        self,
+        template: NexusAlgorithmTemplate,
+        shard_template: NexusAlgorithmTemplate,
+        shard: Shard,
+        workgroup,
+    ) -> str:
+        """Materialize the template's jax_xla runtime as Jobs + headless
+        Services on the shard and return the shard's workload phase.
+
+        This is what makes fan-out *real* on Kubernetes shards (the north
+        star's "template fan-out launches JAX/XLA jobs on the shard's TPU
+        pods") — the reference stops at replicating configuration
+        (controller.go:790-831).
+
+        Cross-slice failure policy (multislice): a terminally-Failed slice
+        Job (backoffLimit exhausted / fatal exit code) fails the whole
+        workload — sibling slice Jobs are deleted (stop burning TPU) and not
+        recreated while the failed Job's spec is current. A template spec
+        change produces different Job specs, which replaces the failed Job
+        and relaunches every slice (the JobSet failurePolicy equivalent).
+        """
+        from nexus_tpu.api.workload import Job, aggregate_phase
+        from nexus_tpu.runtime.materializer import (
+            materialize_headless_service,
+            materialize_job,
+        )
+
+        try:
+            job_manifests = materialize_job(template, workgroup, shard.name)
+            svc_manifests = materialize_headless_service(template)
+        except ValueError as e:
+            self.recorder.event(
+                template, EVENT_TYPE_WARNING, REASON_ERR_RESOURCE_SYNC, str(e)
+            )
+            raise SyncError(str(e)) from e
+
+        for manifest in svc_manifests:
+            shard.apply_service(shard_template, manifest, FIELD_MANAGER)
+
+        ns = template.namespace
+        current: dict = {}
+        for manifest in job_manifests:
+            name = manifest["metadata"]["name"]
+            try:
+                current[name] = shard.store.get(Job.KIND, ns, name)
+            except NotFoundError:
+                current[name] = None
+
+        def _is_current(job, manifest) -> bool:
+            return job is not None and deep_equal(
+                job.spec, manifest.get("spec") or {}
+            )
+
+        failed_current = [
+            name
+            for name, job in current.items()
+            if _is_current(
+                job, next(m for m in job_manifests if m["metadata"]["name"] == name)
+            )
+            and job.phase() == "Failed"
+        ]
+
+        phases = []
+        for manifest in job_manifests:
+            name = manifest["metadata"]["name"]
+            job = current[name]
+            if failed_current:
+                # fail-fast: stop sibling slices, don't relaunch missing ones
+                if (
+                    job is not None
+                    and name not in failed_current
+                    and job.phase() in ("Running", "Pending")
+                ):
+                    try:
+                        shard.store.delete(Job.KIND, ns, name)
+                    except NotFoundError:
+                        pass
+                    job = None
+                phases.append("Failed" if name in failed_current else "Pending")
+                continue
+            applied = shard.apply_job(shard_template, manifest, FIELD_MANAGER)
+            phases.append(applied.phase())
+
+        phase = aggregate_phase(phases)
+        if phase == "Failed" and len(job_manifests) > 1:
+            logger.warning(
+                "workload for template %s on shard %s failed (slices: %s); "
+                "sibling slices stopped",
+                template.key(), shard.name, ",".join(failed_current),
+            )
+        return phase
+
+    def _remove_workload_from_shard(
+        self, template: NexusAlgorithmTemplate, shard: Shard
+    ) -> None:
+        """Delete this template's materialized Jobs/Services from a shard
+        (runtime block removed from the spec). Only provenance-labeled
+        objects carrying our template label are touched."""
+        from nexus_tpu.api.workload import Job, Service
+        from nexus_tpu.runtime.materializer import LABEL_TEMPLATE
+
+        for kind in (Job.KIND, Service.KIND):
+            for obj in shard.store.list(kind, template.namespace):
+                labels = obj.metadata.labels or {}
+                if (
+                    labels.get(LABEL_CONTROLLER_APP) == CONTROLLER_APP_NAME
+                    and labels.get(LABEL_TEMPLATE) == template.name
+                ):
+                    try:
+                        shard.store.delete(
+                            kind, obj.namespace, obj.metadata.name
+                        )
+                    except NotFoundError:
+                        pass
+
+    def _observe_template_to_running(
+        self, template: NexusAlgorithmTemplate, workload_phases: dict
+    ) -> None:
+        """Emit the template-to-running latency gauges the first time a
+        template's workload is observed Running everywhere (the BASELINE
+        config #3 p50 metric; the reference's only latency metric is
+        per-reconcile, controller.go:389)."""
+        from nexus_tpu.api.workload import aggregate_phase
+
+        if aggregate_phase(list(workload_phases.values())) != "Running":
+            return
+        uid = template.metadata.uid
+        created = template.metadata.creation_timestamp
+        if created is None:
+            return
+        with self._t2r_lock:
+            if uid in self._t2r_emitted:
+                return
+            self._t2r_emitted.add(uid)
+            sample = max((utcnow() - created).total_seconds(), 0.0)
+            self._t2r_samples.append(sample)
+            if len(self._t2r_samples) > 1000:
+                self._t2r_samples = self._t2r_samples[-1000:]
+            samples = sorted(self._t2r_samples)
+            p50 = samples[len(samples) // 2]
+        self.statsd.gauge(
+            METRIC_TEMPLATE_TO_RUNNING, sample,
+            tags=[f"template:{template.name}"],
+        )
+        self.statsd.gauge(METRIC_TEMPLATE_TO_RUNNING_P50, p50)
 
     def _remove_from_unselected_shards(
         self, template: NexusAlgorithmTemplate, placed_shards: List[Shard]

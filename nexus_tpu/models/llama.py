@@ -42,6 +42,14 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     attn_impl: Optional[str] = None  # None=auto | 'xla' | 'flash' | 'ring'
     remat: bool = False
+    # remat granularity when remat=True:
+    #   'full' — recompute the whole block on backward (min memory, ~33%
+    #            extra FLOPs);
+    #   'dots' — save matmul outputs, recompute elementwise/norms only
+    #            (jax dots_with_no_batch_dims_saveable policy: most of the
+    #            memory win at a few % recompute cost — the right default
+    #            when activations almost fit)
+    remat_policy: str = "full"
 
     @property
     def head_dim(self) -> int:
@@ -207,7 +215,11 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
 
     block = partial(_block, cfg)
     if cfg.remat:
-        block = jax.checkpoint(block, static_argnums=())
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            block = jax.checkpoint(block, policy=policy)
+        else:
+            block = jax.checkpoint(block)
 
     def scan_body(x, layer_params):
         return block(x, layer_params, cos, sin), None

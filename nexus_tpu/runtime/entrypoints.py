@@ -202,6 +202,15 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel=None):
                 f"train.batchSize {tr.batch_size} is global and must be "
                 f"divisible by the process count {procs}"
             )
+        if procs > 1 and runtime.data.prefetch <= 0:
+            # the prefetcher is where process-local rows become ONE global
+            # sharded array (make_array_from_process_local_data); without it
+            # each process would feed its local array as if global — silent
+            # wrong results or a collective hang
+            raise ValueError(
+                "multi-process training requires data.prefetch >= 1 "
+                "(the prefetcher assembles the global batch across hosts)"
+            )
         local_batch = tr.batch_size // procs
         if runtime.model.family == "mlp":
             data = synthetic_mlp_batches(

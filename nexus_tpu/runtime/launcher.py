@@ -157,10 +157,12 @@ class LocalLauncher:
                 f"Launching {len(jobs)} job(s) for template {name!r} "
                 f"({tmpl.spec.runtime.mode} {tmpl.spec.runtime.model.family})",
             )
+            self._set_job_statuses(tmpl, jobs, "Running")
             metrics = run_template_runtime(
                 tmpl.spec.runtime, devices=self.devices, max_steps=self.max_steps
             )
             self._write_result(tmpl, "Succeeded", metrics, jobs)
+            self._set_job_statuses(tmpl, jobs, "Succeeded")
             self.recorder.event(
                 tmpl, EVENT_TYPE_NORMAL, REASON_JOB_COMPLETED,
                 f"Template {name!r} completed: "
@@ -171,10 +173,83 @@ class LocalLauncher:
             self._write_result(
                 tmpl, "Failed", {"error": str(e), "traceback": traceback.format_exc()[-2000:]}, []
             )
+            self._set_job_statuses(tmpl, None, "Failed")
             self.recorder.event(
                 tmpl, EVENT_TYPE_WARNING, REASON_JOB_FAILED,
                 f"Template {name!r} failed: {e}",
             )
+
+    def _set_job_statuses(self, tmpl, manifests, phase: str) -> None:
+        """Reflect execution state into the store's Job objects (the ones the
+        controller's workload sync applied) — the launcher plays kubelet for
+        in-process shards, so workload phase back-propagates to template
+        status exactly as it would from a real cluster. No-op for Job names
+        that don't exist in the store (launcher running without a
+        controller)."""
+        from nexus_tpu.api.types import Condition, utcnow
+        from nexus_tpu.api.workload import Job
+
+        if manifests is None:
+            try:
+                manifests = materialize_job(tmpl, shard_name=self.store.name)
+            except ValueError:
+                return
+        import time
+
+        from nexus_tpu.api.types import LABEL_CONTROLLER_APP
+
+        ns = tmpl.metadata.namespace
+        now = utcnow().isoformat()
+        # controller-synced templates carry the provenance label; only then
+        # is a controller around to apply Job objects worth waiting for
+        managed = LABEL_CONTROLLER_APP in (tmpl.metadata.labels or {})
+        for manifest in manifests:
+            name = manifest["metadata"]["name"]
+            job = None
+            # the controller's reconcile applies the Job moments after the
+            # template lands on the shard; the launcher thread can get here
+            # first — wait briefly for 'Running' so the phase transition
+            # (and the template_to_running gauge) isn't lost to the race
+            deadline = time.monotonic() + (
+                5.0 if (phase == "Running" and managed) else 0.0
+            )
+            while True:
+                try:
+                    job = self.store.get(Job.KIND, ns, name)
+                    break
+                except NotFoundError:
+                    if time.monotonic() >= deadline or self._stop.is_set():
+                        break
+                    time.sleep(0.05)
+            if job is None:
+                continue
+            updated = job.deepcopy()
+            n = int(job.spec.get("parallelism") or 1)
+            if phase == "Running":
+                updated.status.active = n
+                updated.status.ready = n
+                updated.status.start_time = updated.status.start_time or now
+            elif phase == "Succeeded":
+                updated.status.active = 0
+                updated.status.ready = 0
+                updated.status.succeeded = int(job.spec.get("completions") or 1)
+                updated.status.completion_time = now
+                updated.status.conditions = [
+                    Condition(type="Complete", status="True", reason="Completed")
+                ]
+            else:  # Failed
+                updated.status.active = 0
+                updated.status.ready = 0
+                updated.status.failed = updated.status.failed + 1
+                updated.status.conditions = [
+                    Condition(
+                        type="Failed", status="True", reason="BackoffLimitExceeded"
+                    )
+                ]
+            try:
+                self.store.update_status(updated)
+            except Exception:
+                logger.debug("job status update for %s skipped", name)
 
     def _write_result(
         self, tmpl: NexusAlgorithmTemplate, phase: str, metrics: Dict[str, Any],

@@ -32,6 +32,7 @@ from nexus_tpu.api.workgroup import (
     NexusAlgorithmWorkgroup,
     NexusAlgorithmWorkgroupSpec,
 )
+from nexus_tpu.api.workload import Job, Service
 from nexus_tpu.cluster.informer import InformerFactory, Lister
 from nexus_tpu.cluster.store import ClusterStore, NotFoundError
 
@@ -61,6 +62,11 @@ class Shard:
         self.workgroup_informer = self.informers.informer(NexusAlgorithmWorkgroup.KIND)
         self.secret_informer = self.informers.informer(Secret.KIND)
         self.config_map_informer = self.informers.informer(ConfigMap.KIND)
+        # workload plane: the materialized Jobs/Services this controller
+        # applies to the shard, plus the Job-status watch the controller
+        # consumes to back-propagate workload phase into template status
+        self.job_informer = self.informers.informer(Job.KIND)
+        self.service_informer = self.informers.informer(Service.KIND)
 
         # Reference field surface: {Template,Workgroup,Secret,ConfigMap}Lister
         # + *Synced readiness funcs (controller.go:516,578,792,722,867).
@@ -68,10 +74,14 @@ class Shard:
         self.workgroup_lister: Lister = self.workgroup_informer.lister
         self.secret_lister: Lister = self.secret_informer.lister
         self.config_map_lister: Lister = self.config_map_informer.lister
+        self.job_lister: Lister = self.job_informer.lister
+        self.service_lister: Lister = self.service_informer.lister
         self.templates_synced: Callable[[], bool] = self.template_informer.has_synced
         self.workgroups_synced: Callable[[], bool] = self.workgroup_informer.has_synced
         self.secrets_synced: Callable[[], bool] = self.secret_informer.has_synced
         self.config_maps_synced: Callable[[], bool] = self.config_map_informer.has_synced
+        self.jobs_synced: Callable[[], bool] = self.job_informer.has_synced
+        self.services_synced: Callable[[], bool] = self.service_informer.has_synced
 
     # --------------------------------------------------------------- plumbing
     def provenance_labels(self) -> Dict[str, str]:
@@ -228,6 +238,59 @@ class Shard:
         field_manager: str = "",
     ) -> ConfigMap:
         return self._update_dependent(config_map, data, owner, field_manager)  # type: ignore[return-value]
+
+    # -------------------------------------------------------------- workloads
+    def apply_job(
+        self,
+        owner: NexusAlgorithmTemplate,
+        manifest: Dict,
+        field_manager: str = "",
+    ) -> Job:
+        """Create-or-update a materialized Job on this shard.
+
+        Job specs are immutable after creation in Kubernetes (other than
+        suspend/parallelism); on pod-template drift the old Job is deleted
+        and recreated — the same converge contract the template sync uses,
+        adapted to batch/v1 semantics."""
+        job = Job.from_manifest(manifest)
+        job.metadata.labels.update(self.provenance_labels())
+        job.metadata.owner_references = [self._template_owner_ref(owner)]
+        try:
+            existing = self.store.get(
+                Job.KIND, job.metadata.namespace, job.metadata.name
+            )
+        except NotFoundError:
+            return self.store.create(job, field_manager=field_manager)  # type: ignore[return-value]
+        from nexus_tpu.api.types import deep_equal
+
+        if deep_equal(existing.spec, job.spec):
+            return existing  # type: ignore[return-value]
+        self.store.delete(Job.KIND, job.metadata.namespace, job.metadata.name)
+        return self.store.create(job, field_manager=field_manager)  # type: ignore[return-value]
+
+    def apply_service(
+        self,
+        owner: NexusAlgorithmTemplate,
+        manifest: Dict,
+        field_manager: str = "",
+    ) -> Service:
+        svc = Service.from_manifest(manifest)
+        svc.metadata.labels.update(self.provenance_labels())
+        svc.metadata.owner_references = [self._template_owner_ref(owner)]
+        try:
+            existing = self.store.get(
+                Service.KIND, svc.metadata.namespace, svc.metadata.name
+            )
+        except NotFoundError:
+            return self.store.create(svc, field_manager=field_manager)  # type: ignore[return-value]
+        from nexus_tpu.api.types import deep_equal
+
+        if deep_equal(existing.spec, svc.spec):
+            return existing  # type: ignore[return-value]
+        updated = existing.deepcopy()
+        updated.spec = dict(svc.spec)
+        updated.metadata.labels.update(self.provenance_labels())
+        return self.store.update(updated, field_manager=field_manager)  # type: ignore[return-value]
 
     # ------------------------------------------------------------------- misc
     def start(self) -> None:

@@ -22,6 +22,11 @@ from typing import Dict, List, Optional, Tuple
 
 METRIC_RECONCILE_LATENCY = "reconcile_latency"
 METRIC_WORKQUEUE_LENGTH = "workqueue_length"
+# TPU-native workload-plane metrics (the BASELINE config #3 north-star
+# latency): seconds from template creation to its materialized Jobs first
+# observed Running, per template + rolling p50 across templates.
+METRIC_TEMPLATE_TO_RUNNING = "template_to_running_seconds"
+METRIC_TEMPLATE_TO_RUNNING_P50 = "template_to_running_p50"
 
 
 def configure_logger(

@@ -1,0 +1,199 @@
+"""Workload plane: the controller materializes a synced template's jax_xla
+runtime as Jobs + headless Services on the shard, watches Job status, and
+back-propagates workload phase into template status (VERDICT r1 item 2; the
+north star's "template fan-out launches JAX/XLA jobs on the shard").
+"""
+
+from nexus_tpu.api.runtime_spec import (
+    JaxXlaRuntime,
+    ModelRef,
+    ParallelismSpec,
+    TpuSliceSpec,
+    TrainSpec,
+)
+from nexus_tpu.api.template import NexusAlgorithmTemplate
+from nexus_tpu.api.types import Condition, LABEL_CONTROLLER_APP
+from nexus_tpu.api.workload import Job, Service, aggregate_phase
+from nexus_tpu.cluster.store import NotFoundError
+from nexus_tpu.utils.telemetry import (
+    METRIC_TEMPLATE_TO_RUNNING,
+    METRIC_TEMPLATE_TO_RUNNING_P50,
+)
+from tests.test_controller_sync import NS, Fixture, make_template
+
+import pytest
+
+
+def runtime_block(slice_count=2):
+    return JaxXlaRuntime(
+        mode="train",
+        model=ModelRef(family="llama", preset="tiny"),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="2x2", slice_count=slice_count),
+        parallelism=ParallelismSpec(data=2 * slice_count, tensor=2),
+        train=TrainSpec(batch_size=8, seq_len=32, steps=2),
+    )
+
+
+def make_runtime_template(name="tpu-algo", slice_count=2):
+    tmpl = make_template(name)
+    tmpl.spec.runtime = runtime_block(slice_count)
+    return tmpl
+
+
+def set_job_status(store, name, *, active=0, succeeded=0, failed=0,
+                   condition=None):
+    job = store.get(Job.KIND, NS, name)
+    job.status.active = active
+    job.status.ready = active
+    job.status.succeeded = succeeded
+    job.status.failed = failed
+    job.status.conditions = (
+        [Condition(type=condition, status="True")] if condition else []
+    )
+    store.update_status(job)
+
+
+def test_workload_jobs_and_services_applied():
+    f = Fixture()
+    f.seed_controller(make_runtime_template())
+
+    f.controller.template_sync_handler(NS, "tpu-algo")
+
+    for slice_name in ("tpu-algo-s0", "tpu-algo-s1"):
+        job = f.shard_store.get(Job.KIND, NS, slice_name)
+        svc = f.shard_store.get(Service.KIND, NS, slice_name)
+        # provenance + ownership: owned by the SHARD-side template copy
+        shard_tmpl = f.shard_store.get(NexusAlgorithmTemplate.KIND, NS, "tpu-algo")
+        assert job.metadata.labels[LABEL_CONTROLLER_APP]
+        assert job.metadata.owner_references[0].uid == shard_tmpl.metadata.uid
+        assert svc.metadata.owner_references[0].uid == shard_tmpl.metadata.uid
+        # TPU scheduling materialized
+        pod = job.spec["template"]["spec"]
+        assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2"
+        assert svc.spec["clusterIP"] == "None"
+
+    status = f.controller_store.get(
+        NexusAlgorithmTemplate.KIND, NS, "tpu-algo"
+    ).status
+    assert status.workload_phases == {"shard0": "Pending"}
+    assert status.workload_phase == "Pending"
+
+
+def test_workload_phase_running_emits_t2r_gauges_once():
+    f = Fixture()
+    f.seed_controller(make_runtime_template())
+    f.controller.template_sync_handler(NS, "tpu-algo")
+
+    set_job_status(f.shard_store, "tpu-algo-s0", active=1)
+    set_job_status(f.shard_store, "tpu-algo-s1", active=1)
+    f.controller.template_sync_handler(NS, "tpu-algo")
+
+    status = f.controller_store.get(
+        NexusAlgorithmTemplate.KIND, NS, "tpu-algo"
+    ).status
+    assert status.workload_phase == "Running"
+
+    statsd = f.controller.statsd
+    t2r = [h for h in statsd.history if METRIC_TEMPLATE_TO_RUNNING in h[0]
+           and "p50" not in h[0]]
+    p50 = [h for h in statsd.history if METRIC_TEMPLATE_TO_RUNNING_P50 in h[0]]
+    assert len(t2r) == 1 and len(p50) == 1
+    assert t2r[0][1] >= 0.0
+
+    # second reconcile at Running must NOT re-emit (first-transition metric)
+    f.controller.template_sync_handler(NS, "tpu-algo")
+    t2r = [h for h in statsd.history if METRIC_TEMPLATE_TO_RUNNING in h[0]
+           and "p50" not in h[0]]
+    assert len(t2r) == 1
+
+
+def test_workload_cross_slice_failfast():
+    """Multislice failure policy: one slice terminally Failed → sibling
+    slice Jobs are stopped and not relaunched (VERDICT r1 missing #6)."""
+    f = Fixture()
+    f.seed_controller(make_runtime_template())
+    f.controller.template_sync_handler(NS, "tpu-algo")
+
+    set_job_status(f.shard_store, "tpu-algo-s0", failed=1, condition="Failed")
+    set_job_status(f.shard_store, "tpu-algo-s1", active=1)
+    f.controller.template_sync_handler(NS, "tpu-algo")
+
+    # sibling stopped...
+    with pytest.raises(NotFoundError):
+        f.shard_store.get(Job.KIND, NS, "tpu-algo-s1")
+    # ...and NOT relaunched by another reconcile while the failure is current
+    f.controller.template_sync_handler(NS, "tpu-algo")
+    with pytest.raises(NotFoundError):
+        f.shard_store.get(Job.KIND, NS, "tpu-algo-s1")
+
+    status = f.controller_store.get(
+        NexusAlgorithmTemplate.KIND, NS, "tpu-algo"
+    ).status
+    assert status.workload_phase == "Failed"
+
+
+def test_workload_spec_change_relaunches_after_failure():
+    f = Fixture()
+    f.seed_controller(make_runtime_template())
+    f.controller.template_sync_handler(NS, "tpu-algo")
+    set_job_status(f.shard_store, "tpu-algo-s0", failed=1, condition="Failed")
+    f.controller.template_sync_handler(NS, "tpu-algo")
+
+    # user pushes a new spec revision → different Job manifests
+    tmpl = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "tpu-algo")
+    tmpl.spec.runtime.train.steps = 7
+    updated = f.controller_store.update(tmpl)
+    f.controller.template_lister._set(updated)
+
+    f.controller.template_sync_handler(NS, "tpu-algo")
+
+    # failed job replaced by the fresh revision, all slices relaunched
+    for slice_name in ("tpu-algo-s0", "tpu-algo-s1"):
+        job = f.shard_store.get(Job.KIND, NS, slice_name)
+        assert job.phase() == "Pending"
+        assert '"steps":7' in _runtime_env(job)
+
+
+def _runtime_env(job):
+    env = job.spec["template"]["spec"]["containers"][0]["env"]
+    return next(e["value"] for e in env if e["name"] == "NEXUS_RUNTIME_SPEC")
+
+
+def test_workload_runtime_removal_cleans_up():
+    """Dropping the runtime block stops the materialized Jobs/Services and
+    clears workload status (instead of leaving them running/stale)."""
+    f = Fixture()
+    f.seed_controller(make_runtime_template())
+    f.controller.template_sync_handler(NS, "tpu-algo")
+    set_job_status(f.shard_store, "tpu-algo-s0", active=1)
+    set_job_status(f.shard_store, "tpu-algo-s1", active=1)
+    f.controller.template_sync_handler(NS, "tpu-algo")
+    assert (
+        f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "tpu-algo")
+        .status.workload_phase
+        == "Running"
+    )
+
+    tmpl = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "tpu-algo")
+    tmpl.spec.runtime = None
+    updated = f.controller_store.update(tmpl)
+    f.controller.template_lister._set(updated)
+    f.controller.template_sync_handler(NS, "tpu-algo")
+
+    for slice_name in ("tpu-algo-s0", "tpu-algo-s1"):
+        with pytest.raises(NotFoundError):
+            f.shard_store.get(Job.KIND, NS, slice_name)
+        with pytest.raises(NotFoundError):
+            f.shard_store.get(Service.KIND, NS, slice_name)
+    status = f.controller_store.get(
+        NexusAlgorithmTemplate.KIND, NS, "tpu-algo"
+    ).status
+    assert status.workload_phase == "" and status.workload_phases == {}
+
+
+def test_aggregate_phase_ordering():
+    assert aggregate_phase(["Running", "Pending"]) == "Pending"
+    assert aggregate_phase(["Running", "Failed"]) == "Failed"
+    assert aggregate_phase(["Succeeded", "Succeeded"]) == "Succeeded"
+    assert aggregate_phase(["Running", "Running"]) == "Running"
+    assert aggregate_phase([]) == ""
