@@ -223,6 +223,38 @@ class TrainSpec:
 
 
 @dataclass
+class InferSpec:
+    """Inference shapes + timing (mode='infer', BASELINE config #3).
+
+    ``iterations`` timed decodes run after the compile warm-up; the metric
+    is decode tokens/sec over the best iteration. Weights come from the
+    checkpoint block when enabled (train -> checkpoint -> infer roundtrip),
+    else random init (reported as weights_loaded=false)."""
+
+    prompt_length: int = 64
+    max_new_tokens: int = 512
+    iterations: int = 3
+    temperature: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "promptLength": self.prompt_length,
+            "maxNewTokens": self.max_new_tokens,
+            "iterations": self.iterations,
+            "temperature": self.temperature,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "InferSpec":
+        return cls(
+            prompt_length=int(d.get("promptLength", 64) or 64),
+            max_new_tokens=int(d.get("maxNewTokens", 512) or 512),
+            iterations=int(d.get("iterations", 3) or 3),
+            temperature=float(d.get("temperature", 0.0) or 0.0),
+        )
+
+
+@dataclass
 class DataSpec:
     """Training corpus: deterministic synthetic stream (default) or a flat
     binary token file read via memmap with host-disjoint sampling
@@ -331,6 +363,7 @@ class JaxXlaRuntime:
     tpu: TpuSliceSpec = field(default_factory=TpuSliceSpec)
     parallelism: ParallelismSpec = field(default_factory=ParallelismSpec)
     train: TrainSpec = field(default_factory=TrainSpec)
+    infer: InferSpec = field(default_factory=InferSpec)
     data: DataSpec = field(default_factory=DataSpec)
     checkpoint: CheckpointSpec = field(default_factory=CheckpointSpec)
     profile: ProfileSpec = field(default_factory=ProfileSpec)
@@ -381,6 +414,7 @@ class JaxXlaRuntime:
             "tpu": self.tpu.to_dict(),
             "parallelism": self.parallelism.to_dict(),
             "train": self.train.to_dict(),
+            "infer": self.infer.to_dict(),
             "data": self.data.to_dict(),
             "checkpoint": self.checkpoint.to_dict(),
             "profile": self.profile.to_dict(),
@@ -398,6 +432,7 @@ class JaxXlaRuntime:
             tpu=TpuSliceSpec.from_dict(d.get("tpu") or {}),
             parallelism=ParallelismSpec.from_dict(d.get("parallelism") or {}),
             train=TrainSpec.from_dict(d.get("train") or {}),
+            infer=InferSpec.from_dict(d.get("infer") or {}),
             data=DataSpec.from_dict(d.get("data") or {}),
             checkpoint=CheckpointSpec.from_dict(d.get("checkpoint") or {}),
             profile=ProfileSpec.from_dict(d.get("profile") or {}),

@@ -675,6 +675,7 @@ class Controller:
                 workgroup = None
 
         workload_phases: dict = {}
+        workload_starts: dict = {}
         for shard in placed_shards:
             shard_template: Optional[NexusAlgorithmTemplate]
             try:
@@ -719,9 +720,11 @@ class Controller:
             )
 
             if template.spec.runtime is not None:
-                workload_phases[shard.name] = self._sync_workload_to_shard(
+                phase, started_at = self._sync_workload_to_shard(
                     template, shard_template, shard, workgroup
                 )
+                workload_phases[shard.name] = phase
+                workload_starts[shard.name] = started_at
             else:
                 # runtime block removed: stop + clean up previously
                 # materialized workloads (they'd otherwise burn TPU until the
@@ -731,7 +734,9 @@ class Controller:
         self._remove_from_unselected_shards(template, placed_shards)
 
         if template.spec.runtime is not None:
-            self._observe_template_to_running(template, workload_phases)
+            self._observe_template_to_running(
+                template, workload_phases, workload_starts
+            )
 
         template = self._report_template_synced_condition(
             template,
@@ -811,6 +816,7 @@ class Controller:
         ]
 
         phases = []
+        starts = []
         for manifest in job_manifests:
             name = manifest["metadata"]["name"]
             job = current[name]
@@ -830,6 +836,16 @@ class Controller:
                 continue
             applied = shard.apply_job(shard_template, manifest, FIELD_MANAGER)
             phases.append(applied.phase())
+            starts.append(applied.status.start_time)
+
+        # prune slices a spec change no longer declares (e.g. slice_count
+        # reduced 3 → 2): anything provenance-labeled for this template
+        # whose name left the manifest set is deleted, Jobs and Services both
+        self._prune_stale_workload(
+            template, shard,
+            {m["metadata"]["name"] for m in job_manifests}
+            | {m["metadata"]["name"] for m in svc_manifests},
+        )
 
         phase = aggregate_phase(phases)
         if phase == "Failed" and len(job_manifests) > 1:
@@ -838,7 +854,41 @@ class Controller:
                 "sibling slices stopped",
                 template.key(), shard.name, ",".join(failed_current),
             )
-        return phase
+        # the instant the whole workload was up: the latest Job startTime,
+        # known only when every slice has one (feeds the t2r gauge even if
+        # the controller never observes the Running window itself)
+        started_at = None
+        if starts and all(starts):
+            import datetime as _dt
+
+            try:
+                started_at = max(_dt.datetime.fromisoformat(s) for s in starts)
+            except ValueError:
+                started_at = None
+        return phase, started_at
+
+    def _prune_stale_workload(
+        self, template: NexusAlgorithmTemplate, shard: Shard, keep: set
+    ) -> None:
+        from nexus_tpu.api.workload import Job, Service
+        from nexus_tpu.runtime.materializer import LABEL_TEMPLATE
+
+        for kind in (Job.KIND, Service.KIND):
+            for obj in shard.store.list(kind, template.namespace):
+                labels = obj.metadata.labels or {}
+                if (
+                    labels.get(LABEL_CONTROLLER_APP) == CONTROLLER_APP_NAME
+                    and labels.get(LABEL_TEMPLATE) == template.name
+                    and obj.metadata.name not in keep
+                ):
+                    logger.info(
+                        "pruning stale workload %s %s from shard %s",
+                        kind, obj.key(), shard.name,
+                    )
+                    try:
+                        shard.store.delete(kind, obj.namespace, obj.metadata.name)
+                    except NotFoundError:
+                        pass
 
     def _remove_workload_from_shard(
         self, template: NexusAlgorithmTemplate, shard: Shard
@@ -864,25 +914,48 @@ class Controller:
                         pass
 
     def _observe_template_to_running(
-        self, template: NexusAlgorithmTemplate, workload_phases: dict
+        self,
+        template: NexusAlgorithmTemplate,
+        workload_phases: dict,
+        workload_starts: Optional[dict] = None,
     ) -> None:
         """Emit the template-to-running latency gauges the first time a
-        template's workload is observed Running everywhere (the BASELINE
+        template's workload is known to have run everywhere (the BASELINE
         config #3 p50 metric; the reference's only latency metric is
-        per-reconcile, controller.go:389)."""
+        per-reconcile, controller.go:389).
+
+        The Running window is edge-y — a fast job can transition
+        Pending→Succeeded between reconciles — so a first-observed
+        Succeeded also counts, using the Jobs' recorded startTime (the
+        kubelet/launcher stamps it) rather than observation time."""
         from nexus_tpu.api.workload import aggregate_phase
 
-        if aggregate_phase(list(workload_phases.values())) != "Running":
+        phase = aggregate_phase(list(workload_phases.values()))
+        if phase not in ("Running", "Succeeded"):
             return
         uid = template.metadata.uid
         created = template.metadata.creation_timestamp
         if created is None:
             return
+        # prefer the Jobs' own startTime; fall back to observation time for
+        # a live Running observation (Succeeded without startTimes is
+        # skipped — an observation-time sample would overstate by the whole
+        # run duration)
+        starts = [
+            s for s in (workload_starts or {}).values() if s is not None
+        ]
+        started_at = (
+            max(starts) if starts and len(starts) == len(workload_phases)
+            else None
+        )
+        if started_at is None and phase != "Running":
+            return
         with self._t2r_lock:
             if uid in self._t2r_emitted:
                 return
             self._t2r_emitted.add(uid)
-            sample = max((utcnow() - created).total_seconds(), 0.0)
+            end = started_at if started_at is not None else utcnow()
+            sample = max((end - created).total_seconds(), 0.0)
             self._t2r_samples.append(sample)
             if len(self._t2r_samples) > 1000:
                 self._t2r_samples = self._t2r_samples[-1000:]

@@ -111,11 +111,17 @@ def autoregressive_generate(
     top_k: int = 0,
     top_p: float = 1.0,
     key: Optional[jax.Array] = None,
+    cache_sharding: Optional[Any] = None,
 ) -> jnp.ndarray:
     """prompt (B, P) → (B, P + max_new_tokens).
 
     Greedy by default; ``temperature > 0`` samples (requires ``key``),
-    optionally restricted by top_k / top_p (ops/sampling.py)."""
+    optionally restricted by top_k / top_p (ops/sampling.py).
+
+    ``cache_sharding``: optional ``jax.sharding.Sharding`` pinned onto the
+    K/V cache buffers (e.g. kv-heads over the ``tensor`` mesh axis, batch
+    over ``data``/``fsdp`` — runtime/entrypoints.py); applied via a sharding
+    constraint so it holds inside jit as well as eagerly."""
     if temperature > 0.0 and key is None:
         raise ValueError(
             "temperature > 0 requires an explicit PRNG key — a silent "
@@ -136,6 +142,12 @@ def autoregressive_generate(
     cache = init_kv_cache(
         cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype, b, max_len
     )
+    if cache_sharding is not None:
+        cache = {
+            "k": lax.with_sharding_constraint(cache["k"], cache_sharding),
+            "v": lax.with_sharding_constraint(cache["v"], cache_sharding),
+            "length": cache["length"],
+        }
 
     def pick(logits, step_idx):
         k = None if key is None else jax.random.fold_in(key, step_idx)

@@ -317,7 +317,61 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel=None):
     return metrics
 
 
+def _load_infer_params(runtime, family, cfg, mesh):
+    """Params for inference: restored from the Orbax checkpoint when the
+    template's checkpoint block points at one (the train -> checkpoint ->
+    infer roundtrip, BASELINE config #3), else fresh random init.
+
+    The checkpoint holds the full TrainState; params restore onto their
+    FSDP/TP shardings (abstract leaves carry NamedShardings), the optimizer
+    moments restore unsharded and are dropped immediately — single-chip
+    inference absorbs that transient; a params-only checkpoint format is the
+    future optimization for 8B-class multi-chip restores."""
+    key = jax.random.PRNGKey(runtime.train.seed)
+    ck = runtime.checkpoint
+    checkpointer = None
+    if ck.enabled and ck.directory:
+        checkpointer = Checkpointer(ck.directory, keep=ck.keep)
+        if checkpointer.latest_step() is None:
+            checkpointer = None
+    if checkpointer is None:
+        params = jax.jit(lambda: family.init(key, cfg))()
+        return params, False, -1
+
+    from nexus_tpu.parallel.sharding import sharding_tree
+    from nexus_tpu.train.trainer import TrainState
+
+    optimizer = build_optimizer(
+        learning_rate=runtime.train.learning_rate,
+        warmup_steps=runtime.train.warmup_steps,
+        total_steps=runtime.train.steps,
+        weight_decay=runtime.train.weight_decay,
+    )
+
+    def _make_state():
+        params = family.init(key, cfg)
+        return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+
+    abstract = jax.eval_shape(_make_state)
+    spec_tree = sharding_tree(family.logical_axes(cfg), mesh)
+    abstract.params = jax.tree_util.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        abstract.params,
+        spec_tree,
+    )
+    restored = checkpointer.restore(abstract)
+    step = int(restored.step)
+    params = restored.params
+    checkpointer.close()
+    del restored  # free the optimizer moments before decode allocates cache
+    logger.info("inference params restored from checkpoint step %d", step)
+    return params, True, step
+
+
 def _run_infer(runtime, family, cfg, mesh):
+    """Timed autoregressive decode (BASELINE config #3): load weights, shard
+    the KV cache (kv-heads over 'tensor', batch over 'data'/'fsdp'), decode
+    ``infer.max_new_tokens`` new tokens ``infer.iterations`` timed times."""
     gen = getattr(family, "generate", None)
     if gen is None:
         raise ValueError(
@@ -326,28 +380,68 @@ def _run_infer(runtime, family, cfg, mesh):
         )
     import time
 
-    tr = runtime.train  # batch/seq knobs reused for inference shapes
+    tr = runtime.train  # batch + seed
+    inf = runtime.infer
+    prompt_len = min(inf.prompt_length, cfg.max_seq_len - 1)
+    max_new = min(inf.max_new_tokens, cfg.max_seq_len - prompt_len)
+    if max_new <= 0:
+        raise ValueError(
+            f"infer shapes don't fit: prompt {prompt_len} + new tokens "
+            f"{inf.max_new_tokens} vs max_seq_len {cfg.max_seq_len}"
+        )
     key = jax.random.PRNGKey(tr.seed)
     with mesh:
-        params = jax.jit(lambda: family.init(key, cfg))()
+        params, weights_loaded, restored_step = _load_infer_params(
+            runtime, family, cfg, mesh
+        )
         prompt = jax.random.randint(
-            key, (tr.batch_size, min(32, tr.seq_len)), 0, cfg.vocab_size,
+            key, (tr.batch_size, prompt_len), 0, cfg.vocab_size,
             dtype=jnp.int32,
         )
-        max_new = min(64, cfg.max_seq_len - prompt.shape[1])
-        out = gen(params, cfg, prompt, max_new)  # compile + run
+        # cache layout (L, B, S, Hkv, D): batch over data axes, kv heads
+        # over the tensor axis — decode attention then runs tensor-parallel
+        # with zero cache resharding. Axes that don't tile the dim (small
+        # decode batches, few kv heads) fall back to replication.
+        shape = dict(mesh.shape)
+        dp, d_only = shape["data"] * shape["fsdp"], shape["data"]
+        if dp > 1 and tr.batch_size % dp == 0:
+            batch_axes = ("data", "fsdp")
+        elif d_only > 1 and tr.batch_size % d_only == 0:
+            batch_axes = "data"
+        else:
+            batch_axes = None
+        tp = shape["tensor"]
+        kv_axis = "tensor" if tp > 1 and cfg.n_kv_heads % tp == 0 else None
+        cache_sharding = NamedSharding(
+            mesh, P(None, batch_axes, None, kv_axis, None)
+        )
+        sampling = dict(cache_sharding=cache_sharding)
+        if inf.temperature > 0:
+            sampling.update(
+                temperature=inf.temperature, key=jax.random.fold_in(key, 7)
+            )
+
+        out = gen(params, cfg, prompt, max_new, **sampling)  # compile + warm
         jax.block_until_ready(out)
-        t0 = time.monotonic()
-        out = gen(params, cfg, prompt, max_new)
-        jax.block_until_ready(out)
-        dt = time.monotonic() - t0
+        times = []
+        for _ in range(max(1, inf.iterations)):
+            t0 = time.monotonic()
+            out = gen(params, cfg, prompt, max_new, **sampling)
+            jax.block_until_ready(out)
+            times.append(time.monotonic() - t0)
     new_tokens = tr.batch_size * max_new
+    best = min(times)
     return {
         "mode": "infer",
         "family": runtime.model.family,
         "preset": runtime.model.preset,
-        "decode_tokens_per_sec": new_tokens / dt,
+        "weights_loaded": weights_loaded,
+        "restored_step": restored_step,
+        "decode_tokens_per_sec": new_tokens / best,
+        "decode_tokens_per_sec_mean": new_tokens * len(times) / sum(times),
+        "iteration_seconds": [round(t, 4) for t in times],
         "batch_size": tr.batch_size,
+        "prompt_len": prompt_len,
         "new_tokens": max_new,
         "n_devices": mesh.devices.size,
     }

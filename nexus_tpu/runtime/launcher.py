@@ -203,16 +203,19 @@ class LocalLauncher:
         # controller-synced templates carry the provenance label; only then
         # is a controller around to apply Job objects worth waiting for
         managed = LABEL_CONTROLLER_APP in (tmpl.metadata.labels or {})
+        # the controller's reconcile applies the Jobs moments after the
+        # template lands on the shard; the launcher thread can get here
+        # first — wait briefly for 'Running' so the phase transition (and
+        # the template_to_running gauge) isn't lost to the race. ONE shared
+        # deadline across all manifests: if the Jobs aren't coming (sync
+        # error, fail-fast cleanup), we pay at most 5s per template, not
+        # 5s per slice.
+        deadline = time.monotonic() + (
+            5.0 if (phase == "Running" and managed) else 0.0
+        )
         for manifest in manifests:
             name = manifest["metadata"]["name"]
             job = None
-            # the controller's reconcile applies the Job moments after the
-            # template lands on the shard; the launcher thread can get here
-            # first — wait briefly for 'Running' so the phase transition
-            # (and the template_to_running gauge) isn't lost to the race
-            deadline = time.monotonic() + (
-                5.0 if (phase == "Running" and managed) else 0.0
-            )
             while True:
                 try:
                     job = self.store.get(Job.KIND, ns, name)

@@ -1,0 +1,100 @@
+"""Multi-process execution: two real OS processes, jax.distributed on the
+CPU backend, each running the pod-side worker entrypoint with the
+materializer's env contract (VERDICT r1 item 5; SURVEY.md §7.2).
+
+This exercises for real what the unit tests only exercise as arithmetic:
+coordinator rendezvous, global device visibility (2 processes x 1 CPU
+device), the data-parallel mesh spanning processes, and the Prefetcher's
+``make_array_from_process_local_data`` global-batch assembly.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import json, os, sys
+from nexus_tpu.runtime.worker import run_from_env
+metrics = run_from_env()
+print("RESULT " + json.dumps(
+    {k: metrics[k] for k in (
+        "final_loss", "process_id", "num_processes", "distributed", "steps",
+    )}
+), flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_distributed_train_step():
+    from nexus_tpu.api.runtime_spec import (
+        JaxXlaRuntime,
+        ModelRef,
+        ParallelismSpec,
+        TpuSliceSpec,
+        TrainSpec,
+    )
+
+    runtime = JaxXlaRuntime(
+        mode="train",
+        model=ModelRef(family="mlp", preset="tiny"),
+        # 1 chip per slice x 2 slices -> hosts_per_slice=1, num_processes=2
+        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1", slice_count=2),
+        parallelism=ParallelismSpec(data=2),
+        train=TrainSpec(batch_size=8, steps=3, learning_rate=1e-2),
+    )
+    spec_json = json.dumps(runtime.to_dict())
+    coordinator = f"127.0.0.1:{_free_port()}"
+
+    procs = []
+    for slice_idx in range(2):
+        env = dict(os.environ)
+        env.pop("PYTHONPATH", None)
+        env.update(
+            NEXUS_RUNTIME_SPEC=spec_json,
+            NEXUS_SLICE_INDEX=str(slice_idx),
+            NEXUS_SLICE_COUNT="2",
+            NEXUS_SHARD_NAME="mp-test",
+            JOB_COMPLETION_INDEX="0",
+            JAX_COORDINATOR_ADDRESS=coordinator,
+            JAX_PLATFORMS="cpu",
+            # one CPU device per process: the 2-device global mesh must come
+            # from the TWO processes, not from virtual host devices
+            XLA_FLAGS="",
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", WORKER],
+                cwd=REPO,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=280)
+        assert p.returncode == 0, f"worker failed:\nstdout={out}\nstderr={err[-3000:]}"
+        line = next(l for l in out.splitlines() if l.startswith("RESULT "))
+        results.append(json.loads(line[len("RESULT "):]))
+
+    assert {r["process_id"] for r in results} == {0, 1}
+    assert all(r["num_processes"] == 2 for r in results)
+    assert all(r["distributed"] is True for r in results)
+    assert all(r["steps"] == 3 for r in results)
+    # one SHARED train step: both processes computed the same global loss
+    assert abs(results[0]["final_loss"] - results[1]["final_loss"]) < 1e-6

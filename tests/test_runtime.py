@@ -175,6 +175,68 @@ def test_run_template_runtime_pipeline_rejects_unsupported():
         )
 
 
+def test_train_checkpoint_infer_roundtrip(tmp_path):
+    """VERDICT r1 item 4: weights trained + checkpointed by the train
+    runtime load into the infer runtime (not random init), with the KV
+    cache sharded over the mesh and repeated timed decodes."""
+    from nexus_tpu.api.runtime_spec import CheckpointSpec, InferSpec
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    common = dict(
+        model=ModelRef(family="llama", preset="tiny",
+                       overrides={"dtype": "float32"}),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="2x4", slice_count=1),
+        parallelism=ParallelismSpec(data=2, fsdp=2, tensor=2),
+        checkpoint=CheckpointSpec(enabled=True, directory=ckpt_dir,
+                                  interval_steps=2),
+    )
+    train_metrics = run_template_runtime(
+        runtime_block(
+            mode="train",
+            train=TrainSpec(batch_size=8, seq_len=32, steps=3),
+            **common,
+        )
+    )
+    assert train_metrics["checkpoint_saved"]
+
+    infer_metrics = run_template_runtime(
+        runtime_block(
+            mode="infer",
+            train=TrainSpec(batch_size=2, seq_len=32, steps=1),
+            infer=InferSpec(prompt_length=8, max_new_tokens=24, iterations=2),
+            **common,
+        )
+    )
+    assert infer_metrics["weights_loaded"] is True
+    assert infer_metrics["restored_step"] >= 1
+    assert infer_metrics["decode_tokens_per_sec"] > 0
+    assert infer_metrics["new_tokens"] == 24
+    assert len(infer_metrics["iteration_seconds"]) == 2
+
+
+def test_infer_long_decode_512_tokens():
+    """>=512-token decode through the scanned cache path (the honest
+    config-#3 shape, scaled to the tiny preset)."""
+    from nexus_tpu.api.runtime_spec import InferSpec
+
+    metrics = run_template_runtime(
+        runtime_block(
+            mode="infer",
+            model=ModelRef(
+                family="llama", preset="tiny",
+                overrides={"dtype": "float32", "max_seq_len": 544},
+            ),
+            tpu=TpuSliceSpec(accelerator="v5e", topology="2x4", slice_count=1),
+            parallelism=ParallelismSpec(data=2, fsdp=2, tensor=2),
+            train=TrainSpec(batch_size=2, seq_len=32, steps=1),
+            infer=InferSpec(prompt_length=16, max_new_tokens=512, iterations=1),
+        )
+    )
+    assert metrics["new_tokens"] == 512
+    assert metrics["weights_loaded"] is False
+    assert metrics["decode_tokens_per_sec"] > 0
+
+
 # ------------------------------------------------------- the config #2 e2e
 
 

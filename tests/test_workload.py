@@ -41,12 +41,13 @@ def make_runtime_template(name="tpu-algo", slice_count=2):
 
 
 def set_job_status(store, name, *, active=0, succeeded=0, failed=0,
-                   condition=None):
+                   condition=None, start_time=None):
     job = store.get(Job.KIND, NS, name)
     job.status.active = active
     job.status.ready = active
     job.status.succeeded = succeeded
     job.status.failed = failed
+    job.status.start_time = start_time
     job.status.conditions = (
         [Condition(type=condition, status="True")] if condition else []
     )
@@ -189,6 +190,53 @@ def test_workload_runtime_removal_cleans_up():
         NexusAlgorithmTemplate.KIND, NS, "tpu-algo"
     ).status
     assert status.workload_phase == "" and status.workload_phases == {}
+
+
+def test_workload_slice_count_reduction_prunes_stale_slices():
+    """slice_count 2 -> 1 must delete the no-longer-declared slice's Job and
+    Service, and its phase must not linger in the aggregate."""
+    f = Fixture()
+    f.seed_controller(make_runtime_template(slice_count=2))
+    f.controller.template_sync_handler(NS, "tpu-algo")
+    assert f.shard_store.get(Job.KIND, NS, "tpu-algo-s1") is not None
+
+    tmpl = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "tpu-algo")
+    tmpl.spec.runtime = runtime_block(slice_count=1)
+    updated = f.controller_store.update(tmpl)
+    f.controller.template_lister._set(updated)
+    f.controller.template_sync_handler(NS, "tpu-algo")
+
+    # single-slice naming: the job is now "tpu-algo" (no -sN suffix)
+    assert f.shard_store.get(Job.KIND, NS, "tpu-algo") is not None
+    for stale in ("tpu-algo-s0", "tpu-algo-s1"):
+        with pytest.raises(NotFoundError):
+            f.shard_store.get(Job.KIND, NS, stale)
+        with pytest.raises(NotFoundError):
+            f.shard_store.get(Service.KIND, NS, stale)
+
+
+def test_t2r_emitted_when_running_window_missed():
+    """A fast workload can go Pending -> Succeeded between reconciles; the
+    t2r gauge must still fire, using the Jobs' recorded startTime."""
+    from nexus_tpu.api.types import utcnow
+
+    f = Fixture()
+    f.seed_controller(make_runtime_template())
+    f.controller.template_sync_handler(NS, "tpu-algo")
+
+    started = utcnow().isoformat()
+    for name in ("tpu-algo-s0", "tpu-algo-s1"):
+        set_job_status(f.shard_store, name, succeeded=1, condition="Complete",
+                       start_time=started)
+    f.controller.template_sync_handler(NS, "tpu-algo")
+
+    status = f.controller_store.get(
+        NexusAlgorithmTemplate.KIND, NS, "tpu-algo"
+    ).status
+    assert status.workload_phase == "Succeeded"
+    t2r = [h for h in f.controller.statsd.history
+           if METRIC_TEMPLATE_TO_RUNNING in h[0] and "p50" not in h[0]]
+    assert len(t2r) == 1
 
 
 def test_aggregate_phase_ordering():
