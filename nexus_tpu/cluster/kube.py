@@ -1,44 +1,55 @@
 """Kubernetes-backed cluster store — the real-cluster deployment mode.
 
 Maps the :class:`~nexus_tpu.cluster.store.ClusterStore` surface onto a real
-Kubernetes API server: Secrets/ConfigMaps via CoreV1, the two Nexus CRDs via
-the CustomObjects API (group ``science.sneaksanddata.com/v1``, the reference
-CRD group — RBAC at reference .helm/templates/cluster-role-template-editor.yaml:26).
+Kubernetes API server over the stdlib REST client
+(:mod:`nexus_tpu.cluster.kubeapi` — no dependency on the ``kubernetes``
+package, which is absent from this build image). Kinds served:
+Secrets/ConfigMaps/Services via core v1, Jobs via batch/v1 (the workload
+plane), and the two Nexus CRDs via the group API
+(``science.sneaksanddata.com/v1``, the reference CRD group — RBAC at
+reference .helm/templates/cluster-role-template-editor.yaml:26).
 
-Requires the ``kubernetes`` Python client, which is NOT baked into this
-environment — the import below gates the whole module; the in-process
-``ClusterStore`` / ``.localshard`` path is the supported mode here. This
-module keeps the real-cluster path honest and structurally complete: same
-method surface, same watch-event fan-out, so ``Shard`` / ``Controller`` /
-``InformerFactory`` work unchanged on top of it.
+Watch strategy (the client-go reflector contract, mirrored from the
+reference's informer layer, /root/reference/main.go:70-71):
+LIST → diff against a local mirror (synthesizing ADDED/MODIFIED/DELETED for
+anything that changed while no stream was open) → WATCH from the list's
+resourceVersion → on 410 Gone or stream error, re-list and re-watch.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
-from typing import Callable, Dict, List, Optional, Tuple
-
-import kubernetes  # gated: ImportError here means "use .localshard mode"
-from kubernetes import client as k8s_client
-from kubernetes import config as k8s_config
-from kubernetes import watch as k8s_watch
+from typing import Callable, Dict, List, Optional
 
 from nexus_tpu.api.template import NexusAlgorithmTemplate
 from nexus_tpu.api.types import GROUP, VERSION, APIObject, ConfigMap, Secret
 from nexus_tpu.api.workgroup import NexusAlgorithmWorkgroup
+from nexus_tpu.api.workload import Job, Service
+from nexus_tpu.cluster.kubeapi import ApiError, KubeApiClient, KubeConfig
 from nexus_tpu.cluster.store import Action, NotFoundError, WatchEvent
 
 logger = logging.getLogger("nexus_tpu.cluster.kube")
 
-_PLURALS = {
+_CRD_PLURALS = {
     NexusAlgorithmTemplate.KIND: "nexusalgorithmtemplates",
     NexusAlgorithmWorkgroup.KIND: "nexusalgorithmworkgroups",
 }
-_CRD_TYPES = {
+_TYPES = {
+    Secret.KIND: Secret,
+    ConfigMap.KIND: ConfigMap,
+    Service.KIND: Service,
+    Job.KIND: Job,
     NexusAlgorithmTemplate.KIND: NexusAlgorithmTemplate,
     NexusAlgorithmWorkgroup.KIND: NexusAlgorithmWorkgroup,
 }
+_CORE_PLURALS = {
+    Secret.KIND: "secrets",
+    ConfigMap.KIND: "configmaps",
+    Service.KIND: "services",
+}
+# kinds whose status subresource the controller writes
+_STATUS_KINDS = set(_CRD_PLURALS) | {Job.KIND}
 
 
 class KubeClusterStore:
@@ -47,9 +58,7 @@ class KubeClusterStore:
     def __init__(self, name: str, kubeconfig_path: str, namespace: str = ""):
         self.name = name
         self.namespace = namespace
-        api_client = k8s_config.new_client_from_config(kubeconfig_path)
-        self._core = k8s_client.CoreV1Api(api_client)
-        self._custom = k8s_client.CustomObjectsApi(api_client)
+        self.api = KubeApiClient(KubeConfig.load(kubeconfig_path))
         self.actions: List[Action] = []  # parity with ClusterStore (not used
         # as a test oracle against real clusters)
         self._watchers: Dict[str, List[Callable[[WatchEvent], None]]] = {}
@@ -60,50 +69,41 @@ class KubeClusterStore:
         # so watch-gap deletions surface as synthetic DELETED events
         self._mirror: Dict[str, Dict[str, APIObject]] = {}
 
-    # ------------------------------------------------------------- conversion
-    def _to_wire(self, obj: APIObject) -> dict:
-        return obj.to_dict()
+    # ------------------------------------------------------------------ paths
+    def _collection_path(self, kind: str, namespace: str) -> str:
+        if kind in _CORE_PLURALS:
+            return f"/api/v1/namespaces/{namespace}/{_CORE_PLURALS[kind]}"
+        if kind == Job.KIND:
+            return f"/apis/batch/v1/namespaces/{namespace}/jobs"
+        if kind in _CRD_PLURALS:
+            return (
+                f"/apis/{GROUP}/{VERSION}/namespaces/{namespace}/"
+                f"{_CRD_PLURALS[kind]}"
+            )
+        raise ValueError(f"unsupported kind {kind!r}")
 
-    def _from_wire(self, kind: str, body) -> APIObject:
-        if hasattr(body, "to_dict"):
-            body = k8s_client.ApiClient().sanitize_for_serialization(body)
-        if kind == Secret.KIND:
-            return Secret.from_dict(body)
-        if kind == ConfigMap.KIND:
-            return ConfigMap.from_dict(body)
-        return _CRD_TYPES[kind].from_dict(body)
+    def _object_path(self, kind: str, namespace: str, name: str) -> str:
+        return f"{self._collection_path(kind, namespace)}/{name}"
+
+    # ------------------------------------------------------------- conversion
+    def _from_wire(self, kind: str, body: Dict) -> APIObject:
+        return _TYPES[kind].from_dict(body)
 
     # ------------------------------------------------------------------- CRUD
     def create(self, obj: APIObject, field_manager: str = "") -> APIObject:
         kind = obj.KIND
-        ns = obj.metadata.namespace
-        body = self._to_wire(obj)
-        if kind == Secret.KIND:
-            out = self._core.create_namespaced_secret(
-                ns, body, field_manager=field_manager or None
-            )
-        elif kind == ConfigMap.KIND:
-            out = self._core.create_namespaced_config_map(
-                ns, body, field_manager=field_manager or None
-            )
-        else:
-            out = self._custom.create_namespaced_custom_object(
-                GROUP, VERSION, ns, _PLURALS[kind], body,
-                field_manager=field_manager or None,
-            )
+        params = {"fieldManager": field_manager} if field_manager else None
+        out = self.api.post(
+            self._collection_path(kind, obj.metadata.namespace),
+            obj.to_dict(),
+            params=params,
+        )
         return self._from_wire(kind, out)
 
     def get(self, kind: str, namespace: str, name: str) -> APIObject:
         try:
-            if kind == Secret.KIND:
-                out = self._core.read_namespaced_secret(name, namespace)
-            elif kind == ConfigMap.KIND:
-                out = self._core.read_namespaced_config_map(name, namespace)
-            else:
-                out = self._custom.get_namespaced_custom_object(
-                    GROUP, VERSION, namespace, _PLURALS[kind], name
-                )
-        except k8s_client.ApiException as e:
+            out = self.api.get(self._object_path(kind, namespace, name))
+        except ApiError as e:
             if e.status == 404:
                 raise NotFoundError(kind, namespace, name) from e
             raise
@@ -111,67 +111,47 @@ class KubeClusterStore:
 
     def list(self, kind: str, namespace: Optional[str] = None) -> List[APIObject]:
         ns = namespace if namespace is not None else self.namespace
-        if kind == Secret.KIND:
-            out = self._core.list_namespaced_secret(ns)
-            items = out.items
-        elif kind == ConfigMap.KIND:
-            out = self._core.list_namespaced_config_map(ns)
-            items = out.items
-        else:
-            out = self._custom.list_namespaced_custom_object(
-                GROUP, VERSION, ns, _PLURALS[kind]
-            )
-            items = out.get("items", [])
-        return [self._from_wire(kind, i) for i in items]
+        out = self.api.get(self._collection_path(kind, ns))
+        return [self._from_wire(kind, i) for i in out.get("items", [])]
 
     def update(self, obj: APIObject, field_manager: str = "") -> APIObject:
         kind = obj.KIND
-        ns = obj.metadata.namespace
-        name = obj.metadata.name
-        body = self._to_wire(obj)
+        meta = obj.metadata
+        params = {"fieldManager": field_manager} if field_manager else None
         try:
-            if kind == Secret.KIND:
-                out = self._core.replace_namespaced_secret(
-                    name, ns, body, field_manager=field_manager or None
-                )
-            elif kind == ConfigMap.KIND:
-                out = self._core.replace_namespaced_config_map(
-                    name, ns, body, field_manager=field_manager or None
-                )
-            else:
-                out = self._custom.replace_namespaced_custom_object(
-                    GROUP, VERSION, ns, _PLURALS[kind], name, body,
-                    field_manager=field_manager or None,
-                )
-        except k8s_client.ApiException as e:
+            out = self.api.put(
+                self._object_path(kind, meta.namespace, meta.name),
+                obj.to_dict(),
+                params=params,
+            )
+        except ApiError as e:
             if e.status == 404:
-                raise NotFoundError(kind, ns, name) from e
+                raise NotFoundError(kind, meta.namespace, meta.name) from e
             raise
         return self._from_wire(kind, out)
 
     def update_status(self, obj: APIObject, field_manager: str = "") -> APIObject:
         kind = obj.KIND
-        ns = obj.metadata.namespace
-        name = obj.metadata.name
-        if kind in _PLURALS:
-            out = self._custom.replace_namespaced_custom_object_status(
-                GROUP, VERSION, ns, _PLURALS[kind], name, self._to_wire(obj),
-                field_manager=field_manager or None,
+        meta = obj.metadata
+        if kind not in _STATUS_KINDS:
+            raise ValueError(f"{kind} has no status subresource")
+        params = {"fieldManager": field_manager} if field_manager else None
+        try:
+            out = self.api.put(
+                self._object_path(kind, meta.namespace, meta.name) + "/status",
+                obj.to_dict(),
+                params=params,
             )
-            return self._from_wire(kind, out)
-        raise ValueError(f"{kind} has no status subresource")
+        except ApiError as e:
+            if e.status == 404:
+                raise NotFoundError(kind, meta.namespace, meta.name) from e
+            raise
+        return self._from_wire(kind, out)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         try:
-            if kind == Secret.KIND:
-                self._core.delete_namespaced_secret(name, namespace)
-            elif kind == ConfigMap.KIND:
-                self._core.delete_namespaced_config_map(name, namespace)
-            else:
-                self._custom.delete_namespaced_custom_object(
-                    GROUP, VERSION, namespace, _PLURALS[kind], name
-                )
-        except k8s_client.ApiException as e:
+            self.api.delete(self._object_path(kind, namespace, name))
+        except ApiError as e:
             if e.status == 404:
                 raise NotFoundError(kind, namespace, name) from e
             raise
@@ -209,24 +189,13 @@ class KubeClusterStore:
         ADDED/MODIFIED/DELETED events — this is how deletions (and any other
         changes) that happened while no watch stream was open are recovered.
         Returns the list's resourceVersion to resume the watch from."""
-        ns = self.namespace
-        if kind == Secret.KIND:
-            out = self._core.list_namespaced_secret(ns)
-            rv = out.metadata.resource_version
-            items = out.items
-        elif kind == ConfigMap.KIND:
-            out = self._core.list_namespaced_config_map(ns)
-            rv = out.metadata.resource_version
-            items = out.items
-        else:
-            out = self._custom.list_namespaced_custom_object(
-                GROUP, VERSION, ns, _PLURALS[kind]
-            )
-            rv = (out.get("metadata") or {}).get("resourceVersion", "")
-            items = out.get("items", [])
+        out = self.api.get(self._collection_path(kind, self.namespace))
+        rv = (out.get("metadata") or {}).get("resourceVersion", "")
         fresh = {
             obj.key(): obj
-            for obj in (self._from_wire(kind, i) for i in items)
+            for obj in (
+                self._from_wire(kind, i) for i in out.get("items", [])
+            )
         }
         mirror = self._mirror.setdefault(kind, {})
         for key, obj in fresh.items():
@@ -242,7 +211,6 @@ class KubeClusterStore:
         return rv or ""
 
     def _watch_loop(self, kind: str) -> None:
-        ns = self.namespace
         resource_version = ""
         need_relist = True
         while not self._stop.is_set():
@@ -250,36 +218,32 @@ class KubeClusterStore:
                 if need_relist:
                     resource_version = self._reconcile_mirror(kind)
                     need_relist = False
-                w = k8s_watch.Watch()
-                kwargs = dict(timeout_seconds=60)
-                if resource_version:
-                    kwargs["resource_version"] = resource_version
-                if kind == Secret.KIND:
-                    stream = w.stream(
-                        self._core.list_namespaced_secret, ns, **kwargs
-                    )
-                elif kind == ConfigMap.KIND:
-                    stream = w.stream(
-                        self._core.list_namespaced_config_map, ns, **kwargs
-                    )
-                else:
-                    stream = w.stream(
-                        self._custom.list_namespaced_custom_object,
-                        GROUP, VERSION, ns, _PLURALS[kind], **kwargs,
-                    )
+                stream = self.api.watch(
+                    self._collection_path(kind, self.namespace),
+                    resource_version=resource_version,
+                    timeout_seconds=60,
+                )
                 for event in stream:
                     if self._stop.is_set():
                         return
                     obj = self._from_wire(kind, event["object"])
-                    resource_version = obj.metadata.resource_version or resource_version
+                    resource_version = (
+                        obj.metadata.resource_version or resource_version
+                    )
                     mirror = self._mirror.setdefault(kind, {})
                     if event["type"] == "DELETED":
                         mirror.pop(obj.key(), None)
                     else:
                         mirror[obj.key()] = obj
                     self._dispatch(kind, WatchEvent(event["type"], obj))
-            except k8s_client.ApiException as e:
+            except ApiError as e:
+                if self._stop.is_set():
+                    return
                 if e.status == 410:  # Gone: resourceVersion too old → re-list
+                    logger.info(
+                        "watch for %s on %s got 410 Gone; re-listing",
+                        kind, self.name,
+                    )
                     need_relist = True
                     continue
                 logger.exception(
@@ -288,6 +252,8 @@ class KubeClusterStore:
                 need_relist = True
                 self._stop.wait(1.0)
             except Exception:
+                if self._stop.is_set():
+                    return
                 logger.exception(
                     "watch stream for %s on %s broke; re-listing in 1s",
                     kind, self.name,
@@ -303,33 +269,35 @@ class KubeClusterStore:
         import datetime
 
         meta = obj.metadata
-        now = datetime.datetime.now(datetime.timezone.utc)
+        now = datetime.datetime.now(datetime.timezone.utc).isoformat()
         api_version = (
-            "v1" if obj.KIND in (Secret.KIND, ConfigMap.KIND)
-            else f"{GROUP}/{VERSION}"
+            "v1"
+            if obj.KIND in _CORE_PLURALS
+            else ("batch/v1" if obj.KIND == Job.KIND else f"{GROUP}/{VERSION}")
         )
-        body = k8s_client.CoreV1Event(
-            metadata=k8s_client.V1ObjectMeta(
-                generate_name=f"{meta.name}.", namespace=meta.namespace
-            ),
-            involved_object=k8s_client.V1ObjectReference(
-                api_version=api_version,
-                kind=obj.KIND,
-                name=meta.name,
-                namespace=meta.namespace,
-                uid=meta.uid or None,
-            ),
-            type=event.type,
-            reason=event.reason,
-            message=event.message,
-            source=k8s_client.V1EventSource(component=event.component or None)
-            if getattr(event, "component", "")
-            else None,
-            count=1,
-            first_timestamp=now,
-            last_timestamp=now,
-        )
-        self._core.create_namespaced_event(meta.namespace, body)
+        body = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "generateName": f"{meta.name}.",
+                "namespace": meta.namespace,
+            },
+            "involvedObject": {
+                "apiVersion": api_version,
+                "kind": obj.KIND,
+                "name": meta.name,
+                "namespace": meta.namespace,
+                "uid": meta.uid or None,
+            },
+            "type": event.type,
+            "reason": event.reason,
+            "message": event.message,
+            "source": {"component": getattr(event, "component", "") or None},
+            "count": 1,
+            "firstTimestamp": now,
+            "lastTimestamp": now,
+        }
+        self.api.post(f"/api/v1/namespaces/{meta.namespace}/events", body)
 
     def clear_actions(self) -> None:
         self.actions = []

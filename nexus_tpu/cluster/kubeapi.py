@@ -1,0 +1,258 @@
+"""Minimal Kubernetes REST client on the Python standard library.
+
+The official ``kubernetes`` client is not available in every deployment
+image (and is absent from this build environment), so the real-cluster path
+speaks the API server's REST protocol directly: stdlib ``http.client`` +
+``ssl`` + ``json``, kubeconfig parsed with yaml. The surface is exactly
+what :class:`~nexus_tpu.cluster.kube.KubeClusterStore` needs — typed CRUD,
+LIST with resourceVersion, and chunked watch streams — mirroring the slice
+of client-go the reference leans on (clientset + informer reflectors,
+/root/reference/main.go:58-71).
+
+Auth supported from kubeconfig: bearer token (inline or file), client
+certificate/key (inline base64 ``*-data`` or file paths), cluster CA
+(inline or file), ``insecure-skip-tls-verify``, and plain http servers
+(test/fake API servers).
+"""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+import logging
+import os
+import socket
+import ssl
+import tempfile
+import threading
+import urllib.parse
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+logger = logging.getLogger("nexus_tpu.cluster.kubeapi")
+
+
+class ApiError(RuntimeError):
+    """Non-2xx API server response."""
+
+    def __init__(self, status: int, reason: str = "", body: str = ""):
+        super().__init__(f"kube api error {status}: {reason} {body[:200]}")
+        self.status = status
+        self.reason = reason
+        self.body = body
+
+
+class KubeConfig:
+    """The subset of a kubeconfig the client consumes."""
+
+    def __init__(
+        self,
+        server: str,
+        token: str = "",
+        ssl_context: Optional[ssl.SSLContext] = None,
+    ):
+        self.server = server
+        self.token = token
+        self.ssl_context = ssl_context
+
+    @classmethod
+    def load(cls, path: str) -> "KubeConfig":
+        import yaml
+
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+
+        ctx_name = doc.get("current-context") or ""
+        contexts = {c["name"]: c["context"] for c in doc.get("contexts") or []}
+        ctx = contexts.get(ctx_name) or (
+            next(iter(contexts.values())) if contexts else {}
+        )
+        clusters = {c["name"]: c["cluster"] for c in doc.get("clusters") or []}
+        users = {u["name"]: u.get("user") or {} for u in doc.get("users") or []}
+        cluster = clusters.get(ctx.get("cluster")) or (
+            next(iter(clusters.values())) if clusters else {}
+        )
+        user = users.get(ctx.get("user")) or (
+            next(iter(users.values())) if users else {}
+        )
+
+        server = cluster.get("server") or ""
+        if not server:
+            raise ValueError(f"kubeconfig {path}: no cluster.server")
+
+        token = user.get("token") or ""
+        token_file = user.get("tokenFile") or user.get("token-file") or ""
+        if not token and token_file and os.path.isfile(token_file):
+            with open(token_file) as f:
+                token = f.read().strip()
+
+        ssl_context = None
+        if server.startswith("https"):
+            if cluster.get("insecure-skip-tls-verify"):
+                ssl_context = ssl._create_unverified_context()
+            else:
+                ssl_context = ssl.create_default_context()
+                ca_data = cluster.get("certificate-authority-data")
+                ca_file = cluster.get("certificate-authority")
+                if ca_data:
+                    ssl_context.load_verify_locations(
+                        cadata=base64.b64decode(ca_data).decode()
+                    )
+                elif ca_file:
+                    ssl_context.load_verify_locations(cafile=ca_file)
+            cert_data = user.get("client-certificate-data")
+            key_data = user.get("client-key-data")
+            cert_file = user.get("client-certificate")
+            key_file = user.get("client-key")
+            if cert_data and key_data:
+                # ssl only loads cert chains from files; write decoded PEMs
+                # to a private tempdir living as long as the process
+                tmp = tempfile.mkdtemp(prefix="nexus-kubeapi-")
+                cert_file = os.path.join(tmp, "client.crt")
+                key_file = os.path.join(tmp, "client.key")
+                with open(cert_file, "w") as f:
+                    f.write(base64.b64decode(cert_data).decode())
+                with open(key_file, "w") as f:
+                    f.write(base64.b64decode(key_data).decode())
+                os.chmod(key_file, 0o600)
+            if cert_file and key_file:
+                ssl_context.load_cert_chain(cert_file, key_file)
+        return cls(server=server, token=token, ssl_context=ssl_context)
+
+
+class KubeApiClient:
+    """Thread-safe JSON-over-HTTP client for one API server."""
+
+    def __init__(self, config: KubeConfig, timeout: float = 30.0):
+        self.config = config
+        self.timeout = timeout
+        parsed = urllib.parse.urlparse(config.server)
+        self._https = parsed.scheme == "https"
+        self._host = parsed.hostname or "localhost"
+        self._port = parsed.port or (443 if self._https else 80)
+        self._local = threading.local()
+
+    # ------------------------------------------------------------- plumbing
+    def _connect(self, timeout: Optional[float] = None) -> http.client.HTTPConnection:
+        if self._https:
+            return http.client.HTTPSConnection(
+                self._host, self._port,
+                timeout=timeout or self.timeout,
+                context=self.config.ssl_context,
+            )
+        return http.client.HTTPConnection(
+            self._host, self._port, timeout=timeout or self.timeout
+        )
+
+    def _headers(self) -> Dict[str, str]:
+        h = {"Accept": "application/json", "Content-Type": "application/json"}
+        if self.config.token:
+            h["Authorization"] = f"Bearer {self.config.token}"
+        return h
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Dict[str, Any]] = None,
+        params: Optional[Dict[str, str]] = None,
+    ) -> Dict[str, Any]:
+        """One request/response cycle; raises :class:`ApiError` on non-2xx.
+
+        Connections are per-thread and reused. Only a REUSED keep-alive
+        connection that breaks is retried on a fresh socket — a stale
+        keep-alive failure means the request almost certainly never reached
+        the server. A fresh connection's failure is raised as-is: blindly
+        retrying non-idempotent verbs (POST/DELETE) could double-execute a
+        request the server already processed."""
+        if params:
+            path = f"{path}?{urllib.parse.urlencode(params)}"
+        payload = json.dumps(body) if body is not None else None
+        while True:
+            conn = getattr(self._local, "conn", None)
+            fresh = conn is None
+            if fresh:
+                conn = self._connect()
+                self._local.conn = conn
+            try:
+                conn.request(method, path, body=payload, headers=self._headers())
+                resp = conn.getresponse()
+                data = resp.read()
+                break
+            except (http.client.HTTPException, OSError):
+                self._local.conn = None
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                if fresh:
+                    raise
+                # reused connection died (server closed the keep-alive);
+                # loop once more with fresh=True
+        if resp.status >= 300:
+            raise ApiError(resp.status, resp.reason or "", data.decode(errors="replace"))
+        if not data:
+            return {}
+        return json.loads(data)
+
+    # ----------------------------------------------------------------- verbs
+    def get(self, path: str, params: Optional[Dict[str, str]] = None):
+        return self.request("GET", path, params=params)
+
+    def post(self, path: str, body, params: Optional[Dict[str, str]] = None):
+        return self.request("POST", path, body=body, params=params)
+
+    def put(self, path: str, body, params: Optional[Dict[str, str]] = None):
+        return self.request("PUT", path, body=body, params=params)
+
+    def delete(self, path: str, params: Optional[Dict[str, str]] = None):
+        return self.request("DELETE", path, params=params)
+
+    # ----------------------------------------------------------------- watch
+    def watch(
+        self,
+        path: str,
+        resource_version: str = "",
+        timeout_seconds: int = 60,
+    ) -> Iterator[Dict[str, Any]]:
+        """Stream watch events (``{"type": ..., "object": ...}`` dicts).
+
+        Opens a dedicated connection (watches are long-lived); terminates
+        when the server closes the stream (timeout), yielding control back
+        to the caller's re-list/re-watch loop. A 410 surfaces as
+        :class:`ApiError` with status 410 — the caller must re-list
+        (the reflector contract, mirrored in kube.py's watch loop)."""
+        params = {"watch": "1", "timeoutSeconds": str(timeout_seconds)}
+        if resource_version:
+            params["resourceVersion"] = resource_version
+        full = f"{path}?{urllib.parse.urlencode(params)}"
+        conn = self._connect(timeout=timeout_seconds + 10)
+        try:
+            conn.request("GET", full, headers=self._headers())
+            resp = conn.getresponse()
+            if resp.status >= 300:
+                body = resp.read()
+                raise ApiError(
+                    resp.status, resp.reason or "", body.decode(errors="replace")
+                )
+            while True:
+                try:
+                    line = resp.readline()
+                except (socket.timeout, TimeoutError):
+                    return
+                if not line:
+                    return
+                line = line.strip()
+                if not line:
+                    continue
+                event = json.loads(line)
+                if event.get("type") == "ERROR":
+                    status = (event.get("object") or {}).get("code", 500)
+                    raise ApiError(int(status), "watch ERROR event",
+                                   json.dumps(event)[:200])
+                yield event
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass

@@ -305,6 +305,9 @@ class ClusterStore:
             else:
                 gone = bucket.pop(name)
                 self._record(Action("delete", kind, namespace, name))
+                # the DELETED event carries a fresh resourceVersion (real
+                # API-server behavior) so rv-cursored watch streams deliver it
+                gone.metadata.resource_version = self._next_rv()
                 out = gone.deepcopy()
                 self._enqueue_event(kind, WatchEvent("DELETED", gone.deepcopy()))
         self._drain_events()

@@ -4,9 +4,9 @@ Wires: signals → config → logging/statsd → controller-cluster store +
 informer factories → shard loading → controller construction → run.
 
 The controller cluster itself is resolved the same way shards are: a
-``controller_config_path`` pointing at a kubeconfig uses the (import-gated)
-Kubernetes backend; empty path uses an in-process local store — the local /
-test deployment mode (BASELINE configs #1/#2).
+``controller_config_path`` pointing at a kubeconfig uses the stdlib
+Kubernetes REST backend (cluster/kubeapi.py); empty path uses an in-process
+local store — the local / test deployment mode (BASELINE configs #1/#2).
 """
 
 from __future__ import annotations

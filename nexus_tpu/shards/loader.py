@@ -10,9 +10,9 @@ Supported entries in ``shard_config_dir``:
     resolved by name via :func:`get_local_store` (file contents are currently
     ignored; state is in-memory only). This is the test / single-host path,
     and the path BASELINE config #2 exercises.
-  * ``<name>.kubeconfig`` — a real Kubernetes shard cluster; requires the
-    ``kubernetes`` Python client which is not baked into this environment, so
-    it is import-gated with a clear error.
+  * ``<name>.kubeconfig`` — a real Kubernetes shard cluster, served by the
+    stdlib REST client stack (cluster/kubeapi.py + cluster/kube.py — no
+    dependency on the ``kubernetes`` package).
 """
 
 from __future__ import annotations
@@ -97,14 +97,8 @@ def _read_capabilities(path: str) -> Dict[str, bool]:
 def _load_kube_shard(
     alias: str, shard_name: str, kubeconfig_path: str, namespace: str
 ) -> Shard:
-    try:
-        from nexus_tpu.cluster.kube import KubeClusterStore  # noqa: PLC0415
-    except ImportError as e:  # pragma: no cover - environment-dependent
-        raise ImportError(
-            f"shard {shard_name!r} is a kubeconfig shard but the 'kubernetes' "
-            "Python client is not installed; install it or use .localshard "
-            f"configs ({e})"
-        ) from e
+    from nexus_tpu.cluster.kube import KubeClusterStore  # noqa: PLC0415
+
     store = KubeClusterStore(shard_name, kubeconfig_path, namespace)
     # Optional capabilities sidecar: <name>.capabilities.yaml next to the
     # kubeconfig (a kubeconfig itself has no room for shard metadata).

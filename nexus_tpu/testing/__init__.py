@@ -1,0 +1,6 @@
+"""Test doubles shipped with the framework (envtest-style): an in-process
+Kubernetes API server backed by :class:`~nexus_tpu.cluster.store.ClusterStore`
+so the real-cluster client stack (kubeapi + KubeClusterStore) can be
+exercised end-to-end without a cluster."""
+
+from nexus_tpu.testing.fakekube import FakeKubeApiServer  # noqa: F401

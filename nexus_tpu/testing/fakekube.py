@@ -1,0 +1,376 @@
+"""In-process fake Kubernetes API server (HTTP) over a ClusterStore.
+
+The envtest-equivalent for this framework: it speaks enough of the real API
+server's REST protocol — typed CRUD with resourceVersion/conflict
+semantics, LIST with a list resourceVersion, chunked WATCH streams with
+replay-from-resourceVersion, 410 Gone after history compaction, the status
+subresource, and v1 Events — that the production client stack
+(:mod:`nexus_tpu.cluster.kubeapi` + ``KubeClusterStore``) runs against it
+unmodified. Two of these make a two-cluster e2e
+(tests/test_kube_e2e.py, the reference's Test_ControllerMain shape,
+/root/reference/controller_test.go:1287-1336) without kind or a kubelet.
+
+Storage/semantics come from :class:`~nexus_tpu.cluster.store.ClusterStore`
+(optimistic concurrency, finalizers, owner-reference GC) — the server is a
+wire-protocol shim, not a second implementation.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from nexus_tpu.api.template import NexusAlgorithmTemplate
+from nexus_tpu.api.types import GROUP, VERSION, ConfigMap, Secret
+from nexus_tpu.api.workgroup import NexusAlgorithmWorkgroup
+from nexus_tpu.api.workload import Job, Service
+from nexus_tpu.cluster.store import (
+    AlreadyExistsError,
+    ClusterStore,
+    ConflictError,
+    NotFoundError,
+)
+
+_TYPES = {
+    "secrets": Secret,
+    "configmaps": ConfigMap,
+    "services": Service,
+    "jobs": Job,
+    "nexusalgorithmtemplates": NexusAlgorithmTemplate,
+    "nexusalgorithmworkgroups": NexusAlgorithmWorkgroup,
+}
+_BY_KIND = {t.KIND: t for t in _TYPES.values()}
+_LIST_KINDS = {
+    Secret.KIND: "SecretList",
+    ConfigMap.KIND: "ConfigMapList",
+    Service.KIND: "ServiceList",
+    Job.KIND: "JobList",
+    NexusAlgorithmTemplate.KIND: "NexusAlgorithmTemplateList",
+    NexusAlgorithmWorkgroup.KIND: "NexusAlgorithmWorkgroupList",
+}
+
+
+class _History:
+    """Watch event history with replay + compaction (the etcd window)."""
+
+    def __init__(self):
+        self.lock = threading.Condition()
+        self.entries: List[Tuple[int, str, str, str, Dict[str, Any]]] = []
+        # (rv, kind, namespace, type, object_dict)
+        self.oldest_rv = 0  # events with rv <= oldest_rv are compacted away
+
+    def append(self, rv: int, kind: str, namespace: str, etype: str, obj: Dict):
+        with self.lock:
+            self.entries.append((rv, kind, namespace, etype, obj))
+            self.lock.notify_all()
+
+    def compact(self):
+        """Drop all retained history — any watch resuming from an old
+        resourceVersion must now re-list (410 Gone), exactly the condition
+        the client's reflector loop has to survive."""
+        with self.lock:
+            if self.entries:
+                self.oldest_rv = max(e[0] for e in self.entries)
+                self.entries = []
+            self.lock.notify_all()
+
+
+class FakeKubeApiServer:
+    """HTTP API server over a ClusterStore. Start/stop per test."""
+
+    def __init__(self, store: Optional[ClusterStore] = None, name: str = "fake"):
+        self.store = store or ClusterStore(name)
+        self.events: List[Dict[str, Any]] = []  # posted v1 Events
+        self.history = _History()
+        for plural, typ in _TYPES.items():
+            self.store.subscribe(typ.KIND, self._make_recorder(typ.KIND))
+        handler = self._handler_class()
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"fakekube-{name}",
+        )
+
+    # ---------------------------------------------------------------- control
+    def start(self) -> "FakeKubeApiServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    def write_kubeconfig(self, path: str) -> str:
+        """Emit a minimal kubeconfig pointing at this server."""
+        doc = {
+            "apiVersion": "v1",
+            "kind": "Config",
+            "current-context": "fake",
+            "contexts": [
+                {"name": "fake", "context": {"cluster": "fake", "user": "fake"}}
+            ],
+            "clusters": [{"name": "fake", "cluster": {"server": self.url}}],
+            "users": [{"name": "fake", "user": {"token": "fake-token"}}],
+        }
+        import yaml
+
+        with open(path, "w") as f:
+            yaml.safe_dump(doc, f)
+        return path
+
+    def compact_watch_history(self) -> None:
+        self.history.compact()
+
+    # --------------------------------------------------------------- plumbing
+    def _make_recorder(self, kind: str):
+        def record(ev):
+            obj = ev.obj
+            rv = int(obj.metadata.resource_version or 0)
+            self.history.append(
+                rv, kind, obj.metadata.namespace, ev.type, obj.to_dict()
+            )
+
+        return record
+
+    def _handler_class(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            # silence per-request stderr logging
+            def log_message(self, fmt, *args):  # noqa: D401
+                pass
+
+            # ------------------------------------------------------- helpers
+            def _send_json(self, code: int, body: Dict[str, Any]):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _status(self, code: int, reason: str, message: str):
+                self._send_json(
+                    code,
+                    {
+                        "apiVersion": "v1",
+                        "kind": "Status",
+                        "status": "Failure",
+                        "code": code,
+                        "reason": reason,
+                        "message": message,
+                    },
+                )
+
+            def _route(self):
+                """path → (kind, namespace, name|None, subresource|None)."""
+                parsed = urlparse(self.path)
+                parts = [p for p in parsed.path.split("/") if p]
+                # /api/v1/namespaces/{ns}/{plural}[/name[/status]]
+                # /apis/{group}/{ver}/namespaces/{ns}/{plural}[/name[/status]]
+                if parts[:1] == ["api"]:
+                    rest = parts[2:]
+                elif parts[:1] == ["apis"]:
+                    rest = parts[3:]
+                else:
+                    return None
+                if len(rest) < 2 or rest[0] != "namespaces":
+                    return None
+                ns = rest[1]
+                if len(rest) < 3:
+                    return None
+                plural = rest[2]
+                name = rest[3] if len(rest) > 3 else None
+                sub = rest[4] if len(rest) > 4 else None
+                if plural == "events":
+                    return ("__events__", ns, name, sub)
+                if plural not in _TYPES:
+                    return None
+                return (_TYPES[plural].KIND, ns, name, sub)
+
+            def _read_body(self) -> Dict[str, Any]:
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b"{}"
+                return json.loads(raw or b"{}")
+
+            # --------------------------------------------------------- verbs
+            def do_GET(self):  # noqa: N802
+                route = self._route()
+                if route is None:
+                    if urlparse(self.path).path == "/-/compact":
+                        server.compact_watch_history()
+                        self._send_json(200, {"compacted": True})
+                        return
+                    self._status(404, "NotFound", f"no route {self.path}")
+                    return
+                kind, ns, name, _sub = route
+                params = parse_qs(urlparse(self.path).query)
+                if name is None and params.get("watch", ["0"])[0] in ("1", "true"):
+                    self._do_watch(kind, ns, params)
+                    return
+                try:
+                    if name is None:
+                        # list snapshot + resourceVersion must be atomic:
+                        # an rv newer than the snapshot would make watch
+                        # resumption skip the in-between events (RLock, so
+                        # the nested list() locking is fine)
+                        with server.store._lock:
+                            items = server.store.list(kind, ns)
+                            rv = str(server.store._rv_counter)
+                        self._send_json(
+                            200,
+                            {
+                                "apiVersion": "v1",
+                                "kind": _LIST_KINDS[kind],
+                                "metadata": {"resourceVersion": rv},
+                                "items": [o.to_dict() for o in items],
+                            },
+                        )
+                    else:
+                        obj = server.store.get(kind, ns, name)
+                        self._send_json(200, obj.to_dict())
+                except NotFoundError as e:
+                    self._status(404, "NotFound", str(e))
+
+            def do_POST(self):  # noqa: N802
+                route = self._route()
+                if route is None:
+                    self._status(404, "NotFound", f"no route {self.path}")
+                    return
+                kind, ns, _name, _sub = route
+                body = self._read_body()
+                if kind == "__events__":
+                    server.events.append(body)
+                    self._send_json(201, body)
+                    return
+                typ = _BY_KIND[kind]
+                obj = typ.from_dict(body)
+                obj.metadata.namespace = obj.metadata.namespace or ns
+                try:
+                    created = server.store.create(obj)
+                except AlreadyExistsError as e:
+                    self._status(409, "AlreadyExists", str(e))
+                    return
+                self._send_json(201, created.to_dict())
+
+            def do_PUT(self):  # noqa: N802
+                route = self._route()
+                if route is None or route[2] is None:
+                    self._status(404, "NotFound", f"no route {self.path}")
+                    return
+                kind, ns, name, sub = route
+                body = self._read_body()
+                typ = _BY_KIND[kind]
+                obj = typ.from_dict(body)
+                obj.metadata.namespace = obj.metadata.namespace or ns
+                obj.metadata.name = obj.metadata.name or name
+                try:
+                    if sub == "status":
+                        out = server.store.update_status(obj)
+                    else:
+                        out = server.store.update(obj)
+                except NotFoundError as e:
+                    self._status(404, "NotFound", str(e))
+                    return
+                except ConflictError as e:
+                    self._status(409, "Conflict", str(e))
+                    return
+                self._send_json(200, out.to_dict())
+
+            def do_DELETE(self):  # noqa: N802
+                route = self._route()
+                if route is None or route[2] is None:
+                    self._status(404, "NotFound", f"no route {self.path}")
+                    return
+                kind, ns, name, _sub = route
+                try:
+                    server.store.delete(kind, ns, name)
+                except NotFoundError as e:
+                    self._status(404, "NotFound", str(e))
+                    return
+                self._send_json(
+                    200,
+                    {"apiVersion": "v1", "kind": "Status", "status": "Success"},
+                )
+
+            # --------------------------------------------------------- watch
+            def _write_chunk(self, data: bytes):
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data)
+                self.wfile.write(b"\r\n")
+                self.wfile.flush()
+
+            def _do_watch(self, kind: str, ns: str, params):
+                import time
+
+                rv = int(params.get("resourceVersion", ["0"])[0] or 0)
+                timeout = float(params.get("timeoutSeconds", ["60"])[0])
+                hist = server.history
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def emit(etype: str, obj: Dict[str, Any]) -> bool:
+                    try:
+                        self._write_chunk(
+                            (json.dumps({"type": etype, "object": obj}) + "\n")
+                            .encode()
+                        )
+                        return True
+                    except (BrokenPipeError, ConnectionResetError):
+                        return False
+
+                deadline = time.monotonic() + timeout
+                cursor = rv
+                alive = True
+                while alive and time.monotonic() < deadline:
+                    with hist.lock:
+                        if cursor and cursor < hist.oldest_rv:
+                            # the window was compacted past the client's rv
+                            alive = emit(
+                                "ERROR",
+                                {
+                                    "apiVersion": "v1",
+                                    "kind": "Status",
+                                    "status": "Failure",
+                                    "code": 410,
+                                    "reason": "Expired",
+                                    "message": "resourceVersion too old",
+                                },
+                            )
+                            break
+                        pending = [
+                            e
+                            for e in hist.entries
+                            if e[0] > cursor and e[1] == kind and e[2] == ns
+                        ]
+                        if not pending:
+                            hist.lock.wait(
+                                timeout=min(0.25, max(0.0, deadline - time.monotonic()))
+                            )
+                            continue
+                    for entry_rv, _k, _ns, etype, obj in pending:
+                        cursor = max(cursor, entry_rv)
+                        if not emit(etype, obj):
+                            alive = False
+                            break
+                # terminate the chunked stream
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+        return Handler
