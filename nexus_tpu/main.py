@@ -62,7 +62,13 @@ def main(argv: Optional[list] = None, cancel: Optional[CancelToken] = None) -> i
     if cancel is None:
         cancel = setup_signal_handler()
     config = load_config(AppConfig, config_path=args.config)
-    configure_logger(config.log_level, extra_tags={"alias": config.alias})
+    configure_logger(
+        config.log_level,
+        extra_tags={"alias": config.alias},
+        datadog_api_key=config.datadog_api_key,
+        datadog_site=config.datadog_site,
+        datadog_endpoint=config.datadog_log_endpoint,
+    )
     with_statsd("nexus-tpu", config.statsd_address or None)
 
     controller = build_controller(config)

@@ -37,10 +37,16 @@ class AppConfig:
     rate_limit_elements_per_second: float = 50.0
     rate_limit_elements_burst: int = 300
     # TPU-native extensions:
-    statsd_address: str = ""
+    statsd_address: str = ""  # "host:port" UDP or "unix:///path" DogStatsD
     use_finalizers: bool = False
     resync_period_seconds: float = 30.0
     queue_backend: str = "auto"  # auto | native (C++) | python
+    # Datadog log sink (the slog-datadog equivalent, reference main.go:43):
+    # api key enables shipping logs to the intake; site picks the region;
+    # endpoint overrides the intake URL outright (tests / proxies).
+    datadog_api_key: str = ""
+    datadog_site: str = "datadoghq.com"
+    datadog_log_endpoint: str = ""
 
 
 def _coerce(value: Any, target_type: Any) -> Any:

@@ -18,7 +18,7 @@ import os
 import socket
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple, Union
 
 METRIC_RECONCILE_LATENCY = "reconcile_latency"
 METRIC_WORKQUEUE_LENGTH = "workqueue_length"
@@ -30,9 +30,19 @@ METRIC_TEMPLATE_TO_RUNNING_P50 = "template_to_running_p50"
 
 
 def configure_logger(
-    level: str = "INFO", extra_tags: Optional[Dict[str, str]] = None
+    level: str = "INFO",
+    extra_tags: Optional[Dict[str, str]] = None,
+    datadog_api_key: str = "",
+    datadog_site: str = "datadoghq.com",
+    datadog_endpoint: str = "",
+    service: str = "nexus-tpu",
 ) -> logging.Logger:
-    """Configure root logging (the ConfigureLogger equivalent)."""
+    """Configure root logging (the ConfigureLogger equivalent).
+
+    With a Datadog API key (or explicit endpoint), a
+    :class:`DatadogLogHandler` ships every record to the Datadog logs
+    intake as well — the slog-datadog sink equivalent (reference:
+    main.go:43, go.mod:46)."""
     tag_str = " ".join(f"{k}={v}" for k, v in (extra_tags or {}).items())
     fmt = "%(asctime)s %(levelname)s %(name)s"
     if tag_str:
@@ -41,7 +51,130 @@ def configure_logger(
     logging.basicConfig(
         level=getattr(logging, level.upper(), logging.INFO), format=fmt, force=True
     )
+    root = logging.getLogger()
+    if datadog_api_key or datadog_endpoint:
+        handler = DatadogLogHandler(
+            api_key=datadog_api_key,
+            site=datadog_site,
+            endpoint=datadog_endpoint,
+            service=service,
+            tags=dict(extra_tags or {}),
+        )
+        handler.setLevel(getattr(logging, level.upper(), logging.INFO))
+        root.addHandler(handler)
     return logging.getLogger("nexus_tpu")
+
+
+class DatadogLogHandler(logging.Handler):
+    """Ship log records to the Datadog logs intake (HTTP, batched).
+
+    Stdlib-only (http.client): records are buffered and a background
+    thread POSTs JSON batches to ``/api/v2/logs`` with the ``DD-API-KEY``
+    header. ``endpoint`` overrides the intake URL (tests point it at a
+    local server); delivery is best-effort — intake failures are dropped
+    after one retry, never raised into the logging call site."""
+
+    def __init__(
+        self,
+        api_key: str = "",
+        site: str = "datadoghq.com",
+        endpoint: str = "",
+        service: str = "nexus-tpu",
+        tags: Optional[Dict[str, str]] = None,
+        flush_interval: float = 2.0,
+        max_batch: int = 100,
+    ):
+        import urllib.parse
+
+        super().__init__()
+        self.api_key = api_key
+        self.endpoint = endpoint or f"https://http-intake.logs.{site}/api/v2/logs"
+        self._parsed = urllib.parse.urlparse(self.endpoint)
+        if not self._parsed.hostname:
+            raise ValueError(f"invalid Datadog log endpoint {self.endpoint!r}")
+        self.service = service
+        self.ddtags = ",".join(f"{k}:{v}" for k, v in (tags or {}).items())
+        self.flush_interval = flush_interval
+        self.max_batch = max_batch
+        self._buf: List[dict] = []
+        self._buf_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._pump, daemon=True, name="nexus-dd-logs"
+        )
+        self._thread.start()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            entry = {
+                "message": self.format(record),
+                "status": record.levelname.lower(),
+                "service": self.service,
+                "ddsource": "nexus-tpu",
+                "ddtags": self.ddtags,
+                "logger": {"name": record.name},
+                "timestamp": int(record.created * 1000),
+            }
+        except Exception:  # noqa: BLE001 — formatting must never raise
+            return
+        with self._buf_lock:
+            self._buf.append(entry)
+            if len(self._buf) > 10 * self.max_batch:
+                # intake unreachable: bound memory, drop oldest
+                self._buf = self._buf[-5 * self.max_batch :]
+
+    def _drain(self) -> List[dict]:
+        with self._buf_lock:
+            batch, self._buf = self._buf[: self.max_batch], self._buf[self.max_batch :]
+            return batch
+
+    def _pump(self) -> None:
+        while not self._stop.wait(self.flush_interval):
+            self.flush_once()
+        # final best-effort flush on close: drain everything, not one batch
+        while self.flush_once():
+            pass
+
+    def flush_once(self) -> bool:
+        """Send one batch. Returns True if a batch was sent successfully;
+        on intake failure the batch is put back at the head of the buffer
+        (emit()'s drop-oldest bound then caps memory during long outages)."""
+        import http.client as http_client
+        import json as _json
+        import ssl as _ssl
+
+        batch = self._drain()
+        if not batch:
+            return False
+        parsed = self._parsed
+        try:
+            if parsed.scheme == "https":
+                conn = http_client.HTTPSConnection(
+                    parsed.hostname, parsed.port or 443, timeout=5,
+                    context=_ssl.create_default_context(),
+                )
+            else:
+                conn = http_client.HTTPConnection(
+                    parsed.hostname, parsed.port or 80, timeout=5
+                )
+            headers = {"Content-Type": "application/json"}
+            if self.api_key:
+                headers["DD-API-KEY"] = self.api_key
+            conn.request("POST", parsed.path or "/", _json.dumps(batch), headers)
+            conn.getresponse().read()
+            conn.close()
+            return True
+        except Exception:  # noqa: BLE001 — telemetry must not break the app
+            with self._buf_lock:
+                self._buf = batch + self._buf
+                if len(self._buf) > 10 * self.max_batch:
+                    self._buf = self._buf[-5 * self.max_batch :]
+            return False
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=self.flush_interval + 6)
+        super().close()
 
 
 class StatsdClient:
@@ -56,11 +189,17 @@ class StatsdClient:
         self.app_name = app_name
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
-        self._addr: Optional[Tuple[str, int]] = None
+        # UDP (host, port) tuple or a unix-socket path string
+        self._addr: Optional[Union[Tuple[str, int], str]] = None
         self.gauges: Dict[str, float] = {}
         self.history: List[Tuple[str, float, Tuple[str, ...]]] = []
         address = address or os.environ.get("NEXUS__STATSD_ADDRESS", "")
-        if address:
+        if address.startswith("unix://"):
+            # DogStatsD unix socket (the Datadog agent socket the reference
+            # chart mounts, .helm/templates/deployment.yaml:109-113)
+            self._addr = address[len("unix://"):]
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        elif address:
             host, _, port = address.partition(":")
             self._addr = (host, int(port or 8125))
             self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)

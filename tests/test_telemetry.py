@@ -1,0 +1,113 @@
+"""Telemetry: DogStatsD wire format (UDP + unix socket) and the Datadog
+log sink (the slog-datadog equivalent, reference main.go:43-44)."""
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from nexus_tpu.utils.telemetry import DatadogLogHandler, StatsdClient
+
+
+def test_statsd_udp_wire_format():
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(5)
+    port = rx.getsockname()[1]
+    client = StatsdClient("nexus-tpu", address=f"127.0.0.1:{port}")
+    client.gauge("reconcile_latency", 0.25, tags=["object_type:template"])
+    payload = rx.recv(1024).decode()
+    rx.close()
+    assert payload == "nexus-tpu.reconcile_latency:0.25|g|@1.0|#object_type:template"
+
+
+def test_statsd_unix_socket(tmp_path):
+    path = str(tmp_path / "dsd.socket")
+    rx = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+    rx.bind(path)
+    rx.settimeout(5)
+    client = StatsdClient("nexus-tpu", address=f"unix://{path}")
+    client.gauge("workqueue_length", 3)
+    payload = rx.recv(1024).decode()
+    rx.close()
+    assert payload.startswith("nexus-tpu.workqueue_length:3")
+
+
+class _Intake(ThreadingHTTPServer):
+    pass
+
+
+def _intake_server(batches, api_keys):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):  # noqa: N802
+            length = int(self.headers.get("Content-Length") or 0)
+            batches.append(json.loads(self.rfile.read(length)))
+            api_keys.append(self.headers.get("DD-API-KEY"))
+            self.send_response(202)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+    srv = _Intake(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv
+
+
+def test_datadog_log_handler_ships_batches():
+    batches, api_keys = [], []
+    srv = _intake_server(batches, api_keys)
+    host, port = srv.server_address
+    handler = DatadogLogHandler(
+        api_key="test-key",
+        endpoint=f"http://{host}:{port}/api/v2/logs",
+        service="nexus-tpu-test",
+        tags={"alias": "t"},
+        flush_interval=0.1,
+    )
+    logger = logging.getLogger("nexus_tpu.test.dd")
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        logger.info("hello datadog")
+        logger.warning("something %s", "warned")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and sum(map(len, batches)) < 2:
+            time.sleep(0.05)
+        entries = [e for b in batches for e in b]
+        assert len(entries) >= 2
+        assert api_keys[0] == "test-key"
+        msgs = {e["message"] for e in entries}
+        assert any("hello datadog" in m for m in msgs)
+        statuses = {e["status"] for e in entries}
+        assert {"info", "warning"} <= statuses
+        assert all(e["service"] == "nexus-tpu-test" for e in entries)
+        assert all("alias:t" in e["ddtags"] for e in entries)
+    finally:
+        logger.removeHandler(handler)
+        handler.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_datadog_log_handler_survives_unreachable_intake():
+    handler = DatadogLogHandler(
+        api_key="k", endpoint="http://127.0.0.1:1/api/v2/logs",
+        flush_interval=0.05,
+    )
+    logger = logging.getLogger("nexus_tpu.test.dd.unreachable")
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        for i in range(50):
+            logger.info("spam %d", i)
+        time.sleep(0.3)  # pump cycles run; nothing may raise
+    finally:
+        logger.removeHandler(handler)
+        handler.close()
