@@ -69,7 +69,8 @@ def _validate_flash_on_chip() -> bool:
         return False
 
 
-def _run_candidate(preset, steps, batch, seq, attn, remat, progress):
+def _run_candidate(preset, steps, batch, seq, attn, remat, progress,
+                   ce_chunk=0):
     """One sweep candidate → (mfu, metrics) or None on failure/OOM."""
     from nexus_tpu.api.runtime_spec import (
         JaxXlaRuntime,
@@ -83,6 +84,8 @@ def _run_candidate(preset, steps, batch, seq, attn, remat, progress):
     from nexus_tpu.utils.hw import is_tpu
 
     overrides = {"attn_impl": attn}
+    if ce_chunk:
+        overrides["ce_chunk"] = ce_chunk
     if not is_tpu():
         overrides["dtype"] = "float32"  # CPU smoke: bf16 is emulated + noisy
     if remat == "none":
@@ -99,7 +102,7 @@ def _run_candidate(preset, steps, batch, seq, attn, remat, progress):
             batch_size=batch, seq_len=seq, steps=steps, learning_rate=3e-4,
         ),
     )
-    label = f"attn={attn} remat={remat} batch={batch}"
+    label = f"attn={attn} remat={remat} batch={batch} ce_chunk={ce_chunk}"
     progress(f"candidate {label}: running {steps} steps")
     try:
         metrics = run_template_runtime(runtime)
@@ -116,6 +119,7 @@ def _run_candidate(preset, steps, batch, seq, attn, remat, progress):
     metrics["attn_impl"] = attn
     metrics["remat"] = remat
     metrics["batch_size"] = batch
+    metrics["ce_chunk"] = ce_chunk
     return mfu, metrics
 
 
@@ -161,6 +165,7 @@ def main() -> int:
             "batch_size": metrics.get("batch_size"),
             "attn_impl": metrics.get("attn_impl"),
             "remat": metrics.get("remat"),
+            "ce_chunk": metrics.get("ce_chunk"),
             "steps": metrics.get("steps"),
             "device": device_kind(),
             "n_devices": len(jax.devices()),
@@ -208,9 +213,10 @@ def main() -> int:
     pinned_attn = os.environ.get("NEXUS_BENCH_ATTN")
     pinned_remat = os.environ.get("NEXUS_BENCH_REMAT")
 
+    pinned_ce = os.environ.get("NEXUS_BENCH_CE_CHUNK")
     if not on_tpu:
         # CPU smoke: one tiny candidate, no sweep
-        candidates = [("xla", "none", int(pinned_batch or 4))]
+        candidates = [("xla", "none", int(pinned_batch or 4), 0)]
     else:
         flash_ok = False
         if pinned_attn in (None, "", "flash"):
@@ -218,25 +224,32 @@ def main() -> int:
             flash_ok = _validate_flash_on_chip()
         # a pinned NEXUS_BENCH_ATTN deliberately overrides failed validation
         attn = pinned_attn or ("flash" if flash_ok else "xla")
+        b = int(pinned_batch) if pinned_batch else 8
+        ce = int(pinned_ce) if pinned_ce else 4096
         # Sweep order: most promising first so a watchdog cut still reports
-        # a strong configuration. v5e-16GB at 400m/seq2048: bs8 no-remat is
-        # borderline; 'dots' keeps matmul outputs only and usually fits bs8.
-        if pinned_batch:
-            b = int(pinned_batch)
-            batches = [b]
+        # a strong configuration. v5e-16GB at 400m/seq2048: no-remat fits
+        # only with chunked CE (the f32 logits are the biggest resident
+        # tensor); 'dots' keeps matmul outputs only and is the safe fallback.
+        if pinned_remat:
+            candidates = [(attn, pinned_remat, b, ce)]
         else:
-            batches = [8, 16]
-        remats = [pinned_remat] if pinned_remat else ["dots", "none", "full"]
-        candidates = []
-        for b in batches:
-            for r in remats:
-                candidates.append((attn, r, b))
+            candidates = [
+                (attn, "none", b, ce),   # max FLOP efficiency if it fits
+                (attn, "dots", b, ce),   # cheap-recompute fallback
+                (attn, "dots", b, 0),    # is chunked CE actually winning?
+            ]
+            if not pinned_batch:
+                # a pinned batch means "this batch size, period"; only an
+                # unpinned sweep explores the larger-batch point
+                candidates.insert(2, (attn, "none", 2 * b, ce))
         # cap sweep size: compile time on the tunnel dominates
         candidates = candidates[:4]
 
     best = None
-    for attn, remat, batch in candidates:
-        res = _run_candidate(preset, steps, batch, seq, attn, remat, progress)
+    for attn, remat, batch, ce_chunk in candidates:
+        res = _run_candidate(
+            preset, steps, batch, seq, attn, remat, progress, ce_chunk=ce_chunk
+        )
         if res is not None and (best is None or res[0] > best[0]):
             best = res
             _best[0] = res

@@ -42,6 +42,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     attn_impl: Optional[str] = None  # None=auto | 'xla' | 'flash' | 'ring'
     remat: bool = False
+    # vocab-chunked exact cross entropy (ops/losses.py): 0 = dense logits;
+    # >0 = chunk width — peak logits memory drops from O(B·S·V) to
+    # O(B·S·chunk), the enabler for remat='none' at bench shapes
+    ce_chunk: int = 0
     # remat granularity when remat=True:
     #   'full' — recompute the whole block on backward (min memory, ~33%
     #            extra FLOPs);
@@ -203,9 +207,9 @@ def _block(cfg: LlamaConfig, x: jnp.ndarray, layer: Dict[str, jnp.ndarray],
     return x + gated @ layer["w_down"]
 
 
-def forward(params: Dict[str, Any], cfg: LlamaConfig,
-            tokens: jnp.ndarray, position_offset: int = 0) -> jnp.ndarray:
-    """tokens (B, S) int32 → logits (B, S, V) float32."""
+def forward_hidden(params: Dict[str, Any], cfg: LlamaConfig,
+                   tokens: jnp.ndarray, position_offset: int = 0) -> jnp.ndarray:
+    """Shared trunk: tokens (B, S) int32 → final-norm hidden (B, S, d)."""
     b, s = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     cos, sin = rope_cos_sin(
@@ -225,19 +229,34 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
         return block(x, layer_params, cos, sin), None
 
     x, _ = lax.scan(scan_body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def forward(params: Dict[str, Any], cfg: LlamaConfig,
+            tokens: jnp.ndarray, position_offset: int = 0) -> jnp.ndarray:
+    """tokens (B, S) int32 → logits (B, S, V) float32."""
+    x = forward_hidden(params, cfg, tokens, position_offset)
     return (x @ params["lm_head"]).astype(jnp.float32)
 
 
 def loss_fn(params: Dict[str, Any], cfg: LlamaConfig,
             batch: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, Dict[str, Any]]:
-    """Next-token cross entropy. batch: {'tokens': (B, S+1)}."""
+    """Next-token cross entropy. batch: {'tokens': (B, S+1)}.
+
+    ``cfg.ce_chunk > 0`` routes through the vocab-chunked exact CE
+    (ops/losses.py) — same value as the dense path up to reassociation,
+    without materializing (B, S, V) f32 logits."""
+    from nexus_tpu.ops.losses import chunked_softmax_xent, dense_softmax_xent
+
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, cfg, inputs)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    loss = -jnp.mean(ll)
+    hidden = forward_hidden(params, cfg, inputs)
+    if cfg.ce_chunk > 0:
+        loss = chunked_softmax_xent(
+            hidden, params["lm_head"], targets, chunk=cfg.ce_chunk
+        )
+    else:
+        loss = dense_softmax_xent(hidden, params["lm_head"], targets)
     return loss, {"loss": loss, "perplexity": jnp.exp(loss)}
 
 

@@ -161,8 +161,11 @@ class DatadogLogHandler(logging.Handler):
             if self.api_key:
                 headers["DD-API-KEY"] = self.api_key
             conn.request("POST", parsed.path or "/", _json.dumps(batch), headers)
-            conn.getresponse().read()
+            resp = conn.getresponse()
+            resp.read()
             conn.close()
+            if resp.status >= 300:
+                raise OSError(f"intake rejected batch: {resp.status}")
             return True
         except Exception:  # noqa: BLE001 — telemetry must not break the app
             with self._buf_lock:
