@@ -133,14 +133,15 @@ def pipeline_apply(
 # ----------------------------------------------------- llama integration
 
 
-def llama_pipeline_forward(
+def llama_pipeline_hidden(
     params: Dict[str, Any],
     cfg,
     tokens: jnp.ndarray,
     mesh: Mesh,
     n_microbatches: int,
 ) -> jnp.ndarray:
-    """Llama forward with layers pipelined over the 'pipeline' mesh axis.
+    """Llama trunk with layers pipelined over the 'pipeline' mesh axis:
+    tokens (B, S) → final-norm hidden (B, S, d).
 
     Embedding and the LM head are replicated (cheap vs the layer stack);
     the (B, S) batch is split into M microbatches along batch."""
@@ -176,7 +177,18 @@ def llama_pipeline_forward(
         params_spec=layer_spec, x_spec=P(None, ("data", "fsdp")),
     )
     y = y_mb.reshape(b, s, cfg.d_model)
-    y = rms_norm(y, params["final_norm"], cfg.norm_eps)
+    return rms_norm(y, params["final_norm"], cfg.norm_eps)
+
+
+def llama_pipeline_forward(
+    params: Dict[str, Any],
+    cfg,
+    tokens: jnp.ndarray,
+    mesh: Mesh,
+    n_microbatches: int,
+) -> jnp.ndarray:
+    """tokens (B, S) → logits (B, S, V) f32 through the GPipe trunk."""
+    y = llama_pipeline_hidden(params, cfg, tokens, mesh, n_microbatches)
     return (y @ params["lm_head"]).astype(jnp.float32)
 
 
@@ -184,10 +196,17 @@ def llama_pipeline_loss(
     params: Dict[str, Any], cfg, batch: Dict[str, jnp.ndarray],
     mesh: Mesh, n_microbatches: int,
 ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """GPipe next-token CE; honors ``cfg.ce_chunk`` exactly like the
+    non-pipelined loss (models/llama.py::loss_fn)."""
+    from nexus_tpu.ops.losses import chunked_softmax_xent, dense_softmax_xent
+
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = llama_pipeline_forward(params, cfg, inputs, mesh, n_microbatches)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    loss = -jnp.mean(ll)
+    hidden = llama_pipeline_hidden(params, cfg, inputs, mesh, n_microbatches)
+    if getattr(cfg, "ce_chunk", 0) > 0:
+        loss = chunked_softmax_xent(
+            hidden, params["lm_head"], targets, chunk=cfg.ce_chunk
+        )
+    else:
+        loss = dense_softmax_xent(hidden, params["lm_head"], targets)
     return loss, {"loss": loss, "perplexity": jnp.exp(loss)}

@@ -164,7 +164,12 @@ class DatadogLogHandler(logging.Handler):
             resp = conn.getresponse()
             resp.read()
             conn.close()
-            if resp.status >= 300:
+            if 400 <= resp.status < 500 and resp.status != 429:
+                # client error (bad key, malformed entry): retrying the same
+                # batch forever would head-of-line-block all newer logs —
+                # drop it
+                return True
+            if resp.status >= 300:  # 429 / 5xx: transient, requeue
                 raise OSError(f"intake rejected batch: {resp.status}")
             return True
         except Exception:  # noqa: BLE001 — telemetry must not break the app
