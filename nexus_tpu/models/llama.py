@@ -24,7 +24,8 @@ from jax import lax
 
 from nexus_tpu.ops.attention import attention
 from nexus_tpu.ops.norms import rms_norm
-from nexus_tpu.ops.ring_attention import ring_attention
+from nexus_tpu.ops.remat import checkpoint_block
+from nexus_tpu.ops.ring_attention import ring_attention_sharded
 from nexus_tpu.ops.rope import apply_rope, rope_cos_sin
 
 
@@ -153,38 +154,6 @@ def logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
 # ----------------------------------------------------------------- forward
 
 
-def _ring_attention_sharded(q, k, v):
-    """Ring attention over the active mesh's ``sequence`` axis.
-
-    Wraps the ring op in a shard_map nested inside the surrounding jit
-    (sequence/context parallelism, SURVEY.md §2c "SP/CP"): each device holds
-    an S/n sequence shard of Q/K/V and K/V blocks rotate via ppermute over
-    ICI. Requires an active Mesh context (``with mesh:``) whose ``sequence``
-    axis matches the batch's sequence sharding (parallel/sharding.batch_spec
-    with sequence_sharded=True)."""
-    from jax.interpreters.pxla import thread_resources
-    from jax.sharding import PartitionSpec as P
-
-    mesh = thread_resources.env.physical_mesh
-    if mesh.empty or mesh.shape.get("sequence", 1) == 1:
-        # no sequence axis to shard over — plain attention is exact
-        return attention(q, k, v, causal=True, impl=None)
-    try:
-        smap = jax.shard_map
-    except AttributeError:  # pragma: no cover — older jax
-        from jax.experimental.shard_map import shard_map as smap
-
-    # heads carry the tensor axis (qkv projections are TP-sharded)
-    spec = P(("data", "fsdp"), "sequence", "tensor", None)
-    ring = smap(
-        partial(ring_attention, axis_name="sequence", causal=True),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-    )
-    return ring(q, k, v)
-
-
 def _block(cfg: LlamaConfig, x: jnp.ndarray, layer: Dict[str, jnp.ndarray],
            cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
     b, s, d = x.shape
@@ -197,7 +166,7 @@ def _block(cfg: LlamaConfig, x: jnp.ndarray, layer: Dict[str, jnp.ndarray],
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     if cfg.attn_impl == "ring":
-        attn = _ring_attention_sharded(q, k, v)
+        attn = ring_attention_sharded(q, k, v)
     else:
         attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
     x = x + attn.reshape(b, s, hq * hd) @ layer["wo"]
@@ -219,11 +188,7 @@ def forward_hidden(params: Dict[str, Any], cfg: LlamaConfig,
 
     block = partial(_block, cfg)
     if cfg.remat:
-        if cfg.remat_policy == "dots":
-            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            block = jax.checkpoint(block, policy=policy)
-        else:
-            block = jax.checkpoint(block)
+        block = checkpoint_block(block, cfg.remat_policy)
 
     def scan_body(x, layer_params):
         return block(x, layer_params, cos, sin), None

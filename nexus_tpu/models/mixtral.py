@@ -26,6 +26,8 @@ from nexus_tpu.ops.moe import (
     top_k_routing,
 )
 from nexus_tpu.ops.norms import rms_norm
+from nexus_tpu.ops.remat import checkpoint_block
+from nexus_tpu.ops.ring_attention import ring_attention_sharded
 from nexus_tpu.ops.rope import apply_rope, rope_cos_sin
 
 
@@ -47,6 +49,8 @@ class MixtralConfig:
     dtype: Any = jnp.bfloat16
     attn_impl: Optional[str] = None
     remat: bool = False
+    remat_policy: str = "full"  # 'full' | 'dots' (see models/llama.py)
+    ce_chunk: int = 0  # vocab-chunked exact CE (ops/losses.py); 0 = dense
 
     @property
     def head_dim(self) -> int:
@@ -169,7 +173,12 @@ def _block(cfg: MixtralConfig, carry, layer, cos, sin):
     q = apply_rope((h @ layer["wq"]).reshape(b, s, hq, hd), cos, sin)
     k = apply_rope((h @ layer["wk"]).reshape(b, s, hkv, hd), cos, sin)
     v = (h @ layer["wv"]).reshape(b, s, hkv, hd)
-    attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+    if cfg.attn_impl == "ring":
+        # context parallelism over the 'sequence' mesh axis (same shared
+        # entry the llama block uses)
+        attn = ring_attention_sharded(q, k, v)
+    else:
+        attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
     x = x + attn.reshape(b, s, hq * hd) @ layer["wo"]
 
     h2 = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
@@ -177,16 +186,16 @@ def _block(cfg: MixtralConfig, carry, layer, cos, sin):
     return (x + moe_out, aux + layer_aux)
 
 
-def forward(params: Dict[str, Any], cfg: MixtralConfig,
-            tokens: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """tokens (B, S) → (logits (B, S, V) fp32, total_aux_loss)."""
+def forward_hidden(params: Dict[str, Any], cfg: MixtralConfig,
+                   tokens: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """tokens (B, S) → (final-norm hidden (B, S, d), total_aux_loss)."""
     b, s = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     cos, sin = rope_cos_sin(s, cfg.head_dim, cfg.rope_theta)
 
     block = partial(_block, cfg)
     if cfg.remat:
-        block = jax.checkpoint(block)
+        block = checkpoint_block(block, cfg.remat_policy)
 
     def scan_body(carry, layer_params):
         return block(carry, layer_params, cos, sin), None
@@ -194,18 +203,29 @@ def forward(params: Dict[str, Any], cfg: MixtralConfig,
     (x, aux), _ = lax.scan(
         scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"]
     )
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return rms_norm(x, params["final_norm"], cfg.norm_eps), aux
+
+
+def forward(params: Dict[str, Any], cfg: MixtralConfig,
+            tokens: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """tokens (B, S) → (logits (B, S, V) fp32, total_aux_loss)."""
+    x, aux = forward_hidden(params, cfg, tokens)
     return (x @ params["lm_head"]).astype(jnp.float32), aux
 
 
 def loss_fn(params: Dict[str, Any], cfg: MixtralConfig,
             batch: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    from nexus_tpu.ops.losses import chunked_softmax_xent, dense_softmax_xent
+
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits, aux = forward(params, cfg, inputs)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    ce = -jnp.mean(ll)
+    hidden, aux = forward_hidden(params, cfg, inputs)
+    if cfg.ce_chunk > 0:
+        ce = chunked_softmax_xent(
+            hidden, params["lm_head"], targets, chunk=cfg.ce_chunk
+        )
+    else:
+        ce = dense_softmax_xent(hidden, params["lm_head"], targets)
     loss = ce + cfg.router_aux_weight * aux / cfg.n_layers
     return loss, {"loss": loss, "ce": ce, "aux": aux,
                   "perplexity": jnp.exp(ce)}

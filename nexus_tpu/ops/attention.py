@@ -412,4 +412,12 @@ def attention(
         impl = "flash" if (is_tpu() and tile_ok) else "xla"
     if impl == "flash":
         return flash_attention(q, k, v, causal=causal, q_offset=q_offset)
-    return attention_xla(q, k, v, causal=causal, q_offset=q_offset)
+    if impl == "xla":
+        return attention_xla(q, k, v, causal=causal, q_offset=q_offset)
+    # 'ring' must go through ops.ring_attention.ring_attention_sharded (the
+    # model blocks dispatch it); silently degrading an unknown impl to the
+    # dense path would hide a real configuration error
+    raise ValueError(
+        f"unknown attention impl {impl!r}; expected None, 'xla', or 'flash' "
+        "(ring attention dispatches via ring_attention_sharded)"
+    )

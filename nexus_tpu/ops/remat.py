@@ -1,0 +1,32 @@
+"""Shared rematerialization policy selection for model blocks.
+
+One place maps the spec's ``remat_policy`` string onto ``jax.checkpoint``
+variants (used by models/llama.py, models/mixtral.py, parallel/pipeline.py)
+— and an unknown policy is a loud error, not a silent fall-through to
+full recompute."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+REMAT_POLICIES = ("full", "dots")
+
+
+def checkpoint_block(fn: Callable, remat_policy: str = "full") -> Callable:
+    """Wrap ``fn`` in jax.checkpoint per the named policy.
+
+    ``full``: recompute everything on backward (min memory, max recompute).
+    ``dots``: save matmul outputs, recompute elementwise/norms
+    (``dots_with_no_batch_dims_saveable`` — most of the memory win at a few
+    percent recompute)."""
+    if remat_policy == "dots":
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    if remat_policy == "full":
+        return jax.checkpoint(fn)
+    raise ValueError(
+        f"unknown remat_policy {remat_policy!r}; expected one of {REMAT_POLICIES}"
+    )

@@ -117,3 +117,37 @@ def ring_attention(
     safe_l = jnp.where(l == 0.0, 1.0, l)
     out = (acc / safe_l).astype(q.dtype)  # (B,H,Q,D)
     return out.transpose(0, 2, 1, 3)
+
+
+def ring_attention_sharded(q, k, v):
+    """Ring attention over the ACTIVE mesh's ``sequence`` axis.
+
+    Shared model-side entry (llama + mixtral blocks): wraps the ring op in
+    a shard_map nested inside the surrounding jit — each device holds an
+    S/n sequence shard of Q/K/V (B, S/n, H, D) and K/V blocks rotate via
+    ppermute over ICI. Falls back to plain attention when no sequence axis
+    is sharded (then attention is exact locally). Heads ride the ``tensor``
+    axis, batch the data axes — matching the families' activation layout."""
+    from functools import partial as _partial
+
+    from jax.interpreters.pxla import thread_resources
+    from jax.sharding import PartitionSpec as P
+
+    from nexus_tpu.ops.attention import attention
+
+    mesh = thread_resources.env.physical_mesh
+    if mesh.empty or mesh.shape.get("sequence", 1) == 1:
+        return attention(q, k, v, causal=True, impl=None)
+    try:
+        smap = jax.shard_map
+    except AttributeError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map as smap
+
+    spec = P(("data", "fsdp"), "sequence", "tensor", None)
+    ring = smap(
+        _partial(ring_attention, axis_name="sequence", causal=True),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return ring(q, k, v)

@@ -162,9 +162,19 @@ def llama_pipeline_hidden(
     x_mb = x.reshape(n_microbatches, b // n_microbatches, s, cfg.d_model)
     cos, sin = rope_cos_sin(s, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32)
 
+    block = lambda h, layer: _block(cfg, h, layer, cos, sin)
+    if getattr(cfg, "remat", False):
+        # per-layer remat inside the stage: with M microbatches in flight a
+        # stage holds M activation sets — rematerializing the block bounds
+        # that at M×(layer I/O) instead of M×(full block internals), the
+        # GPipe memory knob until a 1F1B schedule lands
+        from nexus_tpu.ops.remat import checkpoint_block
+
+        block = checkpoint_block(block, getattr(cfg, "remat_policy", "full"))
+
     def stage_fn(layers_local, h):
         def body(h, layer):
-            return _block(cfg, h, layer, cos, sin), None
+            return block(h, layer), None
 
         h, _ = lax.scan(body, h, layers_local)
         return h

@@ -74,6 +74,15 @@ def run_template_runtime(
     checkpoint so the requeued job resumes."""
     family = get_family(runtime.model.family)
     overrides = dict(runtime.model.overrides)
+    # train.remat is the spec-level knob; model.overrides.remat (with
+    # remat_policy) is the fine-grained one and wins when both are set
+    # (mlp has no remat — its two layers don't warrant recompute)
+    if (
+        runtime.train.remat
+        and runtime.model.family != "mlp"
+        and "remat" not in overrides
+    ):
+        overrides["remat"] = True
     mesh = _resolve_mesh(runtime, devices)
     if (
         dict(mesh.shape).get("sequence", 1) > 1

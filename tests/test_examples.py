@@ -1,0 +1,51 @@
+"""The shipped examples must load through the real spec model, validate,
+and materialize — a stale example is worse than none."""
+
+import glob
+import os
+
+import yaml
+
+from nexus_tpu.api.template import NexusAlgorithmTemplate
+from nexus_tpu.api.workgroup import NexusAlgorithmWorkgroup
+from nexus_tpu.runtime.materializer import (
+    materialize_headless_service,
+    materialize_job,
+)
+
+EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"
+)
+
+
+def _load_docs():
+    for path in sorted(glob.glob(os.path.join(EXAMPLES, "*.yaml"))):
+        with open(path) as f:
+            for doc in yaml.safe_load_all(f):
+                if doc:
+                    yield path, doc
+
+
+def test_examples_load_validate_and_materialize():
+    templates = 0
+    for path, doc in _load_docs():
+        kind = doc.get("kind")
+        if kind == NexusAlgorithmWorkgroup.KIND:
+            wg = NexusAlgorithmWorkgroup.from_dict(doc)
+            assert wg.spec.cluster, path
+            continue
+        assert kind == NexusAlgorithmTemplate.KIND, (path, kind)
+        tmpl = NexusAlgorithmTemplate.from_dict(doc)
+        templates += 1
+        rt = tmpl.spec.runtime
+        assert rt is not None, path
+        errs = rt.validate()
+        assert not errs, (path, errs)
+        jobs = materialize_job(tmpl, shard_name="example")
+        assert len(jobs) == rt.tpu.slice_count, path
+        for job in jobs:
+            res = job["spec"]["template"]["spec"]["containers"][0]["resources"]
+            assert res["limits"]["google.com/tpu"] == str(rt.tpu.chips_per_host)
+        svcs = materialize_headless_service(tmpl)
+        assert len(svcs) == rt.tpu.slice_count, path
+    assert templates == 3

@@ -222,3 +222,21 @@ def test_generate_rejects_overlong_request():
     prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
     with pytest.raises(ValueError, match="cache slots"):
         L.generate(params, cfg, prompt, max_new_tokens=cfg.max_seq_len)
+
+
+def test_mixtral_loss_ce_chunk_parity():
+    import jax
+    import jax.numpy as jnp
+
+    from nexus_tpu.models import mixtral
+
+    cfg_dense = mixtral.config("tiny", dtype=jnp.float32)
+    cfg_chunk = mixtral.config("tiny", dtype=jnp.float32, ce_chunk=96)
+    params = mixtral.init(jax.random.PRNGKey(0), cfg_dense)
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 17), 0, cfg_dense.vocab_size, dtype=jnp.int32
+    )
+    l_dense, m_dense = mixtral.loss_fn(params, cfg_dense, {"tokens": toks})
+    l_chunk, m_chunk = mixtral.loss_fn(params, cfg_chunk, {"tokens": toks})
+    assert abs(float(l_dense) - float(l_chunk)) < 1e-4
+    assert abs(float(m_dense["ce"]) - float(m_chunk["ce"])) < 1e-4

@@ -209,3 +209,51 @@ def test_llama_ring_attention_training_path():
     metrics = run_template_runtime(rt)
     assert metrics["steps"] == 3
     assert np.isfinite(metrics["final_loss"])
+
+
+def test_mixtral_ring_attention_forward_parity():
+    """Mixtral context parallelism: attn_impl='ring' on a sequence-sharded
+    mesh matches the dense-attention forward (the shared
+    ring_attention_sharded entry, previously llama-only)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from nexus_tpu.models import mixtral
+    from nexus_tpu.parallel.mesh import MeshPlan, build_mesh
+
+    mesh = build_mesh(MeshPlan(sequence=8))
+    cfg_x = mixtral.config("tiny", dtype=jnp.float32, attn_impl="xla",
+                           n_heads=4, n_kv_heads=2)
+    cfg_r = mixtral.config("tiny", dtype=jnp.float32, attn_impl="ring",
+                           n_heads=4, n_kv_heads=2)
+    params = mixtral.init(jax.random.PRNGKey(0), cfg_x)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
+                                cfg_x.vocab_size)
+    logits_x, aux_x = mixtral.forward(params, cfg_x, tokens)
+    with mesh:
+        logits_r, aux_r = jax.jit(lambda p, t: mixtral.forward(p, cfg_r, t))(
+            params, tokens
+        )
+    np.testing.assert_allclose(np.array(logits_r), np.array(logits_x),
+                               rtol=2e-3, atol=2e-3)
+    assert abs(float(aux_x) - float(aux_r)) < 1e-3
+
+
+def test_unknown_attn_impl_rejected():
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from nexus_tpu.ops.attention import attention
+
+    q = jnp.zeros((1, 8, 2, 16))
+    with _pytest.raises(ValueError, match="unknown attention impl"):
+        attention(q, q, q, impl="ring")
+
+
+def test_unknown_remat_policy_rejected():
+    import pytest as _pytest
+
+    from nexus_tpu.ops.remat import checkpoint_block
+
+    with _pytest.raises(ValueError, match="unknown remat_policy"):
+        checkpoint_block(lambda x: x, "Dots")
