@@ -109,9 +109,11 @@ def _run_candidate(preset, steps, batch, seq, attn, remat, progress,
     except Exception as e:  # noqa: BLE001 — OOM / compile failure: skip
         progress(f"candidate {label} failed: {type(e).__name__}: {str(e)[:200]}")
         return None
+    import math
+
     mfu = float(metrics.get("mfu") or 0.0)
     loss = metrics.get("final_loss")
-    if loss is None or not (loss == loss):  # NaN guard
+    if loss is None or not math.isfinite(loss):  # NaN/inf guard
         progress(f"candidate {label} produced invalid loss {loss}; rejected")
         return None
     progress(f"candidate {label}: MFU={mfu:.4f} "

@@ -85,7 +85,9 @@ def ring_attention(
     # enclosing shard_map may be manual over more axes than the ring axis
     # (e.g. data/fsdp/tensor when nested inside a jitted train step).
     pcast = getattr(lax, "pcast", None)
-    pvary = getattr(lax, "pvary", None)
+    # only reach for the deprecated pvary when pcast is absent (merely
+    # touching lax.pvary emits a DeprecationWarning on jax >= 0.9)
+    pvary = None if pcast is not None else getattr(lax, "pvary", None)
     try:
         vma = tuple(sorted(jax.typeof(q).vma))
     except Exception:
@@ -130,10 +132,16 @@ def ring_attention_sharded(q, k, v):
     axis, batch the data axes — matching the families' activation layout."""
     from functools import partial as _partial
 
-    from jax.interpreters.pxla import thread_resources
     from jax.sharding import PartitionSpec as P
 
     from nexus_tpu.ops.attention import attention
+
+    try:
+        # modern home of the ambient-mesh thread state (the public
+        # jax.interpreters.pxla re-export is deprecated since 0.8.2)
+        from jax._src.mesh import thread_resources
+    except ImportError:  # pragma: no cover — older jax
+        from jax.interpreters.pxla import thread_resources
 
     mesh = thread_resources.env.physical_mesh
     if mesh.empty or mesh.shape.get("sequence", 1) == 1:

@@ -152,6 +152,31 @@ def test_run_template_runtime_pipeline_parallel_matches_plain():
     )
 
 
+def test_run_template_runtime_bench_candidate_path():
+    """The exact config shape bench.py's top sweep candidates run (remat
+    dots + vocab-chunked CE) must train end-to-end — insurance that the
+    driver's on-TPU bench can't hit an untested combination."""
+    metrics = run_template_runtime(
+        runtime_block(
+            model=ModelRef(
+                family="llama", preset="tiny",
+                overrides={
+                    "dtype": "float32",
+                    "remat": True,
+                    "remat_policy": "dots",
+                    "ce_chunk": 96,
+                    "attn_impl": "xla",
+                },
+            ),
+            train=TrainSpec(batch_size=8, seq_len=32, steps=3),
+        )
+    )
+    import math
+
+    assert math.isfinite(metrics["final_loss"])
+    assert metrics["tokens_per_sec"] > 0
+
+
 def test_run_template_runtime_pipeline_rejects_unsupported():
     with pytest.raises(ValueError, match="llama family only"):
         run_template_runtime(
