@@ -28,6 +28,29 @@ except ImportError:  # pragma: no cover
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
+def _tile_needed(i, j, *, block_q: int, block_k: int, q_offset: int,
+                 causal: bool):
+    """Does k-tile ``j`` intersect the causal triangle of q-tile ``i``?
+
+    Shared by the fwd / bwd-dq / bwd-dkv kernels (the dkv kernel calls it
+    with the same (i, j) semantics — i is always the q tile). A tile is
+    needed iff its smallest k position is visible to the q tile's largest
+    row: ``j*block_k <= i*block_q + block_q - 1 + q_offset``."""
+    if not causal:
+        return True
+    return j * block_k <= i * block_q + (block_q - 1) + q_offset
+
+
+def _last_needed_k_tile(i, *, block_q: int, block_k: int, q_offset: int):
+    """Largest k-tile index the causal triangle of q-tile ``i`` touches."""
+    return (i * block_q + (block_q - 1) + q_offset) // block_k
+
+
+def _first_needed_q_tile(j, *, block_q: int, block_k: int, q_offset: int):
+    """Smallest q-tile index whose causal triangle touches k-tile ``j``."""
+    return jnp.maximum(j * block_k - q_offset, 0) // block_q
+
+
 def _repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
     """(B, S, Hkv, D) → (B, S, Hkv*n_rep, D) broadcasting each kv head."""
     if n_rep == 1:
@@ -83,30 +106,45 @@ def _flash_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0]  # (block_q, D)
-    k = k_ref[0]  # (block_k, D)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # (block_q, block_k)
-
-    if causal:
-        rows = lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * block_q + q_offset
-        cols = lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * block_k
-        s = jnp.where(cols <= rows, s, DEFAULT_MASK_VALUE)
-
-    m_prev = m_ref[:, :1]  # (block_q, 1)
-    l_prev = l_ref[:, :1]
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)
-    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+    # causal tile skipping: a k tile entirely above the diagonal contributes
+    # exp(-inf)=0 to every row of this q tile — skip its matmuls (~2x FLOPs
+    # at long seq; the K/V fetches for skipped tiles are elided by the
+    # clamped index maps in _flash_impl, which repeat the last needed block
+    # index so Pallas sees a no-op DMA). Exact: accumulators are untouched.
+    needed = _tile_needed(
+        i, j, block_q=block_q, block_k=block_k, q_offset=q_offset,
+        causal=causal,
     )
-    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]  # (block_q, D)
+        k = k_ref[0]  # (block_k, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (block_q, block_k)
+
+        if causal:
+            rows = (
+                lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                + i * block_q + q_offset
+            )
+            cols = lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * block_k
+            s = jnp.where(cols <= rows, s, DEFAULT_MASK_VALUE)
+
+        m_prev = m_ref[:, :1]  # (block_q, 1)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
     @pl.when(j == nk - 1)
     def _finish():
@@ -191,14 +229,34 @@ def _flash_impl(q, k, v, opts):
         block_k=block_k,
         q_offset=q_offset,
     )
+
+    # clamp skipped k tiles onto the last needed one: Pallas elides the DMA
+    # when the requested block index repeats, so above-diagonal tiles cost
+    # neither FLOPs (pl.when in the kernel) nor HBM fetches
+    if causal:
+        def kv_index(bh, i, j):
+            return (
+                bh,
+                jnp.minimum(
+                    j,
+                    _last_needed_k_tile(
+                        i, block_q=block_q, block_k=block_k, q_offset=q_offset
+                    ),
+                ),
+                0,
+            )
+    else:
+        def kv_index(bh, i, j):
+            return (bh, j, 0)
+
     grid = (b * hq, sq // block_q, sk // block_k)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
@@ -257,20 +315,27 @@ def _flash_bwd_dq_kernel(
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-    lse, delta = lse_ref[0, :], delta_ref[0, :]
-    p = _flash_bwd_p(
-        q, k, lse, scale=scale, causal=causal, i=i, j=j,
-        block_q=block_q, block_k=block_k, q_offset=q_offset,
+    needed = _tile_needed(
+        i, j, block_q=block_q, block_k=block_k, q_offset=q_offset,
+        causal=causal,
     )
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (bq, bk)
-    ds = p * (dp - delta[:, None])  # (bq, bk) f32
-    acc_ref[:] += scale * jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+
+    @pl.when(needed)
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse, delta = lse_ref[0, :], delta_ref[0, :]
+        p = _flash_bwd_p(
+            q, k, lse, scale=scale, causal=causal, i=i, j=j,
+            block_q=block_q, block_k=block_k, q_offset=q_offset,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk)
+        ds = p * (dp - delta[:, None])  # (bq, bk) f32
+        acc_ref[:] += scale * jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
     @pl.when(j == nk - 1)
     def _finish():
@@ -291,24 +356,32 @@ def _flash_bwd_dkv_kernel(
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
-    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-    lse, delta = lse_ref[0, :], delta_ref[0, :]
-    p = _flash_bwd_p(
-        q, k, lse, scale=scale, causal=causal, i=i, j=j,
-        block_q=block_q, block_k=block_k, q_offset=q_offset,
+    # a q tile entirely above the diagonal sees P == 0 for this k tile
+    needed = _tile_needed(
+        i, j, block_q=block_q, block_k=block_k, q_offset=q_offset,
+        causal=causal,
     )
-    dv_acc_ref[:] += jax.lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # Pᵀ dO: (bk, d)
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    ds = p * (dp - delta[:, None])
-    dk_acc_ref[:] += scale * jax.lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # dSᵀ Q: (bk, d)
+
+    @pl.when(needed)
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse, delta = lse_ref[0, :], delta_ref[0, :]
+        p = _flash_bwd_p(
+            q, k, lse, scale=scale, causal=causal, i=i, j=j,
+            block_q=block_q, block_k=block_k, q_offset=q_offset,
+        )
+        dv_acc_ref[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # Pᵀ dO: (bk, d)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None])
+        dk_acc_ref[:] += scale * jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # dSᵀ Q: (bk, d)
 
     @pl.when(i == nq - 1)
     def _finish():
@@ -340,8 +413,41 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts):
         scale=d ** -0.5, causal=causal,
         block_q=block_q, block_k=block_k, q_offset=q_offset,
     )
+
+    # clamped index maps mirror the forward kernel: skipped tiles repeat the
+    # last (dq; k side) / first (dkv; q side) needed block index so their
+    # DMAs are elided alongside the pl.when-skipped compute
+    if causal:
+        def kj(i, j):
+            return jnp.minimum(
+                j,
+                _last_needed_k_tile(
+                    i, block_q=block_q, block_k=block_k, q_offset=q_offset
+                ),
+            )
+
+        def qi(j, i):
+            # upper clamp: a k tile past every q row (sk > sq + offset)
+            # would otherwise request an out-of-range q block — its compute
+            # is skipped anyway, any valid block satisfies the fetch
+            return jnp.minimum(
+                jnp.maximum(
+                    i,
+                    _first_needed_q_tile(
+                        j, block_q=block_q, block_k=block_k, q_offset=q_offset
+                    ),
+                ),
+                sq // block_q - 1,
+            )
+    else:
+        def kj(i, j):
+            return j
+
+        def qi(j, i):
+            return i
+
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
-    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, kj(i, j), 0))
     row_spec = pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i))
 
     dq = pl.pallas_call(
@@ -356,9 +462,9 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts):
 
     # dk/dv: swap the roles — grid's parallel dim walks k blocks, inner
     # sequential dim walks q blocks (index maps receive (bh, j, i))
-    qT_spec = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
+    qT_spec = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, qi(j, i), 0))
     kT_spec = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
-    rowT_spec = pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i))
+    rowT_spec = pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, qi(j, i)))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **common),
         grid=(bh, sk // block_k, sq // block_q),

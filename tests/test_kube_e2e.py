@@ -261,5 +261,47 @@ def test_workload_jobs_applied_to_kube_shard(clusters):
             ).status.workload_phase
             == "Running"
         ), "workload phase never propagated back through the kube stores"
+
+        # the north-star latency gauge fired exactly once over the real stack
+        assert wait_for(
+            lambda: any(
+                "template_to_running_p50" in name
+                for name, _v, _t in controller.statsd.history
+            )
+        ), "template_to_running gauges never emitted"
+    finally:
+        controller.stop()
+
+
+def test_shard_drift_repair_over_kube_stores(clusters):
+    """Out-of-band tampering with the shard-side template spec is repaired
+    by the level-triggered resync — through the real HTTP client stack."""
+    _, shard_srv, ctrl_store, shard_store = clusters
+    shard = Shard("kube-e2e", "shard0", shard_store)
+    controller = Controller(
+        ctrl_store, [shard], statsd=StatsdClient("test"), resync_period=0.5
+    )
+    ctrl_store.create(make_template("algo-drift"))
+    controller.run(workers=2)
+    try:
+        assert wait_for(
+            lambda: shard_store.get(
+                NexusAlgorithmTemplate.KIND, NS, "algo-drift"
+            )
+            is not None
+        )
+        # tamper directly in the shard API server's backing store
+        tampered = shard_srv.store.get(
+            NexusAlgorithmTemplate.KIND, NS, "algo-drift"
+        )
+        tampered.spec.container.version_tag = "tampered"
+        shard_srv.store.update(tampered)
+        assert wait_for(
+            lambda: shard_store.get(
+                NexusAlgorithmTemplate.KIND, NS, "algo-drift"
+            ).spec.container.version_tag
+            != "tampered",
+            timeout=30,
+        ), "tampered shard spec never repaired"
     finally:
         controller.stop()
