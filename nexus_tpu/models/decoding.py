@@ -38,21 +38,25 @@ def _decode_attention(
     """Length-masked attention of t new queries over the full cache buffer.
 
     Static shapes (the mask, not a slice, hides unwritten cache tail) — one
-    compiled program regardless of decode position."""
-    hd = q.shape[-1]
+    compiled program regardless of decode position. GQA runs as grouped
+    einsums against the raw (B, L, Hkv, D) cache: no ``jnp.repeat``
+    materialization, so per-step HBM traffic is the cache itself, not
+    n_rep copies of it (the decode-throughput driver for config #3)."""
+    b, t, hq, hd = q.shape  # t always equals the caller's token count
     max_len = k_buf.shape[1]
-    n_rep = q.shape[2] // k_buf.shape[2]
-    kr = jnp.repeat(k_buf, n_rep, axis=2)
-    vr = jnp.repeat(v_buf, n_rep, axis=2)
+    hkv = k_buf.shape[2]
+    n_rep = hq // hkv
+    qg = q.reshape(b, t, hkv, n_rep, hd)
     logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, kr, preferred_element_type=jnp.float32
-    ) * hd ** -0.5
+        "btgrd,bkgd->bgrtk", qg, k_buf, preferred_element_type=jnp.float32
+    ) * hd ** -0.5  # (B, Hkv, rep, T, L)
     q_pos = start + jnp.arange(t)
     visible = jnp.arange(max_len)[None, :] <= q_pos[:, None]  # (t, max_len)
     mask_value = -0.7 * float(jnp.finfo(jnp.float32).max)
-    logits = jnp.where(visible[None, None], logits, mask_value)
+    logits = jnp.where(visible[None, None, None], logits, mask_value)
     probs = jax.nn.softmax(logits, axis=-1).astype(v_buf.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, vr)
+    out = jnp.einsum("bgrtk,bkgd->btgrd", probs, v_buf)
+    return out.reshape(b, t, hq, hd)
 
 
 def scanned_forward_decode(

@@ -42,8 +42,12 @@ def _tile_needed(i, j, *, block_q: int, block_k: int, q_offset: int,
 
 
 def _last_needed_k_tile(i, *, block_q: int, block_k: int, q_offset: int):
-    """Largest k-tile index the causal triangle of q-tile ``i`` touches."""
-    return (i * block_q + (block_q - 1) + q_offset) // block_k
+    """Largest k-tile index the causal triangle of q-tile ``i`` touches.
+    Clamped at 0: a negative q_offset can push the triangle entirely before
+    k-tile 0 (fully-masked rows) — the fetch must still be in range."""
+    return jnp.maximum(
+        (i * block_q + (block_q - 1) + q_offset) // block_k, 0
+    )
 
 
 def _first_needed_q_tile(j, *, block_q: int, block_k: int, q_offset: int):
