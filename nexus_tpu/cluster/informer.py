@@ -46,6 +46,26 @@ class Lister:
         with self._lock:
             self._items[obj.key()] = obj
 
+    def _set_if_newer(self, obj: APIObject) -> None:
+        """Monotonic cache write: keep the cached object when it has a
+        strictly newer resourceVersion. Out-of-band cache-hot writes (a
+        worker caching the result of its own update) race with the watch
+        thread — an unconditional set lets a worker's already-superseded
+        result clobber a fresher object the watch just delivered, and since
+        the watch never re-sends it, the cache would stay stale forever
+        (a livelock observed under concurrent churn)."""
+        with self._lock:
+            prev = self._items.get(obj.key())
+            if prev is not None:
+                try:
+                    if int(prev.metadata.resource_version) >= int(
+                        obj.metadata.resource_version
+                    ):
+                        return
+                except (TypeError, ValueError):
+                    pass  # opaque RVs: fall through to last-writer-wins
+            self._items[obj.key()] = obj
+
     def _delete(self, obj: APIObject) -> None:
         with self._lock:
             self._items.pop(obj.key(), None)
@@ -118,7 +138,7 @@ class Informer:
     def _on_event(self, event: WatchEvent) -> None:
         obj = event.obj
         if event.type == "ADDED":
-            self.lister._set(obj)
+            self.lister._set_if_newer(obj)
             self._dispatch_add(obj)
         elif event.type == "MODIFIED":
             old = None
@@ -126,7 +146,7 @@ class Informer:
                 old = self.lister.get(obj.metadata.namespace, obj.metadata.name)
             except NotFoundError:
                 pass
-            self.lister._set(obj)
+            self.lister._set_if_newer(obj)
             self._dispatch_update(old if old is not None else obj, obj)
         elif event.type == "DELETED":
             self.lister._delete(obj)

@@ -182,7 +182,19 @@ class KubeClusterStore:
         with self._lock:
             cbs = list(self._watchers.get(kind, []))
         for cb in cbs:
-            cb(ev)
+            # Per-callback isolation: the watch-loop mirror is updated before
+            # dispatch, so an exception escaping here would tear down the
+            # stream AND suppress the re-list diff for this event — the event
+            # would be lost forever. client-go likewise never lets a handler
+            # kill the reflector.
+            try:
+                cb(ev)
+            except Exception:
+                logger.exception(
+                    "watch handler for %s on %s raised; event %s dropped by "
+                    "that handler only",
+                    kind, self.name, ev.type,
+                )
 
     def _reconcile_mirror(self, kind: str) -> str:
         """LIST and diff against the local mirror, emitting synthetic

@@ -20,11 +20,14 @@ Semantics mirrored from the Kubernetes API machinery the reference builds on:
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from nexus_tpu.api.types import APIObject, ObjectMeta, new_uid, utcnow
+
+logger = logging.getLogger("nexus_tpu.cluster")
 
 
 class NotFoundError(KeyError):
@@ -123,7 +126,14 @@ class ClusterStore:
                         k, ev = self._pending_events.pop(0)
                         cbs = list(self._watchers.get(k, []))
                     for cb in cbs:
-                        cb(ev)
+                        # isolate: a raising subscriber must not abort the
+                        # drain and strand later queued events
+                        try:
+                            cb(ev)
+                        except Exception:
+                            logger.exception(
+                                "watch subscriber for %s raised; continuing", k
+                            )
             finally:
                 self._draining.active = False
 

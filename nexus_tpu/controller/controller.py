@@ -534,7 +534,7 @@ class Controller:
                     raise
                 # keep the local cache hot so subsequent stages observe the
                 # adoption even before the watch event lands
-                lister._set(stored)
+                lister._set_if_newer(stored)
 
     # ------------------------------------------------------- dependent syncing
     def _sync_dependents_to_shard(
@@ -585,7 +585,7 @@ class Controller:
                         ),
                     )
                     raise
-                shard_lister._set(shard_obj)
+                shard_lister._set_if_newer(shard_obj)
 
             try:
                 missing_owner = self._is_missing_ownership(shard_obj, shard_template)
@@ -603,11 +603,11 @@ class Controller:
             if not deep_equal(source.data, shard_obj.data):
                 logger.debug("content changed for %s %s, updating", kind, name)
                 shard_obj = update(shard_obj, source.data, None, FIELD_MANAGER)
-                shard_lister._set(shard_obj)
+                shard_lister._set_if_newer(shard_obj)
             if missing_owner:
                 logger.debug("ownership missing for %s %s, updating", kind, name)
                 shard_obj = update(shard_obj, None, shard_template, FIELD_MANAGER)
-                shard_lister._set(shard_obj)
+                shard_lister._set_if_newer(shard_obj)
 
     # ------------------------------------------------------------ sync handlers
     def _resolve_placement(self, template: NexusAlgorithmTemplate) -> List[Shard]:
@@ -658,7 +658,7 @@ class Controller:
                 updated = template.deepcopy()
                 updated.metadata.finalizers.append(FINALIZER)
                 template = self.store.update(updated, field_manager=FIELD_MANAGER)  # type: ignore[assignment]
-                self.template_lister._set(template)
+                self.template_lister._set_if_newer(template)
 
         template = self._report_template_init_condition(template)
         self._adopt_references(template)
@@ -694,7 +694,7 @@ class Controller:
                 shard_template = shard.update_template(
                     shard_template, template.spec, FIELD_MANAGER
                 )
-                shard.template_lister._set(shard_template)
+                shard.template_lister._set_if_newer(shard_template)
             elif shard_template is None:
                 logger.debug(
                     "template %s not found in shard %s, creating", name, shard.name
@@ -702,7 +702,7 @@ class Controller:
                 shard_template = shard.create_template(
                     template.name, template.namespace, template.spec, FIELD_MANAGER
                 )
-                shard.template_lister._set(shard_template)
+                shard.template_lister._set_if_newer(shard_template)
 
             self._sync_dependents_to_shard(
                 Secret.KIND,
@@ -1027,12 +1027,12 @@ class Controller:
                 shard_wg = shard.update_workgroup(
                     shard_wg, workgroup.spec, FIELD_MANAGER
                 )
-                shard.workgroup_lister._set(shard_wg)
+                shard.workgroup_lister._set_if_newer(shard_wg)
             elif shard_wg is None:
                 shard_wg = shard.create_workgroup(
                     workgroup.name, workgroup.namespace, workgroup.spec, FIELD_MANAGER
                 )
-                shard.workgroup_lister._set(shard_wg)
+                shard.workgroup_lister._set_if_newer(shard_wg)
 
         workgroup = self._report_workgroup_synced_condition(workgroup)
         self.recorder.event(

@@ -33,16 +33,16 @@ def init_kv_cache(
 
 def _decode_attention(
     q: jnp.ndarray, k_buf: jnp.ndarray, v_buf: jnp.ndarray,
-    start: jnp.ndarray, t: int,
+    start: jnp.ndarray,
 ) -> jnp.ndarray:
-    """Length-masked attention of t new queries over the full cache buffer.
+    """Length-masked attention of q's tokens over the full cache buffer.
 
     Static shapes (the mask, not a slice, hides unwritten cache tail) — one
     compiled program regardless of decode position. GQA runs as grouped
     einsums against the raw (B, L, Hkv, D) cache: no ``jnp.repeat``
     materialization, so per-step HBM traffic is the cache itself, not
     n_rep copies of it (the decode-throughput driver for config #3)."""
-    b, t, hq, hd = q.shape  # t always equals the caller's token count
+    b, t, hq, hd = q.shape
     max_len = k_buf.shape[1]
     hkv = k_buf.shape[2]
     n_rep = hq // hkv
@@ -91,7 +91,7 @@ def scanned_forward_decode(
         v = (h @ layer["wv"]).reshape(b, t, hkv, hd)
         k_buf = lax.dynamic_update_slice_in_dim(k_cache, k, start, axis=1)
         v_buf = lax.dynamic_update_slice_in_dim(v_cache, v, start, axis=1)
-        attn = _decode_attention(q, k_buf, v_buf, start, t)
+        attn = _decode_attention(q, k_buf, v_buf, start)
         x = x + attn.reshape(b, t, hq * hd) @ layer["wo"]
         h2 = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
         return x + ffn(cfg, h2, layer), (k_buf, v_buf)

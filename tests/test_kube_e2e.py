@@ -311,6 +311,10 @@ def test_concurrent_churn_converges_over_kube_stores(clusters):
                         if e.status != 409:
                             raise
                         time.sleep(0.01)
+                else:
+                    raise AssertionError(
+                        f"writer {name} starved: 40 conflicts at rev {rev}"
+                    )
         except Exception as e:  # noqa: BLE001 — surfaced to the main thread
             errors.append((idx, e))
 
@@ -333,6 +337,10 @@ def test_concurrent_churn_converges_over_kube_stores(clusters):
                     if e.status != 409:
                         raise
                     time.sleep(0.01)
+            else:
+                raise AssertionError(
+                    f"secret writer starved: 40 conflicts at rev {rev}"
+                )
         for t in writers:
             t.join(timeout=60)
         assert not errors, errors
