@@ -242,10 +242,14 @@ def main() -> int:
             ]
             if not pinned_batch:
                 # a pinned batch means "this batch size, period"; only an
-                # unpinned sweep explores the larger-batch point
-                candidates.insert(2, (attn, "none", 2 * b, ce))
+                # unpinned sweep explores the other batch points. bs4 +
+                # no-remat: activation residency halves vs bs8, which is the
+                # config the HBM estimate says fits when bs8 compile-OOMs
+                # (docs/PERF.md)
+                candidates.insert(1, (attn, "none", max(b // 2, 1), ce))
+                candidates.insert(3, (attn, "none", 2 * b, ce))
         # cap sweep size: compile time on the tunnel dominates
-        candidates = candidates[:4]
+        candidates = candidates[:5]
 
     best = None
     for attn, remat, batch, ce_chunk in candidates:

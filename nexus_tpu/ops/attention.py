@@ -27,6 +27,25 @@ except ImportError:  # pragma: no cover
 
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
+# Per-row statistics (logsumexp, delta) are stored broadcast across a small
+# minor dimension: the TPU lowering requires every block's last two dims to
+# be (8k, 128m) *or equal to the array's*, so a 2-D (1, block_q) row-vector
+# block is not tileable, but a 3-D block whose minor dim spans the whole
+# (bh, sq, LANES) array is. 8 lanes (not the 128 the reference JAX TPU
+# kernel uses) keeps the HBM padding tax 16x smaller — the buffers carry
+# one value per row either way.
+LANES = 8
+
+
+def _kv_row(bh, hq: int, hkv: int, n_rep: int):
+    """Grid row (over B*Hq) → K/V row (over B*Hkv) for GQA head sharing.
+
+    THE load-bearing invariant of the no-repeat GQA layout: must match
+    ``_repeat_kv``'s contiguous-group convention (query heads g*n_rep..
+    (g+1)*n_rep-1 read kv head g) and is shared by the fwd and both bwd
+    kernels' index maps."""
+    return (bh // hq) * hkv + (bh % hq) // n_rep
+
 
 def _tile_needed(i, j, *, block_q: int, block_k: int, q_offset: int,
                  causal: bool):
@@ -156,8 +175,33 @@ def _flash_kernel(
         l = l_ref[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        # logsumexp per q row, saved for the backward recompute of P
-        lse_ref[0, :] = (m_ref[:, 0] + jnp.log(safe_l[:, 0]))
+        # logsumexp per q row, saved for the backward recompute of P;
+        # m/l scratches already carry the value on every lane
+        lse_ref[0] = m_ref[:] + jnp.log(jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:]))
+
+
+def _fit_block(seq: int, want: int) -> int:
+    """Pick the block size: ``seq`` itself when it fits under ``want``
+    (a single block spanning the array is always tileable), else the
+    largest power of two ≤ ``want`` that divides ``seq``, else 0 (no
+    usable block — caller raises).
+
+    Measured on v5e at (B8, H16, S2048, D64): 1024×1024 blocks run the fwd
+    kernel at 31.8 causal-TF/s vs 5.4 at 128×128 — per-instance MXU work
+    amortizes the grid/DMA overhead, and VMEM stays comfortable (the f32
+    probability tile is bq×bk×4 = 4 MB at 1024²)."""
+    if seq <= want:
+        return seq
+    b = 1
+    while b * 2 <= want:
+        b *= 2
+    while b >= 8 and seq % b:
+        b //= 2
+    # blocks far below the requested size mean an awkward sequence (e.g.
+    # 1032 = 8·129, whose best power-of-two divisor is 8): per the table in
+    # docs/PERF.md tiny blocks are an order-of-magnitude perf cliff, so
+    # refuse rather than silently crawl
+    return b if b >= 8 and b >= want // 8 and seq % b == 0 else 0
 
 
 def flash_attention(
@@ -166,8 +210,8 @@ def flash_attention(
     v: jnp.ndarray,
     causal: bool = True,
     q_offset: int = 0,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Pallas flash attention. Same signature/semantics as attention_xla.
@@ -210,20 +254,31 @@ def _fold_heads(x):
 def _flash_impl(q, k, v, opts):
     causal, q_offset, block_q, block_k, interpret = opts
     b, sq, hq, d = q.shape
-    n_rep = hq // k.shape[2]
-    k = _repeat_kv(k, n_rep)
-    v = _repeat_kv(v, n_rep)
+    hkv = k.shape[2]
+    n_rep = hq // hkv
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
+    block_q = _fit_block(sq, block_q)
+    block_k = _fit_block(sk, block_k)
+    # TPU tiling: a block's second-to-minor dim must be 8-divisible or span
+    # the whole array dim (the minor dim of the q/k/v tiles is d, full-span)
+    if (
+        not block_q
+        or not block_k
+        or (block_q % 8 and block_q != sq)
+        or (block_k % 8 and block_k != sk)
+    ):
         raise ValueError(
-            f"flash_attention requires seq divisible by blocks: "
-            f"{sq}%{block_q}, {sk}%{block_k}"
+            f"flash_attention requires tileable sequences (pad the sequence "
+            f"or pass explicit blocks): sq={sq} (block_q={block_q}), "
+            f"sk={sk} (block_k={block_k})"
         )
 
-    # fold heads into the grid's batch dim: (B*H, S, D)
+    # fold heads into the grid's batch dim: q (B*Hq, S, D); K/V stay at
+    # their native (B*Hkv, S, D) — GQA is handled by the index map (each
+    # query-head grid row reads its group's kv row), not by materializing
+    # n_rep copies of K/V in HBM
     qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
+    kv_row = functools.partial(_kv_row, hq=hq, hkv=hkv, n_rep=n_rep)
 
     kernel = functools.partial(
         _flash_kernel,
@@ -240,7 +295,7 @@ def _flash_impl(q, k, v, opts):
     if causal:
         def kv_index(bh, i, j):
             return (
-                bh,
+                kv_row(bh),
                 jnp.minimum(
                     j,
                     _last_needed_k_tile(
@@ -251,7 +306,7 @@ def _flash_impl(q, k, v, opts):
             )
     else:
         def kv_index(bh, i, j):
-            return (bh, j, 0)
+            return (kv_row(bh), j, 0)
 
     grid = (b * hq, sq // block_q, sk // block_k)
     out, lse = pl.pallas_call(
@@ -264,16 +319,16 @@ def _flash_impl(q, k, v, opts):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, i, j: (bh, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * hq, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * hq, sq, LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
@@ -295,11 +350,12 @@ def _flash_impl(q, k, v, opts):
 
 
 def _flash_bwd_p(q, k, lse, *, scale, causal, i, j, block_q, block_k, q_offset):
-    """Recompute the (block_q, block_k) probability tile."""
+    """Recompute the (block_q, block_k) probability tile. ``lse``:
+    (block_q, 1) column vector (lane 0 of the lane-broadcast buffer)."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
-    p = jnp.exp(s - lse[:, None])
+    p = jnp.exp(s - lse)
     if causal:
         rows = lax.broadcasted_iota(jnp.int32, p.shape, 0) + i * block_q + q_offset
         cols = lax.broadcasted_iota(jnp.int32, p.shape, 1) + j * block_k
@@ -327,7 +383,7 @@ def _flash_bwd_dq_kernel(
     @pl.when(needed)
     def _compute():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        lse, delta = lse_ref[0, :], delta_ref[0, :]
+        lse, delta = lse_ref[0][:, :1], delta_ref[0][:, :1]
         p = _flash_bwd_p(
             q, k, lse, scale=scale, causal=causal, i=i, j=j,
             block_q=block_q, block_k=block_k, q_offset=q_offset,
@@ -335,7 +391,7 @@ def _flash_bwd_dq_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (bq, bk)
-        ds = p * (dp - delta[:, None])  # (bq, bk) f32
+        ds = p * (dp - delta)  # (bq, bk) f32
         acc_ref[:] += scale * jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -369,7 +425,7 @@ def _flash_bwd_dkv_kernel(
     @pl.when(needed)
     def _compute():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        lse, delta = lse_ref[0, :], delta_ref[0, :]
+        lse, delta = lse_ref[0][:, :1], delta_ref[0][:, :1]
         p = _flash_bwd_p(
             q, k, lse, scale=scale, causal=causal, i=i, j=j,
             block_q=block_q, block_k=block_k, q_offset=q_offset,
@@ -381,7 +437,7 @@ def _flash_bwd_dkv_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         dk_acc_ref[:] += scale * jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -398,20 +454,27 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts):
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
     n_rep = hq // hkv
-    kr = _repeat_kv(k, n_rep)
-    vr = _repeat_kv(v, n_rep)
-    sk = kr.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    sk = k.shape[1]
+    block_q = _fit_block(sq, block_q)
+    block_k = _fit_block(sk, block_k)
 
-    qf, kf, vf = _fold_heads(q), _fold_heads(kr), _fold_heads(vr)
+    # K/V stay un-repeated (B*Hkv, S, D), shared across each query-head
+    # group via the index maps — mirrors the forward. dK/dV are still
+    # produced per *query* head (each grid row accumulates independently)
+    # and group-summed back onto kv heads at the end.
+    qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
     dof, of = _fold_heads(g), _fold_heads(out)
     bh = b * hq
+    kv_row = functools.partial(_kv_row, hq=hq, hkv=hkv, n_rep=n_rep)
 
-    # D = rowsum(dO ⊙ O) — cheap elementwise+reduce; plain XLA
-    delta = jnp.sum(
-        dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1
-    )  # (BH, Sq)
+    # D = rowsum(dO ⊙ O) — cheap elementwise+reduce; plain XLA. Broadcast
+    # across the lane dim to match the LSE buffer layout (see LANES).
+    delta = jnp.broadcast_to(
+        jnp.sum(
+            dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1
+        )[..., None],
+        (bh, sq, LANES),
+    )
 
     common = dict(
         scale=d ** -0.5, causal=causal,
@@ -451,8 +514,10 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts):
             return i
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
-    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, kj(i, j), 0))
-    row_spec = pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i))
+    k_spec = pl.BlockSpec(
+        (1, block_k, d), lambda bh, i, j: (kv_row(bh), kj(i, j), 0)
+    )
+    row_spec = pl.BlockSpec((1, block_q, LANES), lambda bh, i, j: (bh, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, **common),
@@ -467,8 +532,12 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts):
     # dk/dv: swap the roles — grid's parallel dim walks k blocks, inner
     # sequential dim walks q blocks (index maps receive (bh, j, i))
     qT_spec = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, qi(j, i), 0))
-    kT_spec = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
-    rowT_spec = pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, qi(j, i)))
+    kT_spec = pl.BlockSpec(
+        (1, block_k, d), lambda bh, j, i: (kv_row(bh), j, 0)
+    )
+    rowT_spec = pl.BlockSpec(
+        (1, block_q, LANES), lambda bh, j, i: (bh, qi(j, i), 0)
+    )
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **common),
         grid=(bh, sk // block_k, sq // block_q),
