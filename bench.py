@@ -125,6 +125,43 @@ def _run_candidate(preset, steps, batch, seq, attn, remat, progress,
     return mfu, metrics
 
 
+_CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           ".bench_cache.json")
+
+
+def _load_cached_result(preset=None, seq=None):
+    """Last successful on-chip result (None if absent/invalid). When
+    ``preset``/``seq`` are given, a cached result from a different bench
+    configuration is rejected — a stale fallback must at least be the same
+    measurement."""
+    try:
+        with open(_CACHE_PATH) as f:
+            cached = json.load(f)
+        if not isinstance(cached, dict) or not cached.get("value"):
+            return None
+        if preset is not None and cached.get("preset") != preset:
+            return None
+        if seq is not None and cached.get("seq_len") != seq:
+            return None
+        return cached
+    except (OSError, ValueError):
+        return None
+
+
+def _store_cached_result(result: dict) -> None:
+    try:
+        import datetime
+
+        stamped = dict(result)
+        stamped["measured_at"] = datetime.datetime.now(
+            datetime.timezone.utc
+        ).isoformat(timespec="seconds")
+        with open(_CACHE_PATH, "w") as f:
+            json.dump(stamped, f)
+    except OSError:  # read-only checkout etc. — caching is best-effort
+        pass
+
+
 def main() -> int:
     import jax
 
@@ -145,6 +182,12 @@ def main() -> int:
     _done = [False]
     _best = [None]  # best (mfu, metrics) observed so far
     _seq = [None]  # benchmarked sequence length, once parsed
+    # intended config for cache-matching if the watchdog fires before the
+    # backend is up (the TPU-default values; overwritten once known)
+    _cfg = [{
+        "preset": os.environ.get("NEXUS_BENCH_PRESET") or "400m",
+        "seq": int(os.environ.get("NEXUS_BENCH_SEQ") or 2048),
+    }]
     _print_lock = threading.Lock()
     deadline_s = float(os.environ.get("NEXUS_BENCH_DEADLINE_S") or 1500)
 
@@ -185,14 +228,36 @@ def main() -> int:
                     "reporting best completed candidate"
                 )
             else:
-                result = {
-                    "metric": "llama_train_mfu",
-                    "value": 0.0,
-                    "unit": "mfu_fraction",
-                    "vs_baseline": 0.0,
-                    "error": f"deadline {deadline_s}s exceeded at stage: "
-                    f"{_stage[0]}",
-                }
+                err = (
+                    f"deadline {deadline_s}s exceeded at stage '{_stage[0]}'"
+                    " — no candidate completed this run"
+                )
+                cached = _load_cached_result(
+                    preset=_cfg[0].get("preset"), seq=_cfg[0].get("seq")
+                )
+                if cached is not None:
+                    # e.g. the tunnel wedged before any candidate ran (it
+                    # stays down 20+ min after a killed TPU process,
+                    # docs/PERF.md) — carry the last real on-chip
+                    # measurement of the SAME config, explicitly marked:
+                    # 'error' stays set so nothing mistakes this for a
+                    # fresh measurement
+                    result = dict(cached)
+                    result["stale"] = True
+                    result["error"] = err
+                    result["note"] = (
+                        "value is the last successful on-chip run of this "
+                        "config, measured_at "
+                        f"{result.get('measured_at', 'an earlier session')}"
+                    )
+                else:
+                    result = {
+                        "metric": "llama_train_mfu",
+                        "value": 0.0,
+                        "unit": "mfu_fraction",
+                        "vs_baseline": 0.0,
+                        "error": err,
+                    }
             _emit(result)
             print(f"[bench] WATCHDOG fired at stage: {_stage[0]}",
                   file=sys.stderr, flush=True)
@@ -211,6 +276,7 @@ def main() -> int:
     steps = int(os.environ.get("NEXUS_BENCH_STEPS") or (15 if on_tpu else 6))
     seq = int(os.environ.get("NEXUS_BENCH_SEQ") or (2048 if on_tpu else 64))
     _seq[0] = seq
+    _cfg[0] = {"preset": preset, "seq": seq}
     pinned_batch = os.environ.get("NEXUS_BENCH_BATCH")
     pinned_attn = os.environ.get("NEXUS_BENCH_ATTN")
     pinned_remat = os.environ.get("NEXUS_BENCH_REMAT")
@@ -280,6 +346,8 @@ def main() -> int:
         })
         return 1
     result = _result_from(best)
+    if on_tpu and result.get("value"):
+        _store_cached_result(result)
     _emit(result)
     return 0
 

@@ -281,7 +281,13 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel=None):
             cancel=cancel,
         )
         try:
-            result = trainer.run(max(steps - start_step, 1))
+            # 2 untimed warmup steps: the first execution is the compile, and
+            # the second still pays one-time program-load/cache effects on
+            # the remote-tunnel TPU path — with short bench runs (15 steps)
+            # either one inside the timed window visibly skews tokens/sec.
+            # Clamped so ultra-short runs still time at least one step.
+            n_run = max(steps - start_step, 1)
+            result = trainer.run(n_run, warmup_steps=min(2, n_run - 1))
         finally:
             if prefetcher is not None:
                 prefetcher.close()

@@ -10,6 +10,7 @@ the batch is sharded over (data, fsdp), and XLA SPMD inserts every collective
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
@@ -20,6 +21,12 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from nexus_tpu.parallel.sharding import logical_to_spec, sharding_tree
+
+
+def _on_tpu() -> bool:
+    from nexus_tpu.utils.hw import is_tpu
+
+    return is_tpu()
 
 
 @jax.tree_util.register_dataclass
@@ -186,6 +193,7 @@ class Trainer:
         profile_start: int = 2,
         profile_steps: int = 3,
         cancel=None,
+        run_ahead: Optional[int] = None,
     ):
         self.step_fn = step_fn
         self.state = state
@@ -197,6 +205,15 @@ class Trainer:
         self.profile_dir = profile_dir
         self.profile_start = profile_start
         self.profile_steps = profile_steps
+        # In-flight dispatch depth. 1 = block on step i-1 before dispatching
+        # i+1 — REQUIRED on the in-process CPU backend, where concurrent
+        # executions of a collective-bearing step deadlock XLA's
+        # communicator. On TPU the queue just runs ahead, and a deeper bound
+        # hides the host↔device round-trip (~71 ms through the axon tunnel,
+        # docs/PERF.md) behind device work instead of paying it every step.
+        if run_ahead is None:
+            run_ahead = 4 if _on_tpu() else 1
+        self.run_ahead = max(1, int(run_ahead))
         # CancelToken (utils/signals.py): set on SIGTERM — the slice
         # preemption path. The loop stops at the next step boundary and
         # saves a final checkpoint so the requeued job resumes, not restarts.
@@ -216,6 +233,7 @@ class Trainer:
         ever_profiled = False
         interrupted = False
         completed = min(warmup_steps, num_steps)
+        in_flight: deque = deque()
         t0 = time.monotonic()
         for i in range(timed_steps):
             if self.cancel is not None and self.cancel.cancelled():
@@ -226,14 +244,16 @@ class Trainer:
                 jax.profiler.start_trace(self.profile_dir)
                 profiling = ever_profiled = True
             batch = next(self.data_iter)
-            prev_metrics = metrics
+            in_flight.append(metrics)
             self.state, metrics = self.step_fn(self.state, batch)
-            # bound async run-ahead to one in-flight step: unbounded dispatch
-            # lets several executions of the collective-bearing step run
-            # concurrently, which deadlocks XLA's in-process CPU communicator
-            # (and on TPU just queues) — blocking on the *previous* step keeps
-            # the device busy while the host readies the next batch
-            jax.block_until_ready(prev_metrics)
+            # bound async run-ahead to `run_ahead` in-flight steps: unbounded
+            # dispatch lets arbitrarily many executions of the
+            # collective-bearing step run concurrently, which deadlocks XLA's
+            # in-process CPU communicator (hence depth 1 there) — blocking on
+            # the step `run_ahead` back keeps the device busy while the host
+            # readies the next batches
+            if len(in_flight) >= self.run_ahead:
+                jax.block_until_ready(in_flight.popleft())
             completed += 1
             if "loss" in metrics:
                 losses.append(metrics["loss"])

@@ -126,6 +126,39 @@ def test_trainer_reports_throughput():
     assert len(result.loss_history) == 4  # first step is warmup
 
 
+def test_trainer_run_ahead_depth():
+    """Deeper run-ahead bounds in-flight work without changing results, and
+    the CPU default stays 1 (deeper pipelining deadlocks the in-process
+    collective communicator — trainer.py)."""
+    cfg = tiny_cfg()
+    opt = build_optimizer(learning_rate=1e-2)
+
+    def fresh_state():
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        return TrainState(params, opt.init(params), jnp.zeros((), jnp.int32))
+
+    step = make_train_step(
+        lambda p, b: llama.loss_fn(p, cfg, b), opt, donate=False
+    )
+
+    def losses(run_ahead):
+        trainer = Trainer(
+            step, fresh_state(), synthetic_lm_batches(4, 32, cfg.vocab_size),
+            tokens_per_batch=4 * 32, run_ahead=run_ahead,
+        )
+        return [float(l) for l in trainer.run(6).loss_history]
+
+    from nexus_tpu.utils.hw import is_tpu
+
+    default = Trainer(
+        step, fresh_state(), synthetic_lm_batches(4, 32, cfg.vocab_size)
+    )
+    # backend-dependent default: CPU must stay at depth 1 (communicator
+    # deadlock), TPU pipelines deeper
+    assert default.run_ahead == (4 if is_tpu() else 1)
+    np.testing.assert_allclose(losses(1), losses(3), rtol=1e-6)
+
+
 def test_checkpoint_roundtrip(tmp_path):
     from nexus_tpu.train.checkpoint import Checkpointer
 
