@@ -162,7 +162,7 @@ class ParallelismSpec:
 class ModelRef:
     """Which model the runtime builds: a family + preset + overrides."""
 
-    family: str = "mlp"  # mlp | llama | mixtral
+    family: str = "mlp"  # mlp | llama | mixtral | gptneox
     preset: str = "tiny"
     overrides: Dict[str, Any] = field(default_factory=dict)
 

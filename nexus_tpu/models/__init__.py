@@ -1,4 +1,4 @@
-"""Model families runnable under the jax_xla runtime: mlp, llama, mixtral.
+"""Model families runnable under the jax_xla runtime: mlp, llama, mixtral, gptneox.
 
 All models are functional: ``init(key, cfg) -> params`` pytrees +
 ``forward(params, cfg, tokens) -> logits`` pure functions, with
@@ -7,7 +7,7 @@ All models are functional: ``init(key, cfg) -> params`` pytrees +
 scanned (one compiled block regardless of depth — the XLA-friendly layout).
 """
 
-from nexus_tpu.models import llama, mixtral, mlp
+from nexus_tpu.models import gptneox, llama, mixtral, mlp
 from nexus_tpu.models.registry import get_family, list_families
 
-__all__ = ["llama", "mixtral", "mlp", "get_family", "list_families"]
+__all__ = ["gptneox", "llama", "mixtral", "mlp", "get_family", "list_families"]

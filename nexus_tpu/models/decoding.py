@@ -59,6 +59,68 @@ def _decode_attention(
     return out.reshape(b, t, hq, hd)
 
 
+def generic_forward_decode(
+    params: Dict[str, Any],
+    cfg: Any,
+    tokens: jnp.ndarray,
+    cache: Dict[str, Any],
+    layer_fn: Callable,
+    rope_dims: Optional[int] = None,
+    finalize: Optional[Callable] = None,
+):
+    """Shared incremental-decode scaffold: embed → rope-table slice →
+    lax.scan over (stacked layer params, cache) → final norm → lm head.
+
+    The family supplies its whole per-layer block as
+    ``layer_fn(cfg, x, layer, attend, cos, sin) → x_new`` where
+    ``attend(q, k, v) → attn_out`` appends k/v at the cache position and
+    runs the length-masked cache attention (_decode_attention) — the cache
+    layout, update placement, and mask semantics live HERE, once, for every
+    family. ``rope_dims`` sizes the rope tables (partial-rotary families
+    pass fewer than head_dim); ``finalize(params, x) → hidden`` is the
+    final norm (default: Llama-style rms_norm on params['final_norm']).
+
+    One compiled block at any depth — same trace-once strategy as the
+    families' forward()."""
+    b, t = tokens.shape
+    max_len = cache["k"].shape[2]
+    start = cache["length"]
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    # rope tables for the whole buffer; slice at runtime positions
+    cos_full, sin_full = rope_cos_sin(
+        max_len, rope_dims if rope_dims is not None else cfg.head_dim,
+        cfg.rope_theta,
+    )
+    cos = lax.dynamic_slice_in_dim(cos_full, start, t, axis=0)
+    sin = lax.dynamic_slice_in_dim(sin_full, start, t, axis=0)
+
+    def layer_step(x, scanned):
+        layer, k_cache, v_cache = scanned
+        bufs = {}
+
+        def attend(q, k, v):
+            k_buf = lax.dynamic_update_slice_in_dim(k_cache, k, start, axis=1)
+            v_buf = lax.dynamic_update_slice_in_dim(v_cache, v, start, axis=1)
+            bufs["kv"] = (k_buf, v_buf)
+            return _decode_attention(q, k_buf, v_buf, start)
+
+        x = layer_fn(cfg, x, layer, attend, cos, sin)
+        if "kv" not in bufs:
+            raise ValueError("layer_fn must call attend() exactly once")
+        return x, bufs["kv"]
+
+    x, (new_k, new_v) = lax.scan(
+        layer_step, x, (params["layers"], cache["k"], cache["v"])
+    )
+    if finalize is None:
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    else:
+        x = finalize(params, x)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v, "length": start + t}
+
+
 def scanned_forward_decode(
     params: Dict[str, Any],
     cfg: Any,
@@ -66,42 +128,22 @@ def scanned_forward_decode(
     cache: Dict[str, Any],
     ffn: Callable[[Any, jnp.ndarray, Dict[str, jnp.ndarray]], jnp.ndarray],
 ):
-    """Shared incremental-decode scaffold: embed → rope slice → lax.scan
-    over (stacked layer params, cache) → final norm → lm head. The per-layer
-    FFN is the only family-specific piece (``ffn(cfg, h, layer) → delta``).
-
-    One compiled block at any depth — same trace-once strategy as the
-    families' forward()."""
-    b, t = tokens.shape
+    """Llama-block decode (RMSNorm → roped GQA → sequential residual →
+    ``ffn``) over the generic scaffold — the llama and mixtral entry."""
     hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    max_len = cache["k"].shape[2]
-    start = cache["length"]
 
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    # rope tables for the whole buffer; slice at runtime positions
-    cos_full, sin_full = rope_cos_sin(max_len, hd, cfg.rope_theta)
-    cos = lax.dynamic_slice_in_dim(cos_full, start, t, axis=0)
-    sin = lax.dynamic_slice_in_dim(sin_full, start, t, axis=0)
-
-    def layer_step(x, scanned):
-        layer, k_cache, v_cache = scanned
+    def layer_fn(cfg, x, layer, attend, cos, sin):
+        b, t = x.shape[0], x.shape[1]
         h = rms_norm(x, layer["ln_attn"], cfg.norm_eps)
         q = apply_rope((h @ layer["wq"]).reshape(b, t, hq, hd), cos, sin)
         k = apply_rope((h @ layer["wk"]).reshape(b, t, hkv, hd), cos, sin)
         v = (h @ layer["wv"]).reshape(b, t, hkv, hd)
-        k_buf = lax.dynamic_update_slice_in_dim(k_cache, k, start, axis=1)
-        v_buf = lax.dynamic_update_slice_in_dim(v_cache, v, start, axis=1)
-        attn = _decode_attention(q, k_buf, v_buf, start)
+        attn = attend(q, k, v)
         x = x + attn.reshape(b, t, hq * hd) @ layer["wo"]
         h2 = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
-        return x + ffn(cfg, h2, layer), (k_buf, v_buf)
+        return x + ffn(cfg, h2, layer)
 
-    x, (new_k, new_v) = lax.scan(
-        layer_step, x, (params["layers"], cache["k"], cache["v"])
-    )
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
-    return logits, {"k": new_k, "v": new_v, "length": start + t}
+    return generic_forward_decode(params, cfg, tokens, cache, layer_fn)
 
 
 def autoregressive_generate(

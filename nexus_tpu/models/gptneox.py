@@ -1,0 +1,305 @@
+"""GPT-NeoX-style decoder: LayerNorm (+bias), parallel residual, fused QKV,
+partial rotary embeddings, GELU MLP, MHA.
+
+Third transformer family (beyond Llama's GQA/SwiGLU and Mixtral's MoE),
+covering the architecture axis the others don't: pre-LN with biases,
+attention and MLP applied in PARALLEL off the same input (GPT-J/NeoX
+residual: ``x + attn(ln1 x) + mlp(ln2 x)``) and rotary applied to only a
+fraction of each head (``rotary_pct``). Same TPU-first layout as the other
+families: stacked layer params scanned once, bf16 matmuls with fp32
+accumulation, attention dispatched to the Pallas flash kernel / XLA / ring
+via the shared ``ops.attention`` entry.
+
+Reference for the capability surface this slots into: the template's
+``jax_xla.model.family`` field (api/runtime_spec.py) — the reference
+controller itself ships no model code (SURVEY.md §2c), families are part of
+the TPU workload plane this build adds.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nexus_tpu.ops.attention import attention
+from nexus_tpu.ops.norms import layer_norm
+from nexus_tpu.ops.remat import checkpoint_block
+from nexus_tpu.ops.ring_attention import ring_attention_sharded
+from nexus_tpu.ops.rope import apply_rope, rope_cos_sin
+
+
+@dataclass(frozen=True)
+class GPTNeoXConfig:
+    vocab_size: int = 50304
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 2048  # NeoX uses 4*d
+    rope_theta: float = 10000.0
+    rotary_pct: float = 0.25  # fraction of head_dim that rotates
+    norm_eps: float = 1e-5
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    attn_impl: Optional[str] = None  # None=auto | 'xla' | 'flash' | 'ring'
+    remat: bool = False
+    remat_policy: str = "full"
+    ce_chunk: int = 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def n_kv_heads(self) -> int:  # MHA — decode scaffolding reads this
+        return self.n_heads
+
+    @property
+    def rotary_dims(self) -> int:
+        # rounded to an even count (rope rotates pairs)
+        r = int(self.head_dim * self.rotary_pct)
+        return max(2, r - r % 2)
+
+    def param_count(self) -> int:
+        d, f, v = self.d_model, self.d_ff, self.vocab_size
+        attn = d * 3 * d + 3 * d + d * d + d  # wqkv+b, wo+b
+        mlp = d * f + f + f * d + d
+        norms = 4 * d  # two LN scale+bias pairs
+        per_layer = attn + mlp + norms
+        return v * d + self.n_layers * per_layer + 2 * d + d * v
+
+
+PRESETS: Dict[str, Dict[str, Any]] = {
+    "tiny": dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                 d_ff=256, max_seq_len=512),
+    # pythia-160m dims
+    "160m": dict(vocab_size=50304, d_model=768, n_layers=12, n_heads=12,
+                 d_ff=3072, max_seq_len=2048),
+    # pythia-1.4b dims
+    "1b": dict(vocab_size=50304, d_model=2048, n_layers=24, n_heads=16,
+               d_ff=8192, max_seq_len=2048),
+    # gpt-neox-20b dims (public): d 6144, L 44, H 64, ff 24576
+    "20b": dict(vocab_size=50432, d_model=6144, n_layers=44, n_heads=64,
+                d_ff=24576, max_seq_len=2048),
+}
+
+
+def config(preset: str = "tiny", **overrides) -> GPTNeoXConfig:
+    base = dict(PRESETS[preset])
+    base.update(overrides)
+    if isinstance(base.get("dtype"), str):
+        base["dtype"] = getattr(jnp, base["dtype"])
+    return GPTNeoXConfig(**base)
+
+
+# ------------------------------------------------------------------ params
+
+
+def init(key: jax.Array, cfg: GPTNeoXConfig) -> Dict[str, Any]:
+    d, f, v, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers
+    k = iter(jax.random.split(key, 16))
+    dt = cfg.dtype
+
+    def norm_init(key, *shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
+
+    resid_scale = 1.0 / math.sqrt(2 * L)
+    return {
+        "embed": norm_init(next(k), v, d, scale=1.0),
+        "layers": {
+            "wqkv": norm_init(next(k), L, d, 3 * d, scale=d ** -0.5),
+            "b_qkv": jnp.zeros((L, 3 * d), dt),
+            "wo": norm_init(next(k), L, d, d, scale=d ** -0.5 * resid_scale),
+            "b_o": jnp.zeros((L, d), dt),
+            "w_in": norm_init(next(k), L, d, f, scale=d ** -0.5),
+            "b_in": jnp.zeros((L, f), dt),
+            "w_out": norm_init(next(k), L, f, d, scale=f ** -0.5 * resid_scale),
+            "b_out": jnp.zeros((L, d), dt),
+            "ln1": jnp.ones((L, d), dt),
+            "ln1_b": jnp.zeros((L, d), dt),
+            "ln2": jnp.ones((L, d), dt),
+            "ln2_b": jnp.zeros((L, d), dt),
+        },
+        "final_norm": jnp.ones((d,), dt),
+        "final_norm_b": jnp.zeros((d,), dt),
+        "lm_head": norm_init(next(k), d, v, scale=d ** -0.5),
+    }
+
+
+def logical_axes(cfg: GPTNeoXConfig) -> Dict[str, Any]:
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "wqkv": ("layer", "embed", "qkv"),
+            "b_qkv": ("layer", "qkv"),
+            "wo": ("layer", "qkv", "embed"),
+            "b_o": ("layer", None),
+            "w_in": ("layer", "embed", "mlp"),
+            "b_in": ("layer", "mlp"),
+            "w_out": ("layer", "mlp", "embed"),
+            "b_out": ("layer", None),
+            "ln1": ("layer", None),
+            "ln1_b": ("layer", None),
+            "ln2": ("layer", None),
+            "ln2_b": ("layer", None),
+        },
+        "final_norm": (None,),
+        "final_norm_b": (None,),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+# ----------------------------------------------------------------- forward
+
+
+def _partial_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+                  rot: int) -> jnp.ndarray:
+    """Rotate the first ``rot`` dims of each head; pass the rest through."""
+    if rot >= x.shape[-1]:
+        return apply_rope(x, cos, sin)
+    return jnp.concatenate(
+        [apply_rope(x[..., :rot], cos, sin), x[..., rot:]], axis=-1
+    )
+
+
+def _qkv(cfg: GPTNeoXConfig, h: jnp.ndarray, layer: Dict[str, jnp.ndarray],
+         cos: jnp.ndarray, sin: jnp.ndarray):
+    b, s, d = h.shape
+    hq, hd, rot = cfg.n_heads, cfg.head_dim, cfg.rotary_dims
+    qkv = h @ layer["wqkv"] + layer["b_qkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = _partial_rope(q.reshape(b, s, hq, hd), cos, sin, rot)
+    k = _partial_rope(k.reshape(b, s, hq, hd), cos, sin, rot)
+    return q, k, v.reshape(b, s, hq, hd)
+
+
+def _block(cfg: GPTNeoXConfig, x: jnp.ndarray, layer: Dict[str, jnp.ndarray],
+           cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    b, s, d = x.shape
+    q, k, v = _qkv(
+        cfg, layer_norm(x, layer["ln1"], layer["ln1_b"], cfg.norm_eps),
+        layer, cos, sin,
+    )
+    if cfg.attn_impl == "ring":
+        attn = ring_attention_sharded(q, k, v)
+    else:
+        attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+    attn_out = attn.reshape(b, s, d) @ layer["wo"] + layer["b_o"]
+
+    h2 = layer_norm(x, layer["ln2"], layer["ln2_b"], cfg.norm_eps)
+    mlp_out = (
+        jax.nn.gelu(h2 @ layer["w_in"] + layer["b_in"]) @ layer["w_out"]
+        + layer["b_out"]
+    )
+    # parallel residual: both branches read x, one residual add
+    return x + attn_out + mlp_out
+
+
+def forward_hidden(params: Dict[str, Any], cfg: GPTNeoXConfig,
+                   tokens: jnp.ndarray, position_offset: int = 0) -> jnp.ndarray:
+    b, s = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    cos, sin = rope_cos_sin(
+        s, cfg.rotary_dims, cfg.rope_theta, dtype=jnp.float32,
+        position_offset=position_offset,
+    )
+    block = partial(_block, cfg)
+    if cfg.remat:
+        block = checkpoint_block(block, cfg.remat_policy)
+
+    def scan_body(x, layer_params):
+        return block(x, layer_params, cos, sin), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    return layer_norm(x, params["final_norm"], params["final_norm_b"],
+                      cfg.norm_eps)
+
+
+def forward(params: Dict[str, Any], cfg: GPTNeoXConfig,
+            tokens: jnp.ndarray, position_offset: int = 0) -> jnp.ndarray:
+    """tokens (B, S) int32 → logits (B, S, V) float32."""
+    x = forward_hidden(params, cfg, tokens, position_offset)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def loss_fn(params: Dict[str, Any], cfg: GPTNeoXConfig,
+            batch: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """Next-token cross entropy; ``ce_chunk`` routes to the vocab-chunked
+    exact CE exactly as the other families (ops/losses.py)."""
+    from nexus_tpu.ops.losses import chunked_softmax_xent, dense_softmax_xent
+
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    hidden = forward_hidden(params, cfg, inputs)
+    if cfg.ce_chunk > 0:
+        loss = chunked_softmax_xent(
+            hidden, params["lm_head"], targets, chunk=cfg.ce_chunk
+        )
+    else:
+        loss = dense_softmax_xent(hidden, params["lm_head"], targets)
+    return loss, {"loss": loss, "perplexity": jnp.exp(loss)}
+
+
+# ------------------------------------------------------------------ decode
+
+
+def init_kv_cache(cfg: GPTNeoXConfig, batch: int, max_len: int) -> Dict[str, Any]:
+    from nexus_tpu.models.decoding import init_kv_cache as _init
+
+    return _init(
+        cfg.n_layers, cfg.n_heads, cfg.head_dim, cfg.dtype, batch, max_len
+    )
+
+
+def forward_decode(
+    params: Dict[str, Any], cfg: GPTNeoXConfig,
+    tokens: jnp.ndarray, cache: Dict[str, Any],
+) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """Incremental decode over the generic scaffold (models/decoding.py):
+    the cache layout/update/mask logic is shared, only the NeoX block
+    (parallel residual, LayerNorm+bias, partial rope) is supplied here."""
+    from nexus_tpu.models.decoding import generic_forward_decode
+
+    hq, hd = cfg.n_heads, cfg.head_dim
+
+    def layer_fn(cfg, x, layer, attend, cos, sin):
+        b, t = x.shape[0], x.shape[1]
+        q, k, v = _qkv(
+            cfg, layer_norm(x, layer["ln1"], layer["ln1_b"], cfg.norm_eps),
+            layer, cos, sin,
+        )
+        attn = attend(q, k, v)
+        attn_out = attn.reshape(b, t, hq * hd) @ layer["wo"] + layer["b_o"]
+        h2 = layer_norm(x, layer["ln2"], layer["ln2_b"], cfg.norm_eps)
+        mlp_out = (
+            jax.nn.gelu(h2 @ layer["w_in"] + layer["b_in"]) @ layer["w_out"]
+            + layer["b_out"]
+        )
+        return x + attn_out + mlp_out
+
+    def finalize(params, x):
+        return layer_norm(
+            x, params["final_norm"], params["final_norm_b"], cfg.norm_eps
+        )
+
+    return generic_forward_decode(
+        params, cfg, tokens, cache, layer_fn,
+        rope_dims=cfg.rotary_dims, finalize=finalize,
+    )
+
+
+def generate(
+    params: Dict[str, Any], cfg: GPTNeoXConfig, prompt: jnp.ndarray,
+    max_new_tokens: int, **sampling,
+) -> jnp.ndarray:
+    """Autoregressive decoding. prompt (B, P) → (B, P + max_new_tokens)."""
+    from nexus_tpu.models.decoding import autoregressive_generate
+
+    return autoregressive_generate(
+        forward_decode, params, cfg, prompt, max_new_tokens, **sampling
+    )

@@ -8,12 +8,13 @@ from __future__ import annotations
 from types import ModuleType
 from typing import Dict
 
-from nexus_tpu.models import llama, mixtral, mlp
+from nexus_tpu.models import gptneox, llama, mixtral, mlp
 
 _FAMILIES: Dict[str, ModuleType] = {
     "mlp": mlp,
     "llama": llama,
     "mixtral": mixtral,
+    "gptneox": gptneox,
 }
 
 

@@ -6,6 +6,21 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def layer_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray, eps: float = 1e-5
+) -> jnp.ndarray:
+    """LayerNorm (GPT-NeoX-style, with bias): normalize in fp32, affine,
+    cast back. Same fp32-accumulation rationale as rms_norm."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    normed = (x32 - mean) * lax.rsqrt(var + eps)
+    return (
+        normed * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    ).astype(dtype)
+
+
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
     """RMSNorm (Llama-style): normalize in fp32, scale, cast back.
 
