@@ -97,18 +97,23 @@ def generic_forward_decode(
 
     def layer_step(x, scanned):
         layer, k_cache, v_cache = scanned
-        bufs = {}
+        calls = []
 
         def attend(q, k, v):
             k_buf = lax.dynamic_update_slice_in_dim(k_cache, k, start, axis=1)
             v_buf = lax.dynamic_update_slice_in_dim(v_cache, v, start, axis=1)
-            bufs["kv"] = (k_buf, v_buf)
+            calls.append((k_buf, v_buf))
             return _decode_attention(q, k_buf, v_buf, start)
 
         x = layer_fn(cfg, x, layer, attend, cos, sin)
-        if "kv" not in bufs:
-            raise ValueError("layer_fn must call attend() exactly once")
-        return x, bufs["kv"]
+        if len(calls) != 1:
+            # >1 would silently drop the earlier call's K/V from the
+            # returned cache — a family needing multiple attentions per
+            # layer needs its own cache layout, not this scaffold
+            raise ValueError(
+                f"layer_fn must call attend() exactly once, got {len(calls)}"
+            )
+        return x, calls[0]
 
     x, (new_k, new_v) = lax.scan(
         layer_step, x, (params["layers"], cache["k"], cache["v"])

@@ -178,17 +178,19 @@ def _qkv(cfg: GPTNeoXConfig, h: jnp.ndarray, layer: Dict[str, jnp.ndarray],
     return q, k, v.reshape(b, s, hq, hd)
 
 
-def _block(cfg: GPTNeoXConfig, x: jnp.ndarray, layer: Dict[str, jnp.ndarray],
-           cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+def _block_with(cfg: GPTNeoXConfig, x: jnp.ndarray,
+                layer: Dict[str, jnp.ndarray], attend,
+                cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """The NeoX block with attention abstracted as ``attend(q, k, v)`` —
+    the ONE copy of the block body shared by the train-time forward and
+    the KV-cache decode path (generic_forward_decode's layer_fn contract),
+    so the two can't drift."""
     b, s, d = x.shape
     q, k, v = _qkv(
         cfg, layer_norm(x, layer["ln1"], layer["ln1_b"], cfg.norm_eps),
         layer, cos, sin,
     )
-    if cfg.attn_impl == "ring":
-        attn = ring_attention_sharded(q, k, v)
-    else:
-        attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+    attn = attend(q, k, v)
     attn_out = attn.reshape(b, s, d) @ layer["wo"] + layer["b_o"]
 
     h2 = layer_norm(x, layer["ln2"], layer["ln2_b"], cfg.norm_eps)
@@ -198,6 +200,16 @@ def _block(cfg: GPTNeoXConfig, x: jnp.ndarray, layer: Dict[str, jnp.ndarray],
     )
     # parallel residual: both branches read x, one residual add
     return x + attn_out + mlp_out
+
+
+def _block(cfg: GPTNeoXConfig, x: jnp.ndarray, layer: Dict[str, jnp.ndarray],
+           cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    def attend(q, k, v):
+        if cfg.attn_impl == "ring":
+            return ring_attention_sharded(q, k, v)
+        return attention(q, k, v, causal=True, impl=cfg.attn_impl)
+
+    return _block_with(cfg, x, layer, attend, cos, sin)
 
 
 def forward_hidden(params: Dict[str, Any], cfg: GPTNeoXConfig,
@@ -265,30 +277,13 @@ def forward_decode(
     (parallel residual, LayerNorm+bias, partial rope) is supplied here."""
     from nexus_tpu.models.decoding import generic_forward_decode
 
-    hq, hd = cfg.n_heads, cfg.head_dim
-
-    def layer_fn(cfg, x, layer, attend, cos, sin):
-        b, t = x.shape[0], x.shape[1]
-        q, k, v = _qkv(
-            cfg, layer_norm(x, layer["ln1"], layer["ln1_b"], cfg.norm_eps),
-            layer, cos, sin,
-        )
-        attn = attend(q, k, v)
-        attn_out = attn.reshape(b, t, hq * hd) @ layer["wo"] + layer["b_o"]
-        h2 = layer_norm(x, layer["ln2"], layer["ln2_b"], cfg.norm_eps)
-        mlp_out = (
-            jax.nn.gelu(h2 @ layer["w_in"] + layer["b_in"]) @ layer["w_out"]
-            + layer["b_out"]
-        )
-        return x + attn_out + mlp_out
-
     def finalize(params, x):
         return layer_norm(
             x, params["final_norm"], params["final_norm_b"], cfg.norm_eps
         )
 
     return generic_forward_decode(
-        params, cfg, tokens, cache, layer_fn,
+        params, cfg, tokens, cache, _block_with,
         rope_dims=cfg.rotary_dims, finalize=finalize,
     )
 

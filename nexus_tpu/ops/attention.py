@@ -226,6 +226,58 @@ def flash_attention(
     return _flash(q, k, v, (causal, q_offset, block_q, block_k, interpret))
 
 
+def flash_attention_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    q_offset: int = 0,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Flash attention that ALSO returns the per-row logsumexp as a
+    differentiable output: (out (B,Sq,Hq,D), lse (B,Sq,Hq) f32).
+
+    The lse output is what makes block-merged attention (ring attention's
+    per-visiting-block partials) exactly differentiable: for
+    ``lse_i = logsumexp_j(s_ij)`` the cotangent folds into the score grads
+    as ``dL/ds_ij += P_ij·ḡ_lse_i`` — the same shape as the delta term the
+    backward kernels already subtract, so the bwd pass just computes
+    ``delta = rowsum(dO⊙O) − ḡ_lse`` and the kernels stay untouched."""
+    if interpret is None:
+        from nexus_tpu.utils.hw import is_tpu
+
+        interpret = not is_tpu()
+    return _flash_lse(q, k, v, (causal, q_offset, block_q, block_k, interpret))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_lse(q, k, v, opts):
+    out, lse = _flash_impl(q, k, v, opts)
+    return out, _lse_rows(lse, q.shape)
+
+
+def _lse_rows(lse: jnp.ndarray, q_shape) -> jnp.ndarray:
+    """(B*Hq, Sq, LANES) lane-broadcast buffer → (B, Sq, Hq) rows."""
+    b, sq, hq, _ = q_shape
+    return lse[:, :, 0].reshape(b, hq, sq).transpose(0, 2, 1)
+
+
+def _flash_lse_fwd_rule(q, k, v, opts):
+    out, lse = _flash_impl(q, k, v, opts)
+    return (out, _lse_rows(lse, q.shape)), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd_rule(opts, residuals, cts):
+    q, k, v, out, lse = residuals
+    g_out, g_lse = cts
+    return _flash_bwd_impl(q, k, v, out, lse, g_out, opts, g_lse=g_lse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash(q, k, v, opts):
     out, _ = _flash_impl(q, k, v, opts)
@@ -249,6 +301,19 @@ def _fold_heads(x):
     """(B, S, H, D) → (B*H, S, D)."""
     b, s, h, d = x.shape
     return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct carrying ``like``'s varying-axes set: under
+    shard_map manual axes (ring attention's per-block calls) pallas_call
+    outputs must declare their vma explicitly."""
+    try:
+        vma = jax.typeof(like).vma
+        if vma is not None:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except (AttributeError, TypeError):  # older jax: no vma plumbing
+        pass
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _flash_impl(q, k, v, opts):
@@ -322,8 +387,8 @@ def _flash_impl(q, k, v, opts):
             pl.BlockSpec((1, block_q, LANES), lambda bh, i, j: (bh, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * hq, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * hq, sq, LANES), jnp.float32),
+            _out_struct((b * hq, sq, d), q.dtype, qf),
+            _out_struct((b * hq, sq, LANES), jnp.float32, qf),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -449,7 +514,7 @@ def _flash_bwd_dkv_kernel(
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd_impl(q, k, v, out, lse, g, opts):
+def _flash_bwd_impl(q, k, v, out, lse, g, opts, g_lse=None):
     causal, q_offset, block_q, block_k, interpret = opts
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
@@ -467,14 +532,20 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts):
     bh = b * hq
     kv_row = functools.partial(_kv_row, hq=hq, hkv=hkv, n_rep=n_rep)
 
-    # D = rowsum(dO ⊙ O) — cheap elementwise+reduce; plain XLA. Broadcast
-    # across the lane dim to match the LSE buffer layout (see LANES).
-    delta = jnp.broadcast_to(
-        jnp.sum(
-            dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1
-        )[..., None],
-        (bh, sq, LANES),
-    )
+    # D = rowsum(dO ⊙ O) — cheap elementwise+reduce; plain XLA. An lse
+    # cotangent (flash_attention_lse) folds in here with a minus sign:
+    # dL/ds_ij = P_ij·(dP_ij − D_i + ḡ_lse_i), and the kernels compute
+    # ds = p·(dp − delta). Broadcast across the lane dim to match the LSE
+    # buffer layout (see LANES).
+    delta_rows = jnp.sum(
+        dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1
+    )  # (BH, Sq)
+    if g_lse is not None:
+        # (B, Sq, Hq) → (B*Hq, Sq), matching the folded-head layout
+        delta_rows = delta_rows - g_lse.astype(jnp.float32).transpose(
+            0, 2, 1
+        ).reshape(bh, sq)
+    delta = jnp.broadcast_to(delta_rows[..., None], (bh, sq, LANES))
 
     common = dict(
         scale=d ** -0.5, causal=causal,
@@ -524,7 +595,7 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts):
         grid=(bh, sq // block_q, sk // block_k),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        out_shape=_out_struct((bh, sq, d), q.dtype, qf),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
@@ -547,8 +618,8 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts):
             pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            _out_struct((bh, sk, d), k.dtype, qf),
+            _out_struct((bh, sk, d), v.dtype, qf),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),

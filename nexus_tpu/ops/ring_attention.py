@@ -57,16 +57,112 @@ def _online_block(
     return m_new, l_new, acc_new
 
 
+def _pvary_like(xs, template):
+    """Mark arrays as device-varying over ``template``'s varying axes so
+    shard_map's varying-axis typing accepts them in cond branches / scan
+    carries (jax >= 0.8 manual-axes semantics)."""
+    pcast = getattr(lax, "pcast", None)
+    pvary = None if pcast is not None else getattr(lax, "pvary", None)
+    try:
+        vma = tuple(sorted(jax.typeof(template).vma))
+    except Exception:
+        vma = ()
+    if not vma:
+        return xs
+    if pcast is not None:
+        return tuple(pcast(x, vma, to="varying") for x in xs)
+    if pvary is not None:  # pragma: no cover — older jax
+        return tuple(pvary(x, vma) for x in xs)
+    return xs
+
+
+def ring_attention_flash(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = "sequence",
+    causal: bool = True,
+    interpret=None,
+) -> jnp.ndarray:
+    """Ring attention with the Pallas flash kernel on each visiting block.
+
+    Exploits the ring's block structure to keep every kernel offset STATIC
+    (Pallas bakes masks/index-maps at trace time): the step-0 block is the
+    device's own K/V shard → plain causal flash; every later visiting block
+    is either entirely in the past (full non-causal flash) or entirely in
+    the future (exact zero) — selected per device by ``lax.cond``. Partials
+    merge exactly through the differentiable logsumexp output
+    (ops.attention.flash_attention_lse), so the whole thing autodiffs
+    without an S_local×S_local materialization anywhere — the enabler for
+    long-context sequence parallelism at flash-kernel speed.
+
+    K/V stay un-repeated under GQA: the kernel shares kv heads via index
+    maps, and the ppermute moves Hkv-sized blocks around the ring."""
+    from nexus_tpu.ops.attention import flash_attention_lse
+
+    n = lax.psum(1, axis_name)  # static: mesh axis size
+    my_idx = lax.axis_index(axis_name)
+    b, s_local, hq, d = q.shape
+
+    # step 0: own shard, standard causal flash — never empty (diagonal)
+    out_acc, lse_acc = flash_attention_lse(
+        q, k, v, causal=causal, interpret=interpret
+    )
+    out_acc = out_acc.astype(jnp.float32)
+
+    k_blk, v_blk = k, v
+    perm = [(r, (r + 1) % n) for r in range(n)]
+    for step in range(1, n):
+        # rotate: receive the next block from the previous rank in the ring
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        # the block now held originated on shard (my_idx - step) mod n
+        if causal:
+            def _visible(q=q, kb=k_blk, vb=v_blk):
+                o, l = flash_attention_lse(
+                    q, kb, vb, causal=False, interpret=interpret
+                )
+                return o.astype(jnp.float32), l
+
+            def _masked():
+                z = jnp.zeros((b, s_local, hq, d), jnp.float32)
+                neg = jnp.full((b, s_local, hq), -jnp.inf, jnp.float32)
+                return _pvary_like((z, neg), q)
+
+            # src = my_idx - step when my_idx >= step (fully in the past);
+            # otherwise the block wrapped around → entirely in the future
+            o_blk, lse_blk = lax.cond(my_idx >= step, _visible, _masked)
+        else:
+            o_blk, lse_blk = flash_attention_lse(
+                q, k_blk, v_blk, causal=False, interpret=interpret
+            )
+            o_blk = o_blk.astype(jnp.float32)
+
+        # exact merge of normalized partials via logsumexp weights
+        lse_new = jnp.logaddexp(lse_acc, lse_blk)
+        w_acc = jnp.exp(lse_acc - lse_new)[..., None]
+        w_blk = jnp.exp(lse_blk - lse_new)[..., None]
+        out_acc = out_acc * w_acc + o_blk * w_blk
+        lse_acc = lse_new
+
+    return out_acc.astype(q.dtype)
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
     v: jnp.ndarray,
     axis_name: str = "sequence",
     causal: bool = True,
+    block_impl: str = "xla",
 ) -> jnp.ndarray:
     """Exact attention over sequence shards. q/k/v: (B, S_local, H|Hkv, D).
 
-    Must execute under a mapping (shard_map) that binds ``axis_name``."""
+    Must execute under a mapping (shard_map) that binds ``axis_name``.
+    ``block_impl='flash'`` routes each visiting block through the Pallas
+    kernel (ring_attention_flash); 'xla' is the dense online-softmax path."""
+    if block_impl == "flash":
+        return ring_attention_flash(q, k, v, axis_name, causal)
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, s_local, hq, d = q.shape
@@ -151,11 +247,34 @@ def ring_attention_sharded(q, k, v):
     except AttributeError:  # pragma: no cover — older jax
         from jax.experimental.shard_map import shard_map as smap
 
+    # flash inner blocks on TPU when the local shard tiles cleanly (the
+    # kernel needs 8-divisible sequence blocks and a supported head_dim);
+    # dense online-softmax path elsewhere
+    from nexus_tpu.utils.hw import is_tpu
+
+    n_seq = mesh.shape["sequence"]
+    s_local = q.shape[1] // n_seq
+    block_impl = (
+        "flash"
+        if is_tpu() and s_local % 8 == 0 and q.shape[-1] in (64, 128, 256)
+        else "xla"
+    )
+
     spec = P(("data", "fsdp"), "sequence", "tensor", None)
+    smap_kwargs = {}
+    if block_impl == "flash":
+        # pallas interpret/lowering paths mix varying and invariant operands
+        # in their internal dynamic_slices; vma checking rejects that (jax
+        # suggests check_vma=False as the supported escape hatch)
+        smap_kwargs["check_vma"] = False
     ring = smap(
-        _partial(ring_attention, axis_name="sequence", causal=True),
+        _partial(
+            ring_attention, axis_name="sequence", causal=True,
+            block_impl=block_impl,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        **smap_kwargs,
     )
     return ring(q, k, v)
