@@ -13,7 +13,7 @@ broadcast to query heads.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -470,13 +470,23 @@ def _flash_bwd_dq_kernel(
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc_ref, dv_acc_ref,
-    *, scale, causal, block_q, block_k, q_offset,
+    *, scale, causal, block_q, block_k, q_offset, n_rep,
 ):
-    j = pl.program_id(1)  # k block (parallel)
-    i = pl.program_id(2)  # q block (sequential accumulation)
-    nq = pl.num_programs(2)
+    j = pl.program_id(1)  # k block (parallel, one per KV head row)
+    # sequential dim enumerates (q tile, query-head group member): the
+    # whole group accumulates into ONE kv-shaped scratch, so dK/dV leave
+    # the kernel already group-summed — no per-q-head (B*Hq, Sk, D)
+    # materialization + XLA reduction pass afterwards (GQA)
+    t = pl.program_id(2)
+    nt = pl.num_programs(2)
+    # tile-fast ordering (i = t % n_q_tiles, member = t // n_q_tiles): the
+    # q row stays constant across each member's whole tile run, so the
+    # causal qi clamp still repeats block indices on skipped tiles and
+    # their DMAs stay elided (member-fast ordering would cycle rows and
+    # defeat the elision)
+    i = t % (nt // n_rep)  # q tile
 
-    @pl.when(i == 0)
+    @pl.when(t == 0)
     def _init():
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
@@ -508,7 +518,7 @@ def _flash_bwd_dkv_kernel(
             preferred_element_type=jnp.float32,
         )  # dSᵀ Q: (bk, d)
 
-    @pl.when(i == nq - 1)
+    @pl.when(t == nt - 1)
     def _finish():
         dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
@@ -600,26 +610,35 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts, g_lse=None):
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
 
-    # dk/dv: swap the roles — grid's parallel dim walks k blocks, inner
-    # sequential dim walks q blocks (index maps receive (bh, j, i))
-    qT_spec = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, qi(j, i), 0))
-    kT_spec = pl.BlockSpec(
-        (1, block_k, d), lambda bh, j, i: (kv_row(bh), j, 0)
+    # dk/dv: grid's parallel dims walk (B*Hkv, k blocks); the sequential
+    # dim enumerates (q tile × group member) so the whole query-head group
+    # accumulates into one kv-shaped scratch (kernel docstring). Index maps
+    # receive (bhk, j, t) with t = q_tile*n_rep + member.
+    nq_tiles = sq // block_q
+
+    def q_row(bhk, t):
+        return (bhk // hkv) * hq + (bhk % hkv) * n_rep + t // nq_tiles
+
+    qT_spec = pl.BlockSpec(
+        (1, block_q, d),
+        lambda bhk, j, t: (q_row(bhk, t), qi(j, t % nq_tiles), 0),
     )
+    kT_spec = pl.BlockSpec((1, block_k, d), lambda bhk, j, t: (bhk, j, 0))
     rowT_spec = pl.BlockSpec(
-        (1, block_q, LANES), lambda bh, j, i: (bh, qi(j, i), 0)
+        (1, block_q, LANES),
+        lambda bhk, j, t: (q_row(bhk, t), qi(j, t % nq_tiles), 0),
     )
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, **common),
-        grid=(bh, sk // block_k, sq // block_q),
+        functools.partial(_flash_bwd_dkv_kernel, n_rep=n_rep, **common),
+        grid=(b * hkv, sk // block_k, (sq // block_q) * n_rep),
         in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhk, j, t: (bhk, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhk, j, t: (bhk, j, 0)),
         ],
         out_shape=[
-            _out_struct((bh, sk, d), k.dtype, qf),
-            _out_struct((bh, sk, d), v.dtype, qf),
+            _out_struct((b * hkv, sk, d), k.dtype, qf),
+            _out_struct((b * hkv, sk, d), v.dtype, qf),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -628,16 +647,9 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts, g_lse=None):
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
 
-    def _unfold(x, s):
-        return x.reshape(b, hq, s, d).transpose(0, 2, 1, 3)
-
-    dq = _unfold(dq, sq)
-    dk = _unfold(dk, sk)
-    dv = _unfold(dv, sk)
-    if n_rep > 1:
-        # sum the broadcast query-head groups back onto each kv head
-        dk = dk.reshape(b, sk, hkv, n_rep, d).sum(axis=3)
-        dv = dv.reshape(b, sk, hkv, n_rep, d).sum(axis=3)
+    dq = dq.reshape(b, hq, sq, d).transpose(0, 2, 1, 3)
+    dk = dk.reshape(b, hkv, sk, d).transpose(0, 2, 1, 3)
+    dv = dv.reshape(b, hkv, sk, d).transpose(0, 2, 1, 3)
     return dq, dk, dv
 
 

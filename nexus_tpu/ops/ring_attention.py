@@ -57,16 +57,23 @@ def _online_block(
     return m_new, l_new, acc_new
 
 
-def _pvary_like(xs, template):
+def _pvary_like(xs, template, default_vma=()):
     """Mark arrays as device-varying over ``template``'s varying axes so
     shard_map's varying-axis typing accepts them in cond branches / scan
-    carries (jax >= 0.8 manual-axes semantics)."""
+    carries (jax >= 0.8 manual-axes semantics). ``default_vma`` is used
+    when the template's vma can't be read (or is empty) — scan carries
+    must still be varying over at least the ring axis.
+
+    NB prefer lax.pcast; merely touching lax.pvary emits a
+    DeprecationWarning on jax >= 0.9."""
     pcast = getattr(lax, "pcast", None)
     pvary = None if pcast is not None else getattr(lax, "pvary", None)
     try:
         vma = tuple(sorted(jax.typeof(template).vma))
     except Exception:
         vma = ()
+    if not vma:
+        vma = tuple(default_vma)
     if not vma:
         return xs
     if pcast is not None:
@@ -180,20 +187,9 @@ def ring_attention(
     # them with q/k/v, so they must carry q's FULL varying-axis set — the
     # enclosing shard_map may be manual over more axes than the ring axis
     # (e.g. data/fsdp/tensor when nested inside a jitted train step).
-    pcast = getattr(lax, "pcast", None)
-    # only reach for the deprecated pvary when pcast is absent (merely
-    # touching lax.pvary emits a DeprecationWarning on jax >= 0.9)
-    pvary = None if pcast is not None else getattr(lax, "pvary", None)
-    try:
-        vma = tuple(sorted(jax.typeof(q).vma))
-    except Exception:
-        vma = (axis_name,)
-    if not vma:
-        vma = (axis_name,)
-    if pcast is not None:
-        m0, l0, acc0 = (pcast(x, vma, to="varying") for x in (m0, l0, acc0))
-    elif pvary is not None:  # pragma: no cover — older jax
-        m0, l0, acc0 = (pvary(x, vma) for x in (m0, l0, acc0))
+    m0, l0, acc0 = _pvary_like(
+        (m0, l0, acc0), q, default_vma=(axis_name,)
+    )
 
     def step(carry, step_idx):
         k_blk, v_blk, m, l, acc = carry
@@ -244,19 +240,26 @@ def ring_attention_sharded(q, k, v):
         return attention(q, k, v, causal=True, impl=None)
     try:
         smap = jax.shard_map
+        vma_kwarg = "check_vma"
     except AttributeError:  # pragma: no cover — older jax
         from jax.experimental.shard_map import shard_map as smap
+        vma_kwarg = "check_rep"
 
     # flash inner blocks on TPU when the local shard tiles cleanly (the
     # kernel needs 8-divisible sequence blocks and a supported head_dim);
     # dense online-softmax path elsewhere
+    from nexus_tpu.ops.attention import _fit_block
     from nexus_tpu.utils.hw import is_tpu
 
     n_seq = mesh.shape["sequence"]
     s_local = q.shape[1] // n_seq
     block_impl = (
         "flash"
-        if is_tpu() and s_local % 8 == 0 and q.shape[-1] in (64, 128, 256)
+        if (
+            is_tpu()
+            and _fit_block(s_local, 1024) > 0  # kernel-tileable local shard
+            and q.shape[-1] in (64, 128, 256)
+        )
         else "xla"
     )
 
@@ -265,8 +268,9 @@ def ring_attention_sharded(q, k, v):
     if block_impl == "flash":
         # pallas interpret/lowering paths mix varying and invariant operands
         # in their internal dynamic_slices; vma checking rejects that (jax
-        # suggests check_vma=False as the supported escape hatch)
-        smap_kwargs["check_vma"] = False
+        # suggests check_vma=False as the supported escape hatch; the older
+        # shard_map spells the same flag check_rep)
+        smap_kwargs[vma_kwarg] = False
     ring = smap(
         _partial(
             ring_attention, axis_name="sequence", causal=True,

@@ -1,0 +1,59 @@
+"""Capture an XLA profile of N train steps through the product runtime path.
+
+Usage (on the TPU host):
+    python tools/profile_train.py [out_dir]
+    python tools/trace_summary.py [out_dir]
+
+Env knobs: P_ATTN (xla|flash), P_REMAT (none|dots|full), P_BATCH, P_SEQ,
+P_PRESET — mirror the bench sweep's candidate axes (bench.py).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nexus_tpu.api.runtime_spec import (  # noqa: E402
+    JaxXlaRuntimeSpec, ModelSpec, ParallelismSpec, ProfileSpec, TrainSpec,
+)
+from nexus_tpu.runtime.entrypoints import run_template_runtime  # noqa: E402
+
+
+def main() -> int:
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/nexus_prof"
+    attn = os.environ.get("P_ATTN", "xla")
+    remat = os.environ.get("P_REMAT", "dots")
+    overrides = {"attn_impl": attn}
+    if remat == "none":
+        overrides["remat"] = False
+    else:
+        overrides["remat"] = True
+        overrides["remat_policy"] = remat
+
+    runtime = JaxXlaRuntimeSpec(
+        kind="train",
+        model=ModelSpec(
+            family="llama",
+            preset=os.environ.get("P_PRESET", "400m"),
+            overrides=overrides,
+        ),
+        parallelism=ParallelismSpec(),
+        train=TrainSpec(
+            batch_size=int(os.environ.get("P_BATCH", "8")),
+            seq_len=int(os.environ.get("P_SEQ", "2048")),
+            steps=7,
+            learning_rate=3e-4,
+        ),
+        profile=ProfileSpec(
+            enabled=True, directory=out_dir, start_step=2, num_steps=3
+        ),
+    )
+    m = run_template_runtime(runtime)
+    print({k: m.get(k) for k in (
+        "mfu", "tokens_per_sec_per_chip", "steps_per_sec", "final_loss"
+    )})
+    print(f"trace in {out_dir}; summarize with tools/trace_summary.py")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,37 @@
+"""Summarize a jax.profiler TensorBoard trace: top device ops by self time."""
+import glob, gzip, json, sys, collections
+
+root = sys.argv[1] if len(sys.argv) > 1 else "/tmp/prof_out"
+paths = sorted(glob.glob(f"{root}/**/*.trace.json.gz", recursive=True))
+if not paths:
+    sys.exit(f"no trace under {root}")
+path = paths[-1]
+with gzip.open(path, "rt") as f:
+    data = json.load(f)
+events = data.get("traceEvents", [])
+# device lanes: pid names containing TPU/device
+pid_names = {e["pid"]: e["args"].get("name", "") for e in events
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+dev_pids = {p for p, n in pid_names.items()
+            if any(s in n.lower() for s in ("tpu", "device", "xla"))}
+tot = collections.Counter()
+cnt = collections.Counter()
+span = [None, None]
+for e in events:
+    if e.get("ph") == "X" and e.get("pid") in dev_pids:
+        name = e.get("name", "?")
+        dur = e.get("dur", 0)  # us
+        tot[name] += dur
+        cnt[name] += 1
+        ts = e.get("ts", 0)
+        if span[0] is None or ts < span[0]: span[0] = ts
+        te = ts + dur
+        if span[1] is None or te > span[1]: span[1] = te
+print(f"trace: {path}")
+print(f"pids: { {p: pid_names[p] for p in dev_pids} }")
+if span[0] is not None:
+    print(f"device span: {(span[1]-span[0])/1e3:.1f} ms")
+busy = sum(tot.values())
+print(f"total device busy: {busy/1e3:.1f} ms")
+for name, us in tot.most_common(30):
+    print(f"{us/1e3:9.2f} ms  x{cnt[name]:4d}  {name[:110]}")
