@@ -613,7 +613,8 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts, g_lse=None):
     # dk/dv: grid's parallel dims walk (B*Hkv, k blocks); the sequential
     # dim enumerates (q tile × group member) so the whole query-head group
     # accumulates into one kv-shaped scratch (kernel docstring). Index maps
-    # receive (bhk, j, t) with t = q_tile*n_rep + member.
+    # receive (bhk, j, t) with tile-fast ordering: t = member*nq_tiles +
+    # q_tile (q_row constant across each member's tile run — DMA elision).
     nq_tiles = sq // block_q
 
     def q_row(bhk, t):

@@ -13,7 +13,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from nexus_tpu.api.runtime_spec import (  # noqa: E402
-    JaxXlaRuntimeSpec, ModelSpec, ParallelismSpec, ProfileSpec, TrainSpec,
+    JaxXlaRuntime, ModelRef, ParallelismSpec, ProfileSpec, TrainSpec,
 )
 from nexus_tpu.runtime.entrypoints import run_template_runtime  # noqa: E402
 
@@ -29,9 +29,9 @@ def main() -> int:
         overrides["remat"] = True
         overrides["remat_policy"] = remat
 
-    runtime = JaxXlaRuntimeSpec(
-        kind="train",
-        model=ModelSpec(
+    runtime = JaxXlaRuntime(
+        mode="train",
+        model=ModelRef(
             family="llama",
             preset=os.environ.get("P_PRESET", "400m"),
             overrides=overrides,

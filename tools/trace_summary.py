@@ -1,7 +1,7 @@
 """Summarize a jax.profiler TensorBoard trace: top device ops by self time."""
 import glob, gzip, json, sys, collections
 
-root = sys.argv[1] if len(sys.argv) > 1 else "/tmp/prof_out"
+root = sys.argv[1] if len(sys.argv) > 1 else "/tmp/nexus_prof"
 paths = sorted(glob.glob(f"{root}/**/*.trace.json.gz", recursive=True))
 if not paths:
     sys.exit(f"no trace under {root}")
@@ -13,7 +13,9 @@ events = data.get("traceEvents", [])
 pid_names = {e["pid"]: e["args"].get("name", "") for e in events
              if e.get("ph") == "M" and e.get("name") == "process_name"}
 dev_pids = {p for p, n in pid_names.items()
-            if any(s in n.lower() for s in ("tpu", "device", "xla"))}
+            if any(s in n.lower() for s in ("tpu", "device", "xla", "cpu"))}
+if not dev_pids:  # unknown backend naming: fall back to every lane
+    dev_pids = set(pid_names)
 tot = collections.Counter()
 cnt = collections.Counter()
 span = [None, None]
