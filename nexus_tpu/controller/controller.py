@@ -109,7 +109,7 @@ class Controller:
         failure_rate_max_delay: float = 5.0,
         rate_limit_elements_per_second: float = 50.0,
         rate_limit_elements_burst: int = 300,
-        use_finalizers: bool = False,
+        use_finalizers: bool = True,
         resync_period: float = 30.0,
         queue_backend: str = "auto",
     ):

@@ -38,7 +38,7 @@ class AppConfig:
     rate_limit_elements_burst: int = 300
     # TPU-native extensions:
     statsd_address: str = ""  # "host:port" UDP or "unix:///path" DogStatsD
-    use_finalizers: bool = False
+    use_finalizers: bool = True
     resync_period_seconds: float = 30.0
     queue_backend: str = "auto"  # auto | native (C++) | python
     # Datadog log sink (the slog-datadog equivalent, reference main.go:43):

@@ -120,3 +120,55 @@ def test_shard_drift_repaired_by_resync(running_controller):
         ),
         timeout=10.0,
     ), "resync never repaired shard drift"
+
+
+def test_bulk_convergence_at_volume():
+    """Scale tier: 150 templates (sharing 3 secrets) across TWO shards
+    converge well inside the reference's operational envelope — the
+    default token bucket is 50 items/s with burst 300 (reference
+    .helm/values.yaml:165-169), so the initial flood fits the burst and
+    the whole fleet must be synced in seconds, not minutes."""
+    controller_store = ClusterStore("controller")
+    shard_stores = [ClusterStore("shard0"), ClusterStore("shard1")]
+    shards = [
+        Shard("bulk", f"shard{i}", s) for i, s in enumerate(shard_stores)
+    ]
+    controller = Controller(
+        controller_store, shards, statsd=StatsdClient("bulk"),
+        resync_period=5.0,
+    )
+    n = 150
+    secrets = [f"bulk-s{i}" for i in range(3)]
+    for s in secrets:
+        controller_store.create(make_secret(s, {"k": "v"}))
+    controller.run(workers=4)
+    try:
+        for i in range(n):
+            controller_store.create(
+                make_template(f"bulk-{i}", secrets=[secrets[i % 3]])
+            )
+
+        def all_synced():
+            for store in shard_stores:
+                if len(store.list(NexusAlgorithmTemplate.KIND, NS)) < n:
+                    return False
+            return True
+
+        assert wait_for(all_synced, timeout=45), (
+            f"only {[len(s.list(NexusAlgorithmTemplate.KIND, NS)) for s in shard_stores]}"
+            f"/{n} synced"
+        )
+        # every template Ready=True on the controller side
+        def all_ready():
+            for i in range(n):
+                tmpl = controller_store.get(
+                    NexusAlgorithmTemplate.KIND, NS, f"bulk-{i}"
+                )
+                conds = tmpl.status.conditions
+                if not conds or conds[0].status != "True":
+                    return False
+            return True
+
+        assert wait_for(all_ready, timeout=30), "not all templates Ready"
+    finally:
+        controller.stop()

@@ -107,6 +107,12 @@ class Fixture:
             self.shards,
             recorder=self.recorder,
             statsd=StatsdClient("test"),
+            # the action-level oracles in this file pin the REFERENCE's exact
+            # write sequence (inline delete fan-out, no finalizer update
+            # before the init condition — controller_test.go's checkAction);
+            # the finalizer mode that is the product default has its own
+            # tests below (test_finalizer_*) and the e2e/property tiers
+            use_finalizers=False,
         )
 
     @property

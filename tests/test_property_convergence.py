@@ -47,16 +47,11 @@ def _retry_conflict(fn, attempts=40):
     raise AssertionError("store conflict never cleared")
 
 
+from tests.test_controller_e2e import wait_for as _wait_for
+
+
 def _wait(pred, timeout=30.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        try:
-            if pred():
-                return True
-        except (NotFoundError, KeyError):
-            pass
-        time.sleep(0.05)
-    return False
+    return _wait_for(pred, timeout=timeout, interval=0.05)
 
 
 @settings(
@@ -74,12 +69,29 @@ def test_any_action_interleaving_converges(actions):
     )
     for s in SECRETS:
         ctrl.create(make_secret(s, {"rev": "0"}))
-    controller.run(workers=2)
+    # anchor templates hold a permanent ownerReference on each secret so the
+    # churned deletes below can never GC a secret via sole-owner removal
+    # (ownerReference cascading GC is real Kubernetes semantics the store
+    # mirrors, and is covered deterministically in test_controller_sync;
+    # HERE the property under test is spec/data convergence)
     live = {}  # name -> referenced secrets
+    for s in SECRETS:
+        anchor = f"anchor-{s}"
+        ctrl.create(make_template(anchor, secrets=[s]))
+        live[anchor] = (s,)
+    controller.run(workers=2)
     try:
         for kind, target, payload in actions:
             if kind == "create" and target not in live:
-                ctrl.create(make_template(target, secrets=payload))
+                # a finalizer-pending delete of the same name holds the slot
+                # until the controller finalizes — AlreadyExistsError is a
+                # ConflictError, so the retry loop waits it out
+                _retry_conflict(
+                    lambda t=target, p=payload: ctrl.create(
+                        make_template(t, secrets=p)
+                    ),
+                    attempts=200,
+                )
                 live[target] = tuple(payload)
             elif kind == "retag" and target in live:
                 def _do(t=target, rev=payload):

@@ -13,8 +13,8 @@ events = data.get("traceEvents", [])
 pid_names = {e["pid"]: e["args"].get("name", "") for e in events
              if e.get("ph") == "M" and e.get("name") == "process_name"}
 dev_pids = {p for p, n in pid_names.items()
-            if any(s in n.lower() for s in ("tpu", "device", "xla", "cpu"))}
-if not dev_pids:  # unknown backend naming: fall back to every lane
+            if any(s in n.lower() for s in ("tpu", "device", "xla"))}
+if not dev_pids:  # unknown backend naming (e.g. '/host:CPU'): use every lane
     dev_pids = set(pid_names)
 tot = collections.Counter()
 cnt = collections.Counter()
