@@ -273,7 +273,10 @@ def main() -> int:
     on_tpu = is_tpu()
     progress(f"backend up: {device_kind()} x{len(jax.devices())}")
     preset = os.environ.get("NEXUS_BENCH_PRESET") or ("400m" if on_tpu else "tiny")
-    steps = int(os.environ.get("NEXUS_BENCH_STEPS") or (15 if on_tpu else 6))
+    # 25 steps: with 2 untimed warmups, one-time program-load/caching on the
+    # tunnel path stays out of the window and the per-step average stabilizes
+    # (15-step runs showed ~0.7 s/step of unamortized one-time cost)
+    steps = int(os.environ.get("NEXUS_BENCH_STEPS") or (25 if on_tpu else 6))
     seq = int(os.environ.get("NEXUS_BENCH_SEQ") or (2048 if on_tpu else 64))
     _seq[0] = seq
     _cfg[0] = {"preset": preset, "seq": seq}

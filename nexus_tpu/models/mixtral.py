@@ -145,8 +145,9 @@ def logical_axes(cfg: MixtralConfig) -> Dict[str, Any]:
 
 
 def _moe_ffn(cfg: MixtralConfig, x: jnp.ndarray,
-             layer: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """x: (B, S, D) → (out, aux_loss)."""
+             layer: Dict[str, jnp.ndarray],
+             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """x: (B, S, D) → (out, aux_loss, dropped_fraction)."""
     b, s, d = x.shape
     t = b * s
     xf = x.reshape(t, d)
@@ -161,11 +162,11 @@ def _moe_ffn(cfg: MixtralConfig, x: jnp.ndarray,
     ) * jnp.einsum("ecd,edf->ecf", expert_in, layer["w_up"])
     expert_out = jnp.einsum("ecf,efd->ecd", gated, layer["w_down"])  # (E, C, D)
     out = moe_combine_dense(expert_out, routing).reshape(b, s, d)
-    return out.astype(cfg.dtype), routing.aux_loss
+    return out.astype(cfg.dtype), routing.aux_loss, routing.dropped_fraction
 
 
 def _block(cfg: MixtralConfig, carry, layer, cos, sin):
-    x, aux = carry
+    x, aux, dropped = carry
     b, s, d = x.shape
     hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -182,13 +183,14 @@ def _block(cfg: MixtralConfig, carry, layer, cos, sin):
     x = x + attn.reshape(b, s, hq * hd) @ layer["wo"]
 
     h2 = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
-    moe_out, layer_aux = _moe_ffn(cfg, h2, layer)
-    return (x + moe_out, aux + layer_aux)
+    moe_out, layer_aux, layer_dropped = _moe_ffn(cfg, h2, layer)
+    return (x + moe_out, aux + layer_aux, dropped + layer_dropped)
 
 
 def forward_hidden(params: Dict[str, Any], cfg: MixtralConfig,
-                   tokens: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """tokens (B, S) → (final-norm hidden (B, S, d), total_aux_loss)."""
+                   tokens: jnp.ndarray):
+    """tokens (B, S) → (final-norm hidden (B, S, d), layer-mean aux loss,
+    layer-mean dropped-selection fraction)."""
     b, s = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     cos, sin = rope_cos_sin(s, cfg.head_dim, cfg.rope_theta)
@@ -200,16 +202,23 @@ def forward_hidden(params: Dict[str, Any], cfg: MixtralConfig,
     def scan_body(carry, layer_params):
         return block(carry, layer_params, cos, sin), None
 
-    (x, aux), _ = lax.scan(
-        scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+    zero = jnp.zeros((), jnp.float32)
+    (x, aux, dropped), _ = lax.scan(
+        scan_body, (x, zero, zero), params["layers"]
     )
-    return rms_norm(x, params["final_norm"], cfg.norm_eps), aux
+    # both accumulators leave here layer-averaged so no caller has to
+    # remember a second normalization
+    return (
+        rms_norm(x, params["final_norm"], cfg.norm_eps),
+        aux / cfg.n_layers,
+        dropped / cfg.n_layers,
+    )
 
 
 def forward(params: Dict[str, Any], cfg: MixtralConfig,
             tokens: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """tokens (B, S) → (logits (B, S, V) fp32, total_aux_loss)."""
-    x, aux = forward_hidden(params, cfg, tokens)
+    """tokens (B, S) → (logits (B, S, V) fp32, layer-mean aux loss)."""
+    x, aux, _ = forward_hidden(params, cfg, tokens)
     return (x @ params["lm_head"]).astype(jnp.float32), aux
 
 
@@ -219,16 +228,17 @@ def loss_fn(params: Dict[str, Any], cfg: MixtralConfig,
 
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    hidden, aux = forward_hidden(params, cfg, inputs)
+    hidden, aux, dropped = forward_hidden(params, cfg, inputs)
     if cfg.ce_chunk > 0:
         ce = chunked_softmax_xent(
             hidden, params["lm_head"], targets, chunk=cfg.ce_chunk
         )
     else:
         ce = dense_softmax_xent(hidden, params["lm_head"], targets)
-    loss = ce + cfg.router_aux_weight * aux / cfg.n_layers
+    loss = ce + cfg.router_aux_weight * aux
     return loss, {"loss": loss, "ce": ce, "aux": aux,
-                  "perplexity": jnp.exp(ce)}
+                  "perplexity": jnp.exp(ce),
+                  "router_dropped_fraction": dropped}
 
 
 # ------------------------------------------------------------------ decode
@@ -251,7 +261,7 @@ def forward_decode(
     from nexus_tpu.models.decoding import scanned_forward_decode
 
     def moe_ffn(cfg, h, layer):
-        out, _ = _moe_ffn(cfg, h, layer)
+        out, _, _ = _moe_ffn(cfg, h, layer)
         return out
 
     return scanned_forward_decode(params, cfg, tokens, cache, moe_ffn)

@@ -20,6 +20,7 @@ class RoutingResult(NamedTuple):
     dispatch: jnp.ndarray  # (T, E, C) bool-as-float — dispatch mask
     aux_loss: jnp.ndarray  # scalar load-balancing loss
     router_probs: jnp.ndarray  # (T, E)
+    dropped_fraction: jnp.ndarray  # scalar: selections lost to capacity
 
 
 def top_k_routing(
@@ -57,7 +58,14 @@ def top_k_routing(
     slot = sel_mask[..., None] * cap_one_hot * in_capacity[..., None]
     dispatch = jnp.sum(slot, axis=1)  # (T,E,C)
     combine = jnp.sum(slot * top_probs[:, :, None, None], axis=1)  # (T,E,C)
-    return RoutingResult(combine, dispatch, aux_loss, probs)
+    # capacity-drop observability: fraction of (token, choice) selections
+    # that overflowed their expert's capacity — the quality cost of the
+    # static-shape dispatch; surfaces in train metrics as
+    # router_dropped_fraction so capacity_factor can be tuned from data
+    dropped = jnp.maximum(
+        0.0, 1.0 - jnp.sum(dispatch) / (t * num_selected)
+    )  # clamp f32 rounding noise
+    return RoutingResult(combine, dispatch, aux_loss, probs, dropped)
 
 
 def moe_dispatch_dense(

@@ -117,6 +117,8 @@ def test_mixtral_forward_and_loss_decreases():
     logits, aux = mixtral.forward(params, cfg, tokens)
     assert logits.shape == (2, 16, cfg.vocab_size)
     assert float(aux) > 0  # load-balance loss is active
+    _, metrics = mixtral.loss_fn(params, cfg, {"tokens": tokens})
+    assert 0.0 <= float(metrics["router_dropped_fraction"]) <= 1.0
 
     opt = optax.adam(1e-2)
     state = TrainState(params, opt.init(params), jnp.zeros((), jnp.int32))
