@@ -22,7 +22,9 @@ from nexus_tpu.ops.attention import attention
 from nexus_tpu.ops.moe import (
     default_capacity,
     moe_combine_dense,
+    moe_combine_scatter,
     moe_dispatch_dense,
+    moe_dispatch_scatter,
     top_k_routing,
 )
 from nexus_tpu.ops.norms import rms_norm
@@ -43,6 +45,11 @@ class MixtralConfig:
     n_experts_per_token: int = 2
     capacity_factor: float = 1.25
     router_aux_weight: float = 0.02
+    # 'einsum': dense one-hot dispatch/combine contractions (known-good
+    # SPMD partitioning along the expert axis); 'scatter': O(T·k·D)
+    # scatter/gather data movement instead of O(T²·D) MXU work — same
+    # numbers (ops/moe.py), partitioning quality is compiler-dependent
+    dispatch_impl: str = "einsum"
     rope_theta: float = 1000000.0
     norm_eps: float = 1e-5
     max_seq_len: int = 8192
@@ -156,12 +163,25 @@ def _moe_ffn(cfg: MixtralConfig, x: jnp.ndarray,
                            cfg.capacity_factor)
     routing = top_k_routing(router_logits, cfg.n_experts_per_token, cap)
 
-    expert_in = moe_dispatch_dense(xf, routing).astype(cfg.dtype)  # (E, C, D)
+    if cfg.dispatch_impl == "scatter":
+        expert_in = moe_dispatch_scatter(
+            xf, routing, cfg.n_experts, cap
+        ).astype(cfg.dtype)
+    elif cfg.dispatch_impl == "einsum":
+        expert_in = moe_dispatch_dense(xf, routing).astype(cfg.dtype)
+    else:
+        raise ValueError(
+            f"unknown dispatch_impl {cfg.dispatch_impl!r}; "
+            "expected 'einsum' or 'scatter'"
+        )
     gated = jax.nn.silu(
         jnp.einsum("ecd,edf->ecf", expert_in, layer["w_gate"])
     ) * jnp.einsum("ecd,edf->ecf", expert_in, layer["w_up"])
     expert_out = jnp.einsum("ecf,efd->ecd", gated, layer["w_down"])  # (E, C, D)
-    out = moe_combine_dense(expert_out, routing).reshape(b, s, d)
+    if cfg.dispatch_impl == "scatter":
+        out = moe_combine_scatter(expert_out, routing).reshape(b, s, d)
+    else:
+        out = moe_combine_dense(expert_out, routing).reshape(b, s, d)
     return out.astype(cfg.dtype), routing.aux_loss, routing.dropped_fraction
 
 
@@ -236,6 +256,9 @@ def loss_fn(params: Dict[str, Any], cfg: MixtralConfig,
     else:
         ce = dense_softmax_xent(hidden, params["lm_head"], targets)
     loss = ce + cfg.router_aux_weight * aux
+    # NB "aux" is the LAYER-MEAN load-balance loss (it was the layer-sum
+    # before router_dropped_fraction landed) — trend dashboards comparing
+    # across that change see a 1/n_layers step with no routing change
     return loss, {"loss": loss, "ce": ce, "aux": aux,
                   "perplexity": jnp.exp(ce),
                   "router_dropped_fraction": dropped}

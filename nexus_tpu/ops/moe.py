@@ -21,6 +21,11 @@ class RoutingResult(NamedTuple):
     aux_loss: jnp.ndarray  # scalar load-balancing loss
     router_probs: jnp.ndarray  # (T, E)
     dropped_fraction: jnp.ndarray  # scalar: selections lost to capacity
+    # index form of the same assignment (the scatter/gather path):
+    expert_index: jnp.ndarray  # (T, k) int32 — chosen expert per selection
+    slot_index: jnp.ndarray  # (T, k) int32 — capacity slot (clamped)
+    valid: jnp.ndarray  # (T, k) f32 1/0 — selection survived capacity
+    weights: jnp.ndarray  # (T, k) f32 — renormalized combine weights
 
 
 def top_k_routing(
@@ -62,10 +67,19 @@ def top_k_routing(
     # that overflowed their expert's capacity — the quality cost of the
     # static-shape dispatch; surfaces in train metrics as
     # router_dropped_fraction so capacity_factor can be tuned from data
+    slot_idx = jnp.sum(pos_clamped * sel_mask.astype(jnp.int32), axis=-1)
+    valid = jnp.sum(in_capacity.astype(jnp.float32) * sel_mask, axis=-1)
+    # derive the drop metric from the index-form `valid` (identical count to
+    # sum(dispatch)) so the scatter path leaves no live consumer of the
+    # dense (T,E,C) tensors and XLA can DCE them entirely
     dropped = jnp.maximum(
-        0.0, 1.0 - jnp.sum(dispatch) / (t * num_selected)
+        0.0, 1.0 - jnp.sum(valid) / (t * num_selected)
     )  # clamp f32 rounding noise
-    return RoutingResult(combine, dispatch, aux_loss, probs, dropped)
+    return RoutingResult(
+        combine, dispatch, aux_loss, probs, dropped,
+        top_idx.astype(jnp.int32), slot_idx.astype(jnp.int32), valid,
+        top_probs,
+    )
 
 
 def moe_dispatch_dense(
@@ -82,6 +96,54 @@ def moe_combine_dense(
 ) -> jnp.ndarray:
     """Expert buffers → tokens: (E, C, D) × (T, E, C) → (T, D)."""
     return jnp.einsum("ecd,tec->td", expert_out, routing.combine.astype(expert_out.dtype))
+
+
+def moe_dispatch_scatter(
+    x: jnp.ndarray,
+    routing: RoutingResult,
+    num_experts: int,
+    capacity: int,
+) -> jnp.ndarray:
+    """Token → expert buffers via scatter-add: O(T·k·D) data movement.
+
+    The einsum path (moe_dispatch_dense) runs a (T,E,C)×(T,D) contraction —
+    with E·C ≈ k·cf·T that is O(T²·D) MXU work, a third of the whole MoE
+    layer's FLOPs at Mixtral scale. This path just *moves* each selected
+    token into its (expert, slot): each destination receives at most one
+    selection (slot assignment is a per-expert running count), so the
+    scatter-add never actually accumulates. Numerically identical to the
+    dense path (tests/test_ops.py parity, values and gradients).
+
+    Default stays 'einsum' (MixtralConfig.dispatch_impl): under pjit the
+    einsums have known-good SPMD partitionings along the expert axis,
+    while a sharded scatter's partitioning is compiler-dependent — flip
+    per model once profiled on the target mesh."""
+    t, k = routing.expert_index.shape
+    d = x.shape[-1]
+    flat_dest = (
+        routing.expert_index * capacity + routing.slot_index
+    ).reshape(t * k)
+    contrib = (
+        x[:, None, :] * routing.valid[..., None].astype(x.dtype)
+    ).reshape(t * k, d)
+    buf = jnp.zeros((num_experts * capacity, d), x.dtype)
+    buf = buf.at[flat_dest].add(contrib, mode="drop")
+    return buf.reshape(num_experts, capacity, d)
+
+
+def moe_combine_scatter(
+    expert_out: jnp.ndarray,
+    routing: RoutingResult,
+) -> jnp.ndarray:
+    """Expert buffers → tokens via gather + weighted sum over the k
+    selections (inverse of moe_dispatch_scatter)."""
+    e, c, d = expert_out.shape
+    t, k = routing.expert_index.shape
+    flat = expert_out.reshape(e * c, d)
+    flat_src = (routing.expert_index * c + routing.slot_index).reshape(t * k)
+    gathered = flat[flat_src].reshape(t, k, d).astype(jnp.float32)
+    w = (routing.weights * routing.valid)[..., None]
+    return jnp.sum(gathered * w, axis=1).astype(expert_out.dtype)
 
 
 def default_capacity(
