@@ -33,7 +33,7 @@ def init_kv_cache(
 
 def _decode_attention(
     q: jnp.ndarray, k_buf: jnp.ndarray, v_buf: jnp.ndarray,
-    start: jnp.ndarray,
+    start: jnp.ndarray, window: int = 0,
 ) -> jnp.ndarray:
     """Length-masked attention of q's tokens over the full cache buffer.
 
@@ -52,6 +52,10 @@ def _decode_attention(
     ) * hd ** -0.5  # (B, Hkv, rep, T, L)
     q_pos = start + jnp.arange(t)
     visible = jnp.arange(max_len)[None, :] <= q_pos[:, None]  # (t, max_len)
+    if window > 0:  # sliding-window attention: newest `window` positions
+        visible = visible & (
+            jnp.arange(max_len)[None, :] > q_pos[:, None] - window
+        )
     mask_value = -0.7 * float(jnp.finfo(jnp.float32).max)
     logits = jnp.where(visible[None, None, None], logits, mask_value)
     probs = jax.nn.softmax(logits, axis=-1).astype(v_buf.dtype)
@@ -103,7 +107,10 @@ def generic_forward_decode(
             k_buf = lax.dynamic_update_slice_in_dim(k_cache, k, start, axis=1)
             v_buf = lax.dynamic_update_slice_in_dim(v_cache, v, start, axis=1)
             calls.append((k_buf, v_buf))
-            return _decode_attention(q, k_buf, v_buf, start)
+            return _decode_attention(
+                q, k_buf, v_buf, start,
+                window=getattr(cfg, "sliding_window", 0),
+            )
 
         x = layer_fn(cfg, x, layer, attend, cos, sin)
         if len(calls) != 1:

@@ -58,6 +58,10 @@ class MixtralConfig:
     remat: bool = False
     remat_policy: str = "full"  # 'full' | 'dots' (see models/llama.py)
     ce_chunk: int = 0  # vocab-chunked exact CE (ops/losses.py); 0 = dense
+    # sliding-window attention (the Mixtral-8x7B convention, window 4096):
+    # each position attends to the newest `sliding_window` positions only;
+    # 0 = full causal. Flash kernels skip out-of-window tiles entirely.
+    sliding_window: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -87,6 +91,9 @@ PRESETS: Dict[str, Dict[str, Any]] = {
     "tiny": dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
                  n_kv_heads=2, d_ff=128, n_experts=4, max_seq_len=512),
     # Mixtral-8x7B dims (public): d 4096, L 32, H 32, KV 8, ff 14336, E 8 top2
+    # NB sliding_window=4096 matches the public Mixtral-8x7B convention but
+    # stays OPT-IN (override it per template): the fan-out example runs this
+    # preset with ring context parallelism, which does not support windows
     "8x7b": dict(vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
                  n_kv_heads=8, d_ff=14336, n_experts=8,
                  n_experts_per_token=2, max_seq_len=32768),
@@ -195,11 +202,19 @@ def _block(cfg: MixtralConfig, carry, layer, cos, sin):
     k = apply_rope((h @ layer["wk"]).reshape(b, s, hkv, hd), cos, sin)
     v = (h @ layer["wv"]).reshape(b, s, hkv, hd)
     if cfg.attn_impl == "ring":
+        if cfg.sliding_window:
+            raise ValueError(
+                "sliding_window with ring attention is not supported yet — "
+                "use full-window ring or a non-ring impl"
+            )
         # context parallelism over the 'sequence' mesh axis (same shared
         # entry the llama block uses)
         attn = ring_attention_sharded(q, k, v)
     else:
-        attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        attn = attention(
+            q, k, v, causal=True, impl=cfg.attn_impl,
+            window=cfg.sliding_window,
+        )
     x = x + attn.reshape(b, s, hq * hd) @ layer["wo"]
 
     h2 = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)

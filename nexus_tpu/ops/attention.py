@@ -48,16 +48,25 @@ def _kv_row(bh, hq: int, hkv: int, n_rep: int):
 
 
 def _tile_needed(i, j, *, block_q: int, block_k: int, q_offset: int,
-                 causal: bool):
-    """Does k-tile ``j`` intersect the causal triangle of q-tile ``i``?
+                 causal: bool, window: int = 0):
+    """Does k-tile ``j`` intersect the visible band of q-tile ``i``?
 
     Shared by the fwd / bwd-dq / bwd-dkv kernels (the dkv kernel calls it
-    with the same (i, j) semantics — i is always the q tile). A tile is
-    needed iff its smallest k position is visible to the q tile's largest
-    row: ``j*block_k <= i*block_q + block_q - 1 + q_offset``."""
+    with the same (i, j) semantics — i is always the q tile). Causal upper
+    bound: the tile's smallest k position must be visible to the q tile's
+    largest row (``j*block_k <= i*block_q + block_q - 1 + q_offset``).
+    ``window > 0`` (sliding-window attention) adds the lower bound: the
+    tile's largest k position must be inside the newest row's window."""
     if not causal:
         return True
-    return j * block_k <= i * block_q + (block_q - 1) + q_offset
+    needed = j * block_k <= i * block_q + (block_q - 1) + q_offset
+    if window > 0:
+        # newest visible position for the tile's smallest q row is
+        # i*block_q + q_offset; its window floor is that - window + 1
+        needed = needed & (
+            j * block_k + (block_k - 1) > i * block_q + q_offset - window
+        )
+    return needed
 
 
 def _last_needed_k_tile(i, *, block_q: int, block_k: int, q_offset: int):
@@ -72,6 +81,24 @@ def _last_needed_k_tile(i, *, block_q: int, block_k: int, q_offset: int):
 def _first_needed_q_tile(j, *, block_q: int, block_k: int, q_offset: int):
     """Smallest q-tile index whose causal triangle touches k-tile ``j``."""
     return jnp.maximum(j * block_k - q_offset, 0) // block_q
+
+
+def _first_windowed_k_tile(i, *, block_q: int, block_k: int, q_offset: int,
+                           window: int):
+    """Smallest k-tile index inside q-tile ``i``'s sliding window (the
+    lower-bound mirror of _last_needed_k_tile): the newest row's window
+    floor is ``i*block_q + q_offset - window + 1``."""
+    return jnp.maximum(
+        (i * block_q + q_offset - window + 1) // block_k, 0
+    )
+
+
+def _last_windowed_q_tile(j, *, block_q: int, block_k: int, q_offset: int,
+                          window: int, n_q_tiles: int):
+    """Largest q-tile index whose window still reaches k-tile ``j``:
+    needed iff ``j*block_k + block_k - 1 > i*block_q + q_offset - window``."""
+    bound = (j * block_k + block_k - 1 + window - 1 - q_offset) // block_q
+    return jnp.clip(bound, 0, n_q_tiles - 1)
 
 
 def _repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
@@ -91,11 +118,14 @@ def attention_xla(
     causal: bool = True,
     q_offset: int = 0,
     mask_value: float = DEFAULT_MASK_VALUE,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Reference attention. q: (B, Sq, Hq, D); k/v: (B, Skv, Hkv, D).
 
     ``q_offset``: global position of q[0] relative to k[0] (decode-time
-    steps and sequence-parallel shards pass nonzero offsets)."""
+    steps and sequence-parallel shards pass nonzero offsets). ``window > 0``
+    restricts each row to the newest ``window`` positions (sliding-window
+    attention, the Mixtral-8x7B convention; requires ``causal``)."""
     n_rep = q.shape[2] // k.shape[2]
     k = _repeat_kv(k, n_rep)
     v = _repeat_kv(v, n_rep)
@@ -107,7 +137,12 @@ def attention_xla(
         sq, sk = q.shape[1], k.shape[1]
         rows = lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + q_offset
         cols = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        logits = jnp.where(cols <= rows, logits, mask_value)
+        visible = cols <= rows
+        if window > 0:
+            visible = visible & (cols > rows - window)
+        logits = jnp.where(visible, logits, mask_value)
+    elif window > 0:
+        raise ValueError("window requires causal attention")
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -118,6 +153,7 @@ def attention_xla(
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, scale: float, causal: bool, block_q: int, block_k: int, q_offset: int,
+    window: int = 0,
 ):
     i = pl.program_id(1)  # q block
     j = pl.program_id(2)  # k block
@@ -136,7 +172,7 @@ def _flash_kernel(
     # index so Pallas sees a no-op DMA). Exact: accumulators are untouched.
     needed = _tile_needed(
         i, j, block_q=block_q, block_k=block_k, q_offset=q_offset,
-        causal=causal,
+        causal=causal, window=window,
     )
 
     @pl.when(needed)
@@ -153,7 +189,10 @@ def _flash_kernel(
                 + i * block_q + q_offset
             )
             cols = lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * block_k
-            s = jnp.where(cols <= rows, s, DEFAULT_MASK_VALUE)
+            visible = cols <= rows
+            if window > 0:
+                visible = jnp.logical_and(visible, cols > rows - window)
+            s = jnp.where(visible, s, DEFAULT_MASK_VALUE)
 
         m_prev = m_ref[:, :1]  # (block_q, 1)
         l_prev = l_ref[:, :1]
@@ -213,6 +252,7 @@ def flash_attention(
     block_q: int = 1024,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Pallas flash attention. Same signature/semantics as attention_xla.
 
@@ -223,7 +263,9 @@ def flash_attention(
         from nexus_tpu.utils.hw import is_tpu
 
         interpret = not is_tpu()
-    return _flash(q, k, v, (causal, q_offset, block_q, block_k, interpret))
+    return _flash(
+        q, k, v, (causal, q_offset, block_q, block_k, interpret, window)
+    )
 
 
 def flash_attention_lse(
@@ -235,6 +277,7 @@ def flash_attention_lse(
     block_q: int = 1024,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    window: int = 0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Flash attention that ALSO returns the per-row logsumexp as a
     differentiable output: (out (B,Sq,Hq,D), lse (B,Sq,Hq) f32).
@@ -249,7 +292,9 @@ def flash_attention_lse(
         from nexus_tpu.utils.hw import is_tpu
 
         interpret = not is_tpu()
-    return _flash_lse(q, k, v, (causal, q_offset, block_q, block_k, interpret))
+    return _flash_lse(
+        q, k, v, (causal, q_offset, block_q, block_k, interpret, window)
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -317,7 +362,9 @@ def _out_struct(shape, dtype, like):
 
 
 def _flash_impl(q, k, v, opts):
-    causal, q_offset, block_q, block_k, interpret = opts
+    causal, q_offset, block_q, block_k, interpret, window = opts
+    if window > 0 and not causal:
+        raise ValueError("window requires causal attention")
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
     n_rep = hq // hkv
@@ -352,6 +399,7 @@ def _flash_impl(q, k, v, opts):
         block_q=block_q,
         block_k=block_k,
         q_offset=q_offset,
+        window=window,
     )
 
     # clamp skipped k tiles onto the last needed one: Pallas elides the DMA
@@ -359,16 +407,24 @@ def _flash_impl(q, k, v, opts):
     # neither FLOPs (pl.when in the kernel) nor HBM fetches
     if causal:
         def kv_index(bh, i, j):
-            return (
-                kv_row(bh),
-                jnp.minimum(
-                    j,
-                    _last_needed_k_tile(
-                        i, block_q=block_q, block_k=block_k, q_offset=q_offset
-                    ),
+            jc = jnp.minimum(
+                j,
+                _last_needed_k_tile(
+                    i, block_q=block_q, block_k=block_k, q_offset=q_offset
                 ),
-                0,
             )
+            if window > 0:
+                # pre-window tiles repeat the first in-window index so
+                # their DMAs are elided alongside the pl.when-skipped
+                # compute (the window mirror of the causal upper clamp)
+                jc = jnp.maximum(
+                    jc,
+                    _first_windowed_k_tile(
+                        i, block_q=block_q, block_k=block_k,
+                        q_offset=q_offset, window=window,
+                    ),
+                )
+            return (kv_row(bh), jc, 0)
     else:
         def kv_index(bh, i, j):
             return (kv_row(bh), j, 0)
@@ -414,7 +470,8 @@ def _flash_impl(q, k, v, opts):
 # materialization (the previous backward fell back to the XLA einsum path).
 
 
-def _flash_bwd_p(q, k, lse, *, scale, causal, i, j, block_q, block_k, q_offset):
+def _flash_bwd_p(q, k, lse, *, scale, causal, i, j, block_q, block_k,
+                 q_offset, window=0):
     """Recompute the (block_q, block_k) probability tile. ``lse``:
     (block_q, 1) column vector (lane 0 of the lane-broadcast buffer)."""
     s = jax.lax.dot_general(
@@ -424,13 +481,16 @@ def _flash_bwd_p(q, k, lse, *, scale, causal, i, j, block_q, block_k, q_offset):
     if causal:
         rows = lax.broadcasted_iota(jnp.int32, p.shape, 0) + i * block_q + q_offset
         cols = lax.broadcasted_iota(jnp.int32, p.shape, 1) + j * block_k
-        p = jnp.where(cols <= rows, p, 0.0)
+        visible = cols <= rows
+        if window > 0:
+            visible = jnp.logical_and(visible, cols > rows - window)
+        p = jnp.where(visible, p, 0.0)
     return p
 
 
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
-    *, scale, causal, block_q, block_k, q_offset,
+    *, scale, causal, block_q, block_k, q_offset, window=0,
 ):
     i = pl.program_id(1)  # q block (parallel)
     j = pl.program_id(2)  # k block (sequential accumulation)
@@ -442,7 +502,7 @@ def _flash_bwd_dq_kernel(
 
     needed = _tile_needed(
         i, j, block_q=block_q, block_k=block_k, q_offset=q_offset,
-        causal=causal,
+        causal=causal, window=window,
     )
 
     @pl.when(needed)
@@ -452,6 +512,7 @@ def _flash_bwd_dq_kernel(
         p = _flash_bwd_p(
             q, k, lse, scale=scale, causal=causal, i=i, j=j,
             block_q=block_q, block_k=block_k, q_offset=q_offset,
+            window=window,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -470,7 +531,7 @@ def _flash_bwd_dq_kernel(
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc_ref, dv_acc_ref,
-    *, scale, causal, block_q, block_k, q_offset, n_rep,
+    *, scale, causal, block_q, block_k, q_offset, n_rep, window=0,
 ):
     j = pl.program_id(1)  # k block (parallel, one per KV head row)
     # sequential dim enumerates (q tile, query-head group member): the
@@ -494,7 +555,7 @@ def _flash_bwd_dkv_kernel(
     # a q tile entirely above the diagonal sees P == 0 for this k tile
     needed = _tile_needed(
         i, j, block_q=block_q, block_k=block_k, q_offset=q_offset,
-        causal=causal,
+        causal=causal, window=window,
     )
 
     @pl.when(needed)
@@ -504,6 +565,7 @@ def _flash_bwd_dkv_kernel(
         p = _flash_bwd_p(
             q, k, lse, scale=scale, causal=causal, i=i, j=j,
             block_q=block_q, block_k=block_k, q_offset=q_offset,
+            window=window,
         )
         dv_acc_ref[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -525,7 +587,7 @@ def _flash_bwd_dkv_kernel(
 
 
 def _flash_bwd_impl(q, k, v, out, lse, g, opts, g_lse=None):
-    causal, q_offset, block_q, block_k, interpret = opts
+    causal, q_offset, block_q, block_k, interpret, window = opts
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
     n_rep = hq // hkv
@@ -559,7 +621,7 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts, g_lse=None):
 
     common = dict(
         scale=d ** -0.5, causal=causal,
-        block_q=block_q, block_k=block_k, q_offset=q_offset,
+        block_q=block_q, block_k=block_k, q_offset=q_offset, window=window,
     )
 
     # clamped index maps mirror the forward kernel: skipped tiles repeat the
@@ -567,18 +629,27 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts, g_lse=None):
     # DMAs are elided alongside the pl.when-skipped compute
     if causal:
         def kj(i, j):
-            return jnp.minimum(
+            jc = jnp.minimum(
                 j,
                 _last_needed_k_tile(
                     i, block_q=block_q, block_k=block_k, q_offset=q_offset
                 ),
             )
+            if window > 0:
+                jc = jnp.maximum(
+                    jc,
+                    _first_windowed_k_tile(
+                        i, block_q=block_q, block_k=block_k,
+                        q_offset=q_offset, window=window,
+                    ),
+                )
+            return jc
 
         def qi(j, i):
             # upper clamp: a k tile past every q row (sk > sq + offset)
             # would otherwise request an out-of-range q block — its compute
             # is skipped anyway, any valid block satisfies the fetch
-            return jnp.minimum(
+            ic = jnp.minimum(
                 jnp.maximum(
                     i,
                     _first_needed_q_tile(
@@ -587,6 +658,18 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts, g_lse=None):
                 ),
                 sq // block_q - 1,
             )
+            if window > 0:
+                # post-window q tiles (too new to see this k tile) repeat
+                # the last in-window q tile
+                ic = jnp.minimum(
+                    ic,
+                    _last_windowed_q_tile(
+                        j, block_q=block_q, block_k=block_k,
+                        q_offset=q_offset, window=window,
+                        n_q_tiles=sq // block_q,
+                    ),
+                )
+            return ic
     else:
         def kj(i, j):
             return j
@@ -661,6 +744,7 @@ def attention(
     causal: bool = True,
     q_offset: int = 0,
     impl: Optional[str] = None,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Dispatching entry point: impl in {None (auto), 'xla', 'flash'}."""
     if impl is None:
@@ -674,9 +758,13 @@ def attention(
         )
         impl = "flash" if (is_tpu() and tile_ok) else "xla"
     if impl == "flash":
-        return flash_attention(q, k, v, causal=causal, q_offset=q_offset)
+        return flash_attention(
+            q, k, v, causal=causal, q_offset=q_offset, window=window
+        )
     if impl == "xla":
-        return attention_xla(q, k, v, causal=causal, q_offset=q_offset)
+        return attention_xla(
+            q, k, v, causal=causal, q_offset=q_offset, window=window
+        )
     # 'ring' must go through ops.ring_attention.ring_attention_sharded (the
     # model blocks dispatch it); silently degrading an unknown impl to the
     # dense path would hide a real configuration error

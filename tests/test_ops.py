@@ -432,3 +432,54 @@ def test_mixtral_scatter_dispatch_end_to_end():
     for a, b_ in zip(jax.tree_util.tree_leaves(ge), jax.tree_util.tree_leaves(gs)):
         np.testing.assert_allclose(np.array(a), np.array(b_),
                                    rtol=5e-4, atol=1e-5)
+
+
+def test_sliding_window_attention_parity():
+    """window masking: XLA == flash (values + grads), and a window larger
+    than the sequence equals full causal attention."""
+    key = jax.random.PRNGKey(5)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, s, hq, hkv, d = 1, 256, 4, 2, 64
+    q = jax.random.normal(kq, (b, s, hq, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, hkv, d), jnp.float32)
+
+    for w in (64, 100):
+        ref = attention_xla(q, k, v, causal=True, window=w)
+        got = flash_attention(q, k, v, causal=True, window=w,
+                              block_q=64, block_k=64, interpret=True)
+        np.testing.assert_allclose(np.array(got), np.array(ref),
+                                   rtol=2e-3, atol=2e-3)
+        gx = jax.grad(lambda q, k, v: jnp.sum(
+            attention_xla(q, k, v, causal=True, window=w) ** 2
+        ), argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True, window=w,
+                            block_q=64, block_k=64, interpret=True) ** 2
+        ), argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gf, gx):
+            np.testing.assert_allclose(np.array(a), np.array(b_),
+                                       rtol=5e-3, atol=5e-3)
+
+    full = attention_xla(q, k, v, causal=True)
+    wide = attention_xla(q, k, v, causal=True, window=s + 7)
+    np.testing.assert_allclose(np.array(wide), np.array(full), rtol=1e-6)
+
+
+def test_sliding_window_decode_matches_forward():
+    """Mixtral-style sliding window: KV-cache decode == full forward with
+    the same window (both paths mask identically)."""
+    from nexus_tpu.models import mixtral
+
+    cfg = mixtral.config("tiny", dtype=jnp.float32, sliding_window=6)
+    params = mixtral.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0,
+                                cfg.vocab_size)
+    full, _ = mixtral.forward(params, cfg, tokens)
+    cache = mixtral.init_kv_cache(cfg, 2, 16)
+    # MoE capacity differs between prefill and single-token decode (see
+    # test_mixtral_decode_and_generate) — compare the prefill path, which
+    # routes the same token set as the forward
+    pre, cache = mixtral.forward_decode(params, cfg, tokens, cache)
+    np.testing.assert_allclose(np.array(pre), np.array(full),
+                               rtol=5e-3, atol=5e-3)
