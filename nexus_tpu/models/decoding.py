@@ -21,19 +21,42 @@ from nexus_tpu.ops.sampling import sample_logits
 
 def init_kv_cache(
     n_layers: int, n_kv_heads: int, head_dim: int, dtype,
-    batch: int, max_len: int,
+    batch: int, max_len: int, quantized: bool = False,
 ) -> Dict[str, Any]:
+    """KV append buffer. ``quantized=True`` stores K/V as int8 with a
+    per-(position, head) f32 scale — half the cache RESIDENCY vs bf16, and
+    half the read traffic when XLA fuses the dequant into the attention
+    reads (to be confirmed by an on-chip profile before leaning on it for
+    the decode-throughput numbers). Layout matches the fp cache so the
+    scaffold treats both uniformly."""
     shape = (n_layers, batch, max_len, n_kv_heads, head_dim)
-    return {
-        "k": jnp.zeros(shape, dtype),
-        "v": jnp.zeros(shape, dtype),
-        "length": jnp.zeros((), jnp.int32),
-    }
+    cache: Dict[str, Any] = {"length": jnp.zeros((), jnp.int32)}
+    if quantized:
+        cache["k"] = jnp.zeros(shape, jnp.int8)
+        cache["v"] = jnp.zeros(shape, jnp.int8)
+        scale_shape = (n_layers, batch, max_len, n_kv_heads)
+        cache["k_scale"] = jnp.zeros(scale_shape, jnp.float32)
+        cache["v_scale"] = jnp.zeros(scale_shape, jnp.float32)
+    else:
+        cache["k"] = jnp.zeros(shape, dtype)
+        cache["v"] = jnp.zeros(shape, dtype)
+    return cache
+
+
+def _quantize_kv(x: jnp.ndarray):
+    """(B, T, H, D) → (int8 values, (B, T, H) f32 per-vector scales)."""
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / safe[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
 
 
 def _decode_attention(
     q: jnp.ndarray, k_buf: jnp.ndarray, v_buf: jnp.ndarray,
     start: jnp.ndarray, window: int = 0,
+    k_scale=None, v_scale=None,
 ) -> jnp.ndarray:
     """Length-masked attention of q's tokens over the full cache buffer.
 
@@ -46,6 +69,16 @@ def _decode_attention(
     max_len = k_buf.shape[1]
     hkv = k_buf.shape[2]
     n_rep = hq // hkv
+    if k_scale is not None:
+        # int8 cache: dequantize at the model's compute width (bf16), not
+        # f32 — if XLA fails to fuse the convert+scale into the dot read,
+        # the materialized temporary is then no wider than the fp cache
+        k_buf = (
+            k_buf.astype(jnp.float32) * k_scale[..., None]
+        ).astype(q.dtype)
+        v_buf = (
+            v_buf.astype(jnp.float32) * v_scale[..., None]
+        ).astype(q.dtype)
     qg = q.reshape(b, t, hkv, n_rep, hd)
     logits = jnp.einsum(
         "btgrd,bkgd->bgrtk", qg, k_buf, preferred_element_type=jnp.float32
@@ -60,7 +93,7 @@ def _decode_attention(
     logits = jnp.where(visible[None, None, None], logits, mask_value)
     probs = jax.nn.softmax(logits, axis=-1).astype(v_buf.dtype)
     out = jnp.einsum("bgrtk,bkgd->btgrd", probs, v_buf)
-    return out.reshape(b, t, hq, hd)
+    return out.reshape(b, t, hq, hd).astype(q.dtype)
 
 
 def generic_forward_decode(
@@ -99,18 +132,44 @@ def generic_forward_decode(
     cos = lax.dynamic_slice_in_dim(cos_full, start, t, axis=0)
     sin = lax.dynamic_slice_in_dim(sin_full, start, t, axis=0)
 
+    quantized = "k_scale" in cache
+    scan_xs = (params["layers"], cache["k"], cache["v"]) + (
+        (cache["k_scale"], cache["v_scale"]) if quantized else ()
+    )
+
     def layer_step(x, scanned):
-        layer, k_cache, v_cache = scanned
+        if quantized:
+            layer, k_cache, v_cache, ks_cache, vs_cache = scanned
+        else:
+            layer, k_cache, v_cache = scanned
         calls = []
 
         def attend(q, k, v):
+            window = getattr(cfg, "sliding_window", 0)
+            if quantized:
+                kq, ks = _quantize_kv(k)
+                vq, vs = _quantize_kv(v)
+                k_buf = lax.dynamic_update_slice_in_dim(
+                    k_cache, kq, start, axis=1
+                )
+                v_buf = lax.dynamic_update_slice_in_dim(
+                    v_cache, vq, start, axis=1
+                )
+                ks_buf = lax.dynamic_update_slice_in_dim(
+                    ks_cache, ks, start, axis=1
+                )
+                vs_buf = lax.dynamic_update_slice_in_dim(
+                    vs_cache, vs, start, axis=1
+                )
+                calls.append((k_buf, v_buf, ks_buf, vs_buf))
+                return _decode_attention(
+                    q, k_buf, v_buf, start, window=window,
+                    k_scale=ks_buf, v_scale=vs_buf,
+                )
             k_buf = lax.dynamic_update_slice_in_dim(k_cache, k, start, axis=1)
             v_buf = lax.dynamic_update_slice_in_dim(v_cache, v, start, axis=1)
             calls.append((k_buf, v_buf))
-            return _decode_attention(
-                q, k_buf, v_buf, start,
-                window=getattr(cfg, "sliding_window", 0),
-            )
+            return _decode_attention(q, k_buf, v_buf, start, window=window)
 
         x = layer_fn(cfg, x, layer, attend, cos, sin)
         if len(calls) != 1:
@@ -122,15 +181,16 @@ def generic_forward_decode(
             )
         return x, calls[0]
 
-    x, (new_k, new_v) = lax.scan(
-        layer_step, x, (params["layers"], cache["k"], cache["v"])
-    )
+    x, new_bufs = lax.scan(layer_step, x, scan_xs)
     if finalize is None:
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     else:
         x = finalize(params, x)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
-    return logits, {"k": new_k, "v": new_v, "length": start + t}
+    new_cache = {"k": new_bufs[0], "v": new_bufs[1], "length": start + t}
+    if quantized:
+        new_cache["k_scale"], new_cache["v_scale"] = new_bufs[2], new_bufs[3]
+    return logits, new_cache
 
 
 def scanned_forward_decode(
@@ -198,14 +258,17 @@ def autoregressive_generate(
             f"cfg.max_seq_len={cfg.max_seq_len}"
         )
     cache = init_kv_cache(
-        cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype, b, max_len
+        cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype, b, max_len,
+        quantized=getattr(cfg, "kv_cache_quantized", False),
     )
     if cache_sharding is not None:
-        cache = {
-            "k": lax.with_sharding_constraint(cache["k"], cache_sharding),
-            "v": lax.with_sharding_constraint(cache["v"], cache_sharding),
-            "length": cache["length"],
-        }
+        cache = dict(cache)
+        for key_ in ("k", "v"):
+            cache[key_] = lax.with_sharding_constraint(
+                cache[key_], cache_sharding
+            )
+        # the per-vector scales are head_dim-times smaller; leave them to
+        # the compiler rather than reshaping the kv sharding spec
 
     def pick(logits, step_idx):
         k = None if key is None else jax.random.fold_in(key, step_idx)

@@ -49,6 +49,9 @@ class GPTNeoXConfig:
     attn_impl: Optional[str] = None  # None=auto | 'xla' | 'flash' | 'ring'
     remat: bool = False
     remat_policy: str = "full"
+    # int8 KV cache for decode (half the per-step cache HBM traffic at a
+    # small quantization-noise cost); models/decoding.py
+    kv_cache_quantized: bool = False
     ce_chunk: int = 0
 
     @property
@@ -264,7 +267,8 @@ def init_kv_cache(cfg: GPTNeoXConfig, batch: int, max_len: int) -> Dict[str, Any
     from nexus_tpu.models.decoding import init_kv_cache as _init
 
     return _init(
-        cfg.n_layers, cfg.n_heads, cfg.head_dim, cfg.dtype, batch, max_len
+        cfg.n_layers, cfg.n_heads, cfg.head_dim, cfg.dtype, batch, max_len,
+        quantized=cfg.kv_cache_quantized,
     )
 
 

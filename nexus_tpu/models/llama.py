@@ -55,6 +55,9 @@ class LlamaConfig:
     #            memory win at a few % recompute cost — the right default
     #            when activations almost fit)
     remat_policy: str = "full"
+    # int8 KV cache for decode (half the per-step cache HBM traffic at a
+    # small quantization-noise cost); models/decoding.py
+    kv_cache_quantized: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -232,7 +235,8 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Dict[str, Any]:
     from nexus_tpu.models.decoding import init_kv_cache as _init
 
     return _init(
-        cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype, batch, max_len
+        cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype, batch, max_len,
+        quantized=cfg.kv_cache_quantized,
     )
 
 

@@ -62,6 +62,8 @@ class MixtralConfig:
     # each position attends to the newest `sliding_window` positions only;
     # 0 = full causal. Flash kernels skip out-of-window tiles entirely.
     sliding_window: int = 0
+    # int8 KV cache for decode (models/decoding.py)
+    kv_cache_quantized: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -286,7 +288,8 @@ def init_kv_cache(cfg: MixtralConfig, batch: int, max_len: int) -> Dict[str, Any
     from nexus_tpu.models.decoding import init_kv_cache as _init
 
     return _init(
-        cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype, batch, max_len
+        cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype, batch, max_len,
+        quantized=cfg.kv_cache_quantized,
     )
 
 

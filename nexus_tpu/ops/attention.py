@@ -56,7 +56,9 @@ def _tile_needed(i, j, *, block_q: int, block_k: int, q_offset: int,
     bound: the tile's smallest k position must be visible to the q tile's
     largest row (``j*block_k <= i*block_q + block_q - 1 + q_offset``).
     ``window > 0`` (sliding-window attention) adds the lower bound: the
-    tile's largest k position must be inside the newest row's window."""
+    tile's largest k position must be inside the window of the tile's
+    OLDEST (smallest) q row — the most permissive row for the lower
+    bound, mirroring how the upper bound uses the newest row."""
     if not causal:
         return True
     needed = j * block_k <= i * block_q + (block_q - 1) + q_offset
@@ -86,8 +88,9 @@ def _first_needed_q_tile(j, *, block_q: int, block_k: int, q_offset: int):
 def _first_windowed_k_tile(i, *, block_q: int, block_k: int, q_offset: int,
                            window: int):
     """Smallest k-tile index inside q-tile ``i``'s sliding window (the
-    lower-bound mirror of _last_needed_k_tile): the newest row's window
-    floor is ``i*block_q + q_offset - window + 1``."""
+    lower-bound mirror of _last_needed_k_tile): the OLDEST q row's window
+    floor is ``i*block_q + q_offset - window + 1`` — clamping to it keeps
+    every fetch that any row of the tile still needs."""
     return jnp.maximum(
         (i * block_q + q_offset - window + 1) // block_k, 0
     )

@@ -331,3 +331,41 @@ def test_gptneox_generate_greedy():
     out = gptneox.generate(params, cfg, prompt, max_new_tokens=4)
     assert out.shape == (2, 9)
     np.testing.assert_array_equal(np.array(out[:, :5]), np.array(prompt))
+
+
+def test_int8_kv_cache_decode_accuracy():
+    """Opt-in int8 KV cache: decode logits track the fp cache closely,
+    and the quantized cache is self-consistent (prefill == incremental)."""
+    cfg_fp = tiny_llama()
+    cfg_q = tiny_llama(kv_cache_quantized=True)
+    params = llama.init(jax.random.PRNGKey(0), cfg_fp)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0,
+                                cfg_fp.vocab_size)
+
+    cache_fp = llama.init_kv_cache(cfg_fp, 2, 16)
+    cache_q = llama.init_kv_cache(cfg_q, 2, 16)
+    assert cache_q["k"].dtype == jnp.int8 and "k_scale" in cache_q
+
+    l_fp, cache_fp = llama.forward_decode(params, cfg_fp, tokens, cache_fp)
+    l_q, cache_q = llama.forward_decode(params, cfg_q, tokens, cache_q)
+    # int8 per-vector quantization: small relative logit error
+    err = np.max(np.abs(np.array(l_q) - np.array(l_fp)))
+    spread = np.max(np.abs(np.array(l_fp)))
+    assert err < 0.05 * spread, (err, spread)
+
+    out_fp = llama.generate(params, cfg_fp, tokens[:, :4], max_new_tokens=4)
+    out_q = llama.generate(params, cfg_q, tokens[:, :4], max_new_tokens=4)
+    assert out_fp.shape == out_q.shape == (2, 8)
+
+    # deterministic self-consistency: prefilling 8 tokens at once must equal
+    # prefill 5 + three incremental steps (catches scale-buffer mis-updates
+    # that a single-shot logit check cannot see)
+    c1 = llama.init_kv_cache(cfg_q, 2, 16)
+    l_once, _ = llama.forward_decode(params, cfg_q, tokens[:, :8], c1)
+    c2 = llama.init_kv_cache(cfg_q, 2, 16)
+    _, c2 = llama.forward_decode(params, cfg_q, tokens[:, :5], c2)
+    for i in range(5, 8):
+        l_step, c2 = llama.forward_decode(params, cfg_q, tokens[:, i:i + 1], c2)
+        np.testing.assert_allclose(np.array(l_step[:, 0]),
+                                   np.array(l_once[:, i]),
+                                   rtol=5e-3, atol=5e-3)
