@@ -291,3 +291,163 @@ def autoregressive_generate(
     return jnp.concatenate(
         [prompt, next_tok[:, None], toks.swapaxes(0, 1)], axis=1
     )
+
+
+def speculative_generate(
+    target_forward_decode: Callable,
+    target_params: Dict[str, Any],
+    target_cfg: Any,
+    draft_forward_decode: Callable,
+    draft_params: Dict[str, Any],
+    draft_cfg: Any,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    num_speculative: int = 4,
+    max_len: Optional[int] = None,
+) -> jnp.ndarray:
+    """Greedy speculative decoding: a cheap DRAFT model proposes
+    ``num_speculative`` tokens per round; the TARGET model scores them in
+    ONE forward and keeps the longest prefix that matches its own greedy
+    choice, plus one corrected token. Output is EXACTLY the target's
+    greedy decode — the draft only changes how many target forwards are
+    spent per token (ideally ~1/(accepted+1)).
+
+    TPU-shaped: rounds run under ``lax.while_loop`` with static shapes —
+    the KV caches are append buffers whose ``length`` pointer IS the
+    rollback (rejected draft positions are simply overwritten by the next
+    round), so no buffer copying happens on rejection. Both models must
+    share a vocabulary.
+
+    prompt: (B, P) — B must be 1 for now (acceptance lengths are
+    per-sequence; batching would force the slowest sequence's rollback on
+    everyone). Returns (1, P + max_new_tokens)."""
+    b, p = prompt.shape
+    if b != 1:
+        raise ValueError(
+            "speculative_generate supports batch 1 (per-sequence "
+            f"acceptance lengths); got batch {b}"
+        )
+    k = int(num_speculative)
+    if k < 1:
+        raise ValueError(f"num_speculative must be >= 1, got {k}")
+    needed = p + max_new_tokens + k + 1  # room for one overshooting round
+    if max_len is None:
+        max_len = needed
+    cap = min(target_cfg.max_seq_len, draft_cfg.max_seq_len)
+    if max_len < needed or needed > cap:
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) + "
+            f"speculation window ({k + 1}) needs {needed} cache slots but "
+            f"max_len={max_len}, min(max_seq_len)={cap}"
+        )
+
+    t_cache = init_kv_cache(
+        target_cfg.n_layers, target_cfg.n_kv_heads, target_cfg.head_dim,
+        target_cfg.dtype, b, max_len,
+        quantized=getattr(target_cfg, "kv_cache_quantized", False),
+    )
+    d_cache = init_kv_cache(
+        draft_cfg.n_layers, draft_cfg.n_kv_heads, draft_cfg.head_dim,
+        draft_cfg.dtype, b, max_len,
+        quantized=getattr(draft_cfg, "kv_cache_quantized", False),
+    )
+
+    # prefill both models on the prompt; the target's last logit fixes the
+    # first generated token (identical to plain greedy)
+    t_logits, t_cache = target_forward_decode(
+        target_params, target_cfg, prompt, t_cache
+    )
+    _, d_cache = draft_forward_decode(draft_params, draft_cfg, prompt, d_cache)
+    first_tok = jnp.argmax(t_logits[:, -1], axis=-1).astype(prompt.dtype)
+
+    # token buffer holds prompt + generated (+ scratch for the last round)
+    buf = jnp.zeros((b, max_len), prompt.dtype)
+    buf = lax.dynamic_update_slice_in_dim(buf, prompt, 0, axis=1)
+    buf = lax.dynamic_update_slice_in_dim(buf, first_tok[:, None], p, axis=1)
+
+    def set_len(cache, n):
+        c = dict(cache)
+        c["length"] = n
+        return c
+
+    def round_step(state):
+        buf, n_done, t_cache, d_cache = state
+        # absolute position of the newest committed token
+        last_pos = p + n_done - 1
+
+        # 1) draft proposes k tokens autoregressively from the committed
+        #    context (its cache is positioned at last_pos). The scan runs
+        #    k+1 feeds — the final feed's OUTPUT is discarded, but it puts
+        #    the last proposal's K/V into the draft cache, which the
+        #    all-accepted case needs (the next round resumes after it)
+        def draft_one(carry, _):
+            d_cache, tok = carry
+            logits, d_cache = draft_forward_decode(
+                draft_params, draft_cfg, tok[:, None], d_cache
+            )
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(buf.dtype)
+            return (d_cache, nxt), nxt
+
+        last_tok = lax.dynamic_index_in_dim(
+            buf, last_pos, axis=1, keepdims=False
+        )
+        (d_cache, _), drafted = lax.scan(
+            draft_one, (d_cache, last_tok), None, length=k + 1
+        )
+        proposals = drafted.swapaxes(0, 1)[:, :k]  # (B=1, k)
+
+        # 2) one target forward over [last_tok, proposals] (k+1 wide):
+        #    position i's logits give the target's token AFTER seeing
+        #    proposal i-1; the final position yields the BONUS token when
+        #    every proposal is accepted
+        block = jnp.concatenate([last_tok[:, None], proposals], axis=1)
+        t_logits, t_cache_next = target_forward_decode(
+            target_params, target_cfg, block, t_cache
+        )
+        target_choice = jnp.argmax(t_logits, axis=-1).astype(
+            buf.dtype
+        )  # (1, k+1)
+
+        # 3) accept the longest matching prefix; the first mismatch is
+        #    REPLACED by the target's own choice, and a fully-accepted
+        #    round appends the bonus token (still exact greedy)
+        match = proposals == target_choice[:, :k]  # (1, k)
+        accepted = jnp.argmin(
+            jnp.concatenate(
+                [match.astype(jnp.int32), jnp.zeros((b, 1), jnp.int32)],
+                axis=1,
+            ),
+            axis=1,
+        )[0]  # first False index == number of accepted proposals
+        # committed tokens this round: accepted proposals + 1
+        # (correction or bonus)
+        n_new = accepted + 1
+        out = jnp.where(
+            jnp.arange(k + 1) < accepted, drafted.swapaxes(0, 1)[0],
+            target_choice[0],
+        )  # (k+1,) — position `accepted` holds the correction/bonus
+        buf = lax.dynamic_update_slice_in_dim(
+            buf,
+            out[None, :],
+            last_pos + 1,
+            axis=1,
+        )
+        # 4) rollback by pointer: both caches hold K/V up to the scored
+        #    block's end; keep [.., last_tok, accepted proposals]. The
+        #    correction token is committed to `buf` but its K/V is NOT in
+        #    either cache — it gets appended when the next round feeds it
+        #    as its first input (same shape as the post-prefill state,
+        #    where first_tok's K/V is pending)
+        new_len = last_pos + 1 + accepted
+        t_cache = set_len(t_cache_next, new_len)
+        d_cache = set_len(d_cache, new_len)
+        return (buf, n_done + n_new, t_cache, d_cache)
+
+    def cond(state):
+        _, n_done, _, _ = state
+        return n_done < max_new_tokens
+
+    buf, n_done, _, _ = lax.while_loop(
+        cond, round_step, (buf, jnp.asarray(1, jnp.int32), t_cache, d_cache)
+    )
+    return lax.dynamic_slice_in_dim(buf, 0, p + max_new_tokens, axis=1)

@@ -369,3 +369,53 @@ def test_int8_kv_cache_decode_accuracy():
         np.testing.assert_allclose(np.array(l_step[:, 0]),
                                    np.array(l_once[:, i]),
                                    rtol=5e-3, atol=5e-3)
+
+
+def test_speculative_generate_exactly_matches_greedy():
+    """Greedy speculative decoding == plain greedy target decode, token for
+    token, for several speculation widths — incl. a draft that IS the
+    target (always accepts) and an unrelated draft (frequent rejects)."""
+    from nexus_tpu.models.decoding import speculative_generate
+
+    cfg = tiny_llama()
+    target = llama.init(jax.random.PRNGKey(0), cfg)
+    draft_good = target
+    draft_other = llama.init(jax.random.PRNGKey(42), cfg)
+
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 6), 0,
+                                cfg.vocab_size)
+    ref = llama.generate(target, cfg, prompt, max_new_tokens=10)
+
+    for draft, k in ((draft_good, 4), (draft_other, 4), (draft_other, 1),
+                     (draft_good, 7)):
+        out = speculative_generate(
+            llama.forward_decode, target, cfg,
+            llama.forward_decode, draft, cfg,
+            prompt, max_new_tokens=10, num_speculative=k,
+        )
+        np.testing.assert_array_equal(
+            np.array(out), np.array(ref),
+            err_msg=f"speculation width k={k}",
+        )
+
+
+def test_speculative_generate_cross_family_draft():
+    """The draft model can be a different family with a shared vocab —
+    gptneox drafting for llama still reproduces llama's greedy output."""
+    from nexus_tpu.models.decoding import speculative_generate
+
+    t_cfg = tiny_llama()
+    d_cfg = tiny_neox()  # both tiny presets use vocab_size=256
+    assert t_cfg.vocab_size == d_cfg.vocab_size
+    target = llama.init(jax.random.PRNGKey(0), t_cfg)
+    draft = gptneox.init(jax.random.PRNGKey(9), d_cfg)
+
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0,
+                                t_cfg.vocab_size)
+    ref = llama.generate(target, t_cfg, prompt, max_new_tokens=8)
+    out = speculative_generate(
+        llama.forward_decode, target, t_cfg,
+        gptneox.forward_decode, draft, d_cfg,
+        prompt, max_new_tokens=8, num_speculative=3,
+    )
+    np.testing.assert_array_equal(np.array(out), np.array(ref))
