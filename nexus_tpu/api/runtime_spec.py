@@ -235,22 +235,40 @@ class InferSpec:
     max_new_tokens: int = 512
     iterations: int = 3
     temperature: float = 0.0
+    # speculative decoding (models/decoding.py::speculative_generate):
+    # a draft model (family/preset/overrides, shared vocab) proposes
+    # num_speculative tokens per target forward; greedy-exact. Requires
+    # temperature == 0 and batch 1.
+    draft: Optional["ModelRef"] = None
+    num_speculative: int = 4
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        d: Dict[str, Any] = {
             "promptLength": self.prompt_length,
             "maxNewTokens": self.max_new_tokens,
             "iterations": self.iterations,
             "temperature": self.temperature,
         }
+        if self.draft is not None:
+            d["draft"] = self.draft.to_dict()
+            d["numSpeculative"] = self.num_speculative
+        return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "InferSpec":
+        draft = None
+        if d.get("draft"):
+            draft = ModelRef.from_dict(d["draft"])
         return cls(
             prompt_length=int(d.get("promptLength", 64) or 64),
             max_new_tokens=int(d.get("maxNewTokens", 512) or 512),
             iterations=int(d.get("iterations", 3) or 3),
             temperature=float(d.get("temperature", 0.0) or 0.0),
+            draft=draft,
+            # NOT `or 4`: a present-but-zero value must reach validate()
+            num_speculative=int(
+                4 if d.get("numSpeculative") is None else d["numSpeculative"]
+            ),
         )
 
 
@@ -402,6 +420,31 @@ class JaxXlaRuntime:
                 errs.append(
                     "data.kind='tokens' is for LM families; the mlp family "
                     "trains on its synthetic regression stream"
+                )
+        if self.infer.draft is not None and self.mode == "infer":
+            from nexus_tpu.models.registry import list_families
+
+            draft_family = self.infer.draft.family
+            if draft_family == "mlp" or draft_family not in list_families():
+                errs.append(
+                    f"infer.draft.family {draft_family!r} must be an LM "
+                    f"family with a decode path (one of "
+                    f"{[f for f in list_families() if f != 'mlp']})"
+                )
+            if self.infer.temperature > 0:
+                errs.append(
+                    "speculative decoding (infer.draft) is greedy-exact "
+                    "only; set infer.temperature to 0"
+                )
+            if self.train.batch_size != 1:
+                errs.append(
+                    "speculative decoding supports batch 1 (per-sequence "
+                    f"acceptance); got train.batchSize {self.train.batch_size}"
+                )
+            if self.infer.num_speculative < 1:
+                errs.append(
+                    "infer.numSpeculative must be >= 1, got "
+                    f"{self.infer.num_speculative}"
                 )
         return errs
 

@@ -304,6 +304,7 @@ def speculative_generate(
     max_new_tokens: int,
     num_speculative: int = 4,
     max_len: Optional[int] = None,
+    cache_sharding: Optional[Any] = None,
 ) -> jnp.ndarray:
     """Greedy speculative decoding: a cheap DRAFT model proposes
     ``num_speculative`` tokens per round; the TARGET model scores them in
@@ -351,6 +352,14 @@ def speculative_generate(
         draft_cfg.dtype, b, max_len,
         quantized=getattr(draft_cfg, "kv_cache_quantized", False),
     )
+    if cache_sharding is not None:
+        # same layout contract as autoregressive_generate: constrain the
+        # K/V buffers of BOTH models (scales, if any, stay compiler-chosen)
+        for c in (t_cache, d_cache):
+            for key_ in ("k", "v"):
+                c[key_] = lax.with_sharding_constraint(
+                    c[key_], cache_sharding
+                )
 
     # prefill both models on the prompt; the target's last logit fixes the
     # first generated token (identical to plain greedy)

@@ -398,7 +398,13 @@ def _run_infer(runtime, family, cfg, mesh):
     tr = runtime.train  # batch + seed
     inf = runtime.infer
     prompt_len = min(inf.prompt_length, cfg.max_seq_len - 1)
-    max_new = min(inf.max_new_tokens, cfg.max_seq_len - prompt_len)
+    # the speculative path needs num_speculative+1 scratch slots past the
+    # last committed token (one overshooting round) — reserve them here so
+    # a cache-filling config doesn't fail only when a draft is attached
+    reserve = (inf.num_speculative + 1) if inf.draft is not None else 0
+    max_new = min(
+        inf.max_new_tokens, cfg.max_seq_len - prompt_len - reserve
+    )
     if max_new <= 0:
         raise ValueError(
             f"infer shapes don't fit: prompt {prompt_len} + new tokens "
@@ -436,6 +442,44 @@ def _run_infer(runtime, family, cfg, mesh):
                 temperature=inf.temperature, key=jax.random.fold_in(key, 7)
             )
 
+        spec_extra = {}
+        if inf.draft is not None:
+            # speculative decoding: build the draft model (random init —
+            # a production draft would come from its own checkpoint) and
+            # decode through speculative_generate; greedy-exact, batch 1
+            # (validate() enforces both)
+            from nexus_tpu.models.decoding import speculative_generate
+            from nexus_tpu.models.registry import get_family
+
+            draft_family = get_family(inf.draft.family)
+            draft_cfg = draft_family.config(
+                inf.draft.preset, **dict(inf.draft.overrides)
+            )
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    "speculative draft must share the target vocab: "
+                    f"{draft_cfg.vocab_size} != {cfg.vocab_size}"
+                )
+            draft_params = jax.jit(
+                lambda: draft_family.init(jax.random.fold_in(key, 99),
+                                          draft_cfg)
+            )()
+            spec_extra = {
+                "speculative": True,
+                "draft_family": inf.draft.family,
+                "draft_preset": inf.draft.preset,
+                "num_speculative": inf.num_speculative,
+            }
+
+            def gen(params, cfg, prompt, max_new, **kw):
+                return speculative_generate(
+                    family.forward_decode, params, cfg,
+                    draft_family.forward_decode, draft_params, draft_cfg,
+                    prompt, max_new,
+                    num_speculative=inf.num_speculative,
+                    cache_sharding=kw.get("cache_sharding"),
+                )
+
         out = gen(params, cfg, prompt, max_new, **sampling)  # compile + warm
         jax.block_until_ready(out)
         times = []
@@ -447,6 +491,7 @@ def _run_infer(runtime, family, cfg, mesh):
     new_tokens = tr.batch_size * max_new
     best = min(times)
     return {
+        **spec_extra,
         "mode": "infer",
         "family": runtime.model.family,
         "preset": runtime.model.preset,

@@ -126,6 +126,32 @@ def test_run_template_runtime_llama_train_reports_mfu():
     assert metrics["param_count"] > 0
 
 
+def test_run_template_runtime_speculative_infer():
+    """infer with a draft model routes through speculative_generate and
+    reports the speculative metrics (product path for the feature)."""
+    from nexus_tpu.api.runtime_spec import InferSpec
+
+    metrics = run_template_runtime(
+        runtime_block(
+            model=ModelRef(family="llama", preset="tiny",
+                           overrides={"dtype": "float32"}),
+            mode="infer",
+            train=TrainSpec(batch_size=1, seq_len=64, steps=1),
+            infer=InferSpec(
+                prompt_length=8, max_new_tokens=12, iterations=1,
+                draft=ModelRef(family="llama", preset="tiny",
+                               overrides={"dtype": "float32"}),
+                num_speculative=3,
+            ),
+        )
+    )
+    assert metrics["mode"] == "infer"
+    assert metrics["speculative"] is True
+    assert metrics["num_speculative"] == 3
+    assert metrics["decode_tokens_per_sec"] > 0
+    assert metrics["new_tokens"] == 12
+
+
 def test_run_template_runtime_gptneox_train():
     """The gptneox family trains through the product runtime path on the
     8-device mesh — same contract as the other LM families."""
