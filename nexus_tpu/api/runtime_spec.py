@@ -422,7 +422,7 @@ class JaxXlaRuntime:
                     "trains on its synthetic regression stream"
                 )
         if self.infer.draft is not None and self.mode == "infer":
-            from nexus_tpu.models.registry import list_families
+            from nexus_tpu.models.registry import get_family, list_families
 
             draft_family = self.infer.draft.family
             if draft_family == "mlp" or draft_family not in list_families():
@@ -431,6 +431,37 @@ class JaxXlaRuntime:
                     f"family with a decode path (one of "
                     f"{[f for f in list_families() if f != 'mlp']})"
                 )
+            else:
+                # static vocab check: speculative acceptance compares token
+                # ids, so the draft must share the target's vocabulary.
+                # Resolve each config in its own try so a bad target spec
+                # is attributed to model.*, not to the draft.
+                t_cfg = d_cfg = None
+                try:
+                    t_cfg = get_family(self.model.family).config(
+                        self.model.preset, **dict(self.model.overrides)
+                    )
+                except Exception as e:  # config() errors are arbitrary
+                    errs.append(f"model does not resolve: {e!r}")
+                try:
+                    d_cfg = get_family(draft_family).config(
+                        self.infer.draft.preset,
+                        **dict(self.infer.draft.overrides),
+                    )
+                except Exception as e:
+                    errs.append(f"infer.draft does not resolve: {e!r}")
+                if (
+                    t_cfg is not None
+                    and d_cfg is not None
+                    and getattr(t_cfg, "vocab_size", None)
+                    != getattr(d_cfg, "vocab_size", None)
+                ):
+                    errs.append(
+                        "speculative draft must share the target vocab: "
+                        f"draft {d_cfg.vocab_size} != target "
+                        f"{t_cfg.vocab_size} (override the draft's "
+                        "vocab_size)"
+                    )
             if self.infer.temperature > 0:
                 errs.append(
                     "speculative decoding (infer.draft) is greedy-exact "

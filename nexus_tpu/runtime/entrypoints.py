@@ -397,18 +397,36 @@ def _run_infer(runtime, family, cfg, mesh):
 
     tr = runtime.train  # batch + seed
     inf = runtime.infer
-    prompt_len = min(inf.prompt_length, cfg.max_seq_len - 1)
+    # resolve the draft model up front: the speculation cache spans BOTH
+    # models, so shape clamps must respect min(target, draft) context
+    draft_family = draft_cfg = None
+    if inf.draft is not None:
+        from nexus_tpu.models.registry import get_family as _get_family
+
+        draft_family = _get_family(inf.draft.family)
+        draft_cfg = draft_family.config(
+            inf.draft.preset, **dict(inf.draft.overrides)
+        )
+        if draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                "speculative draft must share the target vocab: "
+                f"{draft_cfg.vocab_size} != {cfg.vocab_size}"
+            )
+    ctx = cfg.max_seq_len if draft_cfg is None else min(
+        cfg.max_seq_len, draft_cfg.max_seq_len
+    )
+    prompt_len = min(inf.prompt_length, ctx - 1)
     # the speculative path needs num_speculative+1 scratch slots past the
     # last committed token (one overshooting round) — reserve them here so
     # a cache-filling config doesn't fail only when a draft is attached
     reserve = (inf.num_speculative + 1) if inf.draft is not None else 0
-    max_new = min(
-        inf.max_new_tokens, cfg.max_seq_len - prompt_len - reserve
-    )
+    max_new = min(inf.max_new_tokens, ctx - prompt_len - reserve)
     if max_new <= 0:
         raise ValueError(
             f"infer shapes don't fit: prompt {prompt_len} + new tokens "
-            f"{inf.max_new_tokens} vs max_seq_len {cfg.max_seq_len}"
+            f"{inf.max_new_tokens}"
+            + (f" + speculation reserve {reserve}" if reserve else "")
+            + f" vs effective max_seq_len {ctx}"
         )
     key = jax.random.PRNGKey(tr.seed)
     with mesh:
@@ -447,19 +465,9 @@ def _run_infer(runtime, family, cfg, mesh):
             # speculative decoding: build the draft model (random init —
             # a production draft would come from its own checkpoint) and
             # decode through speculative_generate; greedy-exact, batch 1
-            # (validate() enforces both)
+            # (validate() enforces both; draft_cfg resolved above)
             from nexus_tpu.models.decoding import speculative_generate
-            from nexus_tpu.models.registry import get_family
 
-            draft_family = get_family(inf.draft.family)
-            draft_cfg = draft_family.config(
-                inf.draft.preset, **dict(inf.draft.overrides)
-            )
-            if draft_cfg.vocab_size != cfg.vocab_size:
-                raise ValueError(
-                    "speculative draft must share the target vocab: "
-                    f"{draft_cfg.vocab_size} != {cfg.vocab_size}"
-                )
             draft_params = jax.jit(
                 lambda: draft_family.init(jax.random.fold_in(key, 99),
                                           draft_cfg)
