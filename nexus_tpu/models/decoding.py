@@ -305,7 +305,7 @@ def speculative_generate(
     num_speculative: int = 4,
     max_len: Optional[int] = None,
     cache_sharding: Optional[Any] = None,
-) -> jnp.ndarray:
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Greedy speculative decoding: a cheap DRAFT model proposes
     ``num_speculative`` tokens per round; the TARGET model scores them in
     ONE forward and keeps the longest prefix that matches its own greedy
@@ -321,7 +321,11 @@ def speculative_generate(
 
     prompt: (B, P) — B must be 1 for now (acceptance lengths are
     per-sequence; batching would force the slowest sequence's rollback on
-    everyone). Returns (1, P + max_new_tokens)."""
+    everyone). Returns ``(tokens (1, P + max_new_tokens), stats)`` where
+    stats carries scalar counters: rounds, drafted, accepted — the
+    acceptance rate (accepted/drafted) is THE health metric of a
+    speculative deployment (a mismatched draft silently degrades to
+    slower-than-plain decode)."""
     b, p = prompt.shape
     if b != 1:
         raise ValueError(
@@ -380,7 +384,7 @@ def speculative_generate(
         return c
 
     def round_step(state):
-        buf, n_done, t_cache, d_cache = state
+        buf, n_done, rounds, n_accepted, t_cache, d_cache = state
         # absolute position of the newest committed token
         last_pos = p + n_done - 1
 
@@ -450,13 +454,25 @@ def speculative_generate(
         new_len = last_pos + 1 + accepted
         t_cache = set_len(t_cache_next, new_len)
         d_cache = set_len(d_cache, new_len)
-        return (buf, n_done + n_new, t_cache, d_cache)
+        return (
+            buf, n_done + n_new, rounds + 1, n_accepted + accepted,
+            t_cache, d_cache,
+        )
 
     def cond(state):
-        _, n_done, _, _ = state
-        return n_done < max_new_tokens
+        return state[1] < max_new_tokens
 
-    buf, n_done, _, _ = lax.while_loop(
-        cond, round_step, (buf, jnp.asarray(1, jnp.int32), t_cache, d_cache)
+    zero = jnp.asarray(0, jnp.int32)
+    buf, n_done, rounds, n_accepted, _, _ = lax.while_loop(
+        cond, round_step,
+        (buf, jnp.asarray(1, jnp.int32), zero, zero, t_cache, d_cache),
     )
-    return lax.dynamic_slice_in_dim(buf, 0, p + max_new_tokens, axis=1)
+    stats = {
+        "rounds": rounds,
+        "drafted": rounds * k,
+        "accepted": n_accepted,
+    }
+    return (
+        lax.dynamic_slice_in_dim(buf, 0, p + max_new_tokens, axis=1),
+        stats,
+    )

@@ -480,6 +480,8 @@ def _run_infer(runtime, family, cfg, mesh):
             }
 
             def gen(params, cfg, prompt, max_new, **kw):
+                # returns (tokens, stats) — pure, so it stays jit-safe;
+                # the timing loop below unpacks it
                 return speculative_generate(
                     family.forward_decode, params, cfg,
                     draft_family.forward_decode, draft_params, draft_cfg,
@@ -488,16 +490,38 @@ def _run_infer(runtime, family, cfg, mesh):
                     cache_sharding=kw.get("cache_sharding"),
                 )
 
-        out = gen(params, cfg, prompt, max_new, **sampling)  # compile + warm
+        spec_stats = {}
+
+        def run_once():
+            res = gen(params, cfg, prompt, max_new, **sampling)
+            if spec_extra:  # speculative gen returns (tokens, stats)
+                res, stats = res
+                spec_stats.update(stats)  # scalars; last timed run wins
+            return res
+
+        out = run_once()  # compile + warm
         jax.block_until_ready(out)
         times = []
         for _ in range(max(1, inf.iterations)):
             t0 = time.monotonic()
-            out = gen(params, cfg, prompt, max_new, **sampling)
+            out = run_once()
             jax.block_until_ready(out)
             times.append(time.monotonic() - t0)
     new_tokens = tr.batch_size * max_new
     best = min(times)
+    if spec_extra:
+        rounds = int(spec_stats.get("rounds", 0) or 0)
+        drafted = int(spec_stats.get("drafted", 0) or 0)
+        accepted = int(spec_stats.get("accepted", 0) or 0)
+        spec_extra.update(
+            rounds=rounds,
+            acceptance_rate=round(accepted / drafted, 4) if drafted else 0.0,
+            # target forwards per committed token: the speedup driver
+            # (1.0 == plain greedy; lower is better)
+            target_forwards_per_token=round(
+                (rounds + 1) / max(new_tokens, 1), 4
+            ),
+        )
     return {
         **spec_extra,
         "mode": "infer",

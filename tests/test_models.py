@@ -388,11 +388,16 @@ def test_speculative_generate_exactly_matches_greedy():
 
     for draft, k in ((draft_good, 4), (draft_other, 4), (draft_other, 1),
                      (draft_good, 7)):
-        out = speculative_generate(
+        out, stats = speculative_generate(
             llama.forward_decode, target, cfg,
             llama.forward_decode, draft, cfg,
             prompt, max_new_tokens=10, num_speculative=k,
         )
+        assert int(stats["rounds"]) >= 1
+        assert 0 <= int(stats["accepted"]) <= int(stats["drafted"])
+        if draft is draft_good:
+            # a self-draft always matches: every drafted token is accepted
+            assert int(stats["accepted"]) == int(stats["drafted"])
         np.testing.assert_array_equal(
             np.array(out), np.array(ref),
             err_msg=f"speculation width k={k}",
@@ -413,7 +418,7 @@ def test_speculative_generate_cross_family_draft():
     prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0,
                                 t_cfg.vocab_size)
     ref = llama.generate(target, t_cfg, prompt, max_new_tokens=8)
-    out = speculative_generate(
+    out, _ = speculative_generate(
         llama.forward_decode, target, t_cfg,
         gptneox.forward_decode, draft, d_cfg,
         prompt, max_new_tokens=8, num_speculative=3,

@@ -150,6 +150,9 @@ def test_run_template_runtime_speculative_infer():
     assert metrics["num_speculative"] == 3
     assert metrics["decode_tokens_per_sec"] > 0
     assert metrics["new_tokens"] == 12
+    assert metrics["rounds"] >= 1
+    assert 0.0 <= metrics["acceptance_rate"] <= 1.0
+    assert 0.0 < metrics["target_forwards_per_token"] <= 1.0
 
 
 def test_run_template_runtime_gptneox_train():
