@@ -241,6 +241,10 @@ class InferSpec:
     # temperature == 0 and batch 1.
     draft: Optional["ModelRef"] = None
     num_speculative: int = 4
+    # Orbax checkpoint for the draft's weights (params restored the same
+    # way as the target's; random init when empty — fine for timing runs,
+    # useless acceptance in production)
+    draft_checkpoint_directory: str = ""
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -252,6 +256,10 @@ class InferSpec:
         if self.draft is not None:
             d["draft"] = self.draft.to_dict()
             d["numSpeculative"] = self.num_speculative
+            if self.draft_checkpoint_directory:
+                d["draftCheckpointDirectory"] = (
+                    self.draft_checkpoint_directory
+                )
         return d
 
     @classmethod
@@ -268,6 +276,9 @@ class InferSpec:
             # NOT `or 4`: a present-but-zero value must reach validate()
             num_speculative=int(
                 4 if d.get("numSpeculative") is None else d["numSpeculative"]
+            ),
+            draft_checkpoint_directory=str(
+                d.get("draftCheckpointDirectory", "") or ""
             ),
         )
 

@@ -305,6 +305,7 @@ def speculative_generate(
     num_speculative: int = 4,
     max_len: Optional[int] = None,
     cache_sharding: Optional[Any] = None,
+    draft_cache_sharding: Optional[Any] = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Greedy speculative decoding: a cheap DRAFT model proposes
     ``num_speculative`` tokens per round; the TARGET model scores them in
@@ -356,14 +357,13 @@ def speculative_generate(
         draft_cfg.dtype, b, max_len,
         quantized=getattr(draft_cfg, "kv_cache_quantized", False),
     )
-    if cache_sharding is not None:
-        # same layout contract as autoregressive_generate: constrain the
-        # K/V buffers of BOTH models (scales, if any, stay compiler-chosen)
-        for c in (t_cache, d_cache):
+    # same layout contract as autoregressive_generate; each model's cache
+    # takes its own sharding (kv-head counts can differ across families)
+    for c, sh in ((t_cache, cache_sharding),
+                  (d_cache, draft_cache_sharding or cache_sharding)):
+        if sh is not None:
             for key_ in ("k", "v"):
-                c[key_] = lax.with_sharding_constraint(
-                    c[key_], cache_sharding
-                )
+                c[key_] = lax.with_sharding_constraint(c[key_], sh)
 
     # prefill both models on the prompt; the target's last logit fixes the
     # first generated token (identical to plain greedy)

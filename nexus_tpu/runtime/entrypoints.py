@@ -337,11 +337,10 @@ def _load_infer_params(runtime, family, cfg, mesh):
     template's checkpoint block points at one (the train -> checkpoint ->
     infer roundtrip, BASELINE config #3), else fresh random init.
 
-    The checkpoint holds the full TrainState; params restore onto their
-    FSDP/TP shardings (abstract leaves carry NamedShardings), the optimizer
-    moments restore unsharded and are dropped immediately — single-chip
-    inference absorbs that transient; a params-only checkpoint format is the
-    future optimization for 8B-class multi-chip restores."""
+    Params-only restore: the checkpoint's own metadata supplies the
+    optimizer-state skeleton, so the infer template does NOT need to
+    repeat the training run's hyperparameters (a warmup schedule changes
+    the opt_state pytree; mismatches used to fail the restore)."""
     key = jax.random.PRNGKey(runtime.train.seed)
     ck = runtime.checkpoint
     checkpointer = None
@@ -353,34 +352,64 @@ def _load_infer_params(runtime, family, cfg, mesh):
         params = jax.jit(lambda: family.init(key, cfg))()
         return params, False, -1
 
-    from nexus_tpu.parallel.sharding import sharding_tree
-    from nexus_tpu.train.trainer import TrainState
-
-    optimizer = build_optimizer(
-        learning_rate=runtime.train.learning_rate,
-        warmup_steps=runtime.train.warmup_steps,
-        total_steps=runtime.train.steps,
-        weight_decay=runtime.train.weight_decay,
+    step = checkpointer.latest_step()
+    params = checkpointer.restore_params(
+        _sharded_abstract_params(family, cfg, mesh, key), step=step
     )
-
-    def _make_state():
-        params = family.init(key, cfg)
-        return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
-
-    abstract = jax.eval_shape(_make_state)
-    spec_tree = sharding_tree(family.logical_axes(cfg), mesh)
-    abstract.params = jax.tree_util.tree_map(
-        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-        abstract.params,
-        spec_tree,
-    )
-    restored = checkpointer.restore(abstract)
-    step = int(restored.step)
-    params = restored.params
     checkpointer.close()
-    del restored  # free the optimizer moments before decode allocates cache
     logger.info("inference params restored from checkpoint step %d", step)
     return params, True, step
+
+
+def _sharded_abstract_params(family, cfg, mesh, key):
+    """Abstract param structs carrying the family's FSDP/TP shardings —
+    the restore target for params-only checkpoint loads."""
+    from nexus_tpu.parallel.sharding import sharding_tree
+
+    abstract = jax.eval_shape(lambda: family.init(key, cfg))
+    spec_tree = sharding_tree(family.logical_axes(cfg), mesh)
+    return jax.tree_util.tree_map(
+        lambda st, sh: jax.ShapeDtypeStruct(st.shape, st.dtype, sharding=sh),
+        abstract,
+        spec_tree,
+    )
+
+
+def _load_draft_params(runtime, draft_family, draft_cfg, mesh, key):
+    """Draft weights for speculative decoding: params-only restore from
+    ``infer.draftCheckpointDirectory`` when set (the checkpoint's own
+    metadata supplies the rest of the restore skeleton, so the draft may
+    have been trained with ANY optimizer schedule), else random init.
+    Returns (params, loaded)."""
+    ck_dir = runtime.infer.draft_checkpoint_directory
+    if ck_dir:
+        import os
+
+        # existence probe BEFORE constructing a (writable) Checkpointer: a
+        # typo'd path must not be mkdir'd, and a read-only inference mount
+        # must reach the random-init fallback rather than an OSError
+        if os.path.isdir(ck_dir):
+            checkpointer = Checkpointer(ck_dir)
+            step = checkpointer.latest_step()
+            if step is not None:
+                params = checkpointer.restore_params(
+                    _sharded_abstract_params(
+                        draft_family, draft_cfg, mesh, key
+                    ),
+                    step=step,
+                )
+                checkpointer.close()
+                logger.info(
+                    "draft params restored from %s step %d", ck_dir, step
+                )
+                return params, True
+            checkpointer.close()
+        logger.warning(
+            "infer.draftCheckpointDirectory %s has no checkpoint; the "
+            "draft runs with RANDOM weights (acceptance will be ~0)",
+            ck_dir,
+        )
+    return jax.jit(lambda: draft_family.init(key, draft_cfg))(), False
 
 
 def _run_infer(runtime, family, cfg, mesh):
@@ -450,10 +479,14 @@ def _run_infer(runtime, family, cfg, mesh):
         else:
             batch_axes = None
         tp = shape["tensor"]
-        kv_axis = "tensor" if tp > 1 and cfg.n_kv_heads % tp == 0 else None
-        cache_sharding = NamedSharding(
-            mesh, P(None, batch_axes, None, kv_axis, None)
-        )
+
+        def _cache_sharding_for(n_kv_heads):
+            kv_axis = "tensor" if tp > 1 and n_kv_heads % tp == 0 else None
+            return NamedSharding(
+                mesh, P(None, batch_axes, None, kv_axis, None)
+            )
+
+        cache_sharding = _cache_sharding_for(cfg.n_kv_heads)
         sampling = dict(cache_sharding=cache_sharding)
         if inf.temperature > 0:
             sampling.update(
@@ -462,17 +495,17 @@ def _run_infer(runtime, family, cfg, mesh):
 
         spec_extra = {}
         if inf.draft is not None:
-            # speculative decoding: build the draft model (random init —
-            # a production draft would come from its own checkpoint) and
-            # decode through speculative_generate; greedy-exact, batch 1
+            # speculative decoding: draft weights from its checkpoint (or
+            # random init for timing runs); greedy-exact, batch 1
             # (validate() enforces both; draft_cfg resolved above)
             from nexus_tpu.models.decoding import speculative_generate
 
-            draft_params = jax.jit(
-                lambda: draft_family.init(jax.random.fold_in(key, 99),
-                                          draft_cfg)
-            )()
+            draft_params, draft_loaded = _load_draft_params(
+                runtime, draft_family, draft_cfg, mesh,
+                jax.random.fold_in(key, 99),
+            )
             spec_extra = {
+                "draft_weights_loaded": draft_loaded,
                 "speculative": True,
                 "draft_family": inf.draft.family,
                 "draft_preset": inf.draft.preset,
@@ -488,6 +521,12 @@ def _run_infer(runtime, family, cfg, mesh):
                     prompt, max_new,
                     num_speculative=inf.num_speculative,
                     cache_sharding=kw.get("cache_sharding"),
+                    # the draft's kv-head count may not tile the tensor
+                    # axis even when the target's does (cross-family
+                    # drafts) — its cache gets its own layout
+                    draft_cache_sharding=_cache_sharding_for(
+                        draft_cfg.n_kv_heads
+                    ),
                 )
 
         spec_stats = {}

@@ -26,6 +26,11 @@ class Checkpointer:
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=keep, create=True
             ),
+            # registering the handler lets a FRESH manager over an existing
+            # directory serve item_metadata() (otherwise it cannot infer
+            # how "default" was written and returns None — restore_params
+            # depends on the metadata)
+            item_handlers=ocp.StandardCheckpointHandler(),
         )
 
     def save(self, state, step: Optional[int] = None, wait: bool = False) -> int:
@@ -55,6 +60,53 @@ class Checkpointer:
         from nexus_tpu.parallel.sharding import repin_tree
 
         return repin_tree(restored, abstract_state)
+
+    def restore_params(self, abstract_params: Any, step: Optional[int] = None):
+        """Restore the params subtree: the checkpoint's own metadata
+        supplies the tree structure, so the caller does not need the
+        training run's optimizer hyperparameters (a warmup schedule
+        changes the opt_state pytree; guessing wrong fails the restore).
+        Optimizer moments are still read and immediately discarded — see
+        the in-body note."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        meta = self._mgr.item_metadata(step)
+        # CheckpointManager returns a TreeMetadata wrapper; the actual
+        # pytree (dict layout with ArrayMetadata leaves) lives in .tree
+        tree = getattr(meta, "tree", meta)
+        if tree is None:
+            raise ValueError(
+                f"checkpoint step {step} under {self.directory} has no "
+                "readable tree metadata (written by a non-Standard handler "
+                "or an incompatible Orbax layout) — cannot do a params-only "
+                "restore; restore the full TrainState instead"
+            )
+
+        # NB the Standard handler offers no leaf-skipping (PLACEHOLDER is
+        # PyTree-handler-only), so optimizer moments ARE read and
+        # transiently allocated before being dropped — the known memory
+        # transient for 8B-class restores; a params-only save format is
+        # the future fix
+        def _to_struct(m):
+            return jax.ShapeDtypeStruct(m.shape, m.dtype)
+
+        abstract = jax.tree_util.tree_map(_to_struct, tree)
+        if hasattr(abstract, "params"):
+            abstract.params = abstract_params
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+            params = restored.params
+        else:
+            abstract["params"] = abstract_params
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+            params = restored["params"]
+        from nexus_tpu.parallel.sharding import repin_tree
+
+        return repin_tree(params, abstract_params)
 
     def close(self):
         self._mgr.wait_until_finished()

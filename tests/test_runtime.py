@@ -284,6 +284,52 @@ def test_train_checkpoint_infer_roundtrip(tmp_path):
     assert len(infer_metrics["iteration_seconds"]) == 2
 
 
+def test_speculative_infer_loads_draft_checkpoint(tmp_path):
+    """A trained draft checkpoint restores into the speculative infer path:
+    with the SAME weights trained for target and draft, acceptance is
+    perfect and draft_weights_loaded reports true."""
+    from nexus_tpu.api.runtime_spec import CheckpointSpec, InferSpec
+
+    ckpt_dir = str(tmp_path / "draft-ckpt")
+    common = dict(
+        model=ModelRef(family="llama", preset="tiny",
+                       overrides={"dtype": "float32"}),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="2x4", slice_count=1),
+        parallelism=ParallelismSpec(data=2, fsdp=2, tensor=2),
+    )
+    train_metrics = run_template_runtime(
+        runtime_block(
+            mode="train",
+            train=TrainSpec(batch_size=8, seq_len=32, steps=3),
+            checkpoint=CheckpointSpec(enabled=True, directory=ckpt_dir,
+                                      interval_steps=2),
+            **common,
+        )
+    )
+    assert train_metrics["checkpoint_saved"]
+
+    infer_metrics = run_template_runtime(
+        runtime_block(
+            mode="infer",
+            train=TrainSpec(batch_size=1, seq_len=32, steps=1),
+            infer=InferSpec(
+                prompt_length=8, max_new_tokens=12, iterations=1,
+                draft=ModelRef(family="llama", preset="tiny",
+                               overrides={"dtype": "float32"}),
+                num_speculative=3,
+                draft_checkpoint_directory=ckpt_dir,
+            ),
+            # target loads the same checkpoint -> draft == target
+            checkpoint=CheckpointSpec(enabled=True, directory=ckpt_dir),
+            **common,
+        )
+    )
+    assert infer_metrics["weights_loaded"] is True
+    assert infer_metrics["draft_weights_loaded"] is True
+    # identical weights -> the draft always matches the target
+    assert infer_metrics["acceptance_rate"] == 1.0
+
+
 def test_infer_long_decode_512_tokens():
     """>=512-token decode through the scanned cache path (the honest
     config-#3 shape, scaled to the tiny preset)."""
