@@ -306,8 +306,10 @@ def speculative_generate(
     max_len: Optional[int] = None,
     cache_sharding: Optional[Any] = None,
     draft_cache_sharding: Optional[Any] = None,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-    """Greedy speculative decoding: a cheap DRAFT model proposes
+    """Greedy (default) or sampled speculative decoding: a cheap DRAFT model proposes
     ``num_speculative`` tokens per round; the TARGET model scores them in
     ONE forward and keeps the longest prefix that matches its own greedy
     choice, plus one corrected token. Output is EXACTLY the target's
@@ -326,13 +328,23 @@ def speculative_generate(
     stats carries scalar counters: rounds, drafted, accepted — the
     acceptance rate (accepted/drafted) is THE health metric of a
     speculative deployment (a mismatched draft silently degrades to
-    slower-than-plain decode)."""
+    slower-than-plain decode).
+
+    ``temperature > 0`` (requires ``key``) switches to the standard
+    rejection-sampling rule (speculative_accept_step): the draft SAMPLES
+    proposals from its temperature-adjusted distribution, and the output
+    marginal equals sampling from the TARGET's — exactness verified in
+    closed form by tests/test_models.py. top-k/top-p truncation is not
+    supported here (truncation breaks the residual-distribution math)."""
     b, p = prompt.shape
     if b != 1:
         raise ValueError(
             "speculative_generate supports batch 1 (per-sequence "
             f"acceptance lengths); got batch {b}"
         )
+    if temperature > 0.0 and key is None:
+        raise ValueError("temperature > 0 requires an explicit PRNG key")
+    sampled = temperature > 0.0
     k = int(num_speculative)
     if k < 1:
         raise ValueError(f"num_speculative must be >= 1, got {k}")
@@ -371,7 +383,12 @@ def speculative_generate(
         target_params, target_cfg, prompt, t_cache
     )
     _, d_cache = draft_forward_decode(draft_params, draft_cfg, prompt, d_cache)
-    first_tok = jnp.argmax(t_logits[:, -1], axis=-1).astype(prompt.dtype)
+    if sampled:
+        first_tok = jax.random.categorical(
+            jax.random.fold_in(key, 0), t_logits[:, -1] / temperature
+        ).astype(prompt.dtype)
+    else:
+        first_tok = jnp.argmax(t_logits[:, -1], axis=-1).astype(prompt.dtype)
 
     # token buffer holds prompt + generated (+ scratch for the last round)
     buf = jnp.zeros((b, max_len), prompt.dtype)
@@ -387,26 +404,43 @@ def speculative_generate(
         buf, n_done, rounds, n_accepted, t_cache, d_cache = state
         # absolute position of the newest committed token
         last_pos = p + n_done - 1
+        round_key = (
+            jax.random.fold_in(key, rounds + 1) if sampled else None
+        )
 
         # 1) draft proposes k tokens autoregressively from the committed
         #    context (its cache is positioned at last_pos). The scan runs
         #    k+1 feeds — the final feed's OUTPUT is discarded, but it puts
         #    the last proposal's K/V into the draft cache, which the
         #    all-accepted case needs (the next round resumes after it)
-        def draft_one(carry, _):
+        def draft_one(carry, i):
             d_cache, tok = carry
             logits, d_cache = draft_forward_decode(
                 draft_params, draft_cfg, tok[:, None], d_cache
             )
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(buf.dtype)
+            row = logits[:, -1]  # (1, V)
+            if sampled:
+                probs = jax.nn.softmax(row / temperature, axis=-1)
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(round_key, i), row / temperature
+                ).astype(buf.dtype)
+                return (d_cache, nxt), (nxt, probs[0])
+            # greedy: no per-feed softmax, no (k+1, V) probs stack —
+            # `sampled` is a static bool so the scan output structure is
+            # fixed at trace time
+            nxt = jnp.argmax(row, axis=-1).astype(buf.dtype)
             return (d_cache, nxt), nxt
 
         last_tok = lax.dynamic_index_in_dim(
             buf, last_pos, axis=1, keepdims=False
         )
-        (d_cache, _), drafted = lax.scan(
-            draft_one, (d_cache, last_tok), None, length=k + 1
+        (d_cache, _), scanned_out = lax.scan(
+            draft_one, (d_cache, last_tok), jnp.arange(k + 1)
         )
+        if sampled:
+            drafted, draft_probs = scanned_out
+        else:
+            drafted, draft_probs = scanned_out, None
         proposals = drafted.swapaxes(0, 1)[:, :k]  # (B=1, k)
 
         # 2) one target forward over [last_tok, proposals] (k+1 wide):
@@ -417,28 +451,44 @@ def speculative_generate(
         t_logits, t_cache_next = target_forward_decode(
             target_params, target_cfg, block, t_cache
         )
-        target_choice = jnp.argmax(t_logits, axis=-1).astype(
-            buf.dtype
-        )  # (1, k+1)
+        if sampled:
+            # 3) standard rejection rule over the temperature-adjusted
+            #    distributions (speculative_accept_step): output marginal
+            #    == sampling from the target
+            target_probs = jax.nn.softmax(
+                t_logits[0] / temperature, axis=-1
+            )  # (k+1, V)
+            uniforms = jax.random.uniform(
+                jax.random.fold_in(round_key, k + 1), (k,)
+            )
+            accepted, out = speculative_accept_step(
+                draft_probs[:k], target_probs, proposals[0],
+                uniforms, jax.random.fold_in(round_key, k + 2),
+            )
+            out = out.astype(buf.dtype)
+        else:
+            target_choice = jnp.argmax(t_logits, axis=-1).astype(
+                buf.dtype
+            )  # (1, k+1)
 
-        # 3) accept the longest matching prefix; the first mismatch is
-        #    REPLACED by the target's own choice, and a fully-accepted
-        #    round appends the bonus token (still exact greedy)
-        match = proposals == target_choice[:, :k]  # (1, k)
-        accepted = jnp.argmin(
-            jnp.concatenate(
-                [match.astype(jnp.int32), jnp.zeros((b, 1), jnp.int32)],
+            # 3) accept the longest matching prefix; the first mismatch is
+            #    REPLACED by the target's own choice, and a fully-accepted
+            #    round appends the bonus token (still exact greedy)
+            match = proposals == target_choice[:, :k]  # (1, k)
+            accepted = jnp.argmin(
+                jnp.concatenate(
+                    [match.astype(jnp.int32), jnp.zeros((b, 1), jnp.int32)],
+                    axis=1,
+                ),
                 axis=1,
-            ),
-            axis=1,
-        )[0]  # first False index == number of accepted proposals
+            )[0]  # first False index == number of accepted proposals
+            out = jnp.where(
+                jnp.arange(k + 1) < accepted, drafted.swapaxes(0, 1)[0],
+                target_choice[0],
+            )  # (k+1,) — position `accepted` holds the correction/bonus
         # committed tokens this round: accepted proposals + 1
         # (correction or bonus)
         n_new = accepted + 1
-        out = jnp.where(
-            jnp.arange(k + 1) < accepted, drafted.swapaxes(0, 1)[0],
-            target_choice[0],
-        )  # (k+1,) — position `accepted` holds the correction/bonus
         buf = lax.dynamic_update_slice_in_dim(
             buf,
             out[None, :],
@@ -476,3 +526,68 @@ def speculative_generate(
         lax.dynamic_slice_in_dim(buf, 0, p + max_new_tokens, axis=1),
         stats,
     )
+
+
+def speculative_accept_step(
+    draft_probs: jnp.ndarray,
+    target_probs: jnp.ndarray,
+    proposals: jnp.ndarray,
+    uniforms: jnp.ndarray,
+    residual_key: jax.Array,
+):
+    """One round of the standard speculative rejection rule (Leviathan et
+    al. / Chen et al.), as a PURE function over explicit uniforms so the
+    math is unit-testable in closed form.
+
+    Inputs (k = number of proposals, V = vocab):
+      draft_probs  (k, V): draft distribution at each proposal position
+      target_probs (k+1, V): target distribution at each position, incl.
+                    the bonus position after the last proposal
+      proposals    (k,) int32: tokens the draft sampled
+      uniforms     (k,) f32 in [0,1): the accept/reject draws
+      residual_key: PRNG key for the correction/bonus sample
+
+    Proposal i is accepted iff ``u_i < min(1, p_i/q_i)`` (p target, q
+    draft, both at the proposed token). The first rejection at position r
+    replaces the token with a sample from the RESIDUAL distribution
+    ``max(p - q, 0)`` renormalized; if all k are accepted, the bonus token
+    samples from the target's k-th distribution. Marginal over draft
+    randomness + uniforms, the committed tokens follow the target
+    distribution EXACTLY — the property the closed-form test checks.
+
+    Returns (accepted count (scalar int32), out (k+1,) int32) where
+    ``out[i] = proposals[i]`` for i < accepted and ``out[accepted]`` is
+    the correction/bonus token."""
+    k, v = draft_probs.shape
+    idx = jnp.arange(k)
+    p_at = target_probs[idx, proposals]  # (k,)
+    q_at = draft_probs[idx, proposals]
+    accept = uniforms < jnp.minimum(1.0, p_at / jnp.maximum(q_at, 1e-30))
+    # first rejection index (k if none)
+    # argmin over the 0-padded accept vector: the appended 0 at index k is
+    # the first minimum when every proposal is accepted
+    accepted = jnp.argmin(
+        jnp.concatenate([accept.astype(jnp.int32),
+                         jnp.zeros((1,), jnp.int32)])
+    )
+
+    # correction: residual distribution at the rejection position;
+    # bonus: plain target distribution at position k
+    def residual(r):
+        diff = jnp.maximum(target_probs[r] - draft_probs[r], 0.0)
+        z = jnp.sum(diff)
+        # z == 0 only if target == draft exactly — any sample is correct
+        return jnp.where(z > 0, diff / jnp.maximum(z, 1e-30),
+                         target_probs[r])
+
+    corr_dist = jnp.where(
+        accepted < k, residual(jnp.minimum(accepted, k - 1)),
+        target_probs[k],
+    )
+    correction = jax.random.choice(residual_key, v, p=corr_dist)
+    out = jnp.where(
+        idx < accepted, proposals, 0
+    )
+    out = jnp.concatenate([out, jnp.zeros((1,), out.dtype)])
+    out = out.at[accepted].set(correction.astype(out.dtype))
+    return accepted, out

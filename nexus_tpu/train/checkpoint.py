@@ -92,18 +92,15 @@ class Checkpointer:
             return jax.ShapeDtypeStruct(m.shape, m.dtype)
 
         abstract = jax.tree_util.tree_map(_to_struct, tree)
-        if hasattr(abstract, "params"):
+        attr_layout = hasattr(abstract, "params")
+        if attr_layout:
             abstract.params = abstract_params
-            restored = self._mgr.restore(
-                step, args=ocp.args.StandardRestore(abstract)
-            )
-            params = restored.params
         else:
             abstract["params"] = abstract_params
-            restored = self._mgr.restore(
-                step, args=ocp.args.StandardRestore(abstract)
-            )
-            params = restored["params"]
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract)
+        )
+        params = restored.params if attr_layout else restored["params"]
         from nexus_tpu.parallel.sharding import repin_tree
 
         return repin_tree(params, abstract_params)

@@ -424,3 +424,84 @@ def test_speculative_generate_cross_family_draft():
         prompt, max_new_tokens=8, num_speculative=3,
     )
     np.testing.assert_array_equal(np.array(out), np.array(ref))
+
+
+def test_speculative_accept_step_math():
+    """The rejection rule is exact: q(x)·a(x) + P_rej·res(x) == p(x)
+    (closed form), and the implementation's branches follow it."""
+    from nexus_tpu.models.decoding import speculative_accept_step
+
+    rng = np.random.default_rng(0)
+    v = 5
+    p = rng.dirichlet(np.ones(v))
+    q = rng.dirichlet(np.ones(v))
+    a = np.minimum(1.0, p / q)
+    p_rej = float(np.sum(q * (1 - a)))
+    res = np.maximum(p - q, 0.0)
+    res = res / res.sum()
+    marginal = q * a + p_rej * res
+    np.testing.assert_allclose(marginal, p, rtol=1e-12)  # the math
+
+    # implementation: accept iff u < min(1, p/q); k=1
+    dp = jnp.asarray(q, jnp.float32)[None, :]
+    tp = jnp.tile(jnp.asarray(p, jnp.float32)[None, :], (2, 1))
+    for tok in range(v):
+        thresh = float(a[tok])
+        cases = [(thresh * 0.5, 1)]
+        if thresh < 1.0:  # an accept-prob-1 token cannot be rejected
+            cases.append((thresh + (1 - thresh) * 0.5, 0))
+        for u, want in cases:
+            if abs(u - thresh) < 1e-6:
+                continue  # skip boundary-degenerate cases
+            acc, out = speculative_accept_step(
+                dp, tp, jnp.asarray([tok], jnp.int32),
+                jnp.asarray([u], jnp.float32), jax.random.PRNGKey(1),
+            )
+            assert int(acc) == want, (tok, u, thresh)
+            if want == 1:
+                assert int(out[0]) == tok
+
+    # rejected corrections follow the residual distribution (fixed keys —
+    # deterministic test) and never land outside its support
+    counts = np.zeros(v)
+    n = 400
+    for i in range(n):
+        _, out = speculative_accept_step(
+            dp, tp, jnp.asarray([int(np.argmax(a < 1))], jnp.int32),
+            jnp.asarray([0.9999], jnp.float32), jax.random.PRNGKey(i),
+        )
+        counts[int(out[0])] += 1
+    freq = counts / n
+    assert np.all(freq[res < 1e-12] == 0), freq  # support respected
+    assert np.abs(freq - res).sum() < 0.15, (freq, res)
+
+
+def test_speculative_sampled_modes():
+    """Sampled speculative decoding: a self-draft accepts everything
+    (p == q ⇒ accept prob 1), and with near-deterministic distributions
+    the sampled path reproduces the greedy output."""
+    from nexus_tpu.models.decoding import speculative_generate
+
+    cfg = tiny_llama()
+    target = llama.init(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0,
+                                cfg.vocab_size)
+
+    _, stats = speculative_generate(
+        llama.forward_decode, target, cfg,
+        llama.forward_decode, target, cfg,
+        prompt, max_new_tokens=8, num_speculative=3,
+        temperature=0.7, key=jax.random.PRNGKey(3),
+    )
+    assert int(stats["accepted"]) == int(stats["drafted"])  # p == q
+
+    # low temperature ⇒ distributions concentrate ⇒ sampled == greedy
+    draft = llama.init(jax.random.PRNGKey(42), cfg)
+    ref = llama.generate(target, cfg, prompt, max_new_tokens=8)
+    out, _ = speculative_generate(
+        llama.forward_decode, target, cfg,
+        llama.forward_decode, draft, cfg,
+        prompt, max_new_tokens=8, num_speculative=3,
+        temperature=1e-4, key=jax.random.PRNGKey(5),
+    )
+    np.testing.assert_array_equal(np.array(out), np.array(ref))
