@@ -204,14 +204,10 @@ def _block(cfg: MixtralConfig, carry, layer, cos, sin):
     k = apply_rope((h @ layer["wk"]).reshape(b, s, hkv, hd), cos, sin)
     v = (h @ layer["wv"]).reshape(b, s, hkv, hd)
     if cfg.attn_impl == "ring":
-        if cfg.sliding_window:
-            raise ValueError(
-                "sliding_window with ring attention is not supported yet — "
-                "use full-window ring or a non-ring impl"
-            )
         # context parallelism over the 'sequence' mesh axis (same shared
-        # entry the llama block uses)
-        attn = ring_attention_sharded(q, k, v)
+        # entry the llama block uses); a sliding window additionally
+        # truncates the ring statically (ops/ring_attention.py)
+        attn = ring_attention_sharded(q, k, v, window=cfg.sliding_window)
     else:
         attn = attention(
             q, k, v, causal=True, impl=cfg.attn_impl,

@@ -35,6 +35,7 @@ def _online_block(
     q_positions: jnp.ndarray,
     k_positions: jnp.ndarray,
     causal: bool,
+    window: int = 0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Fold one K/V block into the (m, l, acc) online-softmax state."""
     scale = q.shape[-1] ** -0.5
@@ -43,6 +44,11 @@ def _online_block(
     ) * scale
     if causal:
         mask = k_positions[None, None, None, :] <= q_positions[None, None, :, None]
+        if window > 0:
+            mask = mask & (
+                k_positions[None, None, None, :]
+                > q_positions[None, None, :, None] - window
+            )
         s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
     m_cur = jnp.max(s, axis=-1, keepdims=True)  # (B,H,Q,1)
     m_new = jnp.maximum(m, m_cur)
@@ -83,6 +89,20 @@ def _pvary_like(xs, template, default_vma=()):
     return xs
 
 
+def _ring_steps(n: int, s_local: int, window: int, causal: bool) -> int:
+    """Ring hops actually needed under a sliding window: a visiting block
+    at step s spans [(my-s)·L, (my-s+1)·L); it is visible to SOME query
+    row iff its newest position reaches the OLDEST query row's window
+    floor (my·L - window + 1): (my-s+1)·L - 1 >= my·L - window + 1
+    ⟺ s <= 1 + (window - 2)/L. Exact for window >= 2; window == 1 sees
+    only the diagonal (own block)."""
+    if not causal or window <= 0:
+        return n
+    if window == 1:
+        return 1
+    return min(n, 2 + (window - 2) // s_local)
+
+
 def ring_attention_flash(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -90,6 +110,7 @@ def ring_attention_flash(
     axis_name: str = "sequence",
     causal: bool = True,
     interpret=None,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Ring attention with the Pallas flash kernel on each visiting block.
 
@@ -104,30 +125,48 @@ def ring_attention_flash(
     long-context sequence parallelism at flash-kernel speed.
 
     K/V stay un-repeated under GQA: the kernel shares kv heads via index
-    maps, and the ppermute moves Hkv-sized blocks around the ring."""
+    maps, and the ppermute moves Hkv-sized blocks around the ring.
+
+    ``window > 0`` (sliding-window attention; requires ``causal``) cuts
+    BOTH ways: in-block masking rides the kernel's window support, and the
+    ring itself truncates STATICALLY — a visiting block whose newest
+    position is older than ``window`` can never be visible, so it is
+    neither fetched, computed, nor even rotated. At 32-shard/1-block
+    windows the ring runs 2 hops instead of 31."""
     from nexus_tpu.ops.attention import flash_attention_lse
 
     n = lax.psum(1, axis_name)  # static: mesh axis size
     my_idx = lax.axis_index(axis_name)
     b, s_local, hq, d = q.shape
+    if window > 0 and not causal:
+        raise ValueError("window requires causal ring attention")
 
     # step 0: own shard, standard causal flash — never empty (diagonal)
     out_acc, lse_acc = flash_attention_lse(
-        q, k, v, causal=causal, interpret=interpret
+        q, k, v, causal=causal, window=window, interpret=interpret
     )
     out_acc = out_acc.astype(jnp.float32)
 
+    n_steps = _ring_steps(n, s_local, window, causal)
+
     k_blk, v_blk = k, v
     perm = [(r, (r + 1) % n) for r in range(n)]
-    for step in range(1, n):
+    for step in range(1, n_steps):
         # rotate: receive the next block from the previous rank in the ring
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         # the block now held originated on shard (my_idx - step) mod n
         if causal:
-            def _visible(q=q, kb=k_blk, vb=v_blk):
+            def _visible(q=q, kb=k_blk, vb=v_blk, step=step):
+                # a fully-past block under a window is exactly "causal
+                # with offset": the causal bound is vacuous (everything
+                # is older) and the window bound does the cutting
                 o, l = flash_attention_lse(
-                    q, kb, vb, causal=False, interpret=interpret
+                    q, kb, vb,
+                    causal=window > 0,
+                    q_offset=step * s_local if window > 0 else 0,
+                    window=window,
+                    interpret=interpret,
                 )
                 return o.astype(jnp.float32), l
 
@@ -162,14 +201,21 @@ def ring_attention(
     axis_name: str = "sequence",
     causal: bool = True,
     block_impl: str = "xla",
+    window: int = 0,
 ) -> jnp.ndarray:
     """Exact attention over sequence shards. q/k/v: (B, S_local, H|Hkv, D).
 
     Must execute under a mapping (shard_map) that binds ``axis_name``.
     ``block_impl='flash'`` routes each visiting block through the Pallas
-    kernel (ring_attention_flash); 'xla' is the dense online-softmax path."""
+    kernel (ring_attention_flash); 'xla' is the dense online-softmax path.
+    ``window > 0`` = sliding-window attention; in BOTH paths the ring
+    truncates statically (out-of-window blocks never rotate)."""
+    if window > 0 and not causal:
+        raise ValueError("window requires causal ring attention")
     if block_impl == "flash":
-        return ring_attention_flash(q, k, v, axis_name, causal)
+        return ring_attention_flash(
+            q, k, v, axis_name, causal, window=window
+        )
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, s_local, hq, d = q.shape
@@ -191,13 +237,16 @@ def ring_attention(
         (m0, l0, acc0), q, default_vma=(axis_name,)
     )
 
+    n_steps = _ring_steps(n, s_local, window, causal)
+
     def step(carry, step_idx):
         k_blk, v_blk, m, l, acc = carry
         # the block currently held originated on shard (my_idx - step) mod n
         src = (my_idx - step_idx) % n
         k_positions = src * s_local + jnp.arange(s_local)
         m, l, acc = _online_block(
-            q, k_blk, v_blk, m, l, acc, q_positions, k_positions, causal
+            q, k_blk, v_blk, m, l, acc, q_positions, k_positions, causal,
+            window=window,
         )
         # rotate: receive the next block from the previous rank in the ring
         perm = [(r, (r + 1) % n) for r in range(n)]
@@ -206,14 +255,14 @@ def ring_attention(
         return (k_next, v_next, m, l, acc), None
 
     (k, v, m, l, acc), _ = lax.scan(
-        step, (k, v, m0, l0, acc0), jnp.arange(n)
+        step, (k, v, m0, l0, acc0), jnp.arange(n_steps)
     )
     safe_l = jnp.where(l == 0.0, 1.0, l)
     out = (acc / safe_l).astype(q.dtype)  # (B,H,Q,D)
     return out.transpose(0, 2, 1, 3)
 
 
-def ring_attention_sharded(q, k, v):
+def ring_attention_sharded(q, k, v, window: int = 0):
     """Ring attention over the ACTIVE mesh's ``sequence`` axis.
 
     Shared model-side entry (llama + mixtral blocks): wraps the ring op in
@@ -237,7 +286,7 @@ def ring_attention_sharded(q, k, v):
 
     mesh = thread_resources.env.physical_mesh
     if mesh.empty or mesh.shape.get("sequence", 1) == 1:
-        return attention(q, k, v, causal=True, impl=None)
+        return attention(q, k, v, causal=True, impl=None, window=window)
     try:
         smap = jax.shard_map
         vma_kwarg = "check_vma"
@@ -274,7 +323,7 @@ def ring_attention_sharded(q, k, v):
     ring = smap(
         _partial(
             ring_attention, axis_name="sequence", causal=True,
-            block_impl=block_impl,
+            block_impl=block_impl, window=window,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
