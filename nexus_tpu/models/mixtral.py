@@ -94,8 +94,10 @@ PRESETS: Dict[str, Dict[str, Any]] = {
                  n_kv_heads=2, d_ff=128, n_experts=4, max_seq_len=512),
     # Mixtral-8x7B dims (public): d 4096, L 32, H 32, KV 8, ff 14336, E 8 top2
     # NB sliding_window=4096 matches the public Mixtral-8x7B convention but
-    # stays OPT-IN (override it per template): the fan-out example runs this
-    # preset with ring context parallelism, which does not support windows
+    # stays OPT-IN (override it per template): ring context parallelism now
+    # supports windows (ring_attention_sharded(window=...) statically
+    # truncates ring hops outside the window), so the only reason it is not
+    # the default is parity with the windowless presets used in tests
     "8x7b": dict(vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
                  n_kv_heads=8, d_ff=14336, n_experts=8,
                  n_experts_per_token=2, max_seq_len=32768),

@@ -419,13 +419,20 @@ def _flash_impl(q, k, v, opts):
             if window > 0:
                 # pre-window tiles repeat the first in-window index so
                 # their DMAs are elided alongside the pl.when-skipped
-                # compute (the window mirror of the causal upper clamp)
-                jc = jnp.maximum(
-                    jc,
-                    _first_windowed_k_tile(
-                        i, block_q=block_q, block_k=block_k,
-                        q_offset=q_offset, window=window,
+                # compute (the window mirror of the causal upper clamp);
+                # the outer min keeps the fetch in range when the window
+                # floor itself lands past the last k tile (small window +
+                # large q_offset, e.g. later ring hops) — compute there is
+                # pl.when-skipped, any valid block satisfies the DMA
+                jc = jnp.minimum(
+                    jnp.maximum(
+                        jc,
+                        _first_windowed_k_tile(
+                            i, block_q=block_q, block_k=block_k,
+                            q_offset=q_offset, window=window,
+                        ),
                     ),
+                    sk // block_k - 1,
                 )
             return (kv_row(bh), jc, 0)
     else:
@@ -639,12 +646,17 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts, g_lse=None):
                 ),
             )
             if window > 0:
-                jc = jnp.maximum(
-                    jc,
-                    _first_windowed_k_tile(
-                        i, block_q=block_q, block_k=block_k,
-                        q_offset=q_offset, window=window,
+                # same upper clamp as the forward kv_index: the window
+                # floor can exceed the last k tile when window <= q_offset
+                jc = jnp.minimum(
+                    jnp.maximum(
+                        jc,
+                        _first_windowed_k_tile(
+                            i, block_q=block_q, block_k=block_k,
+                            q_offset=q_offset, window=window,
+                        ),
                     ),
+                    sk // block_k - 1,
                 )
             return jc
 
