@@ -9,7 +9,7 @@ all: native
 native: $(NATIVE_LIB)
 
 $(NATIVE_LIB): $(NATIVE_SRC)
-	g++ -std=c++17 -O2 -fPIC -shared -pthread -o $@ $<
+	g++ -std=c++17 -O2 -fPIC -shared -pthread -o $@ $^
 
 test: native
 	python -m pytest tests/ -x -q

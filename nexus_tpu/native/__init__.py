@@ -74,8 +74,18 @@ def load() -> Optional[ctypes.CDLL]:
             _bind(lib)
         except (OSError, AttributeError):
             # AttributeError: a stale prebuilt .so (fresh mtime, old symbol
-            # set — e.g. restored from a cache) — rebuild once from source
+            # set — e.g. built by an older Makefile or restored from a
+            # cache) — rebuild once from source. The broken handle must be
+            # dlclose()d first: glibc dlopen matches loaded objects by path
+            # string, so re-opening the same path would return the stale
+            # mapping instead of the rebuilt file.
             try:
+                try:
+                    import _ctypes
+
+                    _ctypes.dlclose(lib._handle)
+                except Exception:
+                    pass  # lib may never have opened; unload is best-effort
                 os.remove(_LIB)
                 if _build():
                     lib = ctypes.CDLL(_LIB)

@@ -6,6 +6,7 @@ native C++ queue (nexus_tpu/native/src/nexus_core.cpp) — so they stay in
 semantic lockstep.
 """
 
+import os
 import threading
 import time
 
@@ -38,6 +39,30 @@ def test_native_backend_builds_and_loads():
         pytest.skip("no g++ — Python fallback is the supported mode here")
     assert native.available(), "C++ core must build when g++ is present"
     assert isinstance(native.make_queue(), native.NativeRateLimitingQueue)
+    # symbol completeness: BOTH translation units must be linked — a lib
+    # missing the corpus loader (the `make native` $< regression) must
+    # never load as "available"
+    lib = native.load()
+    for sym in ("ncq_new", "ncq_get", "ncd_open", "ncd_next_batch",
+                "ncd_num_tokens", "ncd_close"):
+        assert hasattr(lib, sym), f"native lib missing symbol {sym}"
+
+
+def test_make_native_links_all_sources():
+    """`make native` must produce a complete library (regression: the rule
+    once linked only the first prerequisite, silently dropping
+    nexus_data.cpp and disabling the whole native backend). Textual check —
+    runs everywhere, no compiler needed."""
+    import re
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rule = open(os.path.join(repo, "Makefile")).read()
+    m = re.search(r"\$\(NATIVE_LIB\):.*\n\t(.+)", rule)
+    assert m is not None, "Makefile native rule not found"
+    assert "$<" not in m.group(1), (
+        "native link rule uses $< (first prerequisite only); "
+        "use $^ so every source file is linked"
+    )
 
 
 def test_native_key_map_is_pruned():
