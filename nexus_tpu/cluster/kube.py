@@ -176,7 +176,24 @@ class KubeClusterStore:
                 cbs.remove(callback)
 
     def close(self) -> None:
+        """Stop and JOIN the watch threads (bounded). Cancelling the
+        in-flight watch connections unblocks readers parked in readline();
+        joining prevents the threads from logging into a torn-down process
+        (e.g. pytest's closed capture streams) after teardown."""
         self._stop.set()
+        self.api.cancel_watches()
+        deadline = 5.0
+        import time
+
+        t0 = time.monotonic()
+        for t in self._watch_threads:
+            t.join(timeout=max(0.1, deadline - (time.monotonic() - t0)))
+        stragglers = [t.name for t in self._watch_threads if t.is_alive()]
+        if stragglers:
+            logger.warning(
+                "watch threads still alive %.0fs after close: %s",
+                deadline, stragglers,
+            )
 
     def _dispatch(self, kind: str, ev: WatchEvent) -> None:
         with self._lock:

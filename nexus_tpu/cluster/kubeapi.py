@@ -10,9 +10,13 @@ of client-go the reference leans on (clientset + informer reflectors,
 /root/reference/main.go:58-71).
 
 Auth supported from kubeconfig: bearer token (inline or file), client
-certificate/key (inline base64 ``*-data`` or file paths), cluster CA
-(inline or file), ``insecure-skip-tls-verify``, and plain http servers
-(test/fake API servers).
+certificate/key (inline base64 ``*-data`` or file paths), exec credential
+plugins (``user.exec`` — the client.authentication.k8s.io flow GKE's
+``gke-gcloud-auth-plugin`` and EKS's ``aws eks get-token`` use; the
+reference bundles the AWS CLI into its image for exactly this,
+/root/reference/.container/Dockerfile:16-31), cluster CA (inline or file),
+``insecure-skip-tls-verify``, and plain http servers (test/fake API
+servers).
 """
 
 from __future__ import annotations
@@ -42,6 +46,108 @@ class ApiError(RuntimeError):
         self.body = body
 
 
+class ExecCredentialPlugin:
+    """client.authentication.k8s.io exec plugin runner (kubeconfig
+    ``user.exec`` block). Spawns the configured command, parses the
+    ExecCredential it prints, and caches the token until its
+    ``status.expirationTimestamp`` (minus slack) — the flow behind GKE's
+    ``gke-gcloud-auth-plugin`` and ``aws eks get-token`` (the reference
+    ships the AWS CLI in its image solely for the latter,
+    /root/reference/.container/Dockerfile:16-31, README.md:30)."""
+
+    #: refresh this long before the reported expiry (clock skew slack)
+    EXPIRY_SLACK_S = 60.0
+
+    def __init__(self, spec: Dict[str, Any]):
+        self.command = spec.get("command") or ""
+        if not self.command:
+            raise ValueError("kubeconfig user.exec block has no command")
+        self.args: List[str] = list(spec.get("args") or [])
+        self.env: List[Dict[str, str]] = list(spec.get("env") or [])
+        self.api_version = (
+            spec.get("apiVersion") or "client.authentication.k8s.io/v1"
+        )
+        self._lock = threading.Lock()
+        self._token = ""
+        self._expiry: Optional[float] = None  # unix seconds
+
+    def token(self) -> str:
+        import time
+
+        with self._lock:
+            if self._token and (
+                self._expiry is None
+                or time.time() < self._expiry - self.EXPIRY_SLACK_S
+            ):
+                return self._token
+            self._refresh_locked()
+            return self._token
+
+    def invalidate(self, bad_token: str) -> None:
+        """Drop the cached credential if it is still ``bad_token`` — called
+        on a 401 so the next request re-execs the plugin even when the
+        ExecCredential carried no (or an unparseable) expirationTimestamp
+        (client-go invalidates on 401 the same way). The equality guard
+        keeps a concurrent refresh's newer token."""
+        with self._lock:
+            if self._token == bad_token:
+                self._token = ""
+                self._expiry = None
+
+    def _refresh_locked(self) -> None:
+        import subprocess
+
+        env = dict(os.environ)
+        for item in self.env:
+            env[str(item.get("name", ""))] = str(item.get("value", ""))
+        # the protocol: plugins may inspect KUBERNETES_EXEC_INFO to pick an
+        # output apiVersion / detect non-interactive invocation
+        env["KUBERNETES_EXEC_INFO"] = json.dumps({
+            "apiVersion": self.api_version,
+            "kind": "ExecCredential",
+            "spec": {"interactive": False},
+        })
+        try:
+            proc = subprocess.run(
+                [self.command, *self.args],
+                env=env, capture_output=True, timeout=60,
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise ApiError(401, f"exec plugin {self.command!r} failed: {e}")
+        if proc.returncode != 0:
+            raise ApiError(
+                401,
+                f"exec plugin {self.command!r} exited {proc.returncode}",
+                proc.stderr.decode(errors="replace")[:200],
+            )
+        try:
+            doc = json.loads(proc.stdout)
+        except ValueError as e:
+            raise ApiError(
+                401, f"exec plugin {self.command!r} printed invalid JSON: {e}"
+            )
+        status = doc.get("status") or {}
+        token = status.get("token") or ""
+        if not token:
+            raise ApiError(
+                401,
+                f"exec plugin {self.command!r} returned no status.token "
+                "(client-certificate ExecCredentials are not supported)",
+            )
+        self._token = token
+        self._expiry = None
+        stamp = status.get("expirationTimestamp")
+        if stamp:
+            import datetime
+
+            try:
+                self._expiry = datetime.datetime.fromisoformat(
+                    str(stamp).replace("Z", "+00:00")
+                ).timestamp()
+            except ValueError:
+                pass  # no expiry → cache for the process lifetime
+
+
 class KubeConfig:
     """The subset of a kubeconfig the client consumes."""
 
@@ -50,10 +156,19 @@ class KubeConfig:
         server: str,
         token: str = "",
         ssl_context: Optional[ssl.SSLContext] = None,
+        exec_plugin: Optional[ExecCredentialPlugin] = None,
     ):
         self.server = server
         self.token = token
         self.ssl_context = ssl_context
+        self.exec_plugin = exec_plugin
+
+    def bearer_token(self) -> str:
+        """The Authorization bearer token for the next request — static
+        from the kubeconfig, or minted (and cached) by the exec plugin."""
+        if self.exec_plugin is not None:
+            return self.exec_plugin.token()
+        return self.token
 
     @classmethod
     def load(cls, path: str) -> "KubeConfig":
@@ -86,6 +201,10 @@ class KubeConfig:
             with open(token_file) as f:
                 token = f.read().strip()
 
+        exec_plugin = None
+        if user.get("exec"):
+            exec_plugin = ExecCredentialPlugin(user["exec"])
+
         ssl_context = None
         if server.startswith("https"):
             if cluster.get("insecure-skip-tls-verify"):
@@ -117,7 +236,8 @@ class KubeConfig:
                 os.chmod(key_file, 0o600)
             if cert_file and key_file:
                 ssl_context.load_cert_chain(cert_file, key_file)
-        return cls(server=server, token=token, ssl_context=ssl_context)
+        return cls(server=server, token=token, ssl_context=ssl_context,
+                   exec_plugin=exec_plugin)
 
 
 class KubeApiClient:
@@ -131,6 +251,11 @@ class KubeApiClient:
         self._host = parsed.hostname or "localhost"
         self._port = parsed.port or (443 if self._https else 80)
         self._local = threading.local()
+        # in-flight watch connections, so cancel_watches() can unblock
+        # reader threads parked in readline() (store teardown path)
+        self._watch_conns: set = set()
+        self._watch_lock = threading.Lock()
+        self._watches_cancelled = False
 
     # ------------------------------------------------------------- plumbing
     def _connect(self, timeout: Optional[float] = None) -> http.client.HTTPConnection:
@@ -146,8 +271,9 @@ class KubeApiClient:
 
     def _headers(self) -> Dict[str, str]:
         h = {"Accept": "application/json", "Content-Type": "application/json"}
-        if self.config.token:
-            h["Authorization"] = f"Bearer {self.config.token}"
+        token = self.config.bearer_token()
+        if token:
+            h["Authorization"] = f"Bearer {token}"
         return h
 
     def request(
@@ -168,17 +294,18 @@ class KubeApiClient:
         if params:
             path = f"{path}?{urllib.parse.urlencode(params)}"
         payload = json.dumps(body) if body is not None else None
+        auth_retried = False
         while True:
+            headers = self._headers()
             conn = getattr(self._local, "conn", None)
             fresh = conn is None
             if fresh:
                 conn = self._connect()
                 self._local.conn = conn
             try:
-                conn.request(method, path, body=payload, headers=self._headers())
+                conn.request(method, path, body=payload, headers=headers)
                 resp = conn.getresponse()
                 data = resp.read()
-                break
             except (http.client.HTTPException, OSError):
                 self._local.conn = None
                 try:
@@ -189,6 +316,22 @@ class KubeApiClient:
                     raise
                 # reused connection died (server closed the keep-alive);
                 # loop once more with fresh=True
+                continue
+            if (
+                resp.status == 401
+                and self.config.exec_plugin is not None
+                and not auth_retried
+            ):
+                # the minted token went stale server-side (possibly with no
+                # usable expirationTimestamp to age it out client-side):
+                # invalidate and retry ONCE with a re-exec'd credential
+                auth = headers.get("Authorization") or ""
+                self.config.exec_plugin.invalidate(
+                    auth.removeprefix("Bearer ")
+                )
+                auth_retried = True
+                continue
+            break
         if resp.status >= 300:
             raise ApiError(resp.status, resp.reason or "", data.decode(errors="replace"))
         if not data:
@@ -227,11 +370,24 @@ class KubeApiClient:
             params["resourceVersion"] = resource_version
         full = f"{path}?{urllib.parse.urlencode(params)}"
         conn = self._connect(timeout=timeout_seconds + 10)
+        with self._watch_lock:
+            if self._watches_cancelled:
+                conn.close()
+                raise OSError("client closed; watches cancelled")
+            self._watch_conns.add(conn)
         try:
-            conn.request("GET", full, headers=self._headers())
+            headers = self._headers()
+            conn.request("GET", full, headers=headers)
             resp = conn.getresponse()
             if resp.status >= 300:
                 body = resp.read()
+                if resp.status == 401 and self.config.exec_plugin is not None:
+                    # stale exec credential: invalidate so the reflector's
+                    # re-list/re-watch retry mints a fresh one
+                    auth = headers.get("Authorization") or ""
+                    self.config.exec_plugin.invalidate(
+                        auth.removeprefix("Bearer ")
+                    )
                 raise ApiError(
                     resp.status, resp.reason or "", body.decode(errors="replace")
                 )
@@ -252,6 +408,24 @@ class KubeApiClient:
                                    json.dumps(event)[:200])
                 yield event
         finally:
+            with self._watch_lock:
+                self._watch_conns.discard(conn)
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def cancel_watches(self) -> None:
+        """Terminally cancel watch streaming: close every in-flight watch
+        connection (readline() in reader threads raises immediately instead
+        of blocking out the server timeout) and fail any subsequent
+        :meth:`watch` call fast. Used by store ``close()`` so watch threads
+        can be joined promptly."""
+        with self._watch_lock:
+            self._watches_cancelled = True
+            conns = list(self._watch_conns)
+            self._watch_conns.clear()
+        for conn in conns:
             try:
                 conn.close()
             except Exception:

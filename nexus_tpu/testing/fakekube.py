@@ -81,9 +81,13 @@ class _History:
 class FakeKubeApiServer:
     """HTTP API server over a ClusterStore. Start/stop per test."""
 
-    def __init__(self, store: Optional[ClusterStore] = None, name: str = "fake"):
+    def __init__(self, store: Optional[ClusterStore] = None, name: str = "fake",
+                 required_token: str = ""):
         self.store = store or ClusterStore(name)
         self.events: List[Dict[str, Any]] = []  # posted v1 Events
+        # when set, every request must carry `Authorization: Bearer <this>`
+        # (exercises the client's auth plumbing, incl. exec plugins)
+        self.required_token = required_token
         self.history = _History()
         for plural, typ in _TYPES.items():
             self.store.subscribe(typ.KIND, self._make_recorder(typ.KIND))
@@ -109,8 +113,22 @@ class FakeKubeApiServer:
         host, port = self._httpd.server_address
         return f"http://{host}:{port}"
 
-    def write_kubeconfig(self, path: str) -> str:
-        """Emit a minimal kubeconfig pointing at this server."""
+    def write_kubeconfig(self, path: str,
+                         exec_command: Optional[List[str]] = None) -> str:
+        """Emit a minimal kubeconfig pointing at this server.
+
+        With ``exec_command`` the user block uses a
+        client.authentication.k8s.io exec plugin (command + args) instead of
+        a static token — the shape GKE/EKS kubeconfigs use."""
+        if exec_command:
+            user: Dict[str, Any] = {"exec": {
+                "apiVersion": "client.authentication.k8s.io/v1",
+                "command": exec_command[0],
+                "args": list(exec_command[1:]),
+                "interactiveMode": "Never",
+            }}
+        else:
+            user = {"token": self.required_token or "fake-token"}
         doc = {
             "apiVersion": "v1",
             "kind": "Config",
@@ -119,7 +137,7 @@ class FakeKubeApiServer:
                 {"name": "fake", "context": {"cluster": "fake", "user": "fake"}}
             ],
             "clusters": [{"name": "fake", "cluster": {"server": self.url}}],
-            "users": [{"name": "fake", "user": {"token": "fake-token"}}],
+            "users": [{"name": "fake", "user": user}],
         }
         import yaml
 
@@ -204,8 +222,21 @@ class FakeKubeApiServer:
                 raw = self.rfile.read(length) if length else b"{}"
                 return json.loads(raw or b"{}")
 
+            def _authorized(self) -> bool:
+                """401 unless the request carries the server's bearer token
+                (no-op when the server doesn't require one)."""
+                if not server.required_token:
+                    return True
+                got = self.headers.get("Authorization") or ""
+                if got == f"Bearer {server.required_token}":
+                    return True
+                self._status(401, "Unauthorized", "invalid bearer token")
+                return False
+
             # --------------------------------------------------------- verbs
             def do_GET(self):  # noqa: N802
+                if not self._authorized():
+                    return
                 route = self._route()
                 if route is None:
                     if urlparse(self.path).path == "/-/compact":
@@ -244,6 +275,8 @@ class FakeKubeApiServer:
                     self._status(404, "NotFound", str(e))
 
             def do_POST(self):  # noqa: N802
+                if not self._authorized():
+                    return
                 route = self._route()
                 if route is None:
                     self._status(404, "NotFound", f"no route {self.path}")
@@ -265,6 +298,8 @@ class FakeKubeApiServer:
                 self._send_json(201, created.to_dict())
 
             def do_PUT(self):  # noqa: N802
+                if not self._authorized():
+                    return
                 route = self._route()
                 if route is None or route[2] is None:
                     self._status(404, "NotFound", f"no route {self.path}")
@@ -289,6 +324,8 @@ class FakeKubeApiServer:
                 self._send_json(200, out.to_dict())
 
             def do_DELETE(self):  # noqa: N802
+                if not self._authorized():
+                    return
                 route = self._route()
                 if route is None or route[2] is None:
                     self._status(404, "NotFound", f"no route {self.path}")
