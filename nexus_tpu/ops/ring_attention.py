@@ -287,12 +287,12 @@ def ring_attention_sharded(q, k, v, window: int = 0):
     mesh = thread_resources.env.physical_mesh
     if mesh.empty or mesh.shape.get("sequence", 1) == 1:
         return attention(q, k, v, causal=True, impl=None, window=window)
-    try:
-        smap = jax.shard_map
-        vma_kwarg = "check_vma"
-    except AttributeError:  # pragma: no cover — older jax
-        from jax.experimental.shard_map import shard_map as smap
-        vma_kwarg = "check_rep"
+    from nexus_tpu.parallel.sharding import (
+        get_shard_map,
+        shard_map_unchecked_kwargs,
+    )
+
+    smap = get_shard_map()
 
     # flash inner blocks on TPU when the local shard tiles cleanly (the
     # kernel needs 8-divisible sequence blocks and a supported head_dim);
@@ -317,9 +317,8 @@ def ring_attention_sharded(q, k, v, window: int = 0):
     if block_impl == "flash":
         # pallas interpret/lowering paths mix varying and invariant operands
         # in their internal dynamic_slices; vma checking rejects that (jax
-        # suggests check_vma=False as the supported escape hatch; the older
-        # shard_map spells the same flag check_rep)
-        smap_kwargs[vma_kwarg] = False
+        # suggests disabling the check as the supported escape hatch)
+        smap_kwargs.update(shard_map_unchecked_kwargs())
     ring = smap(
         _partial(
             ring_attention, axis_name="sequence", causal=True,

@@ -1,12 +1,14 @@
-"""Pipeline parallelism: GPipe-style microbatched stages over the
-``pipeline`` mesh axis.
+"""Pipeline parallelism: microbatched stages over the ``pipeline`` mesh
+axis, with two schedules — GPipe and 1F1B.
 
 NOT PRESENT in the reference (SURVEY.md §2c — no model code at all); built
 TPU-first rather than translated: the model's stacked-layer parameter layout
-(models/llama.py) means a "stage" is just a contiguous slice of the stacked
-layer dim, so sharding that dim with ``P('pipeline')`` inside ``shard_map``
-gives each device its stage's weights with zero reshuffling. The schedule is
-the classic bubble-filled GPipe loop:
+(models/llama.py, models/gptneox.py) means a "stage" is just a contiguous
+slice of the stacked layer dim, so sharding that dim with ``P('pipeline')``
+inside ``shard_map`` gives each device its stage's weights with zero
+reshuffling.
+
+**GPipe** (``pipeline_apply``) is the classic bubble-filled loop:
 
     ticks t = 0 .. M + S - 2   (M microbatches, S stages)
       * stage 0 injects microbatch t (while t < M);
@@ -16,11 +18,25 @@ the classic bubble-filled GPipe loop:
         parallel/mesh.py);
       * the last stage emits outputs for ticks t >= S-1.
 
+Autodiff flows through ppermute + scan, so the same forward drives
+pipelined training — but the scan saves every tick's activations, so peak
+memory grows with M (microbatches).
+
+**1F1B** (``pipeline_1f1b_loss_and_grads``) interleaves one forward with
+one backward per tick so a stage holds at most ``2S-1`` in-flight
+microbatch *inputs* (a static ring buffer) instead of all M — the
+standard schedule's memory bound, independent of microbatch count. The
+backward is hand-scheduled (autodiff cannot reorder its own backward):
+each stage saves only the microbatch's stage INPUT and rematerializes the
+stage forward inside ``jax.vjp`` at backward time (the same recompute cost
+as full-block remat). Stage-to-stage activation hops and the reverse
+gradient hops are both neighbor ``ppermute``s. The LM head runs inside the
+last stage's tick under ``lax.cond`` (other stages skip the compute at
+run time), so each microbatch's backward starts the tick after its
+forward finishes — no full-batch logits ever materialize.
+
 All stages run identical SPMD code (shard_map requirement); stage identity
-comes from ``lax.axis_index``. Autodiff flows through ppermute + scan, so
-the same forward drives pipelined training (full-activation GPipe; no 1F1B
-yet). Output is returned sharded ``P('pipeline')`` on a leading per-stage
-dim — reading ``[-1]`` pulls only the last stage's shard, no collective.
+comes from ``lax.axis_index``.
 """
 
 from __future__ import annotations
@@ -112,62 +128,70 @@ def pipeline_apply(
     # the per-stage output keeps whatever sharding the activations carry
     # (e.g. batch over (data, fsdp)), with the stage dim prepended
     x_entries = tuple(in_x_spec) + (None,) * (x_mb.ndim - len(tuple(in_x_spec)))
+    from nexus_tpu.parallel.sharding import shard_map_unchecked_kwargs
+
     kwargs = dict(
         mesh=mesh,
         in_specs=(layer_spec, in_x_spec),
         out_specs=P(axis, *x_entries),
+        # replication checking off: output is intentionally stage-varying
+        **shard_map_unchecked_kwargs(),
     )
-    # replication checking is off: output is intentionally stage-varying
-    # (kwarg renamed check_rep → check_vma across jax versions)
-    import inspect
-
-    if "check_vma" in inspect.signature(shard_map).parameters:
-        kwargs["check_vma"] = False
-    else:
-        kwargs["check_rep"] = False
     fn = shard_map(functools.partial(_pipeline_body, stage_fn, axis), **kwargs)
     staged = fn(params, x_mb)  # (S, M, ...)
     return staged[n_stages - 1]
 
 
-# ----------------------------------------------------- llama integration
+# ------------------------------------------------ model-family adapters
 
 
-def llama_pipeline_hidden(
-    params: Dict[str, Any],
-    cfg,
-    tokens: jnp.ndarray,
-    mesh: Mesh,
-    n_microbatches: int,
-) -> jnp.ndarray:
-    """Llama trunk with layers pipelined over the 'pipeline' mesh axis:
-    tokens (B, S) → final-norm hidden (B, S, d).
+def _trunk_parts(family: str, params: Dict[str, Any], cfg, seq_len: int):
+    """Per-family pieces the schedules compose: ``stage_fn(layers_local, h)``
+    (a contiguous slice of the stacked layer scan) and
+    ``head_loss(head_params, hidden, targets)`` (final norm + LM head + CE,
+    honoring ``cfg.ce_chunk``), plus the head-param subtree keys.
 
-    Embedding and the LM head are replicated (cheap vs the layer stack);
-    the (B, S) batch is split into M microbatches along batch."""
-    from nexus_tpu.models.llama import _block  # stacked-layer block
-    from nexus_tpu.ops.norms import rms_norm
+    Families supported: llama, gptneox — both lay parameters out as
+    {embed, layers(stacked), final_norm(+final_norm_b), lm_head}."""
+    from nexus_tpu.ops.losses import chunked_softmax_xent, dense_softmax_xent
     from nexus_tpu.ops.rope import rope_cos_sin
 
-    b, s = tokens.shape
-    if b % n_microbatches:
-        raise ValueError(f"batch {b} not divisible by microbatches {n_microbatches}")
-    n_stages = mesh.shape["pipeline"]
-    if cfg.n_layers % n_stages:
+    if family == "llama":
+        from nexus_tpu.models.llama import _block
+        from nexus_tpu.ops.norms import rms_norm
+
+        cos, sin = rope_cos_sin(
+            seq_len, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32
+        )
+        block = lambda h, layer: _block(cfg, h, layer, cos, sin)
+        head_keys = ("final_norm", "lm_head")
+
+        def final_norm(head, y):
+            return rms_norm(y, head["final_norm"], cfg.norm_eps)
+    elif family == "gptneox":
+        from nexus_tpu.models.gptneox import _block
+        from nexus_tpu.ops.norms import layer_norm
+
+        cos, sin = rope_cos_sin(
+            seq_len, cfg.rotary_dims, cfg.rope_theta, dtype=jnp.float32
+        )
+        block = lambda h, layer: _block(cfg, h, layer, cos, sin)
+        head_keys = ("final_norm", "final_norm_b", "lm_head")
+
+        def final_norm(head, y):
+            return layer_norm(
+                y, head["final_norm"], head["final_norm_b"], cfg.norm_eps
+            )
+    else:
         raise ValueError(
-            f"n_layers {cfg.n_layers} not divisible by {n_stages} stages"
+            f"pipeline parallelism supports llama/gptneox (got {family!r})"
         )
 
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    x_mb = x.reshape(n_microbatches, b // n_microbatches, s, cfg.d_model)
-    cos, sin = rope_cos_sin(s, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32)
-
-    block = lambda h, layer: _block(cfg, h, layer, cos, sin)
     if getattr(cfg, "remat", False):
-        # per-layer remat inside the stage: with M microbatches in flight a
-        # stage holds M activation sets — rematerializing the block bounds
-        # that at M×(layer I/O) instead of M×(full block internals), the
-        # GPipe memory knob until a 1F1B schedule lands
+        # per-layer remat inside the stage — under GPipe this bounds the M
+        # in-flight activation sets at M×(layer I/O); under 1F1B the stage
+        # input is the only saved tensor already, so remat only trims the
+        # within-tick vjp residuals further
         from nexus_tpu.ops.remat import checkpoint_block
 
         block = checkpoint_block(block, getattr(cfg, "remat_policy", "full"))
@@ -179,6 +203,48 @@ def llama_pipeline_hidden(
         h, _ = lax.scan(body, h, layers_local)
         return h
 
+    def head_loss(head, hidden, targets):
+        """Final norm + LM head + CE. ``head`` needs only the head_keys
+        entries, so the full params tree is also accepted."""
+        y = final_norm(head, hidden)
+        if getattr(cfg, "ce_chunk", 0) > 0:
+            return chunked_softmax_xent(
+                y, head["lm_head"], targets, chunk=cfg.ce_chunk
+            )
+        return dense_softmax_xent(y, head["lm_head"], targets)
+
+    return stage_fn, head_loss, final_norm, head_keys
+
+
+def _check_pipeline_shapes(b, n_microbatches, cfg, mesh):
+    if b % n_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by microbatches {n_microbatches}"
+        )
+    n_stages = mesh.shape["pipeline"]
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by {n_stages} stages"
+        )
+
+
+# ----------------------------------------------------- GPipe integration
+
+
+def _pipeline_trunk(
+    family: str, params: Dict[str, Any], cfg, tokens: jnp.ndarray,
+    mesh: Mesh, n_microbatches: int,
+):
+    """GPipe trunk WITHOUT the final norm: tokens (B, S) → (B, S, d), plus
+    the family parts so callers reuse the one norm/CE dispatch."""
+    b, s = tokens.shape
+    _check_pipeline_shapes(b, n_microbatches, cfg, mesh)
+    parts = _trunk_parts(family, params, cfg, s)
+    stage_fn = parts[0]
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x_mb = x.reshape(n_microbatches, b // n_microbatches, s, cfg.d_model)
+
     layer_spec = jax.tree_util.tree_map(lambda _: P("pipeline"), params["layers"])
     # microbatch dim replicated; per-microbatch batch dim keeps the data
     # sharding so the data axis parallelizes within each pipeline stage
@@ -186,37 +252,301 @@ def llama_pipeline_hidden(
         stage_fn, params["layers"], x_mb, mesh,
         params_spec=layer_spec, x_spec=P(None, ("data", "fsdp")),
     )
-    y = y_mb.reshape(b, s, cfg.d_model)
-    return rms_norm(y, params["final_norm"], cfg.norm_eps)
+    return y_mb.reshape(b, s, cfg.d_model), parts
 
 
-def llama_pipeline_forward(
+def pipeline_hidden(
+    family: str,
     params: Dict[str, Any],
     cfg,
     tokens: jnp.ndarray,
     mesh: Mesh,
     n_microbatches: int,
 ) -> jnp.ndarray:
+    """Model trunk with layers pipelined over the 'pipeline' mesh axis:
+    tokens (B, S) → final-norm hidden (B, S, d).
+
+    Embedding and the LM head are replicated (cheap vs the layer stack);
+    the (B, S) batch is split into M microbatches along batch."""
+    y, (_stage, _loss, final_norm, _keys) = _pipeline_trunk(
+        family, params, cfg, tokens, mesh, n_microbatches
+    )
+    return final_norm(params, y)
+
+
+def pipeline_forward(
+    family: str, params: Dict[str, Any], cfg, tokens: jnp.ndarray,
+    mesh: Mesh, n_microbatches: int,
+) -> jnp.ndarray:
     """tokens (B, S) → logits (B, S, V) f32 through the GPipe trunk."""
-    y = llama_pipeline_hidden(params, cfg, tokens, mesh, n_microbatches)
+    y = pipeline_hidden(family, params, cfg, tokens, mesh, n_microbatches)
     return (y @ params["lm_head"]).astype(jnp.float32)
 
 
-def llama_pipeline_loss(
-    params: Dict[str, Any], cfg, batch: Dict[str, jnp.ndarray],
+def pipeline_loss(
+    family: str, params: Dict[str, Any], cfg, batch: Dict[str, jnp.ndarray],
     mesh: Mesh, n_microbatches: int,
 ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
     """GPipe next-token CE; honors ``cfg.ce_chunk`` exactly like the
-    non-pipelined loss (models/llama.py::loss_fn)."""
-    from nexus_tpu.ops.losses import chunked_softmax_xent, dense_softmax_xent
-
+    non-pipelined losses — the norm/CE dispatch is the same ``head_loss``
+    the 1F1B schedule uses."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    hidden = llama_pipeline_hidden(params, cfg, inputs, mesh, n_microbatches)
-    if getattr(cfg, "ce_chunk", 0) > 0:
-        loss = chunked_softmax_xent(
-            hidden, params["lm_head"], targets, chunk=cfg.ce_chunk
-        )
-    else:
-        loss = dense_softmax_xent(hidden, params["lm_head"], targets)
+    y, (_stage, head_loss, _norm, _keys) = _pipeline_trunk(
+        family, params, cfg, inputs, mesh, n_microbatches
+    )
+    loss = head_loss(params, y, targets)
     return loss, {"loss": loss, "perplexity": jnp.exp(loss)}
+
+
+# thin llama-named wrappers kept for callers/tests predating the
+# family-generic surface
+def llama_pipeline_hidden(params, cfg, tokens, mesh, n_microbatches):
+    return pipeline_hidden("llama", params, cfg, tokens, mesh, n_microbatches)
+
+
+def llama_pipeline_forward(params, cfg, tokens, mesh, n_microbatches):
+    return pipeline_forward("llama", params, cfg, tokens, mesh, n_microbatches)
+
+
+def llama_pipeline_loss(params, cfg, batch, mesh, n_microbatches):
+    return pipeline_loss("llama", params, cfg, batch, mesh, n_microbatches)
+
+
+# ------------------------------------------------------- 1F1B schedule
+
+
+def _1f1b_body(
+    stage_fn, head_loss, axis, n_mb, data_axes,
+    local_layers, head, x_mb, tgt_mb,
+):
+    """Per-device 1F1B schedule (manual forward + backward).
+
+    Tick ``t`` runs two phases on every stage ``s``:
+      * fwd phase: microbatch ``t - s`` (when in range) — stage input saved
+        into a ``2S-1``-slot ring, stage forward applied, result hopped to
+        ``s+1``;
+      * bwd phase: microbatch ``t - (2S-2-s)`` — saved input pulled from
+        the ring, stage forward REMATERIALIZED under ``jax.vjp``, cotangent
+        taken from the next stage's gradient hop (or, on the last stage,
+        from the loss just computed this tick), parameter grads
+        accumulated, input gradient hopped to ``s-1``.
+
+    The last stage's microbatch thus goes fwd -> head-loss -> bwd within
+    one tick, and earlier stages drain backward one hop per tick — the
+    PipeDream-flush (non-interleaved 1F1B) dependency structure, in
+    M + 2S - 2 total ticks.
+
+    Returns ``(loss, d_layers, d_head, dx_mb)``; shared-param grads are
+    already pmean'd over the data axes (global-batch mean semantics,
+    matching what autodiff produces for the non-pipelined loss)."""
+    n_stages = lax.axis_size(axis)
+    stage = lax.axis_index(axis)
+    is_last = stage == n_stages - 1
+    m = n_mb
+    n_slots = 2 * n_stages - 1  # max in-flight inputs per stage (stage 0)
+    n_ticks = m + 2 * n_stages - 2
+
+    f32 = jnp.float32
+
+    def g(layers, head_p, h_in, tgt):
+        """Unified per-microbatch stage computation: trunk slice + (last
+        stage only, via lax.cond — other stages skip the FLOPs at run
+        time) the LM-head loss. One vjp of this covers both the inner
+        stages (cotangent = next stage's dh) and the last stage
+        (cotangent = d loss)."""
+        h_out = stage_fn(layers, h_in)
+        loss = lax.cond(
+            is_last,
+            lambda hp, h: head_loss(hp, h, tgt).astype(f32),
+            lambda hp, h: jnp.zeros((), f32),
+            head_p, h_out,
+        )
+        return h_out, loss
+
+    zero_act = jnp.zeros_like(x_mb[0])
+    carry0 = (
+        zero_act,                                     # fwd_buf: h from s-1
+        zero_act,                                     # bwd_buf: dh from s+1
+        jnp.zeros((n_slots,) + x_mb.shape[1:], x_mb.dtype),  # saved ring
+        jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, f32), local_layers),
+        jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, f32), head),
+        # dx_mb: input-dtype, written once per slot (no accumulation), only
+        # stage 0's copy is ever read (out_specs stage-stack + [0] outside)
+        jnp.zeros(x_mb.shape, x_mb.dtype),
+        jnp.zeros((), f32),                           # loss accumulator
+    )
+    perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    perm_bwd = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        fwd_buf, bwd_buf, saved, g_layers, g_head, dx_mb, loss_acc = carry
+
+        # ---------------------------------------------------- fwd phase
+        fwd_m = t - stage
+        fwd_live = jnp.logical_and(fwd_m >= 0, fwd_m < m)
+        inject = lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(fwd_m, 0, m - 1), axis=0, keepdims=False
+        )
+        h_in = jnp.where(stage == 0, inject, fwd_buf)
+        h_in = jnp.where(fwd_live, h_in, jnp.zeros_like(h_in))
+        slot_f = jnp.mod(jnp.clip(fwd_m, 0, None), n_slots)
+        cur_slot = lax.dynamic_index_in_dim(
+            saved, slot_f, axis=0, keepdims=False
+        )
+        saved = lax.dynamic_update_index_in_dim(
+            saved, jnp.where(fwd_live, h_in, cur_slot), slot_f, axis=0
+        )
+        h_out = stage_fn(local_layers, h_in)
+        fwd_buf = lax.ppermute(h_out, axis, perm_fwd)
+
+        # ---------------------------------------------------- bwd phase
+        bwd_m = t - (2 * n_stages - 2 - stage)
+        bwd_live = jnp.logical_and(bwd_m >= 0, bwd_m < m)
+        slot_b = jnp.mod(jnp.clip(bwd_m, 0, None), n_slots)
+        h_saved = lax.dynamic_index_in_dim(
+            saved, slot_b, axis=0, keepdims=False
+        )
+        tgt = lax.dynamic_index_in_dim(
+            tgt_mb, jnp.clip(bwd_m, 0, m - 1), axis=0, keepdims=False
+        )
+        (h_re, loss_mb), vjp_fn = jax.vjp(
+            lambda L, H, h: g(L, H, h, tgt), local_layers, head, h_saved
+        )
+        dh_out = jnp.where(is_last, jnp.zeros_like(bwd_buf), bwd_buf)
+        # each microbatch contributes loss/M; the cotangent carries the 1/M
+        dloss = jnp.where(
+            jnp.logical_and(is_last, bwd_live), f32(1.0 / m), f32(0.0)
+        )
+        d_layers, d_head, dh_in = vjp_fn((dh_out.astype(h_re.dtype), dloss))
+
+        mask = bwd_live
+        g_layers = jax.tree_util.tree_map(
+            lambda acc, d: acc + jnp.where(mask, d.astype(f32), 0.0),
+            g_layers, d_layers,
+        )
+        g_head = jax.tree_util.tree_map(
+            lambda acc, d: acc + jnp.where(mask, d.astype(f32), 0.0),
+            g_head, d_head,
+        )
+        loss_acc = loss_acc + jnp.where(mask, loss_mb / m, 0.0)
+        # stage 0's input gradient is d(embedding output) — record it
+        dx_cur = lax.dynamic_index_in_dim(
+            dx_mb, jnp.clip(bwd_m, 0, m - 1), axis=0, keepdims=False
+        )
+        record_dx = jnp.logical_and(stage == 0, mask)
+        dx_mb = lax.dynamic_update_index_in_dim(
+            dx_mb,
+            jnp.where(record_dx, dh_in.astype(dx_mb.dtype), dx_cur),
+            jnp.clip(bwd_m, 0, m - 1), axis=0,
+        )
+        bwd_buf = lax.ppermute(dh_in.astype(bwd_buf.dtype), axis, perm_bwd)
+
+        return (
+            fwd_buf, bwd_buf, saved, g_layers, g_head, dx_mb, loss_acc
+        ), None
+
+    carry, _ = lax.scan(tick, carry0, jnp.arange(n_ticks))
+    _, _, _, g_layers, g_head, dx_mb, loss_acc = carry
+
+    # stage-varying scalars/params collapse over 'pipeline' (exactly one
+    # stage holds nonzero values); shared-param grads and the loss then
+    # average over the data shards — global-batch mean semantics. dx_mb is
+    # NOT collectived: it is returned with a leading per-stage dim and the
+    # caller reads stage 0's shard lazily (a full-batch-activation psum of
+    # which S-1 contributions are zeros would be pure waste).
+    loss = lax.psum(loss_acc, axis)
+    g_head = jax.tree_util.tree_map(lambda gv: lax.psum(gv, axis), g_head)
+    if data_axes:
+        loss = lax.pmean(loss, data_axes)
+        g_head = jax.tree_util.tree_map(
+            lambda gv: lax.pmean(gv, data_axes), g_head
+        )
+        g_layers = jax.tree_util.tree_map(
+            lambda gv: lax.pmean(gv, data_axes), g_layers
+        )
+        # dx is PER-SHARD (it feeds this shard's embedding-lookup rows); the
+        # global loss carries a 1/n factor the local vjp didn't see — but
+        # ONLY over the axes the batch is actually sharded on (data, fsdp).
+        # Axes the activations are REPLICATED over (tensor/sequence/expert)
+        # contribute identical dx copies, not disjoint batch shards, and
+        # must not scale the gradient down.
+        n_batch_shards = 1
+        for ax in ("data", "fsdp"):
+            if ax in data_axes:
+                n_batch_shards *= lax.axis_size(ax)
+        dx_mb = dx_mb / n_batch_shards
+    return loss, g_layers, g_head, dx_mb[None]
+
+
+def pipeline_1f1b_loss_and_grads(
+    family: str,
+    params: Dict[str, Any],
+    cfg,
+    batch: Dict[str, jnp.ndarray],
+    mesh: Mesh,
+    n_microbatches: int,
+) -> Tuple[jnp.ndarray, Dict[str, Any], Dict[str, Any]]:
+    """1F1B pipelined train computation: ``(loss, metrics, grads)``.
+
+    Unlike the GPipe path this does NOT go through ``jax.grad`` — the
+    backward is part of the schedule (see ``_1f1b_body``). Peak activation
+    memory per stage is the static ``2S-1``-slot input ring (+ one
+    microbatch's within-tick vjp residuals), versus GPipe's all-M in-flight
+    activations. Grads cover the full param tree: trunk layers from the
+    schedule, embed via a scatter-add of the returned input gradients,
+    head/final-norm from the last stage's per-tick head vjp."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    b, s = inputs.shape
+    _check_pipeline_shapes(b, n_microbatches, cfg, mesh)
+    m = n_microbatches
+    stage_fn, head_loss, _norm, head_keys = _trunk_parts(family, params, cfg, s)
+
+    embed = params["embed"]
+    x = embed.astype(cfg.dtype)[inputs]
+    x_mb = x.reshape(m, b // m, s, cfg.d_model)
+    tgt_mb = targets.reshape(m, b // m, s)
+    head = {k: params[k] for k in head_keys}
+
+    data_axes = tuple(
+        ax for ax in mesh.axis_names
+        if ax != "pipeline" and mesh.shape[ax] > 1
+    )
+    layer_spec = jax.tree_util.tree_map(
+        lambda _: P("pipeline"), params["layers"]
+    )
+    head_spec = jax.tree_util.tree_map(lambda _: P(), head)
+    x_spec = P(None, ("data", "fsdp"))
+
+    # dx comes back with a leading per-stage dim (P('pipeline')); reading
+    # [0] pulls only stage 0's shard — the one that holds the real values —
+    # with no collective
+    dx_spec = P("pipeline", None, ("data", "fsdp"))
+    from nexus_tpu.parallel.sharding import shard_map_unchecked_kwargs
+
+    kwargs = dict(
+        mesh=mesh,
+        in_specs=(layer_spec, head_spec, x_spec, x_spec),
+        out_specs=(P(), layer_spec, head_spec, dx_spec),
+        **shard_map_unchecked_kwargs(),
+    )
+    body = functools.partial(
+        _1f1b_body, stage_fn, head_loss, "pipeline", m, data_axes
+    )
+    loss, g_layers, g_head, dx_staged = shard_map(body, **kwargs)(
+        params["layers"], head, x_mb, tgt_mb
+    )
+
+    # embedding gradient: scatter the input gradients back onto the rows
+    # the lookup read (plain SPMD — XLA shards/combines the scatter)
+    dx = dx_staged[0].reshape(b, s, cfg.d_model)
+    d_embed = (
+        jnp.zeros(embed.shape, jnp.float32)
+        .at[inputs]
+        .add(dx.astype(jnp.float32))
+    )
+
+    grads = {"embed": d_embed, "layers": g_layers, **g_head}
+    metrics = {"loss": loss, "perplexity": jnp.exp(loss)}
+    return loss, metrics, grads

@@ -103,3 +103,30 @@ def sharding_tree(logical_tree, mesh: Mesh, rules=None):
         logical_tree,
         is_leaf=lambda x: isinstance(x, tuple) or x is None,
     )
+
+
+def get_shard_map():
+    """The shard_map entry point for this jax version."""
+    try:
+        return jax.shard_map
+    except AttributeError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map
+
+
+def shard_map_unchecked_kwargs() -> Dict[str, bool]:
+    """The kwargs that disable shard_map's replication/varying-manual-axes
+    checking, across the jax versions that renamed the flag
+    (``check_rep`` → ``check_vma``). Needed wherever a body's outputs are
+    intentionally stage/device-varying (pipeline schedules) or where pallas
+    lowering mixes varying and invariant operands (ring attention flash
+    blocks)."""
+    import inspect
+
+    name = (
+        "check_vma"
+        if "check_vma" in inspect.signature(get_shard_map()).parameters
+        else "check_rep"
+    )
+    return {name: False}

@@ -59,21 +59,37 @@ def build_optimizer(
 
 
 def make_train_step(
-    loss_fn: Callable[[Any, Dict[str, jnp.ndarray]], Tuple[jnp.ndarray, Dict]],
+    loss_fn: Optional[
+        Callable[[Any, Dict[str, jnp.ndarray]], Tuple[jnp.ndarray, Dict]]
+    ],
     optimizer: optax.GradientTransformation,
     mesh: Optional[Mesh] = None,
     batch_spec: P = P(("data", "fsdp")),
     grad_accum: int = 1,
     donate: bool = True,
+    grads_fn: Optional[
+        Callable[[Any, Dict[str, jnp.ndarray]], Tuple[Any, Dict]]
+    ] = None,
 ):
     """Build a jitted ``step(state, batch) -> (state, metrics)``.
 
     With a mesh, the batch is pinned to data-parallel sharding; the state
     keeps the (FSDP/TP) shardings it was created with (init_train_state) and
     XLA SPMD propagates them through the whole step. ``grad_accum > 1`` runs
-    a lax.scan over microbatches (batch's leading dim must be divisible)."""
+    a lax.scan over microbatches (batch's leading dim must be divisible).
+
+    ``grads_fn(params, batch) -> (grads, metrics)`` replaces the
+    ``jax.value_and_grad(loss_fn)`` pair for schedules that hand-write their
+    backward (the 1F1B pipeline, parallel/pipeline.py); it is mutually
+    exclusive with ``loss_fn``/``grad_accum``."""
+    if grads_fn is not None and grad_accum != 1:
+        raise ValueError("grads_fn already microbatches; grad_accum must be 1")
+    if (loss_fn is None) == (grads_fn is None):
+        raise ValueError("pass exactly one of loss_fn or grads_fn")
 
     def compute_grads(params, batch):
+        if grads_fn is not None:
+            return grads_fn(params, batch)
         if grad_accum == 1:
             (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, batch

@@ -257,3 +257,215 @@ def test_unknown_remat_policy_rejected():
 
     with _pytest.raises(ValueError, match="unknown remat_policy"):
         checkpoint_block(lambda x: x, "Dots")
+
+
+def test_1f1b_matches_single_path_loss_and_grads():
+    """1F1B's hand-written backward == autodiff of the plain (non-pipelined)
+    loss: same loss, same gradients for every param (embed included)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nexus_tpu.models import llama
+    from nexus_tpu.parallel.pipeline import pipeline_1f1b_loss_and_grads
+
+    cfg = llama.config("tiny", n_layers=4, dtype=jnp.float32, attn_impl="xla")
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    batch = {"tokens": tokens}
+
+    (ref_loss, _), ref_grads = jax.value_and_grad(
+        lambda p: llama.loss_fn(p, cfg, batch), has_aux=True
+    )(params)
+
+    mesh = build_mesh(MeshPlan(pipeline=4, data=2))
+    with mesh:
+        loss, metrics, grads = jax.jit(
+            lambda p, b: pipeline_1f1b_loss_and_grads(
+                "llama", p, cfg, b, mesh, n_microbatches=4
+            )
+        )(params, batch)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    flat_ref = jax.tree_util.tree_leaves_with_path(ref_grads)
+    flat_got = {
+        jax.tree_util.keystr(kp): v
+        for kp, v in jax.tree_util.tree_leaves_with_path(grads)
+    }
+    assert set(flat_got) == {jax.tree_util.keystr(kp) for kp, _ in flat_ref}
+    for kp, ref in flat_ref:
+        got = flat_got[jax.tree_util.keystr(kp)]
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-5,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(kp)}",
+        )
+
+
+def test_1f1b_matches_gpipe_loss():
+    """Both schedules compute the same loss on the same batch."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nexus_tpu.models import llama
+    from nexus_tpu.parallel.pipeline import (
+        pipeline_1f1b_loss_and_grads,
+        pipeline_loss,
+    )
+
+    cfg = llama.config("tiny", n_layers=4, dtype=jnp.float32, attn_impl="xla")
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (8, 17), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    batch = {"tokens": tokens}
+    mesh = build_mesh(MeshPlan(pipeline=4, data=2))
+    with mesh:
+        gp_loss, _ = jax.jit(
+            lambda p, b: pipeline_loss("llama", p, cfg, b, mesh, 4)
+        )(params, batch)
+        f_loss, _, _ = jax.jit(
+            lambda p, b: pipeline_1f1b_loss_and_grads(
+                "llama", p, cfg, b, mesh, 4
+            )
+        )(params, batch)
+    np.testing.assert_allclose(float(f_loss), float(gp_loss), rtol=1e-5)
+
+
+def test_1f1b_trains_gptneox():
+    """The PP families now include gptneox; the 1F1B step descends."""
+    import jax.numpy as jnp
+    import optax
+
+    from nexus_tpu.models import gptneox
+    from nexus_tpu.parallel.pipeline import pipeline_1f1b_loss_and_grads
+
+    cfg = gptneox.config("tiny", n_layers=4, dtype=jnp.float32,
+                         attn_impl="xla")
+    params = gptneox.init(jax.random.PRNGKey(0), cfg)
+    mesh = build_mesh(MeshPlan(pipeline=4, data=2))
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    batch = {"tokens": tokens}
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, metrics, grads = pipeline_1f1b_loss_and_grads(
+            "gptneox", params, cfg, batch, mesh, n_microbatches=2
+        )
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    with mesh:
+        losses = []
+        for _ in range(8):
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_1f1b_memory_bounded_vs_gpipe():
+    """The point of 1F1B: at many microbatches, peak temp memory stays
+    bounded by the stage count while GPipe's grows with M. Compared via
+    XLA's compile-time memory analysis of the full grad computation."""
+    import jax.numpy as jnp
+
+    from nexus_tpu.models import llama
+    from nexus_tpu.parallel.pipeline import (
+        pipeline_1f1b_loss_and_grads,
+        pipeline_loss,
+    )
+
+    cfg = llama.config("tiny", n_layers=4, dtype=jnp.float32, attn_impl="xla")
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    m = 16  # many microbatches — the GPipe-residency regime
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (32, 65), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    batch = {"tokens": tokens}
+    mesh = build_mesh(MeshPlan(pipeline=4, data=2))
+
+    def gpipe_grads(p, b):
+        return jax.grad(
+            lambda p: pipeline_loss("llama", p, cfg, b, mesh, m),
+            has_aux=True,
+        )(p)
+
+    def f1b_grads(p, b):
+        return pipeline_1f1b_loss_and_grads("llama", p, cfg, b, mesh, m)[2]
+
+    with mesh:
+        mem_gpipe = (
+            jax.jit(gpipe_grads).lower(params, batch).compile()
+            .memory_analysis()
+        )
+        mem_1f1b = (
+            jax.jit(f1b_grads).lower(params, batch).compile()
+            .memory_analysis()
+        )
+    assert mem_gpipe is not None and mem_1f1b is not None
+    temp_g = mem_gpipe.temp_size_in_bytes
+    temp_f = mem_1f1b.temp_size_in_bytes
+    # the 1F1B step must use meaningfully less scratch than GPipe here
+    assert temp_f < 0.7 * temp_g, (temp_f, temp_g)
+
+
+def test_hybrid_device_array_layout():
+    """The emulated multislice layout must put the DCN (slice) factor on
+    the OUTER stride of the absorbing axis: with 2 slices and data=4, rows
+    data[0:2] are slice 0 and data[2:4] are slice 1 — every ICI-axis
+    neighbor hop stays within one slice."""
+    import numpy as np
+
+    from nexus_tpu.parallel.mesh import _hybrid_device_array
+
+    devices = list(range(16))  # slice-major: 0-7 slice0, 8-15 slice1
+    plan = (1, 4, 2, 1, 1, 2)  # pipeline, data, fsdp, expert, seq, tensor
+    arr = _hybrid_device_array(devices, plan, 2)
+    assert arr.shape == plan
+    flat_by_data = arr.reshape(4, -1)
+    # data rows 0,1 hold slice-0 devices; rows 2,3 slice-1 devices
+    assert set(flat_by_data[:2].ravel()) == set(range(8))
+    assert set(flat_by_data[2:].ravel()) == set(range(8, 16))
+    # fsdp/tensor (pure-ICI axes) never cross a slice boundary
+    for d in range(4):
+        block = flat_by_data[d]
+        slice_ids = {int(x) // 8 for x in block}
+        assert len(slice_ids) == 1, (d, block)
+
+
+def test_1f1b_grads_correct_on_tensor_mesh():
+    """Regression: on a mesh with a tensor axis (activations REPLICATED
+    over it, batch sharded over data only), the embed gradient must not be
+    scaled down by the tensor size — 1F1B grads still match single-path
+    autodiff exactly."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nexus_tpu.models import llama
+    from nexus_tpu.parallel.pipeline import pipeline_1f1b_loss_and_grads
+
+    cfg = llama.config("tiny", n_layers=4, dtype=jnp.float32, attn_impl="xla")
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (8, 17), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    batch = {"tokens": tokens}
+
+    (ref_loss, _), ref_grads = jax.value_and_grad(
+        lambda p: llama.loss_fn(p, cfg, batch), has_aux=True
+    )(params)
+
+    mesh = build_mesh(MeshPlan(pipeline=2, data=2, tensor=2))
+    with mesh:
+        loss, _, grads = jax.jit(
+            lambda p, b: pipeline_1f1b_loss_and_grads(
+                "llama", p, cfg, b, mesh, n_microbatches=4
+            )
+        )(params, batch)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(grads["embed"]), np.asarray(ref_grads["embed"]),
+        rtol=2e-4, atol=2e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(grads["lm_head"]), np.asarray(ref_grads["lm_head"]),
+        rtol=2e-4, atol=2e-5,
+    )
