@@ -84,7 +84,34 @@ def split_dcn_axes(
     return ici, tuple(dcn)
 
 
-def build_mesh(plan: MeshPlan, devices: Optional[Sequence] = None) -> Mesh:
+def _hybrid_device_array(
+    devices: Sequence, plan_shape: Sequence[int], n_slices: int
+) -> np.ndarray:
+    """Arrange slice-contiguous ``devices`` into a hybrid ICI/DCN mesh
+    array: per mesh axis, the DCN factor is OUTER and the ICI factor inner
+    (the create_hybrid_device_mesh layout), so slice boundaries land on the
+    outermost strides of the axes that absorbed them.
+
+    Assumes ``devices`` is ordered slice-major (slice 0's devices first) —
+    true both for real multislice (process ids are slice-contiguous,
+    runtime/worker.py::WorkerIdentity.process_id) and for the CPU
+    emulation used in tests."""
+    ici, dcn = split_dcn_axes(plan_shape, n_slices)
+    arr = np.array(devices).reshape(tuple(dcn) + tuple(ici))
+    n = len(plan_shape)
+    # interleave (dcn_0, ici_0, dcn_1, ici_1, ...) then merge pairs
+    order = []
+    for i in range(n):
+        order.extend([i, n + i])
+    arr = arr.transpose(order)
+    return arr.reshape(tuple(plan_shape))
+
+
+def build_mesh(
+    plan: MeshPlan,
+    devices: Optional[Sequence] = None,
+    n_slices: Optional[int] = None,
+) -> Mesh:
     """Build a ``jax.sharding.Mesh`` with the framework's named axes.
 
     ``devices`` defaults to ``jax.devices()``; its length must equal the
@@ -94,7 +121,10 @@ def build_mesh(plan: MeshPlan, devices: Optional[Sequence] = None) -> Mesh:
     Multislice: when the devices span multiple slices (``slice_index``
     attribute), the mesh is built with ``mesh_utils.create_hybrid_device_mesh``
     so slice boundaries land on the outermost (DCN-tolerant) axes and
-    intra-slice neighbors stay adjacent on the inner (ICI) axes."""
+    intra-slice neighbors stay adjacent on the inner (ICI) axes.
+    ``n_slices`` forces the same hybrid layout when the backend does not
+    expose ``slice_index`` (the CPU multislice emulation: N processes
+    standing in for slices' hosts, devices ordered slice-major)."""
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
@@ -112,6 +142,10 @@ def build_mesh(plan: MeshPlan, devices: Optional[Sequence] = None) -> Mesh:
             ici, dcn, devices, allow_split_physical_axes=True
         )
         return Mesh(dev_array, AXES)
+    if n_slices and n_slices > 1:
+        return Mesh(
+            _hybrid_device_array(devices, plan.shape, n_slices), AXES
+        )
     dev_array = np.array(devices).reshape(plan.shape)
     return Mesh(dev_array, AXES)
 

@@ -98,3 +98,113 @@ def test_two_process_distributed_train_step():
     assert all(r["steps"] == 3 for r in results)
     # one SHARED train step: both processes computed the same global loss
     assert abs(results[0]["final_loss"] - results[1]["final_loss"]) < 1e-6
+
+
+MULTISLICE_WORKER = """
+import json, os, sys
+from nexus_tpu.runtime.worker import run_from_env
+import jax
+metrics = run_from_env()
+mesh_probe = {
+    "n_global_devices": len(jax.devices()),
+    "n_local_devices": len(jax.local_devices()),
+}
+print("RESULT " + json.dumps({**mesh_probe, **{
+    k: metrics[k] for k in (
+        "final_loss", "process_id", "num_processes", "distributed", "steps",
+    )
+}}), flush=True)
+"""
+
+
+def test_multislice_two_slices_two_hosts_each():
+    """MULTISLICE EXECUTION (VERDICT r2 item 3): 2 slices x 2 hosts/slice =
+    4 real OS processes x 4 CPU devices each = a 16-device hybrid ICI/DCN
+    mesh built by split_dcn_axes, running ONE shared llama train step
+    through the materializer's per-slice env contract.
+
+    The env each process gets is literally the env block of the Job the
+    materializer emits for its slice (coordinator address rewritten from
+    the headless-Service DNS name — which only resolves inside a cluster —
+    to a local port), so the contract that real pods consume is what this
+    test executes."""
+    from nexus_tpu.api.runtime_spec import (
+        DataSpec,
+        JaxXlaRuntime,
+        ModelRef,
+        ParallelismSpec,
+        TpuSliceSpec,
+        TrainSpec,
+    )
+    from nexus_tpu.runtime.materializer import materialize_job
+    from tests.test_controller_sync import make_template
+
+    runtime = JaxXlaRuntime(
+        mode="train",
+        model=ModelRef(family="llama", preset="tiny",
+                       overrides={"dtype": "float32", "attn_impl": "xla"}),
+        # v5e 2x4 = 8 chips/slice over 2 hosts (4 chips each); x2 slices
+        tpu=TpuSliceSpec(accelerator="v5e", topology="2x4", slice_count=2),
+        # data=4 absorbs the 2-slice DCN factor (split_dcn_axes), fsdp+tensor
+        # stay intra-slice (ICI)
+        parallelism=ParallelismSpec(data=4, fsdp=2, tensor=2),
+        train=TrainSpec(batch_size=8, seq_len=16, steps=2,
+                        learning_rate=1e-2),
+        data=DataSpec(prefetch=1),
+    )
+    template = make_template("ms-emu")
+    template.spec.runtime = runtime
+    jobs = materialize_job(template, shard_name="ms-test")
+    assert len(jobs) == 2  # one Job per slice
+    coordinator = f"127.0.0.1:{_free_port()}"
+
+    procs = []
+    for slice_idx, job in enumerate(jobs):
+        job_env = {
+            e["name"]: e["value"]
+            for e in job["spec"]["template"]["spec"]["containers"][0]["env"]
+        }
+        assert job_env["NEXUS_SLICE_INDEX"] == str(slice_idx)
+        assert job_env["NEXUS_SLICE_COUNT"] == "2"
+        for host_idx in range(2):  # hosts_per_slice = 8 chips / 4 per host
+            env = dict(os.environ)
+            env.pop("PYTHONPATH", None)
+            env.update(job_env)
+            env.update(
+                JOB_COMPLETION_INDEX=str(host_idx),
+                # the materializer's coordinator is a pod DNS name; rewire
+                # to loopback for the local emulation
+                JAX_COORDINATOR_ADDRESS=coordinator,
+                JAX_PLATFORMS="cpu",
+                # 4 virtual devices per process = this host's 4 chips
+                XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            )
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-c", MULTISLICE_WORKER],
+                    cwd=REPO,
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                )
+            )
+
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=560)
+        assert p.returncode == 0, (
+            f"worker failed:\nstdout={out}\nstderr={err[-3000:]}"
+        )
+        line = next(l for l in out.splitlines() if l.startswith("RESULT "))
+        results.append(json.loads(line[len("RESULT "):]))
+
+    assert {r["process_id"] for r in results} == {0, 1, 2, 3}
+    assert all(r["num_processes"] == 4 for r in results)
+    assert all(r["distributed"] is True for r in results)
+    assert all(r["n_global_devices"] == 16 for r in results)
+    assert all(r["n_local_devices"] == 4 for r in results)
+    assert all(r["steps"] == 2 for r in results)
+    # ONE shared SPMD step: every process reports the same global loss
+    losses = [r["final_loss"] for r in results]
+    assert max(losses) - min(losses) < 1e-6, losses
