@@ -41,6 +41,7 @@ from nexus_tpu.api.runtime_spec import (
     ModelRef,
     TrainSpec,
     CheckpointSpec,
+    WeightsSpec,
 )
 
 __all__ = [
@@ -71,6 +72,7 @@ __all__ = [
     "TpuSliceSpec",
     "ParallelismSpec",
     "ModelRef",
+    "WeightsSpec",
     "TrainSpec",
     "CheckpointSpec",
 ]

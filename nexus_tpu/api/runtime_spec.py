@@ -120,9 +120,13 @@ class ParallelismSpec:
     sequence: int = 1
     expert: int = 1
     pipeline: int = 1
-    # GPipe microbatches per step when pipeline > 1; 0 = auto (2× stages
+    # microbatches per step when pipeline > 1; 0 = auto (2× stages
     # when that divides the batch, else the stage count). Not a mesh axis.
     pipeline_microbatches: int = 0
+    # pipeline schedule: '1f1b' (default — peak activation memory bounded
+    # by the stage count, not the microbatch count; parallel/pipeline.py)
+    # or 'gpipe' (autodiff through the forward schedule; the fallback)
+    pipeline_schedule: str = "1f1b"
 
     def total(self) -> int:
         return (
@@ -143,6 +147,7 @@ class ParallelismSpec:
             "expert": self.expert,
             "pipeline": self.pipeline,
             "pipelineMicrobatches": self.pipeline_microbatches,
+            "pipelineSchedule": self.pipeline_schedule,
         }
 
     @classmethod
@@ -155,6 +160,33 @@ class ParallelismSpec:
             expert=int(d.get("expert", 1) or 1),
             pipeline=int(d.get("pipeline", 1) or 1),
             pipeline_microbatches=int(d.get("pipelineMicrobatches", 0) or 0),
+            pipeline_schedule=str(d.get("pipelineSchedule", "1f1b") or "1f1b"),
+        )
+
+
+@dataclass
+class WeightsSpec:
+    """Pretrained weights for the model: a HF-format safetensors
+    checkpoint (+ optional tokenizer.json), converted on load
+    (runtime/weights.py). Makes BASELINE config #3's "Llama-3-8B
+    inference" literal — real weights, real prompts."""
+
+    format: str = "safetensors"
+    path: str = ""  # file, shard dir, or dir with model.safetensors[.index.json]
+    tokenizer: str = ""  # tokenizer.json path ("" = no text prompts)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"format": self.format, "path": self.path}
+        if self.tokenizer:
+            d["tokenizer"] = self.tokenizer
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "WeightsSpec":
+        return cls(
+            format=str(d.get("format", "safetensors") or "safetensors"),
+            path=str(d.get("path", "") or ""),
+            tokenizer=str(d.get("tokenizer", "") or ""),
         )
 
 
@@ -165,20 +197,28 @@ class ModelRef:
     family: str = "mlp"  # mlp | llama | mixtral | gptneox
     preset: str = "tiny"
     overrides: Dict[str, Any] = field(default_factory=dict)
+    weights: Optional[WeightsSpec] = None
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        d = {
             "family": self.family,
             "preset": self.preset,
             "overrides": dict(self.overrides),
         }
+        if self.weights is not None:
+            d["weights"] = self.weights.to_dict()
+        return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ModelRef":
+        weights = None
+        if d.get("weights"):
+            weights = WeightsSpec.from_dict(d["weights"])
         return cls(
             family=d.get("family", "mlp"),
             preset=d.get("preset", "tiny"),
             overrides=dict(d.get("overrides") or {}),
+            weights=weights,
         )
 
 
@@ -235,6 +275,9 @@ class InferSpec:
     max_new_tokens: int = 512
     iterations: int = 3
     temperature: float = 0.0
+    # literal prompt text; tokenized with model.weights.tokenizer when both
+    # are set (otherwise the timing prompt is random ids of promptLength)
+    prompt: str = ""
     # speculative decoding (models/decoding.py::speculative_generate):
     # a draft model (family/preset/overrides, shared vocab) proposes
     # num_speculative tokens per target forward; greedy-exact. Requires
@@ -253,6 +296,8 @@ class InferSpec:
             "iterations": self.iterations,
             "temperature": self.temperature,
         }
+        if self.prompt:
+            d["prompt"] = self.prompt
         if self.draft is not None:
             d["draft"] = self.draft.to_dict()
             d["numSpeculative"] = self.num_speculative
@@ -272,6 +317,7 @@ class InferSpec:
             max_new_tokens=int(d.get("maxNewTokens", 512) or 512),
             iterations=int(d.get("iterations", 3) or 3),
             temperature=float(d.get("temperature", 0.0) or 0.0),
+            prompt=str(d.get("prompt", "") or ""),
             draft=draft,
             # NOT `or 4`: a present-but-zero value must reach validate()
             num_speculative=int(
@@ -413,6 +459,27 @@ class JaxXlaRuntime:
             )
         if self.tpu.accelerator not in TPU_GENERATIONS:
             errs.append(f"unknown accelerator {self.tpu.accelerator!r}")
+        if self.parallelism.pipeline_schedule not in ("1f1b", "gpipe"):
+            errs.append(
+                "parallelism.pipelineSchedule must be '1f1b' or 'gpipe', "
+                f"got {self.parallelism.pipeline_schedule!r}"
+            )
+        if self.model.weights is not None:
+            w = self.model.weights
+            if w.format != "safetensors":
+                errs.append(
+                    f"model.weights.format {w.format!r} unsupported "
+                    "(safetensors only)"
+                )
+            if not w.path:
+                errs.append("model.weights requires model.weights.path")
+            from nexus_tpu.runtime.weights import CONVERTERS
+
+            if self.model.family not in CONVERTERS:
+                errs.append(
+                    f"model.weights: no safetensors converter for family "
+                    f"{self.model.family!r} (have: {sorted(CONVERTERS)})"
+                )
         if self.profile.enabled:
             if not self.profile.directory:
                 errs.append("profile.enabled requires profile.directory")

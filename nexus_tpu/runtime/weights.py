@@ -1,0 +1,363 @@
+"""Pretrained-weight ingestion: HF safetensors checkpoints → param trees.
+
+Makes BASELINE config #3 ("Llama-3-8B JAX inference") literal: a template
+can point ``model.weights`` at a HuggingFace-format Llama checkpoint
+(single ``model.safetensors``, a sharded set with
+``model.safetensors.index.json``, or a directory of ``*.safetensors``) and
+``_run_infer`` decodes with those weights instead of random init.
+
+The reference has no model weights at all (SURVEY.md: it syncs config
+objects, never tensors); this subsystem exists for the TPU workload plane
+the north star adds. TPU-first design points:
+  * the safetensors container is parsed with the stdlib (8-byte little-
+    endian header length + JSON header + raw buffer) and tensors are read
+    through ``np.memmap`` slices — no full-file load, so an 8B checkpoint
+    streams layer-by-layer instead of doubling host RAM;
+  * bf16 tensors decode via ``ml_dtypes.bfloat16`` (numpy itself has no
+    bf16) and stay bf16 end-to-end — the MXU-native dtype;
+  * each converted leaf is ``jax.device_put`` straight onto its target
+    NamedSharding when one is given, so no host ever materializes more
+    than one stacked tensor beyond the current one and the device-side
+    layout matches the model's FSDP/TP logical axes from the start.
+
+HF→nexus mapping notes: our RoPE is the rotate-half convention
+(ops/rope.py), the same convention HF Llama checkpoints are stored in, so
+q/k projections transfer without the head-permutation some ports need.
+HF stores projections as (out, in); our params are (in, out) — transposed
+on ingest. Per-layer tensors stack along a leading layer dim (the
+lax.scan layout, models/llama.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("nexus_tpu.runtime.weights")
+
+_DTYPES: Dict[str, Any] = {
+    "F64": np.float64,
+    "F32": np.float32,
+    "F16": np.float16,
+    "I64": np.int64,
+    "I32": np.int32,
+    "I16": np.int16,
+    "I8": np.int8,
+    "U8": np.uint8,
+    "BOOL": np.bool_,
+}
+
+
+def _bf16():
+    import ml_dtypes
+
+    return ml_dtypes.bfloat16
+
+
+def _np_dtype(st_dtype: str):
+    if st_dtype == "BF16":
+        return _bf16()
+    try:
+        return _DTYPES[st_dtype]
+    except KeyError:
+        raise ValueError(f"unsupported safetensors dtype {st_dtype!r}")
+
+
+class SafetensorsFile:
+    """Zero-copy reader for one ``.safetensors`` file (stdlib parsing,
+    np.memmap-backed tensor views)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            (header_len,) = struct.unpack("<Q", f.read(8))
+            header = json.loads(f.read(header_len))
+        self._data_start = 8 + header_len
+        header.pop("__metadata__", None)
+        self.tensors: Dict[str, Dict[str, Any]] = header
+        self._mmap: Optional[np.memmap] = None
+
+    def keys(self) -> List[str]:
+        return list(self.tensors)
+
+    def _buffer(self) -> np.memmap:
+        if self._mmap is None:
+            self._mmap = np.memmap(self.path, dtype=np.uint8, mode="r")
+        return self._mmap
+
+    def tensor(self, name: str) -> np.ndarray:
+        """A read-only view onto the mapped file (copy before mutating)."""
+        info = self.tensors[name]
+        start, end = info["data_offsets"]
+        dt = _np_dtype(info["dtype"])
+        raw = self._buffer()[self._data_start + start:self._data_start + end]
+        return raw.view(dt).reshape(info["shape"])
+
+    def close(self) -> None:
+        self._mmap = None
+
+
+class CheckpointReader:
+    """Uniform tensor access over the three HF checkpoint layouts:
+    one file, an index.json shard map, or a directory of shards."""
+
+    def __init__(self, path: str):
+        self.files: Dict[str, SafetensorsFile] = {}
+        self.name_to_file: Dict[str, str] = {}
+        if os.path.isfile(path) and path.endswith(".safetensors"):
+            self._add_file(path)
+            return
+        if os.path.isdir(path):
+            index = os.path.join(path, "model.safetensors.index.json")
+            single = os.path.join(path, "model.safetensors")
+            if os.path.isfile(index):
+                with open(index) as f:
+                    weight_map = json.load(f).get("weight_map") or {}
+                for name, fname in weight_map.items():
+                    fpath = os.path.join(path, fname)
+                    if fpath not in self.files:
+                        self.files[fpath] = SafetensorsFile(fpath)
+                    self.name_to_file[name] = fpath
+                return
+            if os.path.isfile(single):
+                self._add_file(single)
+                return
+            shards = sorted(
+                os.path.join(path, p)
+                for p in os.listdir(path)
+                if p.endswith(".safetensors")
+            )
+            if shards:
+                for s in shards:
+                    self._add_file(s)
+                return
+        raise FileNotFoundError(
+            f"{path!r} is not a .safetensors file, a directory containing "
+            "model.safetensors(.index.json), or a directory of shards"
+        )
+
+    def _add_file(self, fpath: str) -> None:
+        sf = SafetensorsFile(fpath)
+        self.files[fpath] = sf
+        for name in sf.keys():
+            self.name_to_file[name] = fpath
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.name_to_file
+
+    def keys(self) -> List[str]:
+        return list(self.name_to_file)
+
+    def tensor(self, name: str) -> np.ndarray:
+        try:
+            fpath = self.name_to_file[name]
+        except KeyError:
+            raise KeyError(
+                f"tensor {name!r} not in checkpoint "
+                f"(have {len(self.name_to_file)} tensors)"
+            )
+        return self.files[fpath].tensor(name)
+
+    def close(self) -> None:
+        for sf in self.files.values():
+            sf.close()
+
+
+# --------------------------------------------------------------- conversion
+
+
+def _put(x: np.ndarray, dtype, sharding=None):
+    """Cast + (optionally) place a host array onto its target sharding."""
+    import jax
+
+    arr = np.asarray(x, dtype=dtype)
+    if sharding is not None:
+        return jax.device_put(arr, sharding)
+    return jax.numpy.asarray(arr)
+
+
+def _stack_layers(
+    reader: CheckpointReader,
+    n_layers: int,
+    template: str,
+    transpose: bool,
+    dtype,
+    out_shape: Tuple[int, ...],
+    sharding=None,
+):
+    """Stack ``template.format(i)`` for all layers into one leading-dim
+    array, verifying the per-layer shape."""
+    per_shape = out_shape[1:]
+    out = np.empty(out_shape, dtype=dtype)
+    for i in range(n_layers):
+        t = reader.tensor(template.format(i))
+        if transpose:
+            t = t.T
+        if tuple(t.shape) != per_shape:
+            raise ValueError(
+                f"{template.format(i)}: shape {tuple(t.shape)} != expected "
+                f"{per_shape} (config/checkpoint mismatch)"
+            )
+        out[i] = np.asarray(t, dtype=dtype)
+    return _put(out, dtype, sharding)
+
+
+# name templates in HF Llama checkpoints (transformers LlamaForCausalLM)
+_HF_LLAMA_LAYERS: Dict[str, Tuple[str, bool]] = {
+    # ours -> (HF template, transpose?)
+    "wq": ("model.layers.{}.self_attn.q_proj.weight", True),
+    "wk": ("model.layers.{}.self_attn.k_proj.weight", True),
+    "wv": ("model.layers.{}.self_attn.v_proj.weight", True),
+    "wo": ("model.layers.{}.self_attn.o_proj.weight", True),
+    "w_gate": ("model.layers.{}.mlp.gate_proj.weight", True),
+    "w_up": ("model.layers.{}.mlp.up_proj.weight", True),
+    "w_down": ("model.layers.{}.mlp.down_proj.weight", True),
+    "ln_attn": ("model.layers.{}.input_layernorm.weight", False),
+    "ln_mlp": ("model.layers.{}.post_attention_layernorm.weight", False),
+}
+
+
+def convert_hf_llama(
+    path: str,
+    cfg,
+    shardings: Optional[Dict[str, Any]] = None,
+    progress: Optional[Callable[[str], None]] = None,
+) -> Dict[str, Any]:
+    """HF-format Llama safetensors checkpoint → our param tree
+    (models/llama.py layout: stacked layers, (in, out) projections).
+
+    ``shardings``: optional tree matching the param tree (NamedShardings —
+    e.g. from ``sharding_tree(llama.logical_axes(cfg), mesh)``); each leaf
+    is placed as it is built. Tied-embedding checkpoints (no
+    ``lm_head.weight``, e.g. Llama-3.2-1B) reuse the embedding transposed.
+    Raises ValueError on any shape/layer-count mismatch with ``cfg``."""
+    reader = CheckpointReader(path)
+    note = progress or (lambda msg: logger.info("%s", msg))
+    try:
+        d, f, v, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers
+        hq = cfg.n_heads * cfg.head_dim
+        hkv = cfg.n_kv_heads * cfg.head_dim
+        dt = cfg.dtype
+
+        expected_last = f"model.layers.{L - 1}.input_layernorm.weight"
+        if expected_last not in reader:
+            extra = [
+                n for n in reader.keys()
+                if n.startswith(f"model.layers.{L}.")
+            ]
+            raise ValueError(
+                f"checkpoint does not match n_layers={L}: "
+                + (
+                    f"has layers past {L - 1}"
+                    if extra
+                    else f"missing {expected_last!r}"
+                )
+            )
+
+        sh = shardings or {}
+        layer_sh = sh.get("layers") or {}
+        shapes = {
+            "wq": (L, d, hq),
+            "wk": (L, d, hkv),
+            "wv": (L, d, hkv),
+            "wo": (L, hq, d),
+            "w_gate": (L, d, f),
+            "w_up": (L, d, f),
+            "w_down": (L, f, d),
+            "ln_attn": (L, d),
+            "ln_mlp": (L, d),
+        }
+        layers: Dict[str, Any] = {}
+        for ours, (tmpl, transpose) in _HF_LLAMA_LAYERS.items():
+            note(f"converting {ours} ({L} layers)")
+            layers[ours] = _stack_layers(
+                reader, L, tmpl, transpose, dt, shapes[ours],
+                sharding=layer_sh.get(ours),
+            )
+
+        def fetch(name: str, shape: Tuple[int, ...], transpose=False):
+            t = reader.tensor(name)
+            if transpose:
+                t = t.T
+            if tuple(t.shape) != shape:
+                raise ValueError(
+                    f"{name}: shape {tuple(t.shape)} != expected {shape}"
+                )
+            return t
+
+        note("converting embed / final_norm / lm_head")
+        embed = fetch("model.embed_tokens.weight", (v, d))
+        if "lm_head.weight" in reader:
+            lm_head = fetch("lm_head.weight", (d, v), transpose=True)
+        else:
+            # tied word embeddings (Llama-3.2 style)
+            lm_head = embed.T
+        params = {
+            "embed": _put(embed, dt, sh.get("embed")),
+            "layers": layers,
+            "final_norm": _put(
+                fetch("model.norm.weight", (d,)), dt, sh.get("final_norm")
+            ),
+            "lm_head": _put(lm_head, dt, sh.get("lm_head")),
+        }
+        return params
+    finally:
+        reader.close()
+
+
+def export_hf_llama(params: Dict[str, Any], cfg, path: str) -> str:
+    """Our param tree → an HF-format single-file safetensors checkpoint
+    (the inverse mapping of :func:`convert_hf_llama`). Test/interop tool:
+    round-tripping through this is how conversion parity is proven without
+    network access to real checkpoints."""
+    from safetensors.numpy import save_file
+
+    out: Dict[str, np.ndarray] = {}
+
+    def host(x) -> np.ndarray:
+        return np.asarray(x)
+
+    out["model.embed_tokens.weight"] = host(params["embed"])
+    out["model.norm.weight"] = host(params["final_norm"])
+    out["lm_head.weight"] = host(params["lm_head"]).T.copy()
+    for ours, (tmpl, transpose) in _HF_LLAMA_LAYERS.items():
+        stacked = host(params["layers"][ours])
+        for i in range(cfg.n_layers):
+            t = stacked[i]
+            out[tmpl.format(i)] = (t.T if transpose else t).copy()
+    save_file(out, path)
+    return path
+
+
+CONVERTERS: Dict[str, Callable[..., Dict[str, Any]]] = {
+    "llama": convert_hf_llama,
+}
+
+
+def load_pretrained(
+    family_name: str,
+    path: str,
+    cfg,
+    mesh=None,
+    logical_tree=None,
+) -> Dict[str, Any]:
+    """Entry point the runtime uses: convert ``path`` for ``family_name``,
+    placing leaves onto ``mesh`` shardings when given."""
+    try:
+        converter = CONVERTERS[family_name]
+    except KeyError:
+        raise ValueError(
+            f"no safetensors converter for family {family_name!r} "
+            f"(have: {sorted(CONVERTERS)})"
+        )
+    shardings = None
+    if mesh is not None and logical_tree is not None:
+        from nexus_tpu.parallel.sharding import sharding_tree
+
+        shardings = sharding_tree(logical_tree, mesh)
+    return converter(path, cfg, shardings=shardings)

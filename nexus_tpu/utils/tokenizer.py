@@ -1,0 +1,197 @@
+"""Tokenizer loading for LM inference: HF ``tokenizer.json`` byte-level BPE.
+
+Completes the "Llama-3-8B inference" story (BASELINE config #3): an infer
+template can carry a prompt STRING; the runtime tokenizes it with the
+checkpoint's own tokenizer and detokenizes the decoded ids.
+
+Two engines behind one surface:
+  * the ``tokenizers`` Rust library when importable (exact HF behavior —
+    it is part of this image's transformers install);
+  * a pure-Python byte-level BPE fallback (`PureBpeTokenizer`) implementing
+    the same tokenizer.json subset Llama-3 uses — byte-to-unicode mapping
+    (the GPT-2 table), regex pre-tokenization, greedy lowest-rank merges,
+    added/special tokens — so tokenization works even without the package.
+    Cross-checked against the Rust engine in tests/test_weights.py.
+
+The reference has no tokenizer (it is a config-sync controller, SURVEY.md);
+this is workload-plane capability the north star adds.
+"""
+
+from __future__ import annotations
+
+import json
+from functools import lru_cache
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@lru_cache(maxsize=1)
+def _byte_to_unicode() -> Dict[int, str]:
+    """GPT-2's reversible byte↔unicode table: printable bytes map to
+    themselves, the rest to private-ish codepoints ≥256."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("¡"), ord("¬") + 1))
+        + list(range(ord("®"), ord("ÿ") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+# Llama-3's pre-tokenization pattern (tiktoken cl100k lineage; also what
+# its tokenizer.json carries in pre_tokenizer.pattern.Regex)
+_LLAMA3_PATTERN = (
+    r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|\p{N}{1,3}|"
+    r" ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+"
+)
+
+
+class PureBpeTokenizer:
+    """Self-contained byte-level BPE over a parsed tokenizer.json."""
+
+    def __init__(
+        self,
+        vocab: Dict[str, int],
+        merges: Sequence[Tuple[str, str]],
+        added_tokens: Optional[Dict[str, int]] = None,
+        pattern: str = _LLAMA3_PATTERN,
+    ):
+        self.vocab = dict(vocab)
+        self.id_to_token = {i: t for t, i in self.vocab.items()}
+        self.ranks = {tuple(m): r for r, m in enumerate(merges)}
+        self.added = dict(added_tokens or {})
+        self.id_to_token.update({i: t for t, i in self.added.items()})
+        import regex
+
+        self._pat = regex.compile(pattern)
+        self._b2u = _byte_to_unicode()
+        self._u2b = {u: b for b, u in self._b2u.items()}
+
+    @classmethod
+    def from_file(cls, path: str) -> "PureBpeTokenizer":
+        with open(path) as f:
+            doc = json.load(f)
+        model = doc.get("model") or {}
+        if model.get("type") != "BPE":
+            raise ValueError(
+                f"tokenizer.json model.type {model.get('type')!r} "
+                "unsupported (BPE only)"
+            )
+        merges_raw = model.get("merges") or []
+        merges = [
+            tuple(m.split(" ", 1)) if isinstance(m, str) else tuple(m)
+            for m in merges_raw
+        ]
+        added = {
+            t["content"]: t["id"] for t in doc.get("added_tokens") or []
+        }
+        pattern = _LLAMA3_PATTERN
+        pre = doc.get("pre_tokenizer") or {}
+        # accept both a bare Split pre-tokenizer and a Sequence of them
+        candidates = pre.get("pretokenizers") or [pre]
+        for p in candidates:
+            pat = ((p or {}).get("pattern") or {}).get("Regex")
+            if pat:
+                pattern = pat
+                break
+        return cls(model.get("vocab") or {}, merges, added, pattern)
+
+    # ------------------------------------------------------------------ BPE
+    def _bpe(self, piece: str) -> List[str]:
+        parts = list(piece)
+        if len(parts) < 2:
+            return parts
+        while True:
+            best = None
+            best_rank = None
+            for i in range(len(parts) - 1):
+                r = self.ranks.get((parts[i], parts[i + 1]))
+                if r is not None and (best_rank is None or r < best_rank):
+                    best, best_rank = i, r
+            if best is None:
+                return parts
+            parts = (
+                parts[:best]
+                + [parts[best] + parts[best + 1]]
+                + parts[best + 2:]
+            )
+            if len(parts) < 2:
+                return parts
+
+    def encode(self, text: str) -> List[int]:
+        """Text → token ids. Added/special tokens match as whole pieces
+        first (longest-first), the rest goes through byte-level BPE."""
+        if not text:
+            return []
+        if self.added:
+            for tok in sorted(self.added, key=len, reverse=True):
+                if tok in text:
+                    left, _, right = text.partition(tok)
+                    return (
+                        self.encode(left)
+                        + [self.added[tok]]
+                        + self.encode(right)
+                    )
+        ids: List[int] = []
+        for piece in self._pat.findall(text):
+            mapped = "".join(self._b2u[b] for b in piece.encode("utf-8"))
+            for unit in self._bpe(mapped):
+                try:
+                    ids.append(self.vocab[unit])
+                except KeyError:
+                    # merges/vocab disagree (malformed file): emit per-char
+                    ids.extend(
+                        self.vocab[c] for c in unit if c in self.vocab
+                    )
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        out = bytearray()
+        for i in ids:
+            tok = self.id_to_token.get(int(i))
+            if tok is None:
+                continue
+            if tok in self.added:
+                out += tok.encode("utf-8")
+                continue
+            for ch in tok:
+                b = self._u2b.get(ch)
+                if b is not None:
+                    out.append(b)
+                else:  # not a byte-level char (shouldn't happen for BPE)
+                    out += ch.encode("utf-8")
+        return out.decode("utf-8", errors="replace")
+
+
+class _RustTokenizer:
+    """Thin adapter over the HF ``tokenizers`` engine."""
+
+    def __init__(self, path: str):
+        from tokenizers import Tokenizer
+
+        self._tk = Tokenizer.from_file(path)
+
+    def encode(self, text: str) -> List[int]:
+        return self._tk.encode(text, add_special_tokens=False).ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tk.decode(list(map(int, ids)), skip_special_tokens=False)
+
+
+def load_tokenizer(path: str, engine: str = "auto"):
+    """Load a tokenizer.json. ``engine``: 'auto' (Rust when importable,
+    else pure), 'rust', or 'pure'."""
+    if engine not in ("auto", "rust", "pure"):
+        raise ValueError(f"unknown tokenizer engine {engine!r}")
+    if engine in ("auto", "rust"):
+        try:
+            return _RustTokenizer(path)
+        except ImportError:
+            if engine == "rust":
+                raise
+    return PureBpeTokenizer.from_file(path)

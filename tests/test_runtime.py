@@ -185,14 +185,27 @@ def test_run_template_runtime_pipeline_parallel_matches_plain():
             parallelism=ParallelismSpec(pipeline=2, data=4), **common
         )
     )
+    gpipe = run_template_runtime(
+        runtime_block(
+            parallelism=ParallelismSpec(
+                pipeline=2, data=4, pipeline_schedule="gpipe"
+            ),
+            **common,
+        )
+    )
     plain = run_template_runtime(
         runtime_block(parallelism=ParallelismSpec(data=4, fsdp=2), **common)
     )
     assert pp["final_loss"] is not None
     # identical init (same seed) + identical data stream → first-step loss
-    # must agree across schedules up to float reassociation
+    # must agree across schedules up to float reassociation (default
+    # schedule is 1F1B; gpipe is the explicit fallback)
     assert abs(pp["loss_history"][0] - plain["loss_history"][0]) < 1e-3, (
         pp["loss_history"],
+        plain["loss_history"],
+    )
+    assert abs(gpipe["loss_history"][0] - plain["loss_history"][0]) < 1e-3, (
+        gpipe["loss_history"],
         plain["loss_history"],
     )
 
@@ -223,7 +236,7 @@ def test_run_template_runtime_bench_candidate_path():
 
 
 def test_run_template_runtime_pipeline_rejects_unsupported():
-    with pytest.raises(ValueError, match="llama family only"):
+    with pytest.raises(ValueError, match="llama and gptneox"):
         run_template_runtime(
             runtime_block(
                 model=ModelRef(family="mlp", preset="tiny"),

@@ -1,0 +1,311 @@
+"""Pretrained-weight ingestion (runtime/weights.py) + tokenizer
+(utils/tokenizer.py): the literal "Llama-3-8B inference" path of BASELINE
+config #3, tested against synthetic HF-format checkpoints (zero-egress
+environment — real checkpoints can't be fetched, so parity is proven by
+exporting our own params to the HF layout and converting back)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nexus_tpu.models import llama
+from nexus_tpu.runtime.weights import (
+    CheckpointReader,
+    SafetensorsFile,
+    convert_hf_llama,
+    export_hf_llama,
+    load_pretrained,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return llama.config("tiny", dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def tiny_params(tiny_cfg):
+    return llama.init(jax.random.PRNGKey(0), tiny_cfg)
+
+
+def test_safetensors_roundtrip_exact_logits(tmp_path, tiny_cfg, tiny_params):
+    """export → convert must reproduce the EXACT params (and logits)."""
+    path = str(tmp_path / "model.safetensors")
+    export_hf_llama(tiny_params, tiny_cfg, path)
+    restored = convert_hf_llama(path, tiny_cfg)
+
+    ref_leaves = {
+        jax.tree_util.keystr(kp): v
+        for kp, v in jax.tree_util.tree_leaves_with_path(tiny_params)
+    }
+    got_leaves = {
+        jax.tree_util.keystr(kp): v
+        for kp, v in jax.tree_util.tree_leaves_with_path(restored)
+    }
+    assert set(ref_leaves) == set(got_leaves)
+    for k, ref in ref_leaves.items():
+        np.testing.assert_array_equal(
+            np.asarray(got_leaves[k]), np.asarray(ref), err_msg=k
+        )
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 16), 0, tiny_cfg.vocab_size, jnp.int32
+    )
+    ref_logits = llama.forward(tiny_params, tiny_cfg, tokens)
+    got_logits = llama.forward(restored, tiny_cfg, tokens)
+    np.testing.assert_array_equal(
+        np.asarray(got_logits), np.asarray(ref_logits)
+    )
+
+
+def test_convert_places_on_mesh(tmp_path, tiny_cfg, tiny_params):
+    """With a mesh + logical tree, converted leaves land sharded."""
+    from nexus_tpu.parallel.mesh import MeshPlan, build_mesh
+
+    path = str(tmp_path / "model.safetensors")
+    export_hf_llama(tiny_params, tiny_cfg, path)
+    mesh = build_mesh(MeshPlan(fsdp=4, tensor=2))
+    params = load_pretrained(
+        "llama", path, tiny_cfg, mesh=mesh,
+        logical_tree=llama.logical_axes(tiny_cfg),
+    )
+    # embed: ('vocab','embed') → P('tensor','fsdp')
+    sh = params["embed"].sharding
+    assert set(sh.device_set) == set(mesh.devices.flat)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 16), 0, tiny_cfg.vocab_size, jnp.int32
+    )
+    with mesh:
+        logits = jax.jit(lambda p, t: llama.forward(p, tiny_cfg, t))(
+            params, tokens
+        )
+    ref = llama.forward(tiny_params, tiny_cfg, tokens)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_convert_tied_embeddings(tmp_path, tiny_cfg, tiny_params):
+    """Checkpoints without lm_head.weight (Llama-3.2 style) tie to embed."""
+    from safetensors.numpy import load_file, save_file
+
+    path = str(tmp_path / "model.safetensors")
+    export_hf_llama(tiny_params, tiny_cfg, path)
+    tensors = load_file(path)
+    tensors.pop("lm_head.weight")
+    save_file(tensors, path)
+    restored = convert_hf_llama(path, tiny_cfg)
+    np.testing.assert_array_equal(
+        np.asarray(restored["lm_head"]),
+        np.asarray(restored["embed"]).T,
+    )
+
+
+def test_convert_sharded_index_checkpoint(tmp_path, tiny_cfg, tiny_params):
+    """model.safetensors.index.json weight_map over multiple shard files."""
+    from safetensors.numpy import load_file, save_file
+
+    single = str(tmp_path / "all.safetensors")
+    export_hf_llama(tiny_params, tiny_cfg, single)
+    tensors = load_file(single)
+    names = sorted(tensors)
+    half = len(names) // 2
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    save_file(
+        {n: tensors[n] for n in names[:half]},
+        str(ckpt / "model-00001-of-00002.safetensors"),
+    )
+    save_file(
+        {n: tensors[n] for n in names[half:]},
+        str(ckpt / "model-00002-of-00002.safetensors"),
+    )
+    weight_map = {
+        n: ("model-00001-of-00002.safetensors" if i < half
+            else "model-00002-of-00002.safetensors")
+        for i, n in enumerate(names)
+    }
+    (ckpt / "model.safetensors.index.json").write_text(
+        json.dumps({"weight_map": weight_map})
+    )
+    restored = convert_hf_llama(str(ckpt), tiny_cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 16), 0, tiny_cfg.vocab_size, jnp.int32
+    )
+    np.testing.assert_array_equal(
+        np.asarray(llama.forward(restored, tiny_cfg, tokens)),
+        np.asarray(llama.forward(tiny_params, tiny_cfg, tokens)),
+    )
+
+
+def test_convert_rejects_mismatched_config(tmp_path, tiny_cfg, tiny_params):
+    path = str(tmp_path / "model.safetensors")
+    export_hf_llama(tiny_params, tiny_cfg, path)
+    bad_layers = llama.config("tiny", n_layers=tiny_cfg.n_layers + 2,
+                              dtype=jnp.float32)
+    with pytest.raises(ValueError, match="n_layers"):
+        convert_hf_llama(path, bad_layers)
+    bad_width = llama.config("tiny", d_model=tiny_cfg.d_model * 2,
+                             dtype=jnp.float32)
+    with pytest.raises(ValueError, match="shape"):
+        convert_hf_llama(path, bad_width)
+
+
+def test_bf16_tensors_decode(tmp_path):
+    """BF16 safetensors (the dtype real Llama checkpoints ship in) decode
+    via ml_dtypes through the stdlib parser."""
+    import ml_dtypes
+    from safetensors.numpy import save_file
+
+    x = np.arange(32, dtype=np.float32).reshape(4, 8)
+    path = str(tmp_path / "bf16.safetensors")
+    save_file({"t": x.astype(ml_dtypes.bfloat16)}, path)
+    sf = SafetensorsFile(path)
+    got = sf.tensor("t")
+    assert got.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(got.astype(np.float32), x)
+
+
+def test_checkpoint_reader_rejects_nonsense(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        CheckpointReader(str(tmp_path / "nope"))
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(FileNotFoundError):
+        CheckpointReader(str(empty))
+
+
+# ------------------------------------------------------------- tokenizer
+
+
+def _build_tokenizer_json(path: str) -> str:
+    """A real (small) byte-level BPE tokenizer.json built with the HF
+    `tokenizers` library from a tiny corpus — the exact file format
+    Llama-3 checkpoints ship."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders, trainers
+
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False,
+                                                 use_regex=True)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=400, special_tokens=["<|begin_of_text|>", "<|eot_id|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    corpus = [
+        "the quick brown fox jumps over the lazy dog",
+        "TPU native frameworks shard attention over meshes",
+        "hello world, hello tokens! 12345",
+        "multi-cluster controllers reconcile templates",
+    ]
+    tok.train_from_iterator(corpus, trainer)
+    tok.save(path)
+    return path
+
+
+def test_tokenizer_pure_matches_rust(tmp_path):
+    """The pure-Python BPE must agree with the Rust engine token-for-token
+    on in-domain and out-of-domain text."""
+    from nexus_tpu.utils.tokenizer import load_tokenizer
+
+    path = _build_tokenizer_json(str(tmp_path / "tokenizer.json"))
+    rust = load_tokenizer(path, engine="rust")
+    pure = load_tokenizer(path, engine="pure")
+    samples = [
+        "the quick brown fox",
+        "hello world",
+        "unseen wörds — with ünïcode! 67890",
+        "  leading spaces\nand newlines\n\n",
+        "",
+    ]
+    for s in samples:
+        assert pure.encode(s) == rust.encode(s), s
+
+
+def test_tokenizer_roundtrip_and_special_tokens(tmp_path):
+    from nexus_tpu.utils.tokenizer import load_tokenizer
+
+    path = _build_tokenizer_json(str(tmp_path / "tokenizer.json"))
+    pure = load_tokenizer(path, engine="pure")
+    text = "hello world, the quick fox"
+    assert pure.decode(pure.encode(text)) == text
+    # special tokens match as whole pieces
+    with open(path) as f:
+        doc = json.load(f)
+    bos = next(
+        t for t in doc["added_tokens"]
+        if t["content"] == "<|begin_of_text|>"
+    )
+    ids = pure.encode("<|begin_of_text|>hello")
+    assert ids[0] == bos["id"]
+    assert pure.decode(ids) == "<|begin_of_text|>hello"
+
+
+def test_infer_runtime_with_pretrained_weights_and_prompt(tmp_path):
+    """End-to-end config #3 shape: an infer template pointing at a
+    safetensors checkpoint + tokenizer decodes a TEXT prompt with the
+    converted weights and reports a text completion."""
+    from nexus_tpu.api.runtime_spec import (
+        InferSpec,
+        JaxXlaRuntime,
+        ModelRef,
+        ParallelismSpec,
+        TpuSliceSpec,
+        TrainSpec,
+        WeightsSpec,
+    )
+    from nexus_tpu.runtime.entrypoints import run_template_runtime
+
+    cfg = llama.config("tiny", dtype=jnp.float32)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    ckpt = str(tmp_path / "model.safetensors")
+    export_hf_llama(params, cfg, ckpt)
+    tok_path = _build_tokenizer_json(str(tmp_path / "tokenizer.json"))
+
+    runtime = JaxXlaRuntime(
+        mode="infer",
+        model=ModelRef(
+            family="llama", preset="tiny",
+            overrides={"dtype": "float32"},
+            weights=WeightsSpec(path=ckpt, tokenizer=tok_path),
+        ),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="2x4", slice_count=1),
+        parallelism=ParallelismSpec(data=2, fsdp=2, tensor=2),
+        train=TrainSpec(batch_size=2, seq_len=32),
+        infer=InferSpec(
+            prompt="the quick brown fox", max_new_tokens=8, iterations=1
+        ),
+    )
+    assert runtime.validate() == []
+    metrics = run_template_runtime(runtime)
+    assert metrics["weights_loaded"] is True
+    assert metrics["prompt_tokens"] > 0
+    assert isinstance(metrics["completion"], str)
+    assert metrics["decode_tokens_per_sec"] > 0
+
+
+def test_weights_spec_validation():
+    from nexus_tpu.api.runtime_spec import (
+        JaxXlaRuntime,
+        ModelRef,
+        WeightsSpec,
+    )
+
+    rt = JaxXlaRuntime(
+        mode="infer",
+        model=ModelRef(family="mixtral", preset="tiny",
+                       weights=WeightsSpec(path="/x")),
+    )
+    errs = rt.validate()
+    assert any("no safetensors converter" in e for e in errs)
+    rt2 = JaxXlaRuntime(
+        mode="infer",
+        model=ModelRef(family="llama", preset="tiny",
+                       weights=WeightsSpec(path="", format="gguf")),
+    )
+    errs2 = rt2.validate()
+    assert any("format" in e for e in errs2)
+    assert any("path" in e for e in errs2)
