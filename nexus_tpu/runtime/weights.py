@@ -1,8 +1,8 @@
 """Pretrained-weight ingestion: HF safetensors checkpoints → param trees.
 
 Makes BASELINE config #3 ("Llama-3-8B JAX inference") literal: a template
-can point ``model.weights`` at a HuggingFace-format Llama checkpoint
-(single ``model.safetensors``, a sharded set with
+can point ``model.weights`` at a HuggingFace-format checkpoint — Llama,
+GPT-NeoX, or Mixtral — (single ``model.safetensors``, a sharded set with
 ``model.safetensors.index.json``, or a directory of ``*.safetensors``) and
 ``_run_infer`` decodes with those weights instead of random init.
 
@@ -181,6 +181,19 @@ def _put(x: np.ndarray, dtype, sharding=None):
     return jax.numpy.asarray(arr)
 
 
+def _fetch(reader: CheckpointReader, name: str, shape: Tuple[int, ...],
+           transpose: bool = False) -> np.ndarray:
+    """One tensor, shape-checked against the target config."""
+    t = reader.tensor(name)
+    if transpose:
+        t = t.T
+    if tuple(t.shape) != shape:
+        raise ValueError(
+            f"{name}: shape {tuple(t.shape)} != expected {shape}"
+        )
+    return t
+
+
 def _stack_layers(
     reader: CheckpointReader,
     n_layers: int,
@@ -280,20 +293,10 @@ def convert_hf_llama(
                 sharding=layer_sh.get(ours),
             )
 
-        def fetch(name: str, shape: Tuple[int, ...], transpose=False):
-            t = reader.tensor(name)
-            if transpose:
-                t = t.T
-            if tuple(t.shape) != shape:
-                raise ValueError(
-                    f"{name}: shape {tuple(t.shape)} != expected {shape}"
-                )
-            return t
-
         note("converting embed / final_norm / lm_head")
-        embed = fetch("model.embed_tokens.weight", (v, d))
+        embed = _fetch(reader, "model.embed_tokens.weight", (v, d))
         if "lm_head.weight" in reader:
-            lm_head = fetch("lm_head.weight", (d, v), transpose=True)
+            lm_head = _fetch(reader, "lm_head.weight", (d, v), transpose=True)
         else:
             # tied word embeddings (Llama-3.2 style)
             lm_head = embed.T
@@ -301,7 +304,7 @@ def convert_hf_llama(
             "embed": _put(embed, dt, sh.get("embed")),
             "layers": layers,
             "final_norm": _put(
-                fetch("model.norm.weight", (d,)), dt, sh.get("final_norm")
+                _fetch(reader, "model.norm.weight", (d,)), dt, sh.get("final_norm")
             ),
             "lm_head": _put(lm_head, dt, sh.get("lm_head")),
         }
@@ -334,8 +337,295 @@ def export_hf_llama(params: Dict[str, Any], cfg, path: str) -> str:
     return path
 
 
+# ------------------------------------------------------------ gptneox
+
+
+def _deinterleave_neox_qkv(w: np.ndarray, n_heads: int, head_dim: int):
+    """HF NeoX fuses query_key_value with PER-HEAD interleaving on the
+    output dim (head-major: [h0:q k v, h1:q k v, ...]); our wqkv splits
+    into contiguous thirds (all-q | all-k | all-v). (3d, ...) → (3d, ...)
+    reordered."""
+    rest = w.shape[1:]
+    w = w.reshape(n_heads, 3, head_dim, *rest)
+    w = np.moveaxis(w, 1, 0)  # (3, H, hd, ...)
+    return w.reshape(3 * n_heads * head_dim, *rest)
+
+
+def _interleave_neox_qkv(w: np.ndarray, n_heads: int, head_dim: int):
+    """Inverse of :func:`_deinterleave_neox_qkv` (export path)."""
+    rest = w.shape[1:]
+    w = w.reshape(3, n_heads, head_dim, *rest)
+    w = np.moveaxis(w, 0, 1)  # (H, 3, hd, ...)
+    return w.reshape(3 * n_heads * head_dim, *rest)
+
+
+_HF_NEOX_PLAIN: Dict[str, Tuple[str, bool]] = {
+    # ours -> (HF template, transpose?) — everything except the fused qkv
+    "wo": ("gpt_neox.layers.{}.attention.dense.weight", True),
+    "b_o": ("gpt_neox.layers.{}.attention.dense.bias", False),
+    "w_in": ("gpt_neox.layers.{}.mlp.dense_h_to_4h.weight", True),
+    "b_in": ("gpt_neox.layers.{}.mlp.dense_h_to_4h.bias", False),
+    "w_out": ("gpt_neox.layers.{}.mlp.dense_4h_to_h.weight", True),
+    "b_out": ("gpt_neox.layers.{}.mlp.dense_4h_to_h.bias", False),
+    "ln1": ("gpt_neox.layers.{}.input_layernorm.weight", False),
+    "ln1_b": ("gpt_neox.layers.{}.input_layernorm.bias", False),
+    "ln2": ("gpt_neox.layers.{}.post_attention_layernorm.weight", False),
+    "ln2_b": ("gpt_neox.layers.{}.post_attention_layernorm.bias", False),
+}
+
+
+def convert_hf_gptneox(
+    path: str,
+    cfg,
+    shardings: Optional[Dict[str, Any]] = None,
+    progress: Optional[Callable[[str], None]] = None,
+) -> Dict[str, Any]:
+    """HF GPTNeoXForCausalLM safetensors checkpoint → our param tree.
+
+    Handles the fused ``query_key_value`` head-interleaved layout (see
+    :func:`_deinterleave_neox_qkv`) and the untied ``embed_out`` head."""
+    reader = CheckpointReader(path)
+    note = progress or (lambda msg: logger.info("%s", msg))
+    try:
+        d, f, v, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers
+        hq, hd = cfg.n_heads, cfg.head_dim
+        dt = cfg.dtype
+        last = f"gpt_neox.layers.{L - 1}.input_layernorm.weight"
+        if last not in reader:
+            raise ValueError(
+                f"checkpoint does not match n_layers={L}: missing {last!r}"
+            )
+        sh = shardings or {}
+        layer_sh = sh.get("layers") or {}
+
+        shapes = {
+            "wo": (L, d, d), "b_o": (L, d),
+            "w_in": (L, d, f), "b_in": (L, f),
+            "w_out": (L, f, d), "b_out": (L, d),
+            "ln1": (L, d), "ln1_b": (L, d),
+            "ln2": (L, d), "ln2_b": (L, d),
+        }
+        layers: Dict[str, Any] = {}
+        for ours, (tmpl, transpose) in _HF_NEOX_PLAIN.items():
+            note(f"converting {ours} ({L} layers)")
+            layers[ours] = _stack_layers(
+                reader, L, tmpl, transpose, dt, shapes[ours],
+                sharding=layer_sh.get(ours),
+            )
+        note("converting fused qkv")
+        wqkv = np.empty((L, d, 3 * d), dtype=dt)
+        b_qkv = np.empty((L, 3 * d), dtype=dt)
+        for i in range(L):
+            w = np.asarray(
+                reader.tensor(
+                    f"gpt_neox.layers.{i}.attention.query_key_value.weight"
+                )
+            )
+            b = np.asarray(
+                reader.tensor(
+                    f"gpt_neox.layers.{i}.attention.query_key_value.bias"
+                )
+            )
+            if w.shape != (3 * d, d):
+                raise ValueError(
+                    f"query_key_value.weight shape {w.shape} != {(3 * d, d)}"
+                )
+            wqkv[i] = _deinterleave_neox_qkv(w, hq, hd).T.astype(dt)
+            b_qkv[i] = _deinterleave_neox_qkv(b, hq, hd).astype(dt)
+        layers["wqkv"] = _put(wqkv, dt, layer_sh.get("wqkv"))
+        layers["b_qkv"] = _put(b_qkv, dt, layer_sh.get("b_qkv"))
+
+        note("converting embed / final norm / head")
+        return {
+            "embed": _put(
+                _fetch(reader, "gpt_neox.embed_in.weight", (v, d)), dt,
+                sh.get("embed"),
+            ),
+            "layers": layers,
+            "final_norm": _put(
+                _fetch(reader, "gpt_neox.final_layer_norm.weight", (d,)), dt,
+                sh.get("final_norm"),
+            ),
+            "final_norm_b": _put(
+                _fetch(reader, "gpt_neox.final_layer_norm.bias", (d,)), dt,
+                sh.get("final_norm_b"),
+            ),
+            "lm_head": _put(
+                _fetch(reader, "embed_out.weight", (d, v), transpose=True), dt,
+                sh.get("lm_head"),
+            ),
+        }
+    finally:
+        reader.close()
+
+
+def export_hf_gptneox(params: Dict[str, Any], cfg, path: str) -> str:
+    """Our gptneox tree → HF-format safetensors (test/interop inverse)."""
+    from safetensors.numpy import save_file
+
+    hq, hd = cfg.n_heads, cfg.head_dim
+    out: Dict[str, np.ndarray] = {
+        "gpt_neox.embed_in.weight": np.asarray(params["embed"]),
+        "gpt_neox.final_layer_norm.weight": np.asarray(params["final_norm"]),
+        "gpt_neox.final_layer_norm.bias": np.asarray(params["final_norm_b"]),
+        "embed_out.weight": np.asarray(params["lm_head"]).T.copy(),
+    }
+    for ours, (tmpl, transpose) in _HF_NEOX_PLAIN.items():
+        stacked = np.asarray(params["layers"][ours])
+        for i in range(cfg.n_layers):
+            t = stacked[i]
+            out[tmpl.format(i)] = (t.T if transpose else t).copy()
+    for i in range(cfg.n_layers):
+        w = np.asarray(params["layers"]["wqkv"][i]).T  # (3d, d)
+        b = np.asarray(params["layers"]["b_qkv"][i])
+        out[f"gpt_neox.layers.{i}.attention.query_key_value.weight"] = (
+            _interleave_neox_qkv(w, hq, hd).copy()
+        )
+        out[f"gpt_neox.layers.{i}.attention.query_key_value.bias"] = (
+            _interleave_neox_qkv(b, hq, hd).copy()
+        )
+    save_file(out, path)
+    return path
+
+
+# ------------------------------------------------------------ mixtral
+
+
+_HF_MIXTRAL_ATTN: Dict[str, Tuple[str, bool]] = {
+    "wq": ("model.layers.{}.self_attn.q_proj.weight", True),
+    "wk": ("model.layers.{}.self_attn.k_proj.weight", True),
+    "wv": ("model.layers.{}.self_attn.v_proj.weight", True),
+    "wo": ("model.layers.{}.self_attn.o_proj.weight", True),
+    "ln_attn": ("model.layers.{}.input_layernorm.weight", False),
+    "ln_mlp": ("model.layers.{}.post_attention_layernorm.weight", False),
+}
+# HF expert naming: w1 = gate, w2 = down, w3 = up (all stored (out, in))
+_HF_MIXTRAL_EXPERTS: Dict[str, str] = {
+    "w_gate": "model.layers.{}.block_sparse_moe.experts.{}.w1.weight",
+    "w_down": "model.layers.{}.block_sparse_moe.experts.{}.w2.weight",
+    "w_up": "model.layers.{}.block_sparse_moe.experts.{}.w3.weight",
+}
+
+
+def convert_hf_mixtral(
+    path: str,
+    cfg,
+    shardings: Optional[Dict[str, Any]] = None,
+    progress: Optional[Callable[[str], None]] = None,
+) -> Dict[str, Any]:
+    """HF MixtralForCausalLM safetensors checkpoint → our param tree
+    (per-layer expert-stacked (L, E, in, out) FFN weights, fp32 router)."""
+    reader = CheckpointReader(path)
+    note = progress or (lambda msg: logger.info("%s", msg))
+    try:
+        d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab_size
+        L, E = cfg.n_layers, cfg.n_experts
+        hq = cfg.n_heads * cfg.head_dim
+        hkv = cfg.n_kv_heads * cfg.head_dim
+        dt = cfg.dtype
+        last = f"model.layers.{L - 1}.input_layernorm.weight"
+        if last not in reader:
+            raise ValueError(
+                f"checkpoint does not match n_layers={L}: missing {last!r}"
+            )
+        sh = shardings or {}
+        layer_sh = sh.get("layers") or {}
+
+        shapes = {
+            "wq": (L, d, hq), "wk": (L, d, hkv), "wv": (L, d, hkv),
+            "wo": (L, hq, d), "ln_attn": (L, d), "ln_mlp": (L, d),
+        }
+        layers: Dict[str, Any] = {}
+        for ours, (tmpl, transpose) in _HF_MIXTRAL_ATTN.items():
+            note(f"converting {ours} ({L} layers)")
+            layers[ours] = _stack_layers(
+                reader, L, tmpl, transpose, dt, shapes[ours],
+                sharding=layer_sh.get(ours),
+            )
+        # router: HF gate.weight is (E, d); ours (L, d, E) fp32
+        note("converting router")
+        router = np.empty((L, d, E), dtype=np.float32)
+        for i in range(L):
+            g = reader.tensor(
+                f"model.layers.{i}.block_sparse_moe.gate.weight"
+            )
+            if tuple(g.shape) != (E, d):
+                raise ValueError(
+                    f"gate.weight shape {tuple(g.shape)} != {(E, d)}"
+                )
+            router[i] = np.asarray(g, dtype=np.float32).T
+        layers["router"] = _put(
+            router, np.float32, layer_sh.get("router")
+        )
+        exp_shapes = {
+            "w_gate": (d, f), "w_up": (d, f), "w_down": (f, d),
+        }
+        for ours, tmpl in _HF_MIXTRAL_EXPERTS.items():
+            note(f"converting {ours} ({L} layers x {E} experts)")
+            per = exp_shapes[ours]
+            stacked = np.empty((L, E) + per, dtype=dt)
+            for i in range(L):
+                for e in range(E):
+                    t = reader.tensor(tmpl.format(i, e)).T
+                    if tuple(t.shape) != per:
+                        raise ValueError(
+                            f"{tmpl.format(i, e)}: shape {tuple(t.shape)} "
+                            f"!= expected {per}"
+                        )
+                    stacked[i, e] = np.asarray(t, dtype=dt)
+            layers[ours] = _put(stacked, dt, layer_sh.get(ours))
+
+        note("converting embed / final_norm / lm_head")
+        return {
+            "embed": _put(
+                _fetch(reader, "model.embed_tokens.weight", (v, d)), dt,
+                sh.get("embed"),
+            ),
+            "layers": layers,
+            "final_norm": _put(
+                _fetch(reader, "model.norm.weight", (d,)), dt, sh.get("final_norm")
+            ),
+            "lm_head": _put(
+                _fetch(reader, "lm_head.weight", (d, v), transpose=True), dt,
+                sh.get("lm_head"),
+            ),
+        }
+    finally:
+        reader.close()
+
+
+def export_hf_mixtral(params: Dict[str, Any], cfg, path: str) -> str:
+    """Our mixtral tree → HF-format safetensors (test/interop inverse)."""
+    from safetensors.numpy import save_file
+
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"]),
+        "model.norm.weight": np.asarray(params["final_norm"]),
+        "lm_head.weight": np.asarray(params["lm_head"]).T.copy(),
+    }
+    for ours, (tmpl, transpose) in _HF_MIXTRAL_ATTN.items():
+        stacked = np.asarray(params["layers"][ours])
+        for i in range(cfg.n_layers):
+            t = stacked[i]
+            out[tmpl.format(i)] = (t.T if transpose else t).copy()
+    router = np.asarray(params["layers"]["router"])
+    for i in range(cfg.n_layers):
+        out[f"model.layers.{i}.block_sparse_moe.gate.weight"] = (
+            router[i].T.copy().astype(np.float32)
+        )
+    for ours, tmpl in _HF_MIXTRAL_EXPERTS.items():
+        stacked = np.asarray(params["layers"][ours])
+        for i in range(cfg.n_layers):
+            for e in range(cfg.n_experts):
+                out[tmpl.format(i, e)] = stacked[i, e].T.copy()
+    save_file(out, path)
+    return path
+
+
 CONVERTERS: Dict[str, Callable[..., Dict[str, Any]]] = {
     "llama": convert_hf_llama,
+    "gptneox": convert_hf_gptneox,
+    "mixtral": convert_hf_mixtral,
 }
 
 
