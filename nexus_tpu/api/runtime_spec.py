@@ -280,8 +280,9 @@ class InferSpec:
     prompt: str = ""
     # speculative decoding (models/decoding.py::speculative_generate):
     # a draft model (family/preset/overrides, shared vocab) proposes
-    # num_speculative tokens per target forward; greedy-exact. Requires
-    # temperature == 0 and batch 1.
+    # num_speculative tokens per target forward. Batched (per-row
+    # acceptance over vector-length KV caches); exact greedy when
+    # temperature == 0, exact rejection-sampled otherwise.
     draft: Optional["ModelRef"] = None
     num_speculative: int = 4
     # Orbax checkpoint for the draft's weights (params restored the same
@@ -540,16 +541,6 @@ class JaxXlaRuntime:
                         f"{t_cfg.vocab_size} (override the draft's "
                         "vocab_size)"
                     )
-            if self.infer.temperature > 0:
-                errs.append(
-                    "speculative decoding (infer.draft) is greedy-exact "
-                    "only; set infer.temperature to 0"
-                )
-            if self.train.batch_size != 1:
-                errs.append(
-                    "speculative decoding supports batch 1 (per-sequence "
-                    f"acceptance); got train.batchSize {self.train.batch_size}"
-                )
             if self.infer.num_speculative < 1:
                 errs.append(
                     "infer.numSpeculative must be >= 1, got "

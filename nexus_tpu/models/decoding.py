@@ -64,7 +64,11 @@ def _decode_attention(
     compiled program regardless of decode position. GQA runs as grouped
     einsums against the raw (B, L, Hkv, D) cache: no ``jnp.repeat``
     materialization, so per-step HBM traffic is the cache itself, not
-    n_rep copies of it (the decode-throughput driver for config #3)."""
+    n_rep copies of it (the decode-throughput driver for config #3).
+
+    ``start``: scalar (all rows at one depth) or (B,) vector (per-row
+    depths — the batched-speculation cache, where each sequence committed
+    a different number of tokens)."""
     b, t, hq, hd = q.shape
     max_len = k_buf.shape[1]
     hkv = k_buf.shape[2]
@@ -83,14 +87,17 @@ def _decode_attention(
     logits = jnp.einsum(
         "btgrd,bkgd->bgrtk", qg, k_buf, preferred_element_type=jnp.float32
     ) * hd ** -0.5  # (B, Hkv, rep, T, L)
-    q_pos = start + jnp.arange(t)
-    visible = jnp.arange(max_len)[None, :] <= q_pos[:, None]  # (t, max_len)
+    starts = jnp.broadcast_to(jnp.asarray(start), (b,))  # scalar or (B,)
+    q_pos = starts[:, None] + jnp.arange(t)[None, :]  # (B, t)
+    visible = (
+        jnp.arange(max_len)[None, None, :] <= q_pos[..., None]
+    )  # (B, t, max_len)
     if window > 0:  # sliding-window attention: newest `window` positions
         visible = visible & (
-            jnp.arange(max_len)[None, :] > q_pos[:, None] - window
+            jnp.arange(max_len)[None, None, :] > q_pos[..., None] - window
         )
     mask_value = -0.7 * float(jnp.finfo(jnp.float32).max)
-    logits = jnp.where(visible[None, None, None], logits, mask_value)
+    logits = jnp.where(visible[:, None, None], logits, mask_value)
     probs = jax.nn.softmax(logits, axis=-1).astype(v_buf.dtype)
     out = jnp.einsum("bgrtk,bkgd->btgrd", probs, v_buf)
     return out.reshape(b, t, hq, hd).astype(q.dtype)
@@ -122,6 +129,7 @@ def generic_forward_decode(
     b, t = tokens.shape
     max_len = cache["k"].shape[2]
     start = cache["length"]
+    vector_len = jnp.ndim(start) == 1  # per-row cache depths (batched spec)
 
     x = params["embed"].astype(cfg.dtype)[tokens]
     # rope tables for the whole buffer; slice at runtime positions
@@ -129,8 +137,27 @@ def generic_forward_decode(
         max_len, rope_dims if rope_dims is not None else cfg.head_dim,
         cfg.rope_theta,
     )
-    cos = lax.dynamic_slice_in_dim(cos_full, start, t, axis=0)
-    sin = lax.dynamic_slice_in_dim(sin_full, start, t, axis=0)
+    if vector_len:
+        # per-row positions → (B, t, half) gathered tables (apply_rope
+        # broadcasts 3-dim tables over heads)
+        positions = jnp.clip(
+            start[:, None] + jnp.arange(t)[None, :], 0, max_len - 1
+        )
+        cos = cos_full[positions]
+        sin = sin_full[positions]
+    else:
+        cos = lax.dynamic_slice_in_dim(cos_full, start, t, axis=0)
+        sin = lax.dynamic_slice_in_dim(sin_full, start, t, axis=0)
+
+    def write_cache(buf, new):
+        """Append ``new`` (B, t, ...) at each row's depth: contiguous
+        dynamic-slice in the scalar case, a per-row scatter (dropped when
+        out of range) in the vector case."""
+        if not vector_len:
+            return lax.dynamic_update_slice_in_dim(buf, new, start, axis=1)
+        rows = jnp.arange(b)[:, None]
+        pos = start[:, None] + jnp.arange(t)[None, :]
+        return buf.at[rows, pos].set(new, mode="drop")
 
     quantized = "k_scale" in cache
     scan_xs = (params["layers"], cache["k"], cache["v"]) + (
@@ -149,25 +176,17 @@ def generic_forward_decode(
             if quantized:
                 kq, ks = _quantize_kv(k)
                 vq, vs = _quantize_kv(v)
-                k_buf = lax.dynamic_update_slice_in_dim(
-                    k_cache, kq, start, axis=1
-                )
-                v_buf = lax.dynamic_update_slice_in_dim(
-                    v_cache, vq, start, axis=1
-                )
-                ks_buf = lax.dynamic_update_slice_in_dim(
-                    ks_cache, ks, start, axis=1
-                )
-                vs_buf = lax.dynamic_update_slice_in_dim(
-                    vs_cache, vs, start, axis=1
-                )
+                k_buf = write_cache(k_cache, kq)
+                v_buf = write_cache(v_cache, vq)
+                ks_buf = write_cache(ks_cache, ks)
+                vs_buf = write_cache(vs_cache, vs)
                 calls.append((k_buf, v_buf, ks_buf, vs_buf))
                 return _decode_attention(
                     q, k_buf, v_buf, start, window=window,
                     k_scale=ks_buf, v_scale=vs_buf,
                 )
-            k_buf = lax.dynamic_update_slice_in_dim(k_cache, k, start, axis=1)
-            v_buf = lax.dynamic_update_slice_in_dim(v_cache, v, start, axis=1)
+            k_buf = write_cache(k_cache, k)
+            v_buf = write_cache(v_cache, v)
             calls.append((k_buf, v_buf))
             return _decode_attention(q, k_buf, v_buf, start, window=window)
 
@@ -317,31 +336,30 @@ def speculative_generate(
     spent per token (ideally ~1/(accepted+1)).
 
     TPU-shaped: rounds run under ``lax.while_loop`` with static shapes —
-    the KV caches are append buffers whose ``length`` pointer IS the
-    rollback (rejected draft positions are simply overwritten by the next
-    round), so no buffer copying happens on rejection. Both models must
-    share a vocabulary.
+    the KV caches are append buffers whose per-row ``length`` pointers ARE
+    the rollback (rejected draft positions are simply overwritten by the
+    next round), so no buffer copying happens on rejection. Both models
+    must share a vocabulary.
 
-    prompt: (B, P) — B must be 1 for now (acceptance lengths are
-    per-sequence; batching would force the slowest sequence's rollback on
-    everyone). Returns ``(tokens (1, P + max_new_tokens), stats)`` where
+    prompt: (B, P). BATCHED: each row accepts its own prefix length per
+    round (the caches run VECTOR lengths — per-row write positions, rope
+    offsets, and attention masks; decoding.py's generic scaffold), so a
+    slow row never forces a rollback on the others; rows that reach
+    ``max_new_tokens`` early freeze (their commits mask out) while the
+    rest drain. Returns ``(tokens (B, P + max_new_tokens), stats)`` where
     stats carries scalar counters: rounds, drafted, accepted — the
-    acceptance rate (accepted/drafted) is THE health metric of a
-    speculative deployment (a mismatched draft silently degrades to
-    slower-than-plain decode).
+    acceptance rate (accepted/drafted, counted over ACTIVE rows only) is
+    THE health metric of a speculative deployment (a mismatched draft
+    silently degrades to slower-than-plain decode).
 
     ``temperature > 0`` (requires ``key``) switches to the standard
-    rejection-sampling rule (speculative_accept_step): the draft SAMPLES
-    proposals from its temperature-adjusted distribution, and the output
-    marginal equals sampling from the TARGET's — exactness verified in
-    closed form by tests/test_models.py. top-k/top-p truncation is not
-    supported here (truncation breaks the residual-distribution math)."""
+    rejection-sampling rule (speculative_accept_step, vmapped over rows):
+    the draft SAMPLES proposals from its temperature-adjusted
+    distribution, and the output marginal equals sampling from the
+    TARGET's — exactness verified in closed form by
+    tests/test_models.py. top-k/top-p truncation is not supported here
+    (truncation breaks the residual-distribution math)."""
     b, p = prompt.shape
-    if b != 1:
-        raise ValueError(
-            "speculative_generate supports batch 1 (per-sequence "
-            f"acceptance lengths); got batch {b}"
-        )
     if temperature > 0.0 and key is None:
         raise ValueError("temperature > 0 requires an explicit PRNG key")
     sampled = temperature > 0.0
@@ -400,48 +418,51 @@ def speculative_generate(
         c["length"] = n
         return c
 
+    # switch both caches to VECTOR lengths: from here on every row tracks
+    # its own depth (prefill ran at scalar 0 — cheaper contiguous writes)
+    rows = jnp.arange(b)
+
     def round_step(state):
-        buf, n_done, rounds, n_accepted, t_cache, d_cache = state
-        # absolute position of the newest committed token
-        last_pos = p + n_done - 1
+        buf, n_done, rounds, drafted_n, n_accepted, t_cache, d_cache = state
+        # per-row absolute position of the newest committed token
+        last_pos = p + n_done - 1  # (B,)
+        active = n_done < max_new_tokens  # (B,) — finished rows freeze
         round_key = (
             jax.random.fold_in(key, rounds + 1) if sampled else None
         )
 
         # 1) draft proposes k tokens autoregressively from the committed
-        #    context (its cache is positioned at last_pos). The scan runs
-        #    k+1 feeds — the final feed's OUTPUT is discarded, but it puts
-        #    the last proposal's K/V into the draft cache, which the
+        #    context (each row's cache sits at its own last_pos). The scan
+        #    runs k+1 feeds — the final feed's OUTPUT is discarded, but it
+        #    puts the last proposal's K/V into the draft cache, which the
         #    all-accepted case needs (the next round resumes after it)
         def draft_one(carry, i):
             d_cache, tok = carry
             logits, d_cache = draft_forward_decode(
                 draft_params, draft_cfg, tok[:, None], d_cache
             )
-            row = logits[:, -1]  # (1, V)
+            row = logits[:, -1]  # (B, V)
             if sampled:
                 probs = jax.nn.softmax(row / temperature, axis=-1)
                 nxt = jax.random.categorical(
                     jax.random.fold_in(round_key, i), row / temperature
                 ).astype(buf.dtype)
-                return (d_cache, nxt), (nxt, probs[0])
-            # greedy: no per-feed softmax, no (k+1, V) probs stack —
+                return (d_cache, nxt), (nxt, probs)
+            # greedy: no per-feed softmax, no (k+1, B, V) probs stack —
             # `sampled` is a static bool so the scan output structure is
             # fixed at trace time
             nxt = jnp.argmax(row, axis=-1).astype(buf.dtype)
             return (d_cache, nxt), nxt
 
-        last_tok = lax.dynamic_index_in_dim(
-            buf, last_pos, axis=1, keepdims=False
-        )
+        last_tok = buf[rows, last_pos]  # (B,)
         (d_cache, _), scanned_out = lax.scan(
             draft_one, (d_cache, last_tok), jnp.arange(k + 1)
         )
         if sampled:
-            drafted, draft_probs = scanned_out
+            drafted, draft_probs = scanned_out  # (k+1, B), (k+1, B, V)
         else:
             drafted, draft_probs = scanned_out, None
-        proposals = drafted.swapaxes(0, 1)[:, :k]  # (B=1, k)
+        proposals = drafted.swapaxes(0, 1)[:, :k]  # (B, k)
 
         # 2) one target forward over [last_tok, proposals] (k+1 wide):
         #    position i's logits give the target's token AFTER seeing
@@ -453,73 +474,90 @@ def speculative_generate(
         )
         if sampled:
             # 3) standard rejection rule over the temperature-adjusted
-            #    distributions (speculative_accept_step): output marginal
-            #    == sampling from the target
+            #    distributions, per row (speculative_accept_step vmapped):
+            #    output marginal == sampling from the target
             target_probs = jax.nn.softmax(
-                t_logits[0] / temperature, axis=-1
-            )  # (k+1, V)
+                t_logits / temperature, axis=-1
+            )  # (B, k+1, V)
             uniforms = jax.random.uniform(
-                jax.random.fold_in(round_key, k + 1), (k,)
+                jax.random.fold_in(round_key, k + 1), (b, k)
             )
-            accepted, out = speculative_accept_step(
-                draft_probs[:k], target_probs, proposals[0],
-                uniforms, jax.random.fold_in(round_key, k + 2),
+            res_keys = jax.random.split(
+                jax.random.fold_in(round_key, k + 2), b
             )
+            accepted, out = jax.vmap(speculative_accept_step)(
+                jnp.moveaxis(draft_probs[:k], 1, 0),  # (B, k, V)
+                target_probs,
+                proposals,
+                uniforms,
+                res_keys,
+            )  # (B,), (B, k+1)
             out = out.astype(buf.dtype)
         else:
             target_choice = jnp.argmax(t_logits, axis=-1).astype(
                 buf.dtype
-            )  # (1, k+1)
+            )  # (B, k+1)
 
-            # 3) accept the longest matching prefix; the first mismatch is
-            #    REPLACED by the target's own choice, and a fully-accepted
-            #    round appends the bonus token (still exact greedy)
-            match = proposals == target_choice[:, :k]  # (1, k)
+            # 3) accept the longest matching prefix per row; the first
+            #    mismatch is REPLACED by the target's own choice, and a
+            #    fully-accepted round appends the bonus token (still
+            #    exactly the target's greedy decode, row by row)
+            match = proposals == target_choice[:, :k]  # (B, k)
             accepted = jnp.argmin(
                 jnp.concatenate(
                     [match.astype(jnp.int32), jnp.zeros((b, 1), jnp.int32)],
                     axis=1,
                 ),
                 axis=1,
-            )[0]  # first False index == number of accepted proposals
+            )  # (B,) first False index == number of accepted proposals
             out = jnp.where(
-                jnp.arange(k + 1) < accepted, drafted.swapaxes(0, 1)[0],
-                target_choice[0],
-            )  # (k+1,) — position `accepted` holds the correction/bonus
-        # committed tokens this round: accepted proposals + 1
-        # (correction or bonus)
-        n_new = accepted + 1
-        buf = lax.dynamic_update_slice_in_dim(
-            buf,
-            out[None, :],
-            last_pos + 1,
-            axis=1,
+                jnp.arange(k + 1)[None, :] < accepted[:, None],
+                drafted.swapaxes(0, 1),
+                target_choice,
+            )  # (B, k+1) — position accepted_i holds correction/bonus
+        # committed tokens this round: accepted proposals + 1 (correction
+        # or bonus); FROZEN rows commit nothing — their writes are pushed
+        # out of range (scatter drop) and their pointers stay put
+        n_new = jnp.where(active, accepted + 1, 0)  # (B,)
+        write_pos = jnp.where(
+            active[:, None],
+            last_pos[:, None] + 1 + jnp.arange(k + 1)[None, :],
+            max_len + 1,  # dropped by the scatter
         )
-        # 4) rollback by pointer: both caches hold K/V up to the scored
-        #    block's end; keep [.., last_tok, accepted proposals]. The
-        #    correction token is committed to `buf` but its K/V is NOT in
-        #    either cache — it gets appended when the next round feeds it
-        #    as its first input (same shape as the post-prefill state,
-        #    where first_tok's K/V is pending)
-        new_len = last_pos + 1 + accepted
+        buf = buf.at[rows[:, None], write_pos].set(out, mode="drop")
+        # 4) rollback by pointer, per row: both caches hold K/V up to the
+        #    scored block's end; keep [.., last_tok, accepted proposals].
+        #    The correction token is committed to `buf` but its K/V is NOT
+        #    in either cache — it gets appended when the next round feeds
+        #    it as its first input
+        new_len = jnp.where(
+            active, last_pos + 1 + accepted, t_cache["length"]
+        )
         t_cache = set_len(t_cache_next, new_len)
         d_cache = set_len(d_cache, new_len)
+        n_active = jnp.sum(active.astype(jnp.int32))
         return (
-            buf, n_done + n_new, rounds + 1, n_accepted + accepted,
+            buf, n_done + n_new, rounds + 1,
+            drafted_n + k * n_active,
+            n_accepted + jnp.sum(jnp.where(active, accepted, 0)),
             t_cache, d_cache,
         )
 
     def cond(state):
-        return state[1] < max_new_tokens
+        return jnp.any(state[1] < max_new_tokens)
 
     zero = jnp.asarray(0, jnp.int32)
-    buf, n_done, rounds, n_accepted, _, _ = lax.while_loop(
+    vec_p = jnp.full((b,), p, jnp.int32)
+    buf, n_done, rounds, drafted_n, n_accepted, _, _ = lax.while_loop(
         cond, round_step,
-        (buf, jnp.asarray(1, jnp.int32), zero, zero, t_cache, d_cache),
+        (
+            buf, jnp.full((b,), 1, jnp.int32), zero, zero, zero,
+            set_len(t_cache, vec_p), set_len(d_cache, vec_p),
+        ),
     )
     stats = {
         "rounds": rounds,
-        "drafted": rounds * k,
+        "drafted": drafted_n,
         "accepted": n_accepted,
     }
     return (

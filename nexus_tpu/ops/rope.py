@@ -31,11 +31,13 @@ def apply_rope(
 ) -> jnp.ndarray:
     """Rotate pairs (x[..., :half], x[..., half:]) — x: (..., seq, heads, head_dim).
 
-    cos/sin: (seq, head_dim/2), broadcast over batch and heads."""
+    cos/sin: (seq, head_dim/2), broadcast over batch and heads — or
+    (batch, seq, head_dim/2) when positions differ per batch row (the
+    vector-length decode cache: each sequence sits at its own depth)."""
     half = x.shape[-1] // 2
     x1, x2 = x[..., :half], x[..., half:]
     # broadcast tables to (..., seq, 1, half)
-    c = cos[:, None, :]
-    s = sin[:, None, :]
+    c = cos[..., :, None, :]
+    s = sin[..., :, None, :]
     out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
     return out.astype(x.dtype)

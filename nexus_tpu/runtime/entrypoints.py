@@ -11,6 +11,7 @@ metrics dict (tokens/sec, MFU, loss history, …).
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Dict, Optional, Sequence
 
 import jax
@@ -299,6 +300,10 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel=None):
             profile_start=prof.start_step,
             profile_steps=prof.num_steps,
             cancel=cancel,
+            # dispatch-depth override for profiling sweeps
+            # (tools/sweep_levers.py); unset → Trainer's platform default
+            run_ahead=int(os.environ.get("NEXUS_RUN_AHEAD", "0") or 0)
+            or None,
         )
         try:
             # 2 untimed warmup steps: the first execution is the compile, and
@@ -568,8 +573,9 @@ def _run_infer(runtime, family, cfg, mesh):
         spec_extra = {}
         if inf.draft is not None:
             # speculative decoding: draft weights from its checkpoint (or
-            # random init for timing runs); greedy-exact, batch 1
-            # (validate() enforces both; draft_cfg resolved above)
+            # random init for timing runs). Batched — each row accepts its
+            # own prefix length per round (vector-length caches); greedy
+            # by default, rejection-sampled when temperature > 0
             from nexus_tpu.models.decoding import speculative_generate
 
             draft_params, draft_loaded = _load_draft_params(
@@ -599,6 +605,8 @@ def _run_infer(runtime, family, cfg, mesh):
                     draft_cache_sharding=_cache_sharding_for(
                         draft_cfg.n_kv_heads
                     ),
+                    temperature=kw.get("temperature", 0.0),
+                    key=kw.get("key"),
                 )
 
         spec_stats = {}
@@ -627,10 +635,13 @@ def _run_infer(runtime, family, cfg, mesh):
         spec_extra.update(
             rounds=rounds,
             acceptance_rate=round(accepted / drafted, 4) if drafted else 0.0,
-            # target forwards per committed token: the speedup driver
-            # (1.0 == plain greedy; lower is better)
+            # target forwards per committed token PER ROW: the speedup
+            # driver (1.0 == plain greedy; lower is better). Each round is
+            # one batched target forward, so the per-row basis is max_new —
+            # dividing by batch*max_new would claim a batch-size 'speedup'
+            # that plain greedy decoding gets identically
             target_forwards_per_token=round(
-                (rounds + 1) / max(new_tokens, 1), 4
+                (rounds + 1) / max(max_new, 1), 4
             ),
         )
     text_extra = {}

@@ -505,3 +505,83 @@ def test_speculative_sampled_modes():
         temperature=1e-4, key=jax.random.PRNGKey(5),
     )
     np.testing.assert_array_equal(np.array(out), np.array(ref))
+
+
+def test_speculative_generate_batched_exactly_matches_greedy():
+    """BATCHED speculation (per-row acceptance over vector-length caches)
+    still reproduces plain greedy decode EXACTLY for every row — rows with
+    different prompts accept different prefix lengths per round, and rows
+    finishing early freeze while the rest drain."""
+    from nexus_tpu.models.decoding import speculative_generate
+
+    cfg = tiny_llama()
+    target = llama.init(jax.random.PRNGKey(0), cfg)
+    draft = llama.init(jax.random.PRNGKey(42), cfg)
+
+    b = 4
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (b, 6), 0,
+                                cfg.vocab_size)
+    ref = llama.generate(target, cfg, prompt, max_new_tokens=10)
+    for k in (1, 3, 4):
+        out, stats = speculative_generate(
+            llama.forward_decode, target, cfg,
+            llama.forward_decode, draft, cfg,
+            prompt, max_new_tokens=10, num_speculative=k,
+        )
+        assert out.shape == (b, 6 + 10)
+        assert 0 <= int(stats["accepted"]) <= int(stats["drafted"])
+        np.testing.assert_array_equal(
+            np.array(out), np.array(ref), err_msg=f"k={k}"
+        )
+    # self-draft: every row accepts everything it needs
+    out, stats = speculative_generate(
+        llama.forward_decode, target, cfg,
+        llama.forward_decode, target, cfg,
+        prompt, max_new_tokens=10, num_speculative=4,
+    )
+    np.testing.assert_array_equal(np.array(out), np.array(ref))
+    assert int(stats["accepted"]) == int(stats["drafted"])
+
+
+def test_vector_length_cache_matches_scalar():
+    """The vector-length decode path (per-row depths) must equal the
+    scalar path when all rows share one depth — and stay correct when
+    rows sit at genuinely different depths."""
+    cfg = tiny_llama()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    b, pre, max_len = 3, 6, 24
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (b, pre + 4), 0,
+                                cfg.vocab_size)
+
+    # same depth, scalar vs vector
+    c_s = llama.init_kv_cache(cfg, b, max_len)
+    l_s, c_s = llama.forward_decode(params, cfg, tokens[:, :pre], c_s)
+    c_v = llama.init_kv_cache(cfg, b, max_len)
+    _, c_v = llama.forward_decode(params, cfg, tokens[:, :pre], c_v)
+    c_v["length"] = jnp.full((b,), pre, jnp.int32)
+    l2_s, _ = llama.forward_decode(params, cfg, tokens[:, pre:pre + 1], c_s)
+    l2_v, _ = llama.forward_decode(params, cfg, tokens[:, pre:pre + 1], c_v)
+    np.testing.assert_allclose(np.array(l2_v), np.array(l2_s),
+                               rtol=2e-5, atol=2e-5)
+
+    # different depths: row i prefilled to pre - i, then one step each;
+    # each row's logits must match a per-row scalar-cache reference
+    c = llama.init_kv_cache(cfg, b, max_len)
+    # manual per-row prefill through the vector path: prefill all to the
+    # max depth then rewind rows (pointer rollback = vector lengths)
+    _, c = llama.forward_decode(params, cfg, tokens[:, :pre], c)
+    depths = jnp.asarray([pre, pre - 1, pre - 2], jnp.int32)
+    c["length"] = depths
+    step = tokens[jnp.arange(b), depths][:, None]  # each row's next token
+    l_vec, _ = llama.forward_decode(params, cfg, step, c)
+    for i in range(b):
+        ci = llama.init_kv_cache(cfg, 1, max_len)
+        d = int(depths[i])
+        _, ci = llama.forward_decode(params, cfg, tokens[i:i + 1, :d], ci)
+        li, _ = llama.forward_decode(
+            params, cfg, tokens[i:i + 1, d:d + 1], ci
+        )
+        np.testing.assert_allclose(
+            np.array(l_vec[i]), np.array(li[0]), rtol=5e-3, atol=5e-3,
+            err_msg=f"row {i} depth {d}",
+        )
