@@ -1,0 +1,226 @@
+"""On-chip profiling battery for the opt-in perf levers (VERDICT r2 item 5).
+
+Runs each lever's A/B measurement on the attached accelerator and appends
+one JSON line per result to stdout (and NEXUS_SWEEP_OUT if set), so a
+partially-completed battery still yields numbers:
+
+  * moe-dispatch   — einsum (T,E,C) contraction vs scatter/gather token
+                     movement at Mixtral-layer shapes;
+  * window-flash   — sliding-window flash kernel fwd+grad wall time vs the
+                     windowless kernel at long sequence (tile-skipping);
+  * run-ahead      — trainer dispatch depth 1/2/4/8 steps/sec (hides the
+                     host↔device round-trip);
+  * (int8 KV and speculative decode are covered by bench.py's decode
+     suite — same artifact, no duplication here.)
+
+Each phase is wrapped in its own try/except and the whole battery sits
+under an internal watchdog (NEXUS_SWEEP_DEADLINE_S, default 2400) — the
+TPU tunnel wedging mid-phase must not hang the caller, and no external
+killer should be needed (killing a TPU process mid-RPC wedges the tunnel,
+docs/PERF.md).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _emit(rec: dict) -> None:
+    line = json.dumps(rec)
+    print(line, flush=True)
+    out = os.environ.get("NEXUS_SWEEP_OUT", "")
+    if out:
+        with open(out, "a") as f:
+            f.write(line + "\n")
+
+
+def _timed(fn, *args, iters=20, warmup=3):
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def phase_moe_dispatch():
+    """Dense-einsum vs scatter dispatch+combine at a Mixtral-8x7B-ish
+    single-chip layer shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from nexus_tpu.ops.moe import (
+        moe_combine_dense,
+        moe_combine_scatter,
+        moe_dispatch_dense,
+        moe_dispatch_scatter,
+        top_k_routing,
+    )
+
+    # tokens = batch*seq at bench shape; d scaled to fit one v5e
+    t_tokens, d, e, k = 4096, 1024, 8, 2
+    capacity = int(1.25 * k * t_tokens / e)
+    x = jax.random.normal(jax.random.PRNGKey(0), (t_tokens, d), jnp.bfloat16)
+    logits = jax.random.normal(jax.random.PRNGKey(1), (t_tokens, e), jnp.float32)
+    routing = jax.jit(
+        functools.partial(top_k_routing, num_selected=k, capacity=capacity)
+    )(logits)
+    jax.block_until_ready(routing)
+
+    def einsum_path(x, routing):
+        buf = moe_dispatch_dense(x, routing)
+        return moe_combine_dense(buf, routing)
+
+    def scatter_path(x, routing):
+        buf = moe_dispatch_scatter(x, routing, e, capacity)
+        return moe_combine_scatter(buf, routing)
+
+    te = _timed(jax.jit(einsum_path), x, routing)
+    ts = _timed(jax.jit(scatter_path), x, routing)
+    _emit({
+        "phase": "moe-dispatch", "tokens": t_tokens, "d_model": d,
+        "experts": e, "top_k": k,
+        "einsum_ms": round(te * 1e3, 3), "scatter_ms": round(ts * 1e3, 3),
+        "scatter_speedup": round(te / ts, 3) if ts else None,
+    })
+
+
+def phase_window_flash():
+    """Sliding-window tile-skipping: fwd + grad at long sequence."""
+    import jax
+    import jax.numpy as jnp
+
+    from nexus_tpu.ops.attention import flash_attention
+
+    b, s, hq, hkv, dh = 1, 8192, 8, 4, 128
+    window = 1024
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, s, hq, dh), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, s, hkv, dh), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, s, hkv, dh), jnp.bfloat16)
+
+    def fwd(w):
+        return jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, causal=True, window=w)
+        )
+
+    def grad(w):
+        return jax.jit(jax.grad(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True, window=w
+            ).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2),
+        ))
+
+    tf_full = _timed(fwd(0), q, k, v, iters=10)
+    tf_win = _timed(fwd(window), q, k, v, iters=10)
+    tg_full = _timed(grad(0), q, k, v, iters=5)
+    tg_win = _timed(grad(window), q, k, v, iters=5)
+    _emit({
+        "phase": "window-flash", "seq": s, "window": window,
+        "fwd_full_ms": round(tf_full * 1e3, 3),
+        "fwd_window_ms": round(tf_win * 1e3, 3),
+        "fwd_speedup": round(tf_full / tf_win, 3),
+        "grad_full_ms": round(tg_full * 1e3, 3),
+        "grad_window_ms": round(tg_win * 1e3, 3),
+        "grad_speedup": round(tg_full / tg_win, 3),
+    })
+
+
+def phase_run_ahead():
+    """Trainer dispatch depth: steps/sec at depth 1 vs 2 vs 4 vs 8."""
+    from nexus_tpu.api.runtime_spec import (
+        JaxXlaRuntime,
+        ModelRef,
+        ParallelismSpec,
+        TpuSliceSpec,
+        TrainSpec,
+    )
+    from nexus_tpu.runtime.entrypoints import run_template_runtime
+    from nexus_tpu.utils.hw import is_tpu
+
+    preset = "400m" if is_tpu() else "tiny"
+    seq = 2048 if is_tpu() else 64
+    out = {"phase": "run-ahead", "preset": preset, "seq": seq}
+    for depth in (1, 2, 4, 8):
+        os.environ["NEXUS_RUN_AHEAD"] = str(depth)
+        try:
+            runtime = JaxXlaRuntime(
+                mode="train",
+                model=ModelRef(
+                    family="llama", preset=preset,
+                    overrides={} if is_tpu() else {"dtype": "float32"},
+                ),
+                tpu=TpuSliceSpec(accelerator="v5e", topology="1x1"),
+                parallelism=ParallelismSpec(),
+                train=TrainSpec(batch_size=8, seq_len=seq, steps=12,
+                                learning_rate=3e-4),
+            )
+            m = run_template_runtime(runtime)
+            out[f"steps_per_sec_depth{depth}"] = round(
+                m.get("steps_per_sec", 0.0), 4
+            )
+        except Exception as e:  # noqa: BLE001
+            out[f"depth{depth}_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+        finally:
+            os.environ.pop("NEXUS_RUN_AHEAD", None)
+    _emit(out)
+
+
+PHASES = {
+    "moe-dispatch": phase_moe_dispatch,
+    "window-flash": phase_window_flash,
+    "run-ahead": phase_run_ahead,
+}
+
+
+def main() -> int:
+    import threading
+
+    deadline = float(os.environ.get("NEXUS_SWEEP_DEADLINE_S") or 2400)
+    stage = ["startup"]
+
+    def watchdog():
+        _emit({"phase": "watchdog", "error": f"deadline {deadline}s hit "
+               f"at stage {stage[0]}"})
+        os._exit(1)
+
+    timer = threading.Timer(deadline, watchdog)
+    timer.daemon = True
+    timer.start()
+
+    only = [a for a in sys.argv[1:] if not a.startswith("-")]
+    from nexus_tpu.utils.hw import device_kind, honor_env_platforms
+
+    honor_env_platforms()
+    stage[0] = "backend-init"
+    import jax
+
+    _emit({"phase": "backend", "device": device_kind(),
+           "n_devices": len(jax.devices())})
+    rc = 0
+    for name, fn in PHASES.items():
+        if only and name not in only:
+            continue
+        stage[0] = name
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001
+            _emit({"phase": name,
+                   "error": f"{type(e).__name__}: {str(e)[:300]}"})
+            rc = 1
+    timer.cancel()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
