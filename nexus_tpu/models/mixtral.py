@@ -143,19 +143,23 @@ def init(key: jax.Array, cfg: MixtralConfig) -> Dict[str, Any]:
 
 
 def logical_axes(cfg: MixtralConfig) -> Dict[str, Any]:
+    """Leading stacked-layer dim is the logical 'layer' axis — unsharded
+    by default (DEFAULT_LOGICAL_RULES maps it to None) and remapped onto
+    the 'pipeline' mesh axis by the runtime when pipeline parallelism is
+    active, exactly like the dense families."""
     return {
         "embed": ("vocab", "embed"),
         "layers": {
-            "wq": (None, "embed", "qkv"),
-            "wk": (None, "embed", "qkv"),
-            "wv": (None, "embed", "qkv"),
-            "wo": (None, "qkv", "embed"),
-            "router": (None, "embed", None),
-            "w_gate": (None, "expert", "embed", "mlp"),
-            "w_up": (None, "expert", "embed", "mlp"),
-            "w_down": (None, "expert", "mlp", "embed"),
-            "ln_attn": (None, None),
-            "ln_mlp": (None, None),
+            "wq": ("layer", "embed", "qkv"),
+            "wk": ("layer", "embed", "qkv"),
+            "wv": ("layer", "embed", "qkv"),
+            "wo": ("layer", "qkv", "embed"),
+            "router": ("layer", "embed", None),
+            "w_gate": ("layer", "expert", "embed", "mlp"),
+            "w_up": ("layer", "expert", "embed", "mlp"),
+            "w_down": ("layer", "expert", "mlp", "embed"),
+            "ln_attn": ("layer", None),
+            "ln_mlp": ("layer", None),
         },
         "final_norm": (None,),
         "lm_head": ("embed", "vocab"),

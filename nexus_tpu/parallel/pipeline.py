@@ -144,18 +144,32 @@ def pipeline_apply(
 
 # ------------------------------------------------ model-family adapters
 
+#: the one capability matrix: which families each schedule supports.
+#: entrypoints.py consumes this — keep additions here, not there.
+PIPELINE_FAMILIES: Dict[str, Tuple[str, ...]] = {
+    "1f1b": ("llama", "gptneox", "mixtral"),
+    "gpipe": ("llama", "gptneox"),
+}
+
 
 def _trunk_parts(family: str, params: Dict[str, Any], cfg, seq_len: int):
-    """Per-family pieces the schedules compose: ``stage_fn(layers_local, h)``
-    (a contiguous slice of the stacked layer scan) and
-    ``head_loss(head_params, hidden, targets)`` (final norm + LM head + CE,
-    honoring ``cfg.ce_chunk``), plus the head-param subtree keys.
+    """Per-family pieces the schedules compose: ``stage_fn(layers_local, c)``
+    (a contiguous slice of the stacked layer scan over the family's carry)
+    and ``head_loss(head_params, carry, targets)`` (final norm + LM head +
+    CE, honoring ``cfg.ce_chunk``), plus the head-param subtree keys and
+    the carry protocol (``init_carry`` wraps a microbatch activation into
+    the carry pytree; ``carry_x`` extracts the activation leaf).
 
-    Families supported: llama, gptneox — both lay parameters out as
-    {embed, layers(stacked), final_norm(+final_norm_b), lm_head}."""
+    Families supported: llama, gptneox (carry = the activation array) and
+    mixtral (carry = (x, aux_sum, dropped_sum) — the router load-balance
+    terms accumulate across stages and enter the loss at the head)."""
     from nexus_tpu.ops.losses import chunked_softmax_xent, dense_softmax_xent
     from nexus_tpu.ops.rope import rope_cos_sin
 
+    init_carry = lambda x: x
+    carry_x = lambda c: c
+    extra_loss = None  # carry → additive loss term (mixtral router aux)
+    carry_metrics = None  # carry → dict of scalar metrics at the head
     if family == "llama":
         from nexus_tpu.models.llama import _block
         from nexus_tpu.ops.norms import rms_norm
@@ -182,9 +196,40 @@ def _trunk_parts(family: str, params: Dict[str, Any], cfg, seq_len: int):
             return layer_norm(
                 y, head["final_norm"], head["final_norm_b"], cfg.norm_eps
             )
+    elif family == "mixtral":
+        from nexus_tpu.models.mixtral import _block
+        from nexus_tpu.ops.norms import rms_norm
+
+        cos, sin = rope_cos_sin(
+            seq_len, cfg.head_dim, cfg.rope_theta, dtype=jnp.float32
+        )
+        block = lambda c, layer: _block(cfg, c, layer, cos, sin)
+        head_keys = ("final_norm", "lm_head")
+        init_carry = lambda x: (
+            x, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)
+        )
+        carry_x = lambda c: c[0]
+
+        def final_norm(head, y):
+            return rms_norm(y, head["final_norm"], cfg.norm_eps)
+
+        def extra_loss(carry):
+            # layer-mean router aux, matching the non-pipelined loss_fn
+            _, aux, _ = carry
+            return cfg.router_aux_weight * aux / cfg.n_layers
+
+        def carry_metrics(carry):
+            # the observability scalars the non-pipelined loss_fn reports
+            # (models/mixtral.py: aux dashboards, capacity tuning signal)
+            _, aux, dropped = carry
+            return {
+                "aux": aux / cfg.n_layers,
+                "router_dropped_fraction": dropped / cfg.n_layers,
+            }
     else:
         raise ValueError(
-            f"pipeline parallelism supports llama/gptneox (got {family!r})"
+            "pipeline parallelism supports llama/gptneox/mixtral "
+            f"(got {family!r})"
         )
 
     if getattr(cfg, "remat", False):
@@ -196,24 +241,32 @@ def _trunk_parts(family: str, params: Dict[str, Any], cfg, seq_len: int):
 
         block = checkpoint_block(block, getattr(cfg, "remat_policy", "full"))
 
-    def stage_fn(layers_local, h):
-        def body(h, layer):
-            return block(h, layer), None
+    def stage_fn(layers_local, carry):
+        def body(c, layer):
+            return block(c, layer), None
 
-        h, _ = lax.scan(body, h, layers_local)
-        return h
+        carry, _ = lax.scan(body, carry, layers_local)
+        return carry
 
-    def head_loss(head, hidden, targets):
-        """Final norm + LM head + CE. ``head`` needs only the head_keys
-        entries, so the full params tree is also accepted."""
-        y = final_norm(head, hidden)
+    def head_loss(head, carry, targets):
+        """Final norm + LM head + CE (+ family extras, e.g. router aux).
+        ``head`` needs only the head_keys entries, so the full params tree
+        is also accepted."""
+        y = final_norm(head, carry_x(carry))
         if getattr(cfg, "ce_chunk", 0) > 0:
-            return chunked_softmax_xent(
+            loss = chunked_softmax_xent(
                 y, head["lm_head"], targets, chunk=cfg.ce_chunk
             )
-        return dense_softmax_xent(y, head["lm_head"], targets)
+        else:
+            loss = dense_softmax_xent(y, head["lm_head"], targets)
+        if extra_loss is not None:
+            loss = loss + extra_loss(carry)
+        return loss
 
-    return stage_fn, head_loss, final_norm, head_keys
+    return (
+        stage_fn, head_loss, final_norm, head_keys, init_carry, carry_x,
+        carry_metrics,
+    )
 
 
 def _check_pipeline_shapes(b, n_microbatches, cfg, mesh):
@@ -237,6 +290,12 @@ def _pipeline_trunk(
 ):
     """GPipe trunk WITHOUT the final norm: tokens (B, S) → (B, S, d), plus
     the family parts so callers reuse the one norm/CE dispatch."""
+    if family == "mixtral":
+        raise ValueError(
+            "mixtral pipeline parallelism requires the 1f1b schedule "
+            "(the GPipe body carries a single activation array; the MoE "
+            "carry also threads router aux terms)"
+        )
     b, s = tokens.shape
     _check_pipeline_shapes(b, n_microbatches, cfg, mesh)
     parts = _trunk_parts(family, params, cfg, s)
@@ -268,7 +327,7 @@ def pipeline_hidden(
 
     Embedding and the LM head are replicated (cheap vs the layer stack);
     the (B, S) batch is split into M microbatches along batch."""
-    y, (_stage, _loss, final_norm, _keys) = _pipeline_trunk(
+    y, (_stage, _loss, final_norm, *_rest) = _pipeline_trunk(
         family, params, cfg, tokens, mesh, n_microbatches
     )
     return final_norm(params, y)
@@ -292,7 +351,7 @@ def pipeline_loss(
     the 1F1B schedule uses."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    y, (_stage, head_loss, _norm, _keys) = _pipeline_trunk(
+    y, (_stage, head_loss, *_rest) = _pipeline_trunk(
         family, params, cfg, inputs, mesh, n_microbatches
     )
     loss = head_loss(params, y, targets)
@@ -317,7 +376,7 @@ def llama_pipeline_loss(params, cfg, batch, mesh, n_microbatches):
 
 
 def _1f1b_body(
-    stage_fn, head_loss, axis, n_mb, data_axes,
+    stage_fn, head_loss, carry_metrics, axis, n_mb, data_axes,
     local_layers, head, x_mb, tgt_mb,
 ):
     """Per-device 1F1B schedule (manual forward + backward).
@@ -337,9 +396,12 @@ def _1f1b_body(
     PipeDream-flush (non-interleaved 1F1B) dependency structure, in
     M + 2S - 2 total ticks.
 
-    Returns ``(loss, d_layers, d_head, dx_mb)``; shared-param grads are
-    already pmean'd over the data axes (global-batch mean semantics,
-    matching what autodiff produces for the non-pipelined loss)."""
+    Returns ``(loss, metrics_dict, d_layers, d_head, dx_mb)``;
+    shared-param grads are already pmean'd over the data axes
+    (global-batch mean semantics, matching what autodiff produces for the
+    non-pipelined loss). ``metrics_dict`` holds the family's
+    ``carry_metrics`` scalars (mixtral router aux/dropped), microbatch-
+    averaged at the last stage — empty for families without extras."""
     n_stages = lax.axis_size(axis)
     stage = lax.axis_index(axis)
     is_last = stage == n_stages - 1
@@ -349,105 +411,151 @@ def _1f1b_body(
 
     f32 = jnp.float32
 
-    def g(layers, head_p, h_in, tgt):
+    tmap = jax.tree_util.tree_map
+
+    def g(layers, head_p, c_in, tgt):
         """Unified per-microbatch stage computation: trunk slice + (last
         stage only, via lax.cond — other stages skip the FLOPs at run
         time) the LM-head loss. One vjp of this covers both the inner
         stages (cotangent = next stage's dh) and the last stage
-        (cotangent = d loss)."""
-        h_out = stage_fn(layers, h_in)
+        (cotangent = d loss). ``c_in`` is the family's carry pytree (a
+        bare activation array for the dense families; (x, aux, dropped)
+        for mixtral)."""
+        c_out = stage_fn(layers, c_in)
         loss = lax.cond(
             is_last,
-            lambda hp, h: head_loss(hp, h, tgt).astype(f32),
-            lambda hp, h: jnp.zeros((), f32),
-            head_p, h_out,
+            lambda hp, c: head_loss(hp, c, tgt).astype(f32),
+            lambda hp, c: jnp.zeros((), f32),
+            head_p, c_out,
         )
-        return h_out, loss
+        return c_out, loss
 
-    zero_act = jnp.zeros_like(x_mb[0])
+    # x_mb is the CARRY TREE with a leading microbatch dim on every leaf
+    zero_act = tmap(lambda l: jnp.zeros(l.shape[1:], l.dtype), x_mb)
+    metrics0 = (
+        tmap(lambda v: jnp.zeros((), f32), carry_metrics(zero_act))
+        if carry_metrics is not None
+        else {}
+    )
     carry0 = (
-        zero_act,                                     # fwd_buf: h from s-1
-        zero_act,                                     # bwd_buf: dh from s+1
-        jnp.zeros((n_slots,) + x_mb.shape[1:], x_mb.dtype),  # saved ring
-        jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, f32), local_layers),
-        jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, f32), head),
+        zero_act,                                     # fwd_buf: c from s-1
+        zero_act,                                     # bwd_buf: dc from s+1
+        tmap(lambda l: jnp.zeros((n_slots,) + l.shape[1:], l.dtype), x_mb),
+        tmap(lambda p: jnp.zeros(p.shape, f32), local_layers),
+        tmap(lambda p: jnp.zeros(p.shape, f32), head),
         # dx_mb: input-dtype, written once per slot (no accumulation), only
         # stage 0's copy is ever read (out_specs stage-stack + [0] outside)
-        jnp.zeros(x_mb.shape, x_mb.dtype),
+        tmap(jnp.zeros_like, x_mb),
         jnp.zeros((), f32),                           # loss accumulator
+        metrics0,                                     # family extras acc
     )
     perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
     perm_bwd = [(i, (i - 1) % n_stages) for i in range(n_stages)]
 
+    def _index(tree, idx):
+        return tmap(
+            lambda l: lax.dynamic_index_in_dim(l, idx, 0, keepdims=False),
+            tree,
+        )
+
     def tick(carry, t):
-        fwd_buf, bwd_buf, saved, g_layers, g_head, dx_mb, loss_acc = carry
+        (
+            fwd_buf, bwd_buf, saved, g_layers, g_head, dx_mb, loss_acc,
+            m_acc,
+        ) = carry
 
         # ---------------------------------------------------- fwd phase
         fwd_m = t - stage
         fwd_live = jnp.logical_and(fwd_m >= 0, fwd_m < m)
-        inject = lax.dynamic_index_in_dim(
-            x_mb, jnp.clip(fwd_m, 0, m - 1), axis=0, keepdims=False
+        inject = _index(x_mb, jnp.clip(fwd_m, 0, m - 1))
+        c_in = tmap(
+            lambda i, fb: jnp.where(
+                fwd_live, jnp.where(stage == 0, i, fb), jnp.zeros_like(i)
+            ),
+            inject, fwd_buf,
         )
-        h_in = jnp.where(stage == 0, inject, fwd_buf)
-        h_in = jnp.where(fwd_live, h_in, jnp.zeros_like(h_in))
         slot_f = jnp.mod(jnp.clip(fwd_m, 0, None), n_slots)
-        cur_slot = lax.dynamic_index_in_dim(
-            saved, slot_f, axis=0, keepdims=False
-        )
-        saved = lax.dynamic_update_index_in_dim(
-            saved, jnp.where(fwd_live, h_in, cur_slot), slot_f, axis=0
-        )
-        h_out = stage_fn(local_layers, h_in)
-        fwd_buf = lax.ppermute(h_out, axis, perm_fwd)
+
+        def save_slot(s_leaf, c_leaf):
+            cur = lax.dynamic_index_in_dim(
+                s_leaf, slot_f, axis=0, keepdims=False
+            )
+            return lax.dynamic_update_index_in_dim(
+                s_leaf, jnp.where(fwd_live, c_leaf, cur), slot_f, axis=0
+            )
+
+        saved = tmap(save_slot, saved, c_in)
+        c_out = stage_fn(local_layers, c_in)
+        fwd_buf = tmap(lambda y: lax.ppermute(y, axis, perm_fwd), c_out)
 
         # ---------------------------------------------------- bwd phase
         bwd_m = t - (2 * n_stages - 2 - stage)
         bwd_live = jnp.logical_and(bwd_m >= 0, bwd_m < m)
         slot_b = jnp.mod(jnp.clip(bwd_m, 0, None), n_slots)
-        h_saved = lax.dynamic_index_in_dim(
-            saved, slot_b, axis=0, keepdims=False
-        )
+        c_saved = _index(saved, slot_b)
         tgt = lax.dynamic_index_in_dim(
             tgt_mb, jnp.clip(bwd_m, 0, m - 1), axis=0, keepdims=False
         )
-        (h_re, loss_mb), vjp_fn = jax.vjp(
-            lambda L, H, h: g(L, H, h, tgt), local_layers, head, h_saved
+        (c_re, loss_mb), vjp_fn = jax.vjp(
+            lambda L, H, c: g(L, H, c, tgt), local_layers, head, c_saved
         )
-        dh_out = jnp.where(is_last, jnp.zeros_like(bwd_buf), bwd_buf)
+        dc_out = tmap(
+            lambda bb, rr: jnp.where(
+                is_last, jnp.zeros_like(bb), bb
+            ).astype(rr.dtype),
+            bwd_buf, c_re,
+        )
         # each microbatch contributes loss/M; the cotangent carries the 1/M
         dloss = jnp.where(
             jnp.logical_and(is_last, bwd_live), f32(1.0 / m), f32(0.0)
         )
-        d_layers, d_head, dh_in = vjp_fn((dh_out.astype(h_re.dtype), dloss))
+        d_layers, d_head, dc_in = vjp_fn((dc_out, dloss))
 
         mask = bwd_live
-        g_layers = jax.tree_util.tree_map(
+        g_layers = tmap(
             lambda acc, d: acc + jnp.where(mask, d.astype(f32), 0.0),
             g_layers, d_layers,
         )
-        g_head = jax.tree_util.tree_map(
+        g_head = tmap(
             lambda acc, d: acc + jnp.where(mask, d.astype(f32), 0.0),
             g_head, d_head,
         )
         loss_acc = loss_acc + jnp.where(mask, loss_mb / m, 0.0)
+        if carry_metrics is not None:
+            # family extras (mixtral router aux/dropped): meaningful
+            # only from the LAST stage's fully-accumulated carry
+            vals = carry_metrics(c_re)
+            gate = jnp.logical_and(is_last, mask)
+            m_acc = jax.tree_util.tree_map(
+                lambda a, v: a + jnp.where(gate, v.astype(jnp.float32) / m, 0.0),
+                m_acc, vals,
+            )
         # stage 0's input gradient is d(embedding output) — record it
-        dx_cur = lax.dynamic_index_in_dim(
-            dx_mb, jnp.clip(bwd_m, 0, m - 1), axis=0, keepdims=False
-        )
         record_dx = jnp.logical_and(stage == 0, mask)
-        dx_mb = lax.dynamic_update_index_in_dim(
-            dx_mb,
-            jnp.where(record_dx, dh_in.astype(dx_mb.dtype), dx_cur),
-            jnp.clip(bwd_m, 0, m - 1), axis=0,
+
+        def record_slot(dx_leaf, d_leaf):
+            cur = lax.dynamic_index_in_dim(
+                dx_leaf, jnp.clip(bwd_m, 0, m - 1), axis=0, keepdims=False
+            )
+            return lax.dynamic_update_index_in_dim(
+                dx_leaf,
+                jnp.where(record_dx, d_leaf.astype(dx_leaf.dtype), cur),
+                jnp.clip(bwd_m, 0, m - 1), axis=0,
+            )
+
+        dx_mb = tmap(record_slot, dx_mb, dc_in)
+        bwd_buf = tmap(
+            lambda d, bb: lax.ppermute(d.astype(bb.dtype), axis, perm_bwd),
+            dc_in, bwd_buf,
         )
-        bwd_buf = lax.ppermute(dh_in.astype(bwd_buf.dtype), axis, perm_bwd)
 
         return (
-            fwd_buf, bwd_buf, saved, g_layers, g_head, dx_mb, loss_acc
+            fwd_buf, bwd_buf, saved, g_layers, g_head, dx_mb, loss_acc,
+            m_acc,
         ), None
 
     carry, _ = lax.scan(tick, carry0, jnp.arange(n_ticks))
-    _, _, _, g_layers, g_head, dx_mb, loss_acc = carry
+    _, _, _, g_layers, g_head, dx_mb, loss_acc, m_acc = carry
 
     # stage-varying scalars/params collapse over 'pipeline' (exactly one
     # stage holds nonzero values); shared-param grads and the loss then
@@ -456,15 +564,13 @@ def _1f1b_body(
     # caller reads stage 0's shard lazily (a full-batch-activation psum of
     # which S-1 contributions are zeros would be pure waste).
     loss = lax.psum(loss_acc, axis)
-    g_head = jax.tree_util.tree_map(lambda gv: lax.psum(gv, axis), g_head)
+    m_acc = tmap(lambda v: lax.psum(v, axis), m_acc)
+    g_head = tmap(lambda gv: lax.psum(gv, axis), g_head)
     if data_axes:
         loss = lax.pmean(loss, data_axes)
-        g_head = jax.tree_util.tree_map(
-            lambda gv: lax.pmean(gv, data_axes), g_head
-        )
-        g_layers = jax.tree_util.tree_map(
-            lambda gv: lax.pmean(gv, data_axes), g_layers
-        )
+        m_acc = tmap(lambda v: lax.pmean(v, data_axes), m_acc)
+        g_head = tmap(lambda gv: lax.pmean(gv, data_axes), g_head)
+        g_layers = tmap(lambda gv: lax.pmean(gv, data_axes), g_layers)
         # dx is PER-SHARD (it feeds this shard's embedding-lookup rows); the
         # global loss carries a 1/n factor the local vjp didn't see — but
         # ONLY over the axes the batch is actually sharded on (data, fsdp).
@@ -475,8 +581,8 @@ def _1f1b_body(
         for ax in ("data", "fsdp"):
             if ax in data_axes:
                 n_batch_shards *= lax.axis_size(ax)
-        dx_mb = dx_mb / n_batch_shards
-    return loss, g_layers, g_head, dx_mb[None]
+        dx_mb = tmap(lambda l: l / n_batch_shards, dx_mb)
+    return loss, m_acc, g_layers, g_head, tmap(lambda l: l[None], dx_mb)
 
 
 def pipeline_1f1b_loss_and_grads(
@@ -501,11 +607,18 @@ def pipeline_1f1b_loss_and_grads(
     b, s = inputs.shape
     _check_pipeline_shapes(b, n_microbatches, cfg, mesh)
     m = n_microbatches
-    stage_fn, head_loss, _norm, head_keys = _trunk_parts(family, params, cfg, s)
+    (
+        stage_fn, head_loss, _norm, head_keys, init_carry, carry_x,
+        carry_metrics,
+    ) = _trunk_parts(family, params, cfg, s)
 
     embed = params["embed"]
     x = embed.astype(cfg.dtype)[inputs]
     x_mb = x.reshape(m, b // m, s, cfg.d_model)
+    # the carry tree with a leading microbatch dim on every leaf (vmap of
+    # the family's per-microbatch carry constructor — dense families: the
+    # activation itself; mixtral: (x, 0-aux, 0-dropped))
+    carry_mb = jax.vmap(init_carry)(x_mb)
     tgt_mb = targets.reshape(m, b // m, s)
     head = {k: params[k] for k in head_keys}
 
@@ -518,29 +631,51 @@ def pipeline_1f1b_loss_and_grads(
     )
     head_spec = jax.tree_util.tree_map(lambda _: P(), head)
     x_spec = P(None, ("data", "fsdp"))
+    # batch-sharded spec for activation-shaped leaves; per-microbatch
+    # scalar leaves (mixtral aux terms) are replicated
+    carry_spec = jax.tree_util.tree_map(
+        lambda l: x_spec if l.ndim > 1 else P(None), carry_mb
+    )
 
     # dx comes back with a leading per-stage dim (P('pipeline')); reading
     # [0] pulls only stage 0's shard — the one that holds the real values —
     # with no collective
-    dx_spec = P("pipeline", None, ("data", "fsdp"))
+    dx_spec = jax.tree_util.tree_map(
+        lambda l: P("pipeline", None, ("data", "fsdp"))
+        if l.ndim > 1
+        else P("pipeline", None),
+        carry_mb,
+    )
     from nexus_tpu.parallel.sharding import shard_map_unchecked_kwargs
 
+    # metrics dict structure must be known for out_specs: probe it
+    zero_c = jax.tree_util.tree_map(
+        lambda l: jnp.zeros(l.shape[1:], l.dtype), carry_mb
+    )
+    metrics_spec = (
+        jax.tree_util.tree_map(lambda _: P(), carry_metrics(zero_c))
+        if carry_metrics is not None
+        else {}
+    )
     kwargs = dict(
         mesh=mesh,
-        in_specs=(layer_spec, head_spec, x_spec, x_spec),
-        out_specs=(P(), layer_spec, head_spec, dx_spec),
+        in_specs=(layer_spec, head_spec, carry_spec, x_spec),
+        out_specs=(P(), metrics_spec, layer_spec, head_spec, dx_spec),
         **shard_map_unchecked_kwargs(),
     )
     body = functools.partial(
-        _1f1b_body, stage_fn, head_loss, "pipeline", m, data_axes
+        _1f1b_body, stage_fn, head_loss, carry_metrics, "pipeline", m,
+        data_axes,
     )
-    loss, g_layers, g_head, dx_staged = shard_map(body, **kwargs)(
-        params["layers"], head, x_mb, tgt_mb
-    )
+    loss, extra_metrics, g_layers, g_head, dx_staged = shard_map(
+        body, **kwargs
+    )(params["layers"], head, carry_mb, tgt_mb)
 
     # embedding gradient: scatter the input gradients back onto the rows
-    # the lookup read (plain SPMD — XLA shards/combines the scatter)
-    dx = dx_staged[0].reshape(b, s, cfg.d_model)
+    # the lookup read (plain SPMD — XLA shards/combines the scatter).
+    # Only the activation leaf of the carry cotangent feeds the embedding;
+    # the mixtral aux leaves' cotangents are w.r.t. CONSTANT zero inits.
+    dx = carry_x(dx_staged)[0].reshape(b, s, cfg.d_model)
     d_embed = (
         jnp.zeros(embed.shape, jnp.float32)
         .at[inputs]
@@ -548,5 +683,5 @@ def pipeline_1f1b_loss_and_grads(
     )
 
     grads = {"embed": d_embed, "layers": g_layers, **g_head}
-    metrics = {"loss": loss, "perplexity": jnp.exp(loss)}
+    metrics = {"loss": loss, "perplexity": jnp.exp(loss), **extra_metrics}
     return loss, metrics, grads

@@ -126,15 +126,19 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel=None):
         # 1F1B by default (stage-bounded activation memory), GPipe as the
         # autodiff-scheduled fallback (parallel/pipeline.py).
         from nexus_tpu.parallel.pipeline import (
+            PIPELINE_FAMILIES,
             pipeline_1f1b_loss_and_grads,
             pipeline_loss,
         )
         from nexus_tpu.parallel.sharding import DEFAULT_LOGICAL_RULES
 
-        if runtime.model.family not in ("llama", "gptneox"):
+        schedule = runtime.parallelism.pipeline_schedule
+        pp_families = PIPELINE_FAMILIES[schedule]
+        if runtime.model.family not in pp_families:
             raise ValueError(
-                f"pipeline parallelism supports the llama and gptneox "
-                f"families (got {runtime.model.family!r})"
+                f"pipeline parallelism ({schedule}) supports the "
+                f"{'/'.join(pp_families)} families "
+                f"(got {runtime.model.family!r})"
             )
         if tr.gradient_accumulation > 1:
             raise ValueError(

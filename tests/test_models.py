@@ -585,3 +585,23 @@ def test_vector_length_cache_matches_scalar():
             np.array(l_vec[i]), np.array(li[0]), rtol=5e-3, atol=5e-3,
             err_msg=f"row {i} depth {d}",
         )
+
+
+def test_speculative_generate_batched_cross_family():
+    """Batched speculation with a gptneox draft: the vector-length decode
+    path must be correct for the partial-rotary family too."""
+    from nexus_tpu.models.decoding import speculative_generate
+
+    t_cfg = tiny_llama()
+    d_cfg = tiny_neox()
+    target = llama.init(jax.random.PRNGKey(0), t_cfg)
+    draft = gptneox.init(jax.random.PRNGKey(9), d_cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (3, 5), 0,
+                                t_cfg.vocab_size)
+    ref = llama.generate(target, t_cfg, prompt, max_new_tokens=8)
+    out, _ = speculative_generate(
+        llama.forward_decode, target, t_cfg,
+        gptneox.forward_decode, draft, d_cfg,
+        prompt, max_new_tokens=8, num_speculative=3,
+    )
+    np.testing.assert_array_equal(np.array(out), np.array(ref))

@@ -236,7 +236,7 @@ def test_run_template_runtime_bench_candidate_path():
 
 
 def test_run_template_runtime_pipeline_rejects_unsupported():
-    with pytest.raises(ValueError, match="llama and gptneox"):
+    with pytest.raises(ValueError, match="pipeline parallelism"):
         run_template_runtime(
             runtime_block(
                 model=ModelRef(family="mlp", preset="tiny"),
