@@ -31,7 +31,7 @@ import ssl
 import tempfile
 import threading
 import urllib.parse
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Dict, Iterator, List, Optional
 
 logger = logging.getLogger("nexus_tpu.cluster.kubeapi")
 
@@ -426,6 +426,14 @@ class KubeApiClient:
             conns = list(self._watch_conns)
             self._watch_conns.clear()
         for conn in conns:
+            # shutdown() BEFORE close(): closing an fd does not wake a
+            # thread blocked in recv() on it (and the fd number can even be
+            # reused); SHUT_RDWR forces the blocked read to return
+            try:
+                if conn.sock is not None:
+                    conn.sock.shutdown(socket.SHUT_RDWR)
+            except Exception:
+                pass
             try:
                 conn.close()
             except Exception:

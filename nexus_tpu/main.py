@@ -77,6 +77,14 @@ def main(argv: Optional[list] = None, cancel: Optional[CancelToken] = None) -> i
     cancel.wait()
     logger.info("shutting down")
     controller.stop()
+    # close the cluster backends the bootstrap created: real-Kubernetes
+    # stores run watch threads that must be cancelled + joined, or an
+    # embedding process (the in-process e2e, a notebook) keeps orphaned
+    # reflector threads retrying against servers that may be gone
+    for store in [controller.store] + [s.store for s in controller.shards]:
+        close = getattr(store, "close", None)
+        if close is not None:
+            close()
     return 0
 
 
