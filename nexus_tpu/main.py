@@ -72,19 +72,25 @@ def main(argv: Optional[list] = None, cancel: Optional[CancelToken] = None) -> i
     with_statsd("nexus-tpu", config.statsd_address or None)
 
     controller = build_controller(config)
-    controller.run(workers=config.workers)
-    logger.info("controller running; waiting for shutdown signal")
-    cancel.wait()
-    logger.info("shutting down")
-    controller.stop()
-    # close the cluster backends the bootstrap created: real-Kubernetes
-    # stores run watch threads that must be cancelled + joined, or an
-    # embedding process (the in-process e2e, a notebook) keeps orphaned
-    # reflector threads retrying against servers that may be gone
-    for store in [controller.store] + [s.store for s in controller.shards]:
-        close = getattr(store, "close", None)
-        if close is not None:
-            close()
+    try:
+        controller.run(workers=config.workers)
+        logger.info("controller running; waiting for shutdown signal")
+        cancel.wait()
+        logger.info("shutting down")
+        controller.stop()
+    finally:
+        # close the cluster backends the bootstrap created — ALSO on the
+        # failure paths (a cache-sync error raised out of run() has
+        # already started watch threads): real-Kubernetes stores run
+        # reflector threads that must be cancelled + joined, or an
+        # embedding process (the in-process e2e, a notebook) keeps
+        # orphaned threads retrying against servers that may be gone
+        for store in [controller.store] + [
+            s.store for s in controller.shards
+        ]:
+            close = getattr(store, "close", None)
+            if close is not None:
+                close()
     return 0
 
 

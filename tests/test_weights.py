@@ -393,3 +393,42 @@ def test_weights_spec_now_validates_all_lm_families():
         assert not any(
             "no safetensors converter" in e for e in rt.validate()
         ), family
+
+
+def test_build_corpus_roundtrip(tmp_path):
+    """tools/build_corpus.py: text -> tokenizer.json BPE -> binary corpus
+    that token_file_batches (and thus the native reader) consumes, and
+    decoding the corpus recovers the text."""
+    from nexus_tpu.train.data import TOKEN_DTYPES, token_file_batches
+    from nexus_tpu.utils.tokenizer import load_tokenizer
+    from tools.build_corpus import build_corpus
+
+    tok_path = _build_tokenizer_json(str(tmp_path / "tokenizer.json"))
+    docs = [
+        "the quick brown fox jumps over the lazy dog",
+        "hello world, hello tokens",
+    ]
+    for i, d in enumerate(docs):
+        (tmp_path / f"doc{i}.txt").write_text(d)
+    out = str(tmp_path / "corpus.bin")
+    total = build_corpus(
+        [str(tmp_path / f"doc{i}.txt") for i in range(len(docs))],
+        tok_path, out, dtype="uint16",
+    )
+    assert total > 0
+    raw = np.fromfile(out, dtype=TOKEN_DTYPES["uint16"])
+    assert len(raw) == total
+    tok = load_tokenizer(tok_path)
+    assert tok.decode([int(t) for t in raw]) == "".join(docs)
+
+    # the training reader consumes it (seq_len+1 windows)
+    batch = next(token_file_batches(out, batch_size=2, seq_len=8,
+                                    dtype="uint16"))
+    assert batch["tokens"].shape == (2, 9)
+
+    # dtype overflow is caught loudly, not wrapped silently
+    with pytest.raises(ValueError, match="exceeds dtype"):
+        build_corpus(
+            [str(tmp_path / "doc0.txt")], tok_path,
+            str(tmp_path / "c2.bin"), dtype="uint16", separator_id=70000,
+        )

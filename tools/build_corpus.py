@@ -1,0 +1,90 @@
+"""Build a flat binary token corpus from text files.
+
+Connects the tokenizer (utils/tokenizer.py — the same tokenizer.json an
+inference template uses) to the training data plane: the output is the
+headerless little-endian token file `train/data.py::token_file_batches`
+and the native C++ reader (native/src/nexus_data.cpp) mmap directly.
+
+    python tools/build_corpus.py --tokenizer tokenizer.json \
+        --out corpus.bin --dtype uint16 input1.txt input2.txt ...
+
+Documents separated by ``--separator-id`` (default: none). dtype uint16
+halves corpus disk/IO for vocabularies < 65536 (not Llama-3's 128k —
+use int32 there; the builder validates ids fit).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from nexus_tpu.train.data import TOKEN_DTYPES  # noqa: E402
+from nexus_tpu.utils.tokenizer import load_tokenizer  # noqa: E402
+
+
+def build_corpus(
+    inputs,
+    tokenizer_path: str,
+    out_path: str,
+    dtype: str = "int32",
+    separator_id: int = -1,
+    engine: str = "auto",
+) -> int:
+    """Tokenize ``inputs`` (paths or file objects) into ``out_path``.
+    Returns the total token count. Streams file-by-file — the whole corpus
+    is never resident."""
+    if dtype not in TOKEN_DTYPES:
+        raise ValueError(
+            f"dtype {dtype!r} not in {sorted(TOKEN_DTYPES)}"
+        )
+    np_dtype = TOKEN_DTYPES[dtype]
+    limit = np.iinfo(np_dtype).max
+    tok = load_tokenizer(tokenizer_path, engine=engine)
+    total = 0
+    with open(out_path, "wb") as out:
+        for src in inputs:
+            if hasattr(src, "read"):
+                text = src.read()
+            else:
+                with open(src, encoding="utf-8") as f:
+                    text = f.read()
+            ids = tok.encode(text)
+            if separator_id >= 0:
+                ids = ids + [separator_id]
+            if ids and max(ids) > limit:
+                raise ValueError(
+                    f"token id {max(ids)} exceeds dtype {dtype} "
+                    f"(max {limit}); use a wider dtype"
+                )
+            np.asarray(ids, dtype=np_dtype).tofile(out)
+            total += len(ids)
+    return total
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("inputs", nargs="+", help="text files to tokenize")
+    p.add_argument("--tokenizer", required=True, help="tokenizer.json path")
+    p.add_argument("--out", required=True, help="output corpus path")
+    p.add_argument("--dtype", default="int32",
+                   choices=sorted(TOKEN_DTYPES))
+    p.add_argument("--separator-id", type=int, default=-1,
+                   help="token id appended after each document (-1 = none)")
+    p.add_argument("--engine", default="auto",
+                   choices=("auto", "rust", "pure"))
+    args = p.parse_args()
+    total = build_corpus(
+        args.inputs, args.tokenizer, args.out, dtype=args.dtype,
+        separator_id=args.separator_id, engine=args.engine,
+    )
+    print(f"wrote {total} tokens ({args.dtype}) to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
