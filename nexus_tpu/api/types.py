@@ -343,3 +343,54 @@ class ConfigMap(APIObject):
             metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
             data=dict(d.get("data") or {}),
         )
+
+
+@dataclass
+class Lease(APIObject):
+    """coordination.k8s.io/v1 Lease — the leader-election primitive.
+
+    BEYOND the reference: it runs strictly one replica ("NCC only supports
+    single replica for now", reference .helm/templates/deployment.yaml:15-19)
+    because it has no election; this type + controller/leaderelect.py lift
+    that limitation. Timestamps are RFC3339 strings (microsecond precision,
+    MicroTime in the real API)."""
+
+    KIND = "Lease"
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder_identity: str = ""
+    lease_duration_seconds: int = 15
+    acquire_time: str = ""
+    renew_time: str = ""
+    lease_transitions: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        spec: Dict[str, Any] = {
+            "holderIdentity": self.holder_identity,
+            "leaseDurationSeconds": self.lease_duration_seconds,
+            "leaseTransitions": self.lease_transitions,
+        }
+        if self.acquire_time:
+            spec["acquireTime"] = self.acquire_time
+        if self.renew_time:
+            spec["renewTime"] = self.renew_time
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": self.KIND,
+            "metadata": self.metadata.to_dict(),
+            "spec": spec,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Lease":
+        spec = d.get("spec") or {}
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            holder_identity=spec.get("holderIdentity", "") or "",
+            lease_duration_seconds=int(
+                spec.get("leaseDurationSeconds", 15) or 15
+            ),
+            acquire_time=spec.get("acquireTime", "") or "",
+            renew_time=spec.get("renewTime", "") or "",
+            lease_transitions=int(spec.get("leaseTransitions", 0) or 0),
+        )

@@ -23,11 +23,23 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from nexus_tpu.api.template import NexusAlgorithmTemplate
-from nexus_tpu.api.types import GROUP, VERSION, APIObject, ConfigMap, Secret
+from nexus_tpu.api.types import (
+    GROUP,
+    VERSION,
+    APIObject,
+    ConfigMap,
+    Lease,
+    Secret,
+)
 from nexus_tpu.api.workgroup import NexusAlgorithmWorkgroup
 from nexus_tpu.api.workload import Job, Service
 from nexus_tpu.cluster.kubeapi import ApiError, KubeApiClient, KubeConfig
-from nexus_tpu.cluster.store import Action, NotFoundError, WatchEvent
+from nexus_tpu.cluster.store import (
+    Action,
+    ConflictError,
+    NotFoundError,
+    WatchEvent,
+)
 
 logger = logging.getLogger("nexus_tpu.cluster.kube")
 
@@ -40,6 +52,7 @@ _TYPES = {
     ConfigMap.KIND: ConfigMap,
     Service.KIND: Service,
     Job.KIND: Job,
+    Lease.KIND: Lease,
     NexusAlgorithmTemplate.KIND: NexusAlgorithmTemplate,
     NexusAlgorithmWorkgroup.KIND: NexusAlgorithmWorkgroup,
 }
@@ -75,6 +88,10 @@ class KubeClusterStore:
             return f"/api/v1/namespaces/{namespace}/{_CORE_PLURALS[kind]}"
         if kind == Job.KIND:
             return f"/apis/batch/v1/namespaces/{namespace}/jobs"
+        if kind == Lease.KIND:
+            return (
+                f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases"
+            )
         if kind in _CRD_PLURALS:
             return (
                 f"/apis/{GROUP}/{VERSION}/namespaces/{namespace}/"
@@ -93,11 +110,19 @@ class KubeClusterStore:
     def create(self, obj: APIObject, field_manager: str = "") -> APIObject:
         kind = obj.KIND
         params = {"fieldManager": field_manager} if field_manager else None
-        out = self.api.post(
-            self._collection_path(kind, obj.metadata.namespace),
-            obj.to_dict(),
-            params=params,
-        )
+        try:
+            out = self.api.post(
+                self._collection_path(kind, obj.metadata.namespace),
+                obj.to_dict(),
+                params=params,
+            )
+        except ApiError as e:
+            if e.status == 409:
+                # AlreadyExists — the optimistic-concurrency signal leader
+                # election (and any other create-race consumer) keys on;
+                # the in-memory store raises the same type
+                raise ConflictError(str(e)) from e
+            raise
         return self._from_wire(kind, out)
 
     def get(self, kind: str, namespace: str, name: str) -> APIObject:
@@ -127,6 +152,8 @@ class KubeClusterStore:
         except ApiError as e:
             if e.status == 404:
                 raise NotFoundError(kind, meta.namespace, meta.name) from e
+            if e.status == 409:  # stale resourceVersion
+                raise ConflictError(str(e)) from e
             raise
         return self._from_wire(kind, out)
 
@@ -145,6 +172,8 @@ class KubeClusterStore:
         except ApiError as e:
             if e.status == 404:
                 raise NotFoundError(kind, meta.namespace, meta.name) from e
+            if e.status == 409:  # stale resourceVersion
+                raise ConflictError(str(e)) from e
             raise
         return self._from_wire(kind, out)
 

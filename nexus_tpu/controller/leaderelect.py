@@ -1,0 +1,268 @@
+"""Lease-based leader election — the client-go leaderelection equivalent.
+
+BEYOND the reference: it pins itself to one replica with a Recreate
+strategy because it has no election ("NCC only supports single replica for
+now", reference .helm/templates/deployment.yaml:15-19). This module lifts
+that: N controller replicas race for a coordination.k8s.io/v1 Lease; only
+the holder runs the reconcile loop, and a standby takes over within one
+lease duration of the leader dying.
+
+The algorithm is the standard one (client-go
+tools/leaderelection/leaderelection.go semantics, re-implemented — not
+translated — against this repo's ClusterStore surface):
+
+  * try to CREATE the lease naming yourself holder (409 → someone holds);
+  * the holder RENEWs every ``renew_period`` by updating ``renewTime``;
+  * a non-holder watches ``renewTime``: once ``lease_duration`` passes
+    with no renewal, it UPDATEs the lease to itself (leaseTransitions+1);
+  * every write is optimistic-concurrency guarded — the store raises
+    ConflictError on a stale resourceVersion, so two standbys racing for
+    an expired lease cannot both win;
+  * a holder that cannot renew within ``lease_duration`` (e.g. API server
+    partition) must assume it lost the lease and stop leading — the
+    fencing rule that prevents two concurrent reconcilers.
+
+Clock note: expiry is judged from each observer's LOCAL observation time
+of a renewTime CHANGE (the client-go approach) — wall-clock skew between
+replicas does not matter because nobody compares their clock to the
+timestamp in the lease, only to how long ago they last SAW it move.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import threading
+import uuid
+from typing import Callable, Optional
+
+from nexus_tpu.api.types import Lease, ObjectMeta
+from nexus_tpu.cluster.store import ConflictError, NotFoundError
+
+logger = logging.getLogger("nexus_tpu.leaderelect")
+
+
+def _now_str() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="microseconds"
+    )
+
+
+class LeaderElector:
+    """Campaigns for a Lease; drives on_started/on_stopped callbacks.
+
+    ``store``: any ClusterStore-compatible backend (in-memory or the real
+    Kubernetes adapter — the Lease kind is served by both).
+    """
+
+    def __init__(
+        self,
+        store,
+        lease_name: str,
+        namespace: str,
+        identity: str = "",
+        lease_duration: float = 15.0,
+        renew_period: float = 5.0,
+        retry_period: float = 2.0,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ):
+        if renew_period >= lease_duration:
+            raise ValueError(
+                f"renewPeriod {renew_period} must be < leaseDuration "
+                f"{lease_duration} (a healthy leader must renew well "
+                "before expiry)"
+            )
+        self.store = store
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.identity = identity or f"nexus-{uuid.uuid4().hex[:8]}"
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self.retry_period = retry_period
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._leading = False
+        self._leading_lock = threading.Lock()
+        # local observation of the other holder's liveness: identity and
+        # WHEN WE SAW its renewTime last change (monotonic clock)
+        self._observed_renew: str = ""
+        self._observed_at: float = 0.0
+
+    # ---------------------------------------------------------------- state
+    def is_leading(self) -> bool:
+        with self._leading_lock:
+            return self._leading
+
+    def _set_leading(self, leading: bool) -> None:
+        with self._leading_lock:
+            was, self._leading = self._leading, leading
+        if leading and not was:
+            logger.info("became leader: %s (%s)", self.lease_name,
+                        self.identity)
+            if self.on_started_leading is not None:
+                # OWN THREAD (client-go runs OnStartedLeading in its own
+                # goroutine for the same reason): controller startup can
+                # block longer than the lease duration (cache sync), and a
+                # renewal stall there would hand the lease to a standby
+                # while this replica eventually starts reconciling — the
+                # split-brain the election exists to prevent
+                threading.Thread(
+                    target=self._run_callback,
+                    args=(self.on_started_leading, "on_started_leading"),
+                    daemon=True,
+                    name=f"leader-started-{self.identity}",
+                ).start()
+        elif was and not leading:
+            logger.warning("lost leadership: %s (%s)", self.lease_name,
+                           self.identity)
+            if self.on_stopped_leading is not None:
+                # synchronous ON PURPOSE: stop() must not release the lease
+                # until the deposed reconciler has actually stopped
+                self._run_callback(
+                    self.on_stopped_leading, "on_stopped_leading"
+                )
+
+    @staticmethod
+    def _run_callback(cb, label: str) -> None:
+        try:
+            cb()
+        except Exception:  # noqa: BLE001 — a dead callback must not kill
+            # the campaign thread silently; the embedder's callback should
+            # do its own fatal handling (main.py cancels the process)
+            logger.exception("leader-election %s callback raised", label)
+
+    # ------------------------------------------------------------- campaign
+    def _try_acquire_or_renew(self) -> bool:
+        """One campaign step; returns True iff we hold the lease now."""
+        import time
+
+        try:
+            lease = self.store.get(Lease.KIND, self.namespace, self.lease_name)
+        except NotFoundError:
+            fresh = Lease(
+                metadata=ObjectMeta(
+                    name=self.lease_name, namespace=self.namespace
+                ),
+                holder_identity=self.identity,
+                lease_duration_seconds=int(self.lease_duration),
+                acquire_time=_now_str(),
+                renew_time=_now_str(),
+                lease_transitions=0,
+            )
+            try:
+                self.store.create(fresh, field_manager=self.identity)
+                return True
+            except ConflictError:
+                return False  # lost the create race; retry next tick
+
+        if lease.holder_identity == self.identity:
+            # we hold it: renew
+            lease.renew_time = _now_str()
+            try:
+                self.store.update(lease, field_manager=self.identity)
+                return True
+            except (ConflictError, NotFoundError):
+                # someone moved it under us → we no longer hold it
+                return False
+
+        if not lease.holder_identity:
+            # released lease (graceful leader shutdown): claim immediately
+            lease.holder_identity = self.identity
+            lease.acquire_time = _now_str()
+            lease.renew_time = _now_str()
+            lease.lease_transitions += 1
+            try:
+                self.store.update(lease, field_manager=self.identity)
+                return True
+            except (ConflictError, NotFoundError):
+                return False
+
+        # someone else holds it: expired from OUR observation clock?
+        if lease.renew_time != self._observed_renew:
+            self._observed_renew = lease.renew_time
+            self._observed_at = time.monotonic()
+            return False  # saw a fresh renewal; holder is alive
+        held_for = time.monotonic() - self._observed_at
+        duration = float(
+            lease.lease_duration_seconds or self.lease_duration
+        )
+        if self._observed_at == 0.0 or held_for < duration:
+            return False  # not yet expired (or first observation)
+        # expired: take over (optimistic concurrency arbitrates races)
+        lease.holder_identity = self.identity
+        lease.acquire_time = _now_str()
+        lease.renew_time = _now_str()
+        lease.lease_transitions += 1
+        try:
+            self.store.update(lease, field_manager=self.identity)
+            logger.info(
+                "took over expired lease %s (transitions=%d)",
+                self.lease_name, lease.lease_transitions,
+            )
+            return True
+        except (ConflictError, NotFoundError):
+            return False  # another standby won; observe its renewals
+
+    def _run(self) -> None:
+        import time
+
+        last_renewed = 0.0
+        while not self._stop.is_set():
+            got = False
+            try:
+                got = self._try_acquire_or_renew()
+            except Exception:  # noqa: BLE001 — API unavailability != crash
+                logger.exception("leader-election step failed; retrying")
+            now = time.monotonic()
+            if got:
+                last_renewed = now
+                self._set_leading(True)
+            elif self.is_leading():
+                # FENCE: we could not renew; tolerate transient failures
+                # only until the lease would have expired for observers
+                if now - last_renewed >= self.lease_duration:
+                    self._set_leading(False)
+            self._stop.wait(
+                self.renew_period if got or self.is_leading()
+                else self.retry_period
+            )
+
+    # ------------------------------------------------------------ lifecycle
+    def run(self) -> "LeaderElector":
+        """Start campaigning in a background thread."""
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"leader-elect-{self.lease_name}-{self.identity}",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, release: bool = True) -> None:
+        """Stop campaigning; optionally release the lease (zero the holder
+        so a standby takes over immediately instead of after expiry).
+
+        Order matters: the reconciler is stopped (``on_stopped_leading``,
+        synchronous) BEFORE the lease is released — releasing first would
+        let a standby start reconciling while this replica's workers are
+        still draining, the concurrent-writer race the election exists to
+        prevent."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(5.0, self.retry_period * 2))
+        was_leading = self.is_leading()
+        self._set_leading(False)  # runs on_stopped_leading synchronously
+        if release and was_leading:
+            try:
+                lease = self.store.get(
+                    Lease.KIND, self.namespace, self.lease_name
+                )
+                if lease.holder_identity == self.identity:
+                    lease.holder_identity = ""
+                    lease.renew_time = ""
+                    self.store.update(lease, field_manager=self.identity)
+            except Exception:  # noqa: BLE001 — best-effort release
+                logger.warning("could not release lease on stop",
+                               exc_info=True)

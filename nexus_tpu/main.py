@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 from typing import Optional
 
 from nexus_tpu.cluster.store import ClusterStore
@@ -72,12 +73,68 @@ def main(argv: Optional[list] = None, cancel: Optional[CancelToken] = None) -> i
     with_statsd("nexus-tpu", config.statsd_address or None)
 
     controller = build_controller(config)
+    elector = None
     try:
-        controller.run(workers=config.workers)
-        logger.info("controller running; waiting for shutdown signal")
+        if config.leader_election:
+            # HA mode (beyond the reference's single-Recreate-replica
+            # limitation): only the Lease holder runs the reconcile loop;
+            # a standby replica idles here until it wins the lease, and a
+            # deposed leader stops its workers (the fencing rule)
+            import socket as _socket
+
+            from nexus_tpu.controller.leaderelect import LeaderElector
+
+            identity = config.leader_election_identity or (
+                f"{_socket.gethostname()}-{os.getpid()}"
+            )
+
+            def _started_leading():
+                try:
+                    controller.run(workers=config.workers)
+                    logger.info("controller running (leader)")
+                except Exception:
+                    # a leader that cannot start reconciling must EXIT so
+                    # the Deployment replaces it — idling while holding
+                    # the lease would starve the whole fleet
+                    logger.exception(
+                        "controller failed to start after winning the "
+                        "lease; exiting"
+                    )
+                    cancel.cancel()
+
+            def _lost_leadership():
+                # the controller's queue/workers are not restartable after
+                # stop(); the correct HA behavior is to EXIT and let the
+                # Deployment restart the pod as a fresh standby (the same
+                # pattern client-go leader-elected controllers use)
+                controller.stop()
+                cancel.cancel()
+
+            elector = LeaderElector(
+                controller.store,
+                lease_name=config.leader_election_lease_name,
+                namespace=config.controller_namespace,
+                identity=identity,
+                lease_duration=config.leader_election_lease_duration,
+                renew_period=config.leader_election_renew_period,
+                on_started_leading=_started_leading,
+                on_stopped_leading=_lost_leadership,
+            ).run()
+            logger.info(
+                "leader election enabled (lease %s, identity %s); "
+                "campaigning — reconcile starts if this replica wins",
+                config.leader_election_lease_name, identity,
+            )
+        else:
+            controller.run(workers=config.workers)
+            logger.info("controller running")
+        logger.info("waiting for shutdown signal")
         cancel.wait()
         logger.info("shutting down")
-        controller.stop()
+        if elector is not None:
+            elector.stop()  # releases the lease; also stops the controller
+        else:
+            controller.stop()
     finally:
         # close the cluster backends the bootstrap created — ALSO on the
         # failure paths (a cache-sync error raised out of run() has

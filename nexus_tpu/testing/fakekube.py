@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from nexus_tpu.api.template import NexusAlgorithmTemplate
-from nexus_tpu.api.types import GROUP, VERSION, ConfigMap, Secret
+from nexus_tpu.api.types import GROUP, VERSION, ConfigMap, Lease, Secret
 from nexus_tpu.api.workgroup import NexusAlgorithmWorkgroup
 from nexus_tpu.api.workload import Job, Service
 from nexus_tpu.cluster.store import (
@@ -39,6 +39,7 @@ _TYPES = {
     "configmaps": ConfigMap,
     "services": Service,
     "jobs": Job,
+    "leases": Lease,
     "nexusalgorithmtemplates": NexusAlgorithmTemplate,
     "nexusalgorithmworkgroups": NexusAlgorithmWorkgroup,
 }
@@ -48,6 +49,7 @@ _LIST_KINDS = {
     ConfigMap.KIND: "ConfigMapList",
     Service.KIND: "ServiceList",
     Job.KIND: "JobList",
+    Lease.KIND: "LeaseList",
     NexusAlgorithmTemplate.KIND: "NexusAlgorithmTemplateList",
     NexusAlgorithmWorkgroup.KIND: "NexusAlgorithmWorkgroupList",
 }

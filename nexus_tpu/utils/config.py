@@ -47,6 +47,15 @@ class AppConfig:
     datadog_api_key: str = ""
     datadog_site: str = "datadoghq.com"
     datadog_log_endpoint: str = ""
+    # Leader election (BEYOND the reference, which is pinned to a single
+    # Recreate replica): when enabled, N replicas race for a
+    # coordination.k8s.io Lease and only the holder reconciles
+    # (controller/leaderelect.py). identity defaults to hostname+suffix.
+    leader_election: bool = False
+    leader_election_lease_name: str = "nexus-configuration-controller"
+    leader_election_identity: str = ""
+    leader_election_lease_duration: float = 15.0
+    leader_election_renew_period: float = 5.0
 
 
 def _coerce(value: Any, target_type: Any) -> Any:

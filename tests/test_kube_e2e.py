@@ -20,7 +20,7 @@ from nexus_tpu.api.types import Secret
 from nexus_tpu.api.workload import Job
 from nexus_tpu.cluster.kube import KubeClusterStore
 from nexus_tpu.cluster.kubeapi import ApiError, KubeApiClient, KubeConfig
-from nexus_tpu.cluster.store import NotFoundError
+from nexus_tpu.cluster.store import ConflictError, NotFoundError
 from nexus_tpu.controller.controller import Controller
 from nexus_tpu.shards.shard import Shard
 from nexus_tpu.testing.fakekube import FakeKubeApiServer
@@ -80,9 +80,13 @@ def test_kube_client_crud_roundtrip(clusters):
     b.data = {"k": "b"}
     ctrl_store.update(b)
     a.data = {"k": "stale"}
-    with pytest.raises(ApiError) as exc:
+    # the HTTP 409 maps to the SAME ConflictError the in-memory store
+    # raises — backend-uniform optimistic concurrency (leader election and
+    # the controller requeue path both key on it)
+    from nexus_tpu.cluster.store import ConflictError
+
+    with pytest.raises(ConflictError):
         ctrl_store.update(a)
-    assert exc.value.status == 409
 
 
 def test_kube_watch_stream_delivers_events(clusters):
@@ -308,9 +312,7 @@ def test_concurrent_churn_converges_over_kube_stores(clusters):
                         fresh.spec.container.version_tag = f"v{rev}"
                         ctrl_store.update(fresh)
                         break
-                    except ApiError as e:
-                        if e.status != 409:
-                            raise
+                    except ConflictError:
                         time.sleep(0.01)
                 else:
                     raise AssertionError(
@@ -334,9 +336,7 @@ def test_concurrent_churn_converges_over_kube_stores(clusters):
                     s.data = {"rev": str(rev)}
                     ctrl_store.update(s)
                     break
-                except ApiError as e:
-                    if e.status != 409:
-                        raise
+                except ConflictError:
                     time.sleep(0.01)
             else:
                 raise AssertionError(

@@ -1,0 +1,281 @@
+"""Leader election (controller/leaderelect.py) — the HA capability the
+reference explicitly lacks (single Recreate replica, reference
+.helm/templates/deployment.yaml:15-19)."""
+
+import time
+
+import pytest
+
+from nexus_tpu.api.types import Lease
+from nexus_tpu.cluster.store import ClusterStore
+from nexus_tpu.controller.leaderelect import LeaderElector
+
+NS = "nexus"
+
+
+def wait_for(pred, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_elector(store, identity, **kw):
+    kw.setdefault("lease_duration", 1.2)
+    kw.setdefault("renew_period", 0.3)
+    kw.setdefault("retry_period", 0.15)
+    return LeaderElector(
+        store, "ncc-leader", NS, identity=identity, **kw
+    )
+
+
+def test_single_elector_acquires_and_renews():
+    store = ClusterStore("ctrl")
+    started, stopped = [], []
+    e = make_elector(
+        store, "a",
+        on_started_leading=lambda: started.append(1),
+        on_stopped_leading=lambda: stopped.append(1),
+    ).run()
+    try:
+        assert wait_for(e.is_leading)
+        # on_started_leading runs in its own thread (a blocking controller
+        # start must not stall renewals) — wait, don't assert immediately
+        assert wait_for(lambda: started == [1])
+        lease = store.get(Lease.KIND, NS, "ncc-leader")
+        assert lease.holder_identity == "a"
+        first_renew = lease.renew_time
+        assert wait_for(
+            lambda: store.get(Lease.KIND, NS, "ncc-leader").renew_time
+            != first_renew
+        ), "leader never renewed"
+    finally:
+        e.stop()
+    assert stopped == [1]
+    # graceful stop releases the lease
+    assert store.get(Lease.KIND, NS, "ncc-leader").holder_identity == ""
+
+
+def test_exactly_one_of_two_leads():
+    store = ClusterStore("ctrl")
+    a = make_elector(store, "a").run()
+    b = make_elector(store, "b").run()
+    try:
+        assert wait_for(lambda: a.is_leading() or b.is_leading())
+        time.sleep(1.0)  # several renew cycles
+        assert a.is_leading() != b.is_leading(), "split brain"
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_standby_takes_over_after_leader_crash():
+    store = ClusterStore("ctrl")
+    a = make_elector(store, "a").run()
+    assert wait_for(a.is_leading)
+    b = make_elector(store, "b").run()
+    try:
+        time.sleep(0.5)
+        assert not b.is_leading()
+        # CRASH the leader: stop its campaign WITHOUT releasing the lease
+        # (simulates a killed pod — the lease must expire before takeover)
+        a._stop.set()
+        a._thread.join(timeout=5)
+        t0 = time.monotonic()
+        assert wait_for(b.is_leading, timeout=10), "standby never took over"
+        took = time.monotonic() - t0
+        # takeover must wait out the lease (no premature grab)...
+        lease = store.get(Lease.KIND, NS, "ncc-leader")
+        assert lease.holder_identity == "b"
+        assert lease.lease_transitions >= 1
+        # ...but land within ~2x the duration
+        assert took < 2 * a.lease_duration + 2.0
+    finally:
+        b.stop()
+        a._stop.set()
+
+
+def test_graceful_release_hands_over_fast():
+    store = ClusterStore("ctrl")
+    a = make_elector(store, "a").run()
+    assert wait_for(a.is_leading)
+    b = make_elector(store, "b").run()
+    try:
+        time.sleep(0.4)
+        a.stop(release=True)
+        t0 = time.monotonic()
+        assert wait_for(b.is_leading, timeout=5)
+        # released lease is claimed on the next retry tick, well before a
+        # full lease_duration would have expired
+        assert time.monotonic() - t0 < a.lease_duration
+    finally:
+        b.stop()
+
+
+def test_deposed_leader_fences_itself():
+    """A leader whose renewals fail (API partition) must stop leading
+    within one lease duration — the fencing rule that prevents two
+    concurrent reconcilers."""
+    store = ClusterStore("ctrl")
+    stopped = []
+    a = make_elector(
+        store, "a", on_stopped_leading=lambda: stopped.append(1)
+    ).run()
+    assert wait_for(a.is_leading)
+
+    # partition: every store op raises
+    real_get = store.get
+
+    def broken(*args, **kw):
+        raise RuntimeError("api server unreachable")
+
+    store.get = broken
+    try:
+        assert wait_for(
+            lambda: not a.is_leading(), timeout=a.lease_duration + 5
+        ), "leader kept leading through a partition"
+        assert stopped == [1]
+    finally:
+        store.get = real_get
+        a.stop()
+
+
+def test_validates_periods():
+    store = ClusterStore("ctrl")
+    with pytest.raises(ValueError, match="renewPeriod"):
+        LeaderElector(store, "x", NS, lease_duration=1.0, renew_period=2.0)
+
+
+def test_election_over_real_kube_stack(tmp_path):
+    """Two electors through the production HTTP client against a live
+    API server (the Lease kind served over
+    /apis/coordination.k8s.io/v1) — crash the leader, the standby wins."""
+    from nexus_tpu.cluster.kube import KubeClusterStore
+    from nexus_tpu.testing.fakekube import FakeKubeApiServer
+
+    srv = FakeKubeApiServer(name="ctrl").start()
+    cfg = srv.write_kubeconfig(str(tmp_path / "ctrl.kubeconfig"))
+    s1 = KubeClusterStore("ctrl-a", cfg, namespace=NS)
+    s2 = KubeClusterStore("ctrl-b", cfg, namespace=NS)
+    a = make_elector(s1, "pod-a").run()
+    b = make_elector(s2, "pod-b").run()
+    try:
+        assert wait_for(lambda: a.is_leading() or b.is_leading())
+        time.sleep(0.8)
+        assert a.is_leading() != b.is_leading()
+        leader, standby = (a, b) if a.is_leading() else (b, a)
+        leader._stop.set()
+        leader._thread.join(timeout=5)
+        assert wait_for(standby.is_leading, timeout=10)
+    finally:
+        for e in (a, b):
+            e._stop.set()
+        b.stop()
+        a.stop()
+        s1.close()
+        s2.close()
+        srv.stop()
+
+
+def test_main_with_leader_election(tmp_path):
+    """main() with leaderElection: a second instance stays standby; when
+    the leader shuts down it releases the lease and the standby starts
+    reconciling (the full HA handover through the real bootstrap)."""
+    import threading
+
+    from nexus_tpu.api.template import NexusAlgorithmTemplate
+    from nexus_tpu.cluster.kube import KubeClusterStore
+    from nexus_tpu.main import main
+    from nexus_tpu.testing.fakekube import FakeKubeApiServer
+    from nexus_tpu.utils.signals import CancelToken
+    from tests.test_controller_sync import make_template
+
+    ctrl_srv = FakeKubeApiServer(name="controller").start()
+    shard_srv = FakeKubeApiServer(name="shard0").start()
+    shard_dir = tmp_path / "shards"
+    shard_dir.mkdir()
+    ctrl_cfg = ctrl_srv.write_kubeconfig(str(tmp_path / "ctrl.kubeconfig"))
+    shard_srv.write_kubeconfig(str(shard_dir / "shard0.kubeconfig"))
+
+    def appconfig(identity):
+        p = tmp_path / f"appconfig-{identity}.yaml"
+        p.write_text(
+            "alias: ha-e2e\n"
+            f"controllerConfigPath: {ctrl_cfg}\n"
+            f"shardConfigPath: {shard_dir}\n"
+            f"controllerNamespace: {NS}\n"
+            "workers: 2\n"
+            "leaderElection: true\n"
+            f"leaderElectionIdentity: {identity}\n"
+            "leaderElectionLeaseDuration: 1.2\n"
+            "leaderElectionRenewPeriod: 0.3\n"
+        )
+        return str(p)
+
+    observer = KubeClusterStore(
+        "observer", ctrl_srv.write_kubeconfig(str(tmp_path / "obs.kubeconfig")),
+        namespace=NS,
+    )
+    shard_obs = KubeClusterStore(
+        "shard-obs",
+        shard_srv.write_kubeconfig(str(tmp_path / "shard-obs.kubeconfig")),
+        namespace=NS,
+    )
+    cancels = [CancelToken(), CancelToken()]
+    rcs = [None, None]
+    threads = []
+    try:
+        for i, ident in enumerate(("pod-a", "pod-b")):
+            t = threading.Thread(
+                target=lambda i=i, ident=ident: rcs.__setitem__(
+                    i, main(["--config", appconfig(ident)],
+                            cancel=cancels[i])
+                ),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+            time.sleep(0.5)  # deterministic: pod-a campaigns first
+
+        observer.create(make_template("algo-ha"))
+        assert wait_for(
+            lambda: _get_or_none(
+                shard_obs, NexusAlgorithmTemplate.KIND, NS, "algo-ha"
+            )
+            is not None,
+            timeout=20,
+        ), "no leader ever reconciled"
+
+        # shut the leader (pod-a) down; pod-b must take over and keep
+        # reconciling new templates
+        cancels[0].cancel()
+        threads[0].join(timeout=20)
+        assert rcs[0] == 0
+        observer.create(make_template("algo-ha-2"))
+        assert wait_for(
+            lambda: _get_or_none(
+                shard_obs, NexusAlgorithmTemplate.KIND, NS, "algo-ha-2"
+            )
+            is not None,
+            timeout=20,
+        ), "standby never took over reconciliation"
+    finally:
+        for c in cancels:
+            c.cancel()
+        for t in threads:
+            t.join(timeout=15)
+        observer.close()
+        shard_obs.close()
+        ctrl_srv.stop()
+        shard_srv.stop()
+
+
+def _get_or_none(store, kind, ns, name):
+    from nexus_tpu.cluster.store import NotFoundError
+
+    try:
+        return store.get(kind, ns, name)
+    except NotFoundError:
+        return None
