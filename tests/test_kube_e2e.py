@@ -643,3 +643,44 @@ def test_real_cluster_controller_e2e():
         controller.stop()
         ctrl.close()
         shard_store.close()
+
+
+def test_exec_plugin_watch_stream_401_invalidates(tmp_path):
+    """A watch stream opened with a stale exec token gets 401: the client
+    must invalidate the cached credential so the reflector's retry mints a
+    fresh one — watches recover without process restart."""
+    import sys
+
+    count = tmp_path / "plugin-calls"
+    script = tmp_path / "rotating-plugin.py"
+    script.write_text(
+        "import json, os, pathlib\n"
+        f"p = pathlib.Path({str(count)!r})\n"
+        "n = int(p.read_text() or 0) + 1 if p.exists() else 1\n"
+        "p.write_text(str(n))\n"
+        "tok = 'stale' if n == 1 else 'good'\n"
+        "print(json.dumps({'apiVersion': 'client.authentication.k8s.io/v1',"
+        "'kind': 'ExecCredential', 'status': {'token': tok}}))\n"
+    )
+    srv = FakeKubeApiServer(name="w401", required_token="good").start()
+    try:
+        cfg = srv.write_kubeconfig(
+            str(tmp_path / "w401.kubeconfig"),
+            exec_command=[sys.executable, str(script)],
+        )
+        api = KubeApiClient(KubeConfig.load(cfg))
+        # force-mint the stale token (bypasses request()'s retry so the
+        # WATCH is what hits the 401)
+        assert api.config.exec_plugin.token() == "stale"
+        with pytest.raises(ApiError) as e:
+            for _ in api.watch(f"/api/v1/namespaces/{NS}/secrets",
+                               timeout_seconds=3):
+                pass
+        assert e.value.status == 401
+        # the 401 invalidated the cache: the next watch re-execs and works
+        stream = api.watch(f"/api/v1/namespaces/{NS}/secrets",
+                           timeout_seconds=1)
+        assert list(stream) == []  # opened fine; empty namespace times out
+        assert int(count.read_text()) == 2
+    finally:
+        srv.stop()
