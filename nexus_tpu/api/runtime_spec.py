@@ -278,6 +278,13 @@ class InferSpec:
     # literal prompt text; tokenized with model.weights.tokenizer when both
     # are set (otherwise the timing prompt is random ids of promptLength)
     prompt: str = ""
+    # EOS semantics (-1 = decode the full budget). Plain decode freezes a
+    # row once it emits this id (no wasted divergence after EOS); the
+    # speculative loop keeps its own commit structure (no early freeze),
+    # but the reported completion TEXT is trimmed at the first stop token
+    # on both paths — greedy speculative output equals plain greedy, so
+    # the trimmed text is identical either way.
+    stop_token_id: int = -1
     # speculative decoding (models/decoding.py::speculative_generate):
     # a draft model (family/preset/overrides, shared vocab) proposes
     # num_speculative tokens per target forward. Batched (per-row
@@ -299,6 +306,8 @@ class InferSpec:
         }
         if self.prompt:
             d["prompt"] = self.prompt
+        if self.stop_token_id >= 0:
+            d["stopTokenId"] = self.stop_token_id
         if self.draft is not None:
             d["draft"] = self.draft.to_dict()
             d["numSpeculative"] = self.num_speculative
@@ -319,6 +328,9 @@ class InferSpec:
             iterations=int(d.get("iterations", 3) or 3),
             temperature=float(d.get("temperature", 0.0) or 0.0),
             prompt=str(d.get("prompt", "") or ""),
+            stop_token_id=int(
+                -1 if d.get("stopTokenId") is None else d["stopTokenId"]
+            ),
             draft=draft,
             # NOT `or 4`: a present-but-zero value must reach validate()
             num_speculative=int(

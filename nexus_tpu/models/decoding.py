@@ -249,11 +249,18 @@ def autoregressive_generate(
     top_p: float = 1.0,
     key: Optional[jax.Array] = None,
     cache_sharding: Optional[Any] = None,
+    stop_token_id: int = -1,
 ) -> jnp.ndarray:
     """prompt (B, P) → (B, P + max_new_tokens).
 
     Greedy by default; ``temperature > 0`` samples (requires ``key``),
     optionally restricted by top_k / top_p (ops/sampling.py).
+
+    ``stop_token_id >= 0`` enables per-row early stopping: once a row
+    emits the stop token, every later position in that row is forced to
+    the stop token (shapes stay static — the scan still runs
+    ``max_new_tokens`` steps, finished rows just stop CHANGING; callers
+    trim at the first stop token). The standard EOS semantics.
 
     ``cache_sharding``: optional ``jax.sharding.Sharding`` pinned onto the
     K/V cache buffers (e.g. kv-heads over the ``tensor`` mesh axis, batch
@@ -297,15 +304,26 @@ def autoregressive_generate(
 
     logits, cache = forward_decode(params, cfg, prompt, cache)
     next_tok = pick(logits[:, -1], 0)
+    stopping = stop_token_id >= 0
+    done0 = (
+        next_tok == stop_token_id
+        if stopping
+        else jnp.zeros((b,), jnp.bool_)
+    )
 
     def step(carry, step_idx):
-        cache, tok = carry
+        cache, tok, done = carry
         logits, cache = forward_decode(params, cfg, tok[:, None], cache)
         nxt = pick(logits[:, -1], step_idx)
-        return (cache, nxt), nxt
+        if stopping:
+            # finished rows emit the stop token forever (static shapes;
+            # their cache keeps appending but the output is frozen)
+            nxt = jnp.where(done, jnp.asarray(stop_token_id, nxt.dtype), nxt)
+            done = done | (nxt == stop_token_id)
+        return (cache, nxt, done), nxt
 
-    (_, _), toks = lax.scan(
-        step, (cache, next_tok), jnp.arange(1, max_new_tokens)
+    (_, _, _), toks = lax.scan(
+        step, (cache, next_tok, done0), jnp.arange(1, max_new_tokens)
     )
     return jnp.concatenate(
         [prompt, next_tok[:, None], toks.swapaxes(0, 1)], axis=1

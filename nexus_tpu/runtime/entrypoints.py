@@ -573,6 +573,12 @@ def _run_infer(runtime, family, cfg, mesh):
             sampling.update(
                 temperature=inf.temperature, key=jax.random.fold_in(key, 7)
             )
+        if inf.stop_token_id >= 0 and inf.draft is None:
+            # the EOS FREEZE is plain-decode only (the speculative loop
+            # has its own commit structure); the completion-TEXT trim
+            # below applies to both paths — greedy speculative output
+            # equals plain greedy, so the trimmed text is identical
+            sampling["stop_token_id"] = inf.stop_token_id
 
         spec_extra = {}
         if inf.draft is not None:
@@ -652,10 +658,12 @@ def _run_infer(runtime, family, cfg, mesh):
     if tokenizer is not None:
         import numpy as _np
 
-        new_ids = _np.asarray(out)[0, prompt_len:]
+        new_ids = [int(t) for t in _np.asarray(out)[0, prompt_len:]]
+        if inf.stop_token_id >= 0 and inf.stop_token_id in new_ids:
+            new_ids = new_ids[: new_ids.index(inf.stop_token_id)]
         text_extra = {
             "prompt_tokens": prompt_len,
-            "completion": tokenizer.decode([int(t) for t in new_ids]),
+            "completion": tokenizer.decode(new_ids),
         }
     return {
         **spec_extra,

@@ -605,3 +605,33 @@ def test_speculative_generate_batched_cross_family():
         prompt, max_new_tokens=8, num_speculative=3,
     )
     np.testing.assert_array_equal(np.array(out), np.array(ref))
+
+
+def test_generate_stop_token_freezes_rows():
+    """EOS semantics: once a row emits the stop token every later position
+    in that row is the stop token, other rows keep decoding, and the
+    output matches no-stop decode up to each row's first stop."""
+    cfg = tiny_llama()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (3, 5), 0,
+                                cfg.vocab_size)
+    free = llama.generate(params, cfg, prompt, max_new_tokens=12)
+    # pick a token that actually occurs mid-stream in row 0's free decode
+    # (greedy is deterministic, so the stopped run will hit it too)
+    row0_new = [int(t) for t in free[0, 5:]]
+    stop_id = row0_new[3]
+    stopped = llama.generate(params, cfg, prompt, max_new_tokens=12,
+                             stop_token_id=stop_id)
+    s = np.asarray(stopped)
+    f = np.asarray(free)
+    for b in range(3):
+        new = list(f[b, 5:])
+        if stop_id in new:
+            cut = new.index(stop_id)
+            # identical up to and including the first stop...
+            np.testing.assert_array_equal(s[b, 5:5 + cut + 1],
+                                          f[b, 5:5 + cut + 1])
+            # ...then frozen at the stop token
+            assert (s[b, 5 + cut:] == stop_id).all()
+        else:
+            np.testing.assert_array_equal(s[b], f[b])
