@@ -106,6 +106,15 @@ def run_template_runtime(
     return _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel)
 
 
+def _schedule_bubble(schedule: str, n_micro: int, n_stages: int) -> float:
+    """Idle fraction the pipeline schedule imposes (schedule arithmetic,
+    not a measurement): 1F1B runs M + 2S - 2 fwd+bwd ticks for M
+    microbatches of work; GPipe 2*(M + S - 1) half-ticks for 2M halves."""
+    if schedule == "1f1b":
+        return (2 * n_stages - 2) / (n_micro + 2 * n_stages - 2)
+    return (n_stages - 1) / (n_micro + n_stages - 1)
+
+
 def _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel=None):
     tr = runtime.train
     steps = min(tr.steps, max_steps) if max_steps else tr.steps
@@ -169,7 +178,10 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel=None):
                     "stages will idle %d%% of each step; raise batchSize or "
                     "set parallelism.pipelineMicrobatches",
                     n_micro, n_stages, tr.batch_size, dp,
-                    round(100 * (n_stages - 1) / (n_micro + n_stages - 1)),
+                    round(100 * _schedule_bubble(
+                        runtime.parallelism.pipeline_schedule,
+                        n_micro, n_stages,
+                    )),
                 )
         if tr.batch_size % n_micro or (tr.batch_size // n_micro) % dp:
             raise ValueError(
@@ -343,6 +355,15 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel=None):
         "interrupted": result.interrupted,
         "checkpoint_saved": checkpoint_saved,
     }
+    if n_stages > 1:
+        metrics["pipeline_schedule"] = runtime.parallelism.pipeline_schedule
+        metrics["pipeline_microbatches"] = n_micro
+        metrics["pipeline_schedule_bubble_fraction"] = round(
+            _schedule_bubble(
+                runtime.parallelism.pipeline_schedule, n_micro, n_stages
+            ),
+            4,
+        )
     if result.profiled:
         metrics["profile_dir"] = runtime.profile.directory
     elif runtime.profile.enabled and runtime.profile.directory:
