@@ -148,6 +148,18 @@ def _run_decode_bench(preset, progress, *, quantized_kv=False, draft=None,
         overrides["dtype"] = "float32"
     if quantized_kv:
         overrides["kv_cache_quantized"] = True
+    draft_overrides = dict(overrides)
+    if draft:
+        # the rejection-sampling identity requires draft and target to share
+        # a vocabulary; size the draft's up to the target preset's
+        from nexus_tpu.models.llama import PRESETS as _LLAMA_PRESETS
+
+        draft_overrides["vocab_size"] = _LLAMA_PRESETS[preset]["vocab_size"]
+        # ...and the draft's max_seq_len must not clamp the decode length
+        # (the runtime sizes the shared context window off min(target,
+        # draft), so a 512-ctx tiny draft would silently shorten the
+        # speculative leg to 443 new tokens vs the other variants' 512)
+        draft_overrides["max_seq_len"] = _LLAMA_PRESETS[preset]["max_seq_len"]
     label = (
         f"decode preset={preset} int8_kv={quantized_kv} "
         f"draft={draft or '-'} new={max_new}"
@@ -161,7 +173,7 @@ def _run_decode_bench(preset, progress, *, quantized_kv=False, draft=None,
         infer=InferSpec(
             prompt_length=64, max_new_tokens=max_new, iterations=iters,
             draft=ModelRef(family="llama", preset=draft,
-                           overrides=dict(overrides)) if draft else None,
+                           overrides=draft_overrides) if draft else None,
             num_speculative=4,
         ),
     )

@@ -649,13 +649,17 @@ def _run_infer(runtime, family, cfg, mesh):
                 spec_stats.update(stats)  # scalars; last timed run wins
             return res
 
+        from nexus_tpu.utils.hw import sync_host
+
         out = run_once()  # compile + warm
-        jax.block_until_ready(out)
+        sync_host(out)
         times = []
         for _ in range(max(1, inf.iterations)):
             t0 = time.monotonic()
             out = run_once()
-            jax.block_until_ready(out)
+            # close the window with a host fetch: block_until_ready alone
+            # is unreliable on the axon platform (docs/PERF.md)
+            sync_host(out)
             times.append(time.monotonic() - t0)
     new_tokens = tr.batch_size * max_new
     best = min(times)

@@ -239,10 +239,14 @@ class Trainer:
         metrics: Dict[str, Any] = {}
         losses = []
         # warmup (compile) steps excluded from timing
+        from nexus_tpu.utils.hw import sync_host
+
         for _ in range(min(warmup_steps, num_steps)):
             batch = next(self.data_iter)
             self.state, metrics = self.step_fn(self.state, batch)
-        jax.block_until_ready(metrics)
+        # host-fetch-bounded: the warmup tail must not leak into the timed
+        # window (block_until_ready alone is unreliable on axon)
+        sync_host(metrics)
 
         timed_steps = num_steps - min(warmup_steps, num_steps)
         profiling = False
@@ -284,7 +288,7 @@ class Trainer:
             ):
                 jax.block_until_ready(self.state)
                 self.checkpointer.save(self.state)
-        jax.block_until_ready(metrics)
+        sync_host(metrics)  # block_until_ready alone is unreliable on axon
         if profiling:  # window extended past the end of the run
             jax.profiler.stop_trace()
         dt = max(time.monotonic() - t0, 1e-9)

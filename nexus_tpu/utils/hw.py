@@ -62,3 +62,24 @@ def device_kind() -> str:
         return jax.devices()[0].device_kind
     except Exception:
         return "unknown"
+
+
+def sync_host(tree) -> None:
+    """Bound a host-side timing window on the computation producing ``tree``.
+
+    ``jax.block_until_ready`` is NOT a reliable window close on every
+    platform: on the tunneled axon backend it has been observed returning
+    without awaiting the computation (docs/PERF.md round-3 "measurement
+    gotchas" — a seq-8192 flash forward "completed" in 9 µs against a
+    ~71 ms round-trip link). Fetching bytes to the host cannot complete
+    before the computation that produced them, so every timing loop closes
+    with a one-element ``device_get`` of one leaf in addition to the block.
+    """
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    jax.block_until_ready(tree)
+    for leaf in leaves:
+        if hasattr(leaf, "dtype"):
+            jax.device_get(jnp.ravel(leaf)[:1])
+            break
