@@ -104,6 +104,46 @@ def _last_windowed_q_tile(j, *, block_q: int, block_k: int, q_offset: int,
     return jnp.clip(bound, 0, n_q_tiles - 1)
 
 
+def _window_tile_span(block_fixed: int, block_scan: int, window: int) -> int:
+    """Max number of scan-dim tiles a fixed tile's sliding window can touch.
+
+    For k tiles under a q tile (or q tiles over a k tile) the first/last
+    needed indices differ by at most ``floor((block_fixed + window - 2) /
+    block_scan) + 1`` (numerator = newest-row upper bound minus oldest-row
+    window floor), so the span is that + 1 — a STATIC bound, independent of
+    the tile position and q_offset. This is what lets the windowed kernels
+    compact their grid: instead of enumerating every scan tile and
+    `pl.when`-skipping the out-of-window ones (which still costs a grid
+    step and, on the clamped index maps, a DMA fetch — measured at only
+    ~1.2-1.4x instead of the tile-count ratio, docs/PERF.md), the grid's
+    scan dimension shrinks to this span and the kernel offsets the local
+    index by the window's first tile."""
+    return (block_fixed + window - 2) // block_scan + 2
+
+
+def _compact_kv_tile(i, j, *, block_q: int, block_k: int, q_offset: int,
+                     window: int, nk_total: int):
+    """Local→global k-tile index for the COMPACTED windowed grids: offset
+    the grid-local ``j`` by q-tile ``i``'s first in-window tile, elide
+    beyond-diagonal fetches (min with the causal last), and keep the fetch
+    in range when the footprint overhangs the array. Shared by the forward
+    kv index map and the backward dq kernel's k map — the two must agree
+    for DMA elision and the kernels' needed-guards to line up."""
+    return jnp.clip(
+        jnp.minimum(
+            j + _first_windowed_k_tile(
+                i, block_q=block_q, block_k=block_k, q_offset=q_offset,
+                window=window,
+            ),
+            _last_needed_k_tile(
+                i, block_q=block_q, block_k=block_k, q_offset=q_offset
+            ),
+        ),
+        0,
+        nk_total - 1,
+    )
+
+
 def _repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
     """(B, S, Hkv, D) → (B, S, Hkv*n_rep, D) broadcasting each kv head."""
     if n_rep == 1:
@@ -156,13 +196,23 @@ def attention_xla(
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, scale: float, causal: bool, block_q: int, block_k: int, q_offset: int,
-    window: int = 0,
+    window: int = 0, compact_nk: int = 0,
 ):
     i = pl.program_id(1)  # q block
-    j = pl.program_id(2)  # k block
+    jl = pl.program_id(2)  # k block (grid-local; == global unless compacted)
     nk = pl.num_programs(2)
+    # compacted windowed grid (compact_nk = TOTAL k tiles): the scan dim
+    # only spans the window's tile footprint; the global k tile is the
+    # local index offset by the q tile's first in-window tile
+    if compact_nk:
+        j = jl + _first_windowed_k_tile(
+            i, block_q=block_q, block_k=block_k, q_offset=q_offset,
+            window=window,
+        )
+    else:
+        j = jl
 
-    @pl.when(j == 0)
+    @pl.when(jl == 0)
     def _init():
         m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
         l_ref[:] = jnp.zeros_like(l_ref)
@@ -177,6 +227,10 @@ def _flash_kernel(
         i, j, block_q=block_q, block_k=block_k, q_offset=q_offset,
         causal=causal, window=window,
     )
+    if compact_nk:
+        # the offset local index can land past the real tile range (the
+        # window footprint overhangs the diagonal or the array end)
+        needed = needed & (j < compact_nk)
 
     @pl.when(needed)
     def _compute():
@@ -211,7 +265,7 @@ def _flash_kernel(
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    @pl.when(j == nk - 1)
+    @pl.when(jl == nk - 1)
     def _finish():
         # guard against fully-masked rows (padding): l == 0 → output 0
         l = l_ref[:, :1]
@@ -395,6 +449,18 @@ def _flash_impl(q, k, v, opts):
     qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
     kv_row = functools.partial(_kv_row, hq=hq, hkv=hkv, n_rep=n_rep)
 
+    # windowed grid compaction: when the window's static tile footprint is
+    # smaller than the full k range, the grid's scan dim shrinks to it and
+    # every index is offset by the q tile's first in-window tile — skipped
+    # tiles stop costing grid steps and DMA fetches entirely
+    nk_total = sk // block_k
+    nkw = (
+        min(nk_total, _window_tile_span(block_q, block_k, window))
+        if (causal and window > 0)
+        else nk_total
+    )
+    compact = nkw < nk_total
+
     kernel = functools.partial(
         _flash_kernel,
         scale=d ** -0.5,
@@ -403,12 +469,20 @@ def _flash_impl(q, k, v, opts):
         block_k=block_k,
         q_offset=q_offset,
         window=window,
+        compact_nk=nk_total if compact else 0,
     )
 
     # clamp skipped k tiles onto the last needed one: Pallas elides the DMA
     # when the requested block index repeats, so above-diagonal tiles cost
     # neither FLOPs (pl.when in the kernel) nor HBM fetches
-    if causal:
+    if causal and compact:
+        def kv_index(bh, i, j):
+            jc = _compact_kv_tile(
+                i, j, block_q=block_q, block_k=block_k, q_offset=q_offset,
+                window=window, nk_total=nk_total,
+            )
+            return (kv_row(bh), jc, 0)
+    elif causal:
         def kv_index(bh, i, j):
             jc = jnp.minimum(
                 j,
@@ -439,7 +513,7 @@ def _flash_impl(q, k, v, opts):
         def kv_index(bh, i, j):
             return (kv_row(bh), j, 0)
 
-    grid = (b * hq, sq // block_q, sk // block_k)
+    grid = (b * hq, sq // block_q, nkw)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -500,13 +574,20 @@ def _flash_bwd_p(q, k, lse, *, scale, causal, i, j, block_q, block_k,
 
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
-    *, scale, causal, block_q, block_k, q_offset, window=0,
+    *, scale, causal, block_q, block_k, q_offset, window=0, compact_nk=0,
 ):
     i = pl.program_id(1)  # q block (parallel)
-    j = pl.program_id(2)  # k block (sequential accumulation)
+    jl = pl.program_id(2)  # k block (sequential accumulation; grid-local)
     nk = pl.num_programs(2)
+    if compact_nk:  # compacted windowed grid — see _flash_kernel
+        j = jl + _first_windowed_k_tile(
+            i, block_q=block_q, block_k=block_k, q_offset=q_offset,
+            window=window,
+        )
+    else:
+        j = jl
 
-    @pl.when(j == 0)
+    @pl.when(jl == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
@@ -514,6 +595,8 @@ def _flash_bwd_dq_kernel(
         i, j, block_q=block_q, block_k=block_k, q_offset=q_offset,
         causal=causal, window=window,
     )
+    if compact_nk:
+        needed = needed & (j < compact_nk)
 
     @pl.when(needed)
     def _compute():
@@ -533,7 +616,7 @@ def _flash_bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(j == nk - 1)
+    @pl.when(jl == nk - 1)
     def _finish():
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
@@ -542,6 +625,7 @@ def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc_ref, dv_acc_ref,
     *, scale, causal, block_q, block_k, q_offset, n_rep, window=0,
+    compact_nq=0,
 ):
     j = pl.program_id(1)  # k block (parallel, one per KV head row)
     # sequential dim enumerates (q tile, query-head group member): the
@@ -555,7 +639,15 @@ def _flash_bwd_dkv_kernel(
     # causal qi clamp still repeats block indices on skipped tiles and
     # their DMAs stay elided (member-fast ordering would cycle rows and
     # defeat the elision)
-    i = t % (nt // n_rep)  # q tile
+    i = t % (nt // n_rep)  # q tile (grid-local)
+    if compact_nq:
+        # compacted windowed grid (compact_nq = TOTAL q tiles): the local
+        # q-tile index offsets from the k tile's first causally-needed q
+        # tile; the window's upper bound and the array end are enforced by
+        # the needed-guard below
+        i = i + _first_needed_q_tile(
+            j, block_q=block_q, block_k=block_k, q_offset=q_offset
+        )
 
     @pl.when(t == 0)
     def _init():
@@ -567,6 +659,8 @@ def _flash_bwd_dkv_kernel(
         i, j, block_q=block_q, block_k=block_k, q_offset=q_offset,
         causal=causal, window=window,
     )
+    if compact_nq:
+        needed = needed & (i < compact_nq)
 
     @pl.when(needed)
     def _compute():
@@ -634,10 +728,35 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts, g_lse=None):
         block_q=block_q, block_k=block_k, q_offset=q_offset, window=window,
     )
 
+    # windowed grid compaction (mirrors the forward): the dq kernel's k
+    # scan and the dkv kernel's q scan shrink to the window's static tile
+    # footprint when that is smaller than the full range
+    nk_total = sk // block_k
+    nq_total = sq // block_q
+    windowed = causal and window > 0
+    nkw = (
+        min(nk_total, _window_tile_span(block_q, block_k, window))
+        if windowed else nk_total
+    )
+    nqw = (
+        min(nq_total, _window_tile_span(block_k, block_q, window))
+        if windowed else nq_total
+    )
+    compact_k = nkw < nk_total  # dq kernel's scan dim
+    compact_q = nqw < nq_total  # dkv kernel's scan dim
+
     # clamped index maps mirror the forward kernel: skipped tiles repeat the
     # last (dq; k side) / first (dkv; q side) needed block index so their
     # DMAs are elided alongside the pl.when-skipped compute
-    if causal:
+    if causal and compact_k:
+        def kj(i, j):
+            # local j → global (same map as the forward's compacted
+            # kv_index — shared helper keeps fwd/bwd elision in agreement)
+            return _compact_kv_tile(
+                i, j, block_q=block_q, block_k=block_k, q_offset=q_offset,
+                window=window, nk_total=nk_total,
+            )
+    elif causal:
         def kj(i, j):
             jc = jnp.minimum(
                 j,
@@ -659,7 +778,31 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts, g_lse=None):
                     sk // block_k - 1,
                 )
             return jc
+    else:
+        def kj(i, j):
+            return j
 
+    if causal and compact_q:
+        def qi(j, i):
+            # local i → global: offset by the k tile's first causally-
+            # needed q tile, elide post-window fetches (min with the last
+            # in-window q tile), keep the fetch in range
+            return jnp.clip(
+                jnp.minimum(
+                    i + _first_needed_q_tile(
+                        j, block_q=block_q, block_k=block_k,
+                        q_offset=q_offset,
+                    ),
+                    _last_windowed_q_tile(
+                        j, block_q=block_q, block_k=block_k,
+                        q_offset=q_offset, window=window,
+                        n_q_tiles=nq_total,
+                    ),
+                ),
+                0,
+                nq_total - 1,
+            )
+    elif causal:
         def qi(j, i):
             # upper clamp: a k tile past every q row (sk > sq + offset)
             # would otherwise request an out-of-range q block — its compute
@@ -686,9 +829,6 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts, g_lse=None):
                 )
             return ic
     else:
-        def kj(i, j):
-            return j
-
         def qi(j, i):
             return i
 
@@ -699,8 +839,12 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts, g_lse=None):
     row_spec = pl.BlockSpec((1, block_q, LANES), lambda bh, i, j: (bh, i, 0))
 
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, **common),
-        grid=(bh, sq // block_q, sk // block_k),
+        functools.partial(
+            _flash_bwd_dq_kernel,
+            compact_nk=nk_total if compact_k else 0,
+            **common,
+        ),
+        grid=(bh, sq // block_q, nkw),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
         out_shape=_out_struct((bh, sq, d), q.dtype, qf),
@@ -711,25 +855,32 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts, g_lse=None):
     # dk/dv: grid's parallel dims walk (B*Hkv, k blocks); the sequential
     # dim enumerates (q tile × group member) so the whole query-head group
     # accumulates into one kv-shaped scratch (kernel docstring). Index maps
-    # receive (bhk, j, t) with tile-fast ordering: t = member*nq_tiles +
-    # q_tile (q_row constant across each member's tile run — DMA elision).
-    nq_tiles = sq // block_q
+    # receive (bhk, j, t) with tile-fast ordering: t = member*tiles_per_
+    # member + q_tile (q_row constant across each member's tile run — DMA
+    # elision). Under q-side compaction (compact_q) the per-member tile run
+    # is the window footprint nqw, not the full q range.
+    tiles_per_member = nqw if compact_q else nq_total
 
     def q_row(bhk, t):
-        return (bhk // hkv) * hq + (bhk % hkv) * n_rep + t // nq_tiles
+        return (bhk // hkv) * hq + (bhk % hkv) * n_rep + t // tiles_per_member
 
     qT_spec = pl.BlockSpec(
         (1, block_q, d),
-        lambda bhk, j, t: (q_row(bhk, t), qi(j, t % nq_tiles), 0),
+        lambda bhk, j, t: (q_row(bhk, t), qi(j, t % tiles_per_member), 0),
     )
     kT_spec = pl.BlockSpec((1, block_k, d), lambda bhk, j, t: (bhk, j, 0))
     rowT_spec = pl.BlockSpec(
         (1, block_q, LANES),
-        lambda bhk, j, t: (q_row(bhk, t), qi(j, t % nq_tiles), 0),
+        lambda bhk, j, t: (q_row(bhk, t), qi(j, t % tiles_per_member), 0),
     )
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, n_rep=n_rep, **common),
-        grid=(b * hkv, sk // block_k, (sq // block_q) * n_rep),
+        functools.partial(
+            _flash_bwd_dkv_kernel,
+            n_rep=n_rep,
+            compact_nq=nq_total if compact_q else 0,
+            **common,
+        ),
+        grid=(b * hkv, sk // block_k, tiles_per_member * n_rep),
         in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bhk, j, t: (bhk, j, 0)),

@@ -166,11 +166,17 @@ def phase_moe_dispatch():
 
 
 def phase_window_flash():
-    """Sliding-window tile-skipping: fwd + grad at long sequence."""
+    """Sliding-window tile-skipping: fwd + grad at long sequence.
+
+    Numerics gate first: the windowed kernels run their grid COMPACTED
+    (attention.py::_window_tile_span) — interpret-mode tests can't see a
+    real-lowering index bug, so on TPU the phase validates fwd + grads
+    against the XLA reference at a compaction-engaging shape before any
+    timing, and emits the verdict."""
     import jax
     import jax.numpy as jnp
 
-    from nexus_tpu.ops.attention import flash_attention
+    from nexus_tpu.ops.attention import attention_xla, flash_attention
 
     from nexus_tpu.utils.hw import is_tpu
 
@@ -178,6 +184,46 @@ def phase_window_flash():
         b, s, hq, hkv, dh = 1, 8192, 8, 4, 128
         window = 1024
         it_f, it_g = 30, 15
+
+        vq, vk, vv = (
+            jax.random.normal(kk, (1, 2048, 4 if i == 0 else 2, 128),
+                              jnp.bfloat16)
+            for i, kk in enumerate(
+                jax.random.split(jax.random.PRNGKey(7), 3)
+            )
+        )
+
+        def _ref_loss(q_, k_, v_):
+            return (attention_xla(q_, k_, v_, causal=True, window=512)
+                    .astype(jnp.float32) ** 2).sum()
+
+        def _fl_loss(q_, k_, v_):
+            # 256-blocks: 8 k tiles vs a 4-tile window footprint — the
+            # compacted grids are definitely the code path under test
+            return (flash_attention(q_, k_, v_, causal=True, window=512,
+                                    block_q=256, block_k=256,
+                                    interpret=False)
+                    .astype(jnp.float32) ** 2).sum()
+
+        def _close(a_, b_):
+            a32 = jnp.asarray(a_, jnp.float32)
+            b32 = jnp.asarray(b_, jnp.float32)
+            scale = float(jnp.max(jnp.abs(a32))) or 1.0
+            return float(jnp.max(jnp.abs(a32 - b32))) / scale < 2e-2
+
+        ref_o = attention_xla(vq, vk, vv, causal=True, window=512)
+        fl_o = flash_attention(vq, vk, vv, causal=True, window=512,
+                               block_q=256, block_k=256, interpret=False)
+        ref_g = jax.jit(jax.grad(_ref_loss, argnums=(0, 1, 2)))(vq, vk, vv)
+        fl_g = jax.jit(jax.grad(_fl_loss, argnums=(0, 1, 2)))(vq, vk, vv)
+        _sync((ref_g, fl_g))
+        parity = _close(ref_o, fl_o) and all(
+            _close(a_, b_) for a_, b_ in zip(ref_g, fl_g)
+        )
+        _emit({"phase": "window-flash-parity", "on_chip": True,
+               "window": 512, "seq": 2048, "ok": bool(parity)})
+        if not parity:
+            return  # timing a wrong kernel is worse than no number
     else:  # smoke shape: interpret-mode pallas on CPU is minutes-slow
         b, s, hq, hkv, dh = 1, 512, 2, 1, 64
         window = 128
