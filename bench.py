@@ -126,11 +126,12 @@ def _run_candidate(preset, steps, batch, seq, attn, remat, progress,
 
 
 def _run_decode_bench(preset, progress, *, quantized_kv=False, draft=None,
-                      max_new=512, batch=1, iters=2):
+                      prompt_lookup=0, max_new=512, batch=1, iters=2):
     """Timed ≥512-token decode at a fixed shape → metrics dict or None.
 
     Variants: plain greedy, int8 KV cache (``quantized_kv``), speculative
-    with a draft preset (``draft``) — BASELINE config #3's tokens/sec
+    with a draft preset (``draft``), draft-free prompt-lookup speculation
+    (``prompt_lookup`` = n-gram size) — BASELINE config #3's tokens/sec
     metric, tracked per round beside train MFU (VERDICT r2 item 4)."""
     from nexus_tpu.api.runtime_spec import (
         InferSpec,
@@ -162,7 +163,7 @@ def _run_decode_bench(preset, progress, *, quantized_kv=False, draft=None,
         draft_overrides["max_seq_len"] = _LLAMA_PRESETS[preset]["max_seq_len"]
     label = (
         f"decode preset={preset} int8_kv={quantized_kv} "
-        f"draft={draft or '-'} new={max_new}"
+        f"draft={draft or '-'} lookup={prompt_lookup or '-'} new={max_new}"
     )
     runtime = JaxXlaRuntime(
         mode="infer",
@@ -175,6 +176,7 @@ def _run_decode_bench(preset, progress, *, quantized_kv=False, draft=None,
             draft=ModelRef(family="llama", preset=draft,
                            overrides=draft_overrides) if draft else None,
             num_speculative=4,
+            prompt_lookup_ngram=prompt_lookup,
         ),
     )
     progress(f"candidate {label}")
@@ -210,6 +212,15 @@ def _decode_suite(preset, progress):
         # NB random draft weights: acceptance measures mechanism overhead
         # only; with a trained draft the rate (and speedup) rises
         out["speculative_draft"] = "tiny-random"
+    lookup = _run_decode_bench(preset, progress, prompt_lookup=3)
+    if lookup:
+        out["decode_tokens_per_sec_prompt_lookup"] = round(
+            lookup["decode_tokens_per_sec"], 1
+        )
+        # real acceptance even with random weights whenever the greedy
+        # continuation self-repeats (degenerate loops do); with trained
+        # weights this is the draft-free speculation win
+        out["prompt_lookup_acceptance_rate"] = lookup.get("acceptance_rate")
     return out
 
 
@@ -376,17 +387,24 @@ def main() -> int:
         attn = pinned_attn or ("flash" if flash_ok else "xla")
         b = int(pinned_batch) if pinned_batch else 8
         ce = int(pinned_ce) if pinned_ce else 4096
-        # Sweep order: most promising first so a watchdog cut still reports
-        # a strong configuration. v5e-16GB at 400m/seq2048: no-remat fits
-        # only with chunked CE (the f32 logits are the biggest resident
-        # tensor); 'dots' keeps matmul outputs only and is the safe fallback.
+        # Sweep order: measured winner first so a watchdog cut reports the
+        # strong configuration and no tunnel time is spent compiling doomed
+        # candidates ahead of it. Round-3 on-chip sweep (docs/PERF.md):
+        # flash/dots/bs8/dense-CE won at 0.4656 MFU; every remat='none'
+        # variant died in the compile helper (16 GB HBM), and chunked CE
+        # lost ~2.4% while dense logits fit. The none/bs4 probes stay in
+        # the tail — the sweep keeps self-tuning if the attached chip ever
+        # has the HBM for them.
         if pinned_remat:
             candidates = [(attn, pinned_remat, b, ce)]
         else:
+            # a pinned NEXUS_BENCH_CE_CHUNK means "this CE, period" — the
+            # dense-CE candidates honor it (like pinned_batch for batch)
+            ce_main = ce if pinned_ce else 0
             candidates = [
-                (attn, "none", b, ce),   # max FLOP efficiency if it fits
-                (attn, "dots", b, ce),   # cheap-recompute fallback
-                (attn, "dots", b, 0),    # is chunked CE actually winning?
+                (attn, "dots", b, ce_main),  # measured winner (r3: 0.4656)
+                (attn, "dots", b, ce),       # chunked CE A/B at the winner
+                (attn, "none", b, ce),       # max FLOP efficiency if it fits
             ]
             if not pinned_batch:
                 # a pinned batch means "this batch size, period"; only an
@@ -394,8 +412,12 @@ def main() -> int:
                 # no-remat: activation residency halves vs bs8, which is the
                 # config the HBM estimate says fits when bs8 compile-OOMs
                 # (docs/PERF.md)
-                candidates.insert(1, (attn, "none", max(b // 2, 1), ce))
-                candidates.insert(3, (attn, "none", 2 * b, ce))
+                candidates.append((attn, "none", max(b // 2, 1), ce))
+                candidates.append((attn, "dots", 2 * b, ce_main))
+            seen = set()  # pinned ce collapses the winner/AB pair
+            candidates = [
+                c for c in candidates if not (c in seen or seen.add(c))
+            ]
         # cap sweep size: compile time on the tunnel dominates
         candidates = candidates[:5]
 

@@ -296,6 +296,12 @@ class InferSpec:
     # way as the target's; random init when empty — fine for timing runs,
     # useless acceptance in production)
     draft_checkpoint_directory: str = ""
+    # draft-model-FREE speculation (models/decoding.py::
+    # prompt_lookup_generate): > 0 proposes numSpeculative tokens by
+    # copying the continuation of the latest earlier occurrence of the
+    # last N committed tokens. Greedy-exact; mutually exclusive with
+    # ``draft``; requires temperature == 0
+    prompt_lookup_ngram: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -315,6 +321,9 @@ class InferSpec:
                 d["draftCheckpointDirectory"] = (
                     self.draft_checkpoint_directory
                 )
+        if self.prompt_lookup_ngram > 0:
+            d["promptLookupNgram"] = self.prompt_lookup_ngram
+            d["numSpeculative"] = self.num_speculative
         return d
 
     @classmethod
@@ -339,6 +348,7 @@ class InferSpec:
             draft_checkpoint_directory=str(
                 d.get("draftCheckpointDirectory", "") or ""
             ),
+            prompt_lookup_ngram=int(d.get("promptLookupNgram", 0) or 0),
         )
 
 
@@ -553,11 +563,30 @@ class JaxXlaRuntime:
                         f"{t_cfg.vocab_size} (override the draft's "
                         "vocab_size)"
                     )
-            if self.infer.num_speculative < 1:
+        if self.infer.prompt_lookup_ngram > 0 and self.mode == "infer":
+            if self.infer.draft is not None:
                 errs.append(
-                    "infer.numSpeculative must be >= 1, got "
-                    f"{self.infer.num_speculative}"
+                    "infer.promptLookupNgram and infer.draft are mutually "
+                    "exclusive (draft-free vs draft-model speculation)"
                 )
+            if self.infer.temperature > 0:
+                errs.append(
+                    "infer.promptLookupNgram requires temperature == 0: a "
+                    "deterministic copying draft has no proposal "
+                    "distribution, so the rejection-sampling identity "
+                    "does not apply (use a draft model for sampled "
+                    "speculative decoding)"
+                )
+        if (
+            self.mode == "infer"
+            and (self.infer.draft is not None
+                 or self.infer.prompt_lookup_ngram > 0)
+            and self.infer.num_speculative < 1
+        ):
+            errs.append(
+                "infer.numSpeculative must be >= 1, got "
+                f"{self.infer.num_speculative}"
+            )
         return errs
 
     def to_dict(self) -> Dict[str, Any]:

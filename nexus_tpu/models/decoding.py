@@ -330,6 +330,56 @@ def autoregressive_generate(
     )
 
 
+def _greedy_accept(proposals: jnp.ndarray, target_choice: jnp.ndarray):
+    """Greedy speculative acceptance: longest prefix of ``proposals``
+    (B, k) matching the target's own choices (B, k+1); the first mismatch
+    is replaced by the target's choice, and a fully-accepted round
+    appends the bonus token. Returns (accepted (B,), out (B, k+1)) —
+    committed output is EXACTLY the target's greedy decode, row by row.
+    Shared by the draft-model and prompt-lookup speculative loops."""
+    b, k = proposals.shape
+    match = proposals == target_choice[:, :k]
+    accepted = jnp.argmin(
+        jnp.concatenate(
+            [match.astype(jnp.int32), jnp.zeros((b, 1), jnp.int32)],
+            axis=1,
+        ),
+        axis=1,
+    )  # (B,) first False index == number of accepted proposals
+    out = jnp.where(
+        jnp.arange(k + 1)[None, :] < accepted[:, None],
+        # pad to k+1: slot k is never selected (accepted <= k puts the
+        # correction/bonus there), the pad just aligns shapes
+        jnp.concatenate(
+            [proposals, jnp.zeros((b, 1), proposals.dtype)], axis=1
+        ),
+        target_choice,
+    )  # (B, k+1) — position accepted_i holds correction/bonus
+    return accepted, out
+
+
+def _commit_speculation(buf, rows, last_pos, active, accepted, out, k,
+                        max_len, cache_len):
+    """Commit one speculation round into the token buffer + cache pointer,
+    per row: accepted proposals + 1 (correction or bonus) land after each
+    row's ``last_pos``; FROZEN rows commit nothing — their writes are
+    pushed out of range (scatter drop) and their pointers stay put. The
+    returned ``new_len`` keeps K/V through the last ACCEPTED proposal
+    only: the correction token's K/V is NOT in any cache — it is appended
+    when the next round feeds it as its first input. Shared by both
+    speculative loops (the subtle invariants live exactly once)."""
+    b = accepted.shape[0]
+    n_new = jnp.where(active, accepted + 1, 0)  # (B,)
+    write_pos = jnp.where(
+        active[:, None],
+        last_pos[:, None] + 1 + jnp.arange(k + 1)[None, :],
+        max_len + 1,  # dropped by the scatter
+    )
+    buf = buf.at[rows[:, None], write_pos].set(out, mode="drop")
+    new_len = jnp.where(active, last_pos + 1 + accepted, cache_len)
+    return buf, n_new, new_len
+
+
 def speculative_generate(
     target_forward_decode: Callable,
     target_params: Dict[str, Any],
@@ -515,41 +565,15 @@ def speculative_generate(
             target_choice = jnp.argmax(t_logits, axis=-1).astype(
                 buf.dtype
             )  # (B, k+1)
-
-            # 3) accept the longest matching prefix per row; the first
-            #    mismatch is REPLACED by the target's own choice, and a
-            #    fully-accepted round appends the bonus token (still
-            #    exactly the target's greedy decode, row by row)
-            match = proposals == target_choice[:, :k]  # (B, k)
-            accepted = jnp.argmin(
-                jnp.concatenate(
-                    [match.astype(jnp.int32), jnp.zeros((b, 1), jnp.int32)],
-                    axis=1,
-                ),
-                axis=1,
-            )  # (B,) first False index == number of accepted proposals
-            out = jnp.where(
-                jnp.arange(k + 1)[None, :] < accepted[:, None],
-                drafted.swapaxes(0, 1),
-                target_choice,
-            )  # (B, k+1) — position accepted_i holds correction/bonus
-        # committed tokens this round: accepted proposals + 1 (correction
-        # or bonus); FROZEN rows commit nothing — their writes are pushed
-        # out of range (scatter drop) and their pointers stay put
-        n_new = jnp.where(active, accepted + 1, 0)  # (B,)
-        write_pos = jnp.where(
-            active[:, None],
-            last_pos[:, None] + 1 + jnp.arange(k + 1)[None, :],
-            max_len + 1,  # dropped by the scatter
-        )
-        buf = buf.at[rows[:, None], write_pos].set(out, mode="drop")
-        # 4) rollback by pointer, per row: both caches hold K/V up to the
-        #    scored block's end; keep [.., last_tok, accepted proposals].
-        #    The correction token is committed to `buf` but its K/V is NOT
-        #    in either cache — it gets appended when the next round feeds
-        #    it as its first input
-        new_len = jnp.where(
-            active, last_pos + 1 + accepted, t_cache["length"]
+            # 3) longest matching prefix per row, first mismatch replaced
+            #    by the target's choice (_greedy_accept)
+            accepted, out = _greedy_accept(proposals, target_choice)
+        # 4) commit + rollback by pointer (_commit_speculation): both
+        #    caches hold K/V up to the scored block's end; keep
+        #    [.., last_tok, accepted proposals]
+        buf, n_new, new_len = _commit_speculation(
+            buf, rows, last_pos, active, accepted, out, k, max_len,
+            t_cache["length"],
         )
         t_cache = set_len(t_cache_next, new_len)
         d_cache = set_len(d_cache, new_len)
@@ -577,6 +601,181 @@ def speculative_generate(
         "rounds": rounds,
         "drafted": drafted_n,
         "accepted": n_accepted,
+    }
+    return (
+        lax.dynamic_slice_in_dim(buf, 0, p + max_new_tokens, axis=1),
+        stats,
+    )
+
+
+def prompt_lookup_propose(
+    buf: jnp.ndarray,
+    last_pos: jnp.ndarray,
+    k: int,
+    ngram: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Draft-model-free proposals by n-gram lookup in the committed text
+    (prompt-lookup / "assisted generation by copying"): per row, find the
+    LATEST earlier occurrence of the suffix ``ngram`` committed tokens and
+    propose the ``k`` tokens that followed it. O(B·L·ngram) integer
+    compares per round — noise next to a target forward.
+
+    buf: (B, L) token buffer (committed through ``last_pos`` per row; the
+    tail past it may hold stale scratch from overshooting rounds).
+    last_pos: (B,) absolute position of each row's newest committed token.
+
+    Returns (proposals (B, k), found (B,) bool). Rows with no match repeat
+    their last committed token (harmless: the acceptance rule decides).
+    Matches are constrained to END strictly before the suffix's own
+    occurrence (``start + ngram - 1 < last_pos``), which both excludes the
+    trivial self-match and keeps every matched window inside committed
+    text; the proposed continuation may run past ``last_pos`` into scratch,
+    which the acceptance rule also makes safe."""
+    b, max_len = buf.shape
+    npos = max_len - ngram
+    # windows[:, i, g] = buf[:, i + g] — static shifts, no gather
+    windows = jnp.stack(
+        [buf[:, g:g + npos] for g in range(ngram)], axis=-1
+    )  # (B, npos, ngram)
+    gidx = jnp.clip(
+        last_pos[:, None] - (ngram - 1) + jnp.arange(ngram)[None, :],
+        0, max_len - 1,
+    )  # (B, ngram)
+    suffix = jnp.take_along_axis(buf, gidx, axis=1)
+    starts = jnp.arange(npos)[None, :]
+    valid = jnp.all(windows == suffix[:, None, :], axis=-1) & (
+        starts + ngram - 1 < last_pos[:, None]
+    )
+    match = jnp.max(jnp.where(valid, starts, -1), axis=1)  # (B,) or -1
+    found = match >= 0
+    base = jnp.where(found, match + ngram, last_pos)
+    pos = jnp.clip(
+        jnp.where(
+            found[:, None],
+            base[:, None] + jnp.arange(k)[None, :],
+            last_pos[:, None],  # fallback: repeat the last token
+        ),
+        0, max_len - 1,
+    )
+    return jnp.take_along_axis(buf, pos, axis=1), found
+
+
+def prompt_lookup_generate(
+    forward_decode: Callable,
+    params: Dict[str, Any],
+    cfg: Any,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    num_speculative: int = 4,
+    ngram: int = 3,
+    max_len: Optional[int] = None,
+    cache_sharding: Optional[Any] = None,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Speculative decoding WITHOUT a draft model: proposals come from
+    ``prompt_lookup_propose`` (n-gram copying from the committed text), the
+    target scores k+1 positions per forward, and the longest matching
+    prefix commits — output is EXACTLY the target's greedy decode, like
+    ``speculative_generate``, but with zero draft FLOPs and zero draft KV
+    cache. Strong on self-repetitive continuations (code, extraction,
+    summarization-with-quotes); acceptance degrades gracefully to ~0 on
+    novel text, costing only the k extra scored positions per forward.
+
+    Greedy only: a deterministic copying "draft" has no proposal
+    distribution, so the temperature>0 rejection-sampling identity does
+    not apply (use speculative_generate with a real draft for sampled
+    speculative decoding).
+
+    prompt: (B, P); batched with per-row acceptance (vector-length cache
+    pointers), mirroring speculative_generate. Returns
+    ``(tokens (B, P + max_new_tokens), stats)`` with the same stats keys
+    (rounds / drafted / accepted, active rows only) plus ``lookup_hits``
+    (rounds in which a row actually had an n-gram match)."""
+    b, p = prompt.shape
+    k = int(num_speculative)
+    if k < 1:
+        raise ValueError(f"num_speculative must be >= 1, got {k}")
+    if ngram < 1:
+        raise ValueError(f"ngram must be >= 1, got {ngram}")
+    needed = p + max_new_tokens + k + 1  # room for one overshooting round
+    if max_len is None:
+        max_len = needed
+    if max_len < needed or needed > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) + "
+            f"speculation window ({k + 1}) needs {needed} cache slots but "
+            f"max_len={max_len}, max_seq_len={cfg.max_seq_len}"
+        )
+
+    cache = init_kv_cache(
+        cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype, b, max_len,
+        quantized=getattr(cfg, "kv_cache_quantized", False),
+    )
+    if cache_sharding is not None:
+        cache = dict(cache)
+        for key_ in ("k", "v"):
+            cache[key_] = lax.with_sharding_constraint(
+                cache[key_], cache_sharding
+            )
+
+    logits, cache = forward_decode(params, cfg, prompt, cache)
+    first_tok = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+
+    buf = jnp.zeros((b, max_len), prompt.dtype)
+    buf = lax.dynamic_update_slice_in_dim(buf, prompt, 0, axis=1)
+    buf = lax.dynamic_update_slice_in_dim(buf, first_tok[:, None], p, axis=1)
+
+    def set_len(c, n):
+        c = dict(c)
+        c["length"] = n
+        return c
+
+    rows = jnp.arange(b)
+
+    def round_step(state):
+        buf, n_done, rounds, drafted_n, n_accepted, hits, cache = state
+        last_pos = p + n_done - 1  # (B,)
+        active = n_done < max_new_tokens
+
+        proposals, found = prompt_lookup_propose(buf, last_pos, k, ngram)
+        last_tok = buf[rows, last_pos]
+
+        # one target forward over [last_tok, proposals] — identical commit
+        # structure to speculative_generate's greedy branch
+        block = jnp.concatenate([last_tok[:, None], proposals], axis=1)
+        t_logits, cache_next = forward_decode(params, cfg, block, cache)
+        target_choice = jnp.argmax(t_logits, axis=-1).astype(buf.dtype)
+
+        accepted, out = _greedy_accept(proposals, target_choice)
+        buf, n_new, new_len = _commit_speculation(
+            buf, rows, last_pos, active, accepted, out, k, max_len,
+            cache["length"],
+        )
+        n_active = jnp.sum(active.astype(jnp.int32))
+        return (
+            buf, n_done + n_new, rounds + 1,
+            drafted_n + k * n_active,
+            n_accepted + jnp.sum(jnp.where(active, accepted, 0)),
+            hits + jnp.sum((found & active).astype(jnp.int32)),
+            set_len(cache_next, new_len),
+        )
+
+    def cond(state):
+        return jnp.any(state[1] < max_new_tokens)
+
+    zero = jnp.asarray(0, jnp.int32)
+    vec_p = jnp.full((b,), p, jnp.int32)
+    buf, n_done, rounds, drafted_n, n_accepted, hits, _ = lax.while_loop(
+        cond, round_step,
+        (
+            buf, jnp.full((b,), 1, jnp.int32), zero, zero, zero, zero,
+            set_len(cache, vec_p),
+        ),
+    )
+    stats = {
+        "rounds": rounds,
+        "drafted": drafted_n,
+        "accepted": n_accepted,
+        "lookup_hits": hits,
     }
     return (
         lax.dynamic_slice_in_dim(buf, 0, p + max_new_tokens, axis=1),

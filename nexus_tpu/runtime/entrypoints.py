@@ -512,10 +512,12 @@ def _run_infer(runtime, family, cfg, mesh):
         cfg.max_seq_len, draft_cfg.max_seq_len
     )
     prompt_len = min(inf.prompt_length, ctx - 1)
-    # the speculative path needs num_speculative+1 scratch slots past the
-    # last committed token (one overshooting round) — reserve them here so
-    # a cache-filling config doesn't fail only when a draft is attached
-    reserve = (inf.num_speculative + 1) if inf.draft is not None else 0
+    # the speculative paths (draft model OR prompt lookup) need
+    # num_speculative+1 scratch slots past the last committed token (one
+    # overshooting round) — reserve them here so a cache-filling config
+    # doesn't fail only when speculation is enabled
+    speculating = inf.draft is not None or inf.prompt_lookup_ngram > 0
+    reserve = (inf.num_speculative + 1) if speculating else 0
     max_new = min(inf.max_new_tokens, ctx - prompt_len - reserve)
     if max_new <= 0:
         raise ValueError(
@@ -594,15 +596,35 @@ def _run_infer(runtime, family, cfg, mesh):
             sampling.update(
                 temperature=inf.temperature, key=jax.random.fold_in(key, 7)
             )
-        if inf.stop_token_id >= 0 and inf.draft is None:
-            # the EOS FREEZE is plain-decode only (the speculative loop
-            # has its own commit structure); the completion-TEXT trim
-            # below applies to both paths — greedy speculative output
+        if inf.stop_token_id >= 0 and not speculating:
+            # the EOS FREEZE is plain-decode only (the speculative loops
+            # have their own commit structure); the completion-TEXT trim
+            # below applies to all paths — greedy speculative output
             # equals plain greedy, so the trimmed text is identical
             sampling["stop_token_id"] = inf.stop_token_id
 
         spec_extra = {}
-        if inf.draft is not None:
+        if inf.prompt_lookup_ngram > 0:
+            # draft-free speculation: n-gram copying from the committed
+            # text proposes, the target verifies (greedy-exact); no draft
+            # weights, no draft cache
+            from nexus_tpu.models.decoding import prompt_lookup_generate
+
+            spec_extra = {
+                "speculative": True,
+                "speculative_kind": "prompt_lookup",
+                "prompt_lookup_ngram": inf.prompt_lookup_ngram,
+                "num_speculative": inf.num_speculative,
+            }
+
+            def gen(params, cfg, prompt, max_new, **kw):
+                return prompt_lookup_generate(
+                    family.forward_decode, params, cfg, prompt, max_new,
+                    num_speculative=inf.num_speculative,
+                    ngram=inf.prompt_lookup_ngram,
+                    cache_sharding=kw.get("cache_sharding"),
+                )
+        elif inf.draft is not None:
             # speculative decoding: draft weights from its checkpoint (or
             # random init for timing runs). Batched — each row accepts its
             # own prefix length per round (vector-length caches); greedy
@@ -679,6 +701,8 @@ def _run_infer(runtime, family, cfg, mesh):
                 (rounds + 1) / max(max_new, 1), 4
             ),
         )
+        if "lookup_hits" in spec_stats:  # prompt-lookup: rows-with-match
+            spec_extra["lookup_hit_rounds"] = int(spec_stats["lookup_hits"])
     text_extra = {}
     if tokenizer is not None:
         import numpy as _np

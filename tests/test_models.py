@@ -635,3 +635,97 @@ def test_generate_stop_token_freezes_rows():
             assert (s[b, 5 + cut:] == stop_id).all()
         else:
             np.testing.assert_array_equal(s[b], f[b])
+
+
+def test_prompt_lookup_propose_unit():
+    """The n-gram proposer: latest earlier match wins, the match must end
+    inside committed text, and no-match rows fall back to repeating the
+    last committed token."""
+    from nexus_tpu.models.decoding import prompt_lookup_propose
+
+    # row 0: suffix (8 9) ends at last_pos=5; its earlier occurrence
+    #        starts at 1 (ends 2 < 5) → propose buf[3:7] = [7 8 9 0]
+    # row 1: suffix (5 3) ends at last_pos=7; start 6 is the self-match
+    #        (excluded), start 2 is the earlier one → buf[4:8] = [3 4 5 3]
+    # row 2: suffix (1 2) never recurs → fallback repeats buf[last_pos]=2
+    buf = jnp.asarray([
+        [7, 8, 9, 7, 8, 9, 0, 0, 0, 0],
+        [3, 4, 5, 3, 3, 4, 5, 3, 0, 0],
+        [5, 6, 1, 2, 0, 0, 0, 0, 0, 0],
+    ], jnp.int32)
+    last_pos = jnp.asarray([5, 7, 3], jnp.int32)
+    props, found = prompt_lookup_propose(buf, last_pos, k=4, ngram=2)
+    np.testing.assert_array_equal(np.array(found), [True, True, False])
+    np.testing.assert_array_equal(np.array(props[0]), [7, 8, 9, 0])
+    np.testing.assert_array_equal(np.array(props[1]), [3, 4, 5, 3])
+    np.testing.assert_array_equal(np.array(props[2]), [2, 2, 2, 2])
+
+    # the self-match guard: a suffix whose ONLY other occurrence is itself
+    # (start + ngram - 1 == last_pos) must not count
+    buf2 = jnp.asarray([[1, 2, 3, 1, 2, 0, 0, 0]], jnp.int32)
+    _, found2 = prompt_lookup_propose(
+        buf2, jnp.asarray([4], jnp.int32), k=2, ngram=5
+    )
+    assert not bool(found2[0])
+
+
+def test_prompt_lookup_generate_exactly_matches_greedy():
+    """Draft-free prompt-lookup speculation == plain greedy decode, token
+    for token, across speculation widths, n-gram sizes, and batch > 1 (the
+    exactness contract: lookup only changes WHEN tokens commit)."""
+    from nexus_tpu.models.decoding import prompt_lookup_generate
+
+    cfg = tiny_llama()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    for b, p, ngram, k in ((1, 6, 3, 4), (2, 8, 2, 3), (2, 8, 1, 1),
+                           (1, 6, 4, 6)):
+        prompt = jax.random.randint(jax.random.PRNGKey(b * 10 + k),
+                                    (b, p), 0, cfg.vocab_size)
+        ref = llama.generate(params, cfg, prompt, max_new_tokens=10)
+        out, stats = prompt_lookup_generate(
+            llama.forward_decode, params, cfg, prompt,
+            max_new_tokens=10, num_speculative=k, ngram=ngram,
+        )
+        assert int(stats["rounds"]) >= 1
+        assert 0 <= int(stats["accepted"]) <= int(stats["drafted"])
+        np.testing.assert_array_equal(
+            np.array(out), np.array(ref),
+            err_msg=f"b={b} ngram={ngram} k={k}",
+        )
+
+
+def test_prompt_lookup_full_acceptance_on_cyclic_continuation():
+    """When the target's greedy continuation repeats text that already
+    occurred, the lookup proposals are ALL accepted — the win condition of
+    draft-free speculation. Uses a stub 'model' that deterministically
+    predicts (token + 1) mod V, so a cyclic prompt forces a cyclic
+    continuation."""
+    from types import SimpleNamespace
+
+    from nexus_tpu.models.decoding import prompt_lookup_generate
+
+    v = 5
+    cfg = SimpleNamespace(
+        n_layers=1, n_kv_heads=1, head_dim=8, dtype=jnp.float32,
+        max_seq_len=128, vocab_size=v,
+    )
+
+    def cyclic_forward(params, cfg_, tokens, cache):
+        logits = jax.nn.one_hot((tokens + 1) % v, v) * 10.0
+        new = dict(cache)
+        new["length"] = cache["length"] + tokens.shape[1]
+        return logits.astype(jnp.float32), new
+
+    prompt = jnp.asarray([[0, 1, 2, 3, 4, 0, 1]], jnp.int32)
+    max_new, k = 16, 4
+    out, stats = prompt_lookup_generate(
+        cyclic_forward, {}, cfg, prompt,
+        max_new_tokens=max_new, num_speculative=k, ngram=2,
+    )
+    expect = [(2 + i) % v for i in range(max_new)]
+    np.testing.assert_array_equal(np.array(out[0, 7:]), expect)
+    # every proposal matched: acceptance rate 1.0, and the whole decode
+    # took ceil((max_new - 1) / (k + 1)) rounds instead of max_new - 1
+    assert int(stats["accepted"]) == int(stats["drafted"]) > 0
+    assert int(stats["rounds"]) == -(-(max_new - 1) // (k + 1))
+    assert int(stats["lookup_hits"]) == int(stats["rounds"])

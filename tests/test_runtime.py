@@ -155,6 +155,72 @@ def test_run_template_runtime_speculative_infer():
     assert 0.0 < metrics["target_forwards_per_token"] <= 1.0
 
 
+def test_run_template_runtime_prompt_lookup_infer():
+    """infer with promptLookupNgram routes through prompt_lookup_generate
+    (draft-free speculation) and reports the speculative metrics."""
+    from nexus_tpu.api.runtime_spec import InferSpec
+
+    metrics = run_template_runtime(
+        runtime_block(
+            model=ModelRef(family="llama", preset="tiny",
+                           overrides={"dtype": "float32"}),
+            mode="infer",
+            train=TrainSpec(batch_size=2, seq_len=64, steps=1),
+            infer=InferSpec(
+                prompt_length=8, max_new_tokens=12, iterations=1,
+                num_speculative=3, prompt_lookup_ngram=2,
+            ),
+        )
+    )
+    assert metrics["mode"] == "infer"
+    assert metrics["speculative"] is True
+    assert metrics["speculative_kind"] == "prompt_lookup"
+    assert metrics["prompt_lookup_ngram"] == 2
+    assert metrics["decode_tokens_per_sec"] > 0
+    assert metrics["new_tokens"] == 12  # per-row decode budget
+    assert metrics["rounds"] >= 1
+    assert 0.0 <= metrics["acceptance_rate"] <= 1.0
+    assert 0.0 < metrics["target_forwards_per_token"] <= 1.0
+    assert metrics["lookup_hit_rounds"] >= 0
+
+
+def test_prompt_lookup_spec_validation():
+    """promptLookupNgram: mutually exclusive with a draft model, greedy
+    only, and round-trips through the YAML dict form."""
+    from nexus_tpu.api.runtime_spec import InferSpec
+
+    rt = runtime_block(
+        model=ModelRef(family="llama", preset="tiny"),
+        mode="infer",
+        infer=InferSpec(
+            prompt_lookup_ngram=3,
+            draft=ModelRef(family="llama", preset="tiny"),
+        ),
+    )
+    errs = rt.validate()
+    assert any("mutually exclusive" in e for e in errs), errs
+
+    rt = runtime_block(
+        model=ModelRef(family="llama", preset="tiny"),
+        mode="infer",
+        infer=InferSpec(prompt_lookup_ngram=3, temperature=0.7),
+    )
+    errs = rt.validate()
+    assert any("temperature" in e for e in errs), errs
+
+    rt = runtime_block(
+        model=ModelRef(family="llama", preset="tiny"),
+        mode="infer",
+        infer=InferSpec(prompt_lookup_ngram=3, num_speculative=5),
+    )
+    assert rt.validate() == []
+    d = rt.to_dict()
+    assert d["infer"]["promptLookupNgram"] == 3
+    rt2 = type(rt).from_dict(d)
+    assert rt2.infer.prompt_lookup_ngram == 3
+    assert rt2.infer.num_speculative == 5
+
+
 def test_run_template_runtime_gptneox_train():
     """The gptneox family trains through the product runtime path on the
     8-device mesh — same contract as the other LM families."""
