@@ -353,6 +353,53 @@ class InferSpec:
 
 
 @dataclass
+class ServeSpec:
+    """Continuous-batching serving (mode='serve', runtime/serving.py): a
+    request queue decodes through a fixed batch of rows with per-row KV
+    depths; finished rows are refilled between decode chunks. The spec
+    drives a synthetic queue (deterministic from train.seed) so a synced
+    template exercises and times the serving path the way infer.* times
+    single-batch decode; ``train.batch_size`` is the row count."""
+
+    num_requests: int = 32
+    prompt_length_min: int = 16
+    prompt_length_max: int = 128
+    max_new_min: int = 16
+    max_new_max: int = 256
+    # decode steps per dispatch — scheduling granularity vs dispatch
+    # overhead (finished rows waste at most chunk-1 slots)
+    chunk: int = 8
+    stop_token_id: int = -1
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "numRequests": self.num_requests,
+            "promptLengthMin": self.prompt_length_min,
+            "promptLengthMax": self.prompt_length_max,
+            "maxNewMin": self.max_new_min,
+            "maxNewMax": self.max_new_max,
+            "chunk": self.chunk,
+        }
+        if self.stop_token_id >= 0:
+            d["stopTokenId"] = self.stop_token_id
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ServeSpec":
+        return cls(
+            num_requests=int(d.get("numRequests", 32) or 32),
+            prompt_length_min=int(d.get("promptLengthMin", 16) or 16),
+            prompt_length_max=int(d.get("promptLengthMax", 128) or 128),
+            max_new_min=int(d.get("maxNewMin", 16) or 16),
+            max_new_max=int(d.get("maxNewMax", 256) or 256),
+            chunk=int(d.get("chunk", 8) or 8),
+            stop_token_id=int(
+                -1 if d.get("stopTokenId") is None else d["stopTokenId"]
+            ),
+        )
+
+
+@dataclass
 class DataSpec:
     """Training corpus: deterministic synthetic stream (default) or a flat
     binary token file read via memmap with host-disjoint sampling
@@ -462,6 +509,7 @@ class JaxXlaRuntime:
     parallelism: ParallelismSpec = field(default_factory=ParallelismSpec)
     train: TrainSpec = field(default_factory=TrainSpec)
     infer: InferSpec = field(default_factory=InferSpec)
+    serve: ServeSpec = field(default_factory=ServeSpec)
     data: DataSpec = field(default_factory=DataSpec)
     checkpoint: CheckpointSpec = field(default_factory=CheckpointSpec)
     profile: ProfileSpec = field(default_factory=ProfileSpec)
@@ -471,7 +519,7 @@ class JaxXlaRuntime:
         errs: List[str] = []
         if self.kind != "jax_xla":
             errs.append(f"unsupported runtime kind {self.kind!r}")
-        if self.mode not in ("train", "infer"):
+        if self.mode not in ("train", "infer", "serve"):
             errs.append(f"unsupported mode {self.mode!r}")
         total = self.parallelism.total()
         chips = self.tpu.total_chips
@@ -522,6 +570,59 @@ class JaxXlaRuntime:
                     "data.kind='tokens' is for LM families; the mlp family "
                     "trains on its synthetic regression stream"
                 )
+        if self.mode == "serve":
+            sv = self.serve
+            if self.model.family == "mlp":
+                errs.append(
+                    "mode='serve' needs an LM family with a decode path "
+                    "(mlp has none)"
+                )
+            if sv.num_requests < 1:
+                errs.append(
+                    f"serve.numRequests must be >= 1, got {sv.num_requests}"
+                )
+            if not (1 <= sv.prompt_length_min <= sv.prompt_length_max):
+                errs.append(
+                    "serve prompt length range invalid: "
+                    f"[{sv.prompt_length_min}, {sv.prompt_length_max}]"
+                )
+            if not (1 <= sv.max_new_min <= sv.max_new_max):
+                errs.append(
+                    "serve maxNew range invalid: "
+                    f"[{sv.max_new_min}, {sv.max_new_max}]"
+                )
+            if sv.chunk < 1:
+                errs.append(f"serve.chunk must be >= 1, got {sv.chunk}")
+            if self.model.overrides.get("kv_cache_quantized"):
+                errs.append(
+                    "mode='serve' supports the fp KV cache only; unset "
+                    "model.overrides.kv_cache_quantized (the engine's "
+                    "row-insert path has no scale planes)"
+                )
+            if self.model.family != "mlp":
+                # feasibility: the engine budget-trims against
+                # max_seq_len - prompt - chunk - 1; a queue whose LONGEST
+                # prompt leaves no budget aborts mid-run — catch it here
+                try:
+                    from nexus_tpu.models.registry import get_family
+
+                    s_cfg = get_family(self.model.family).config(
+                        self.model.preset, **dict(self.model.overrides)
+                    )
+                except Exception as e:  # config() errors are arbitrary
+                    errs.append(f"model does not resolve: {e!r}")
+                else:
+                    pmax = min(
+                        sv.prompt_length_max, s_cfg.max_seq_len // 2
+                    )  # the runtime clamps prompts the same way
+                    if pmax + sv.chunk + 1 >= s_cfg.max_seq_len:
+                        errs.append(
+                            f"serve shapes don't fit: promptLengthMax "
+                            f"({pmax} after the max_seq_len/2 clamp) + "
+                            f"chunk ({sv.chunk}) + 1 leaves no decode "
+                            f"budget within max_seq_len "
+                            f"{s_cfg.max_seq_len}"
+                        )
         if self.infer.draft is not None and self.mode == "infer":
             from nexus_tpu.models.registry import get_family, list_families
 
@@ -599,6 +700,7 @@ class JaxXlaRuntime:
             "parallelism": self.parallelism.to_dict(),
             "train": self.train.to_dict(),
             "infer": self.infer.to_dict(),
+            "serve": self.serve.to_dict(),
             "data": self.data.to_dict(),
             "checkpoint": self.checkpoint.to_dict(),
             "profile": self.profile.to_dict(),
@@ -617,6 +719,7 @@ class JaxXlaRuntime:
             parallelism=ParallelismSpec.from_dict(d.get("parallelism") or {}),
             train=TrainSpec.from_dict(d.get("train") or {}),
             infer=InferSpec.from_dict(d.get("infer") or {}),
+            serve=ServeSpec.from_dict(d.get("serve") or {}),
             data=DataSpec.from_dict(d.get("data") or {}),
             checkpoint=CheckpointSpec.from_dict(d.get("checkpoint") or {}),
             profile=ProfileSpec.from_dict(d.get("profile") or {}),

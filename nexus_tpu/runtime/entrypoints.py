@@ -103,6 +103,8 @@ def run_template_runtime(
 
     if runtime.mode == "infer":
         return _run_infer(runtime, family, cfg, mesh)
+    if runtime.mode == "serve":
+        return _run_serve(runtime, family, cfg, mesh)
     return _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel)
 
 
@@ -728,5 +730,85 @@ def _run_infer(runtime, family, cfg, mesh):
         "batch_size": tr.batch_size,
         "prompt_len": prompt_len,
         "new_tokens": max_new,
+        "n_devices": mesh.devices.size,
+    }
+
+
+def _run_serve(runtime, family, cfg, mesh):
+    """Continuous-batching serving (mode='serve'): a synthetic request
+    queue — deterministic from train.seed — decodes through
+    runtime/serving.py's fixed-row engine; finished rows are refilled
+    between chunks. Weights load exactly like mode='infer' (checkpoint or
+    safetensors). The headline metrics are aggregate tokens/sec and
+    slot utilization under uneven request lengths — the two numbers
+    static batching sacrifices."""
+    if getattr(family, "forward_decode", None) is None:
+        raise ValueError(
+            f"model family {runtime.model.family!r} does not support "
+            "mode='serve' (no forward_decode incremental path); "
+            "use mode='train'"
+        )
+    import numpy as _np
+
+    from nexus_tpu.runtime.serving import ServeRequest, ServingEngine
+
+    sv = runtime.serve
+    tr = runtime.train
+    pmax = min(sv.prompt_length_max, cfg.max_seq_len // 2)
+    pmin = min(sv.prompt_length_min, pmax)
+    with mesh:
+        params, weights_loaded, restored_step = _load_infer_params(
+            runtime, family, cfg, mesh
+        )
+        rng = _np.random.RandomState(tr.seed)
+        requests = []
+        for _ in range(sv.num_requests):
+            p = int(rng.randint(pmin, pmax + 1))
+            n = int(rng.randint(sv.max_new_min, sv.max_new_max + 1))
+            requests.append(ServeRequest(
+                prompt=rng.randint(
+                    0, cfg.vocab_size, size=p
+                ).astype(_np.int32).tolist(),
+                max_new_tokens=n,
+            ))
+        # serving cache layout mirrors the infer path: kv heads over the
+        # tensor axis, rows over the data axes (replicated when they don't
+        # tile) — without this the 8B example's multi-GB cache replicates
+        # per chip and OOMs a v5e
+        shape = dict(mesh.shape)
+        dp, d_only = shape["data"] * shape["fsdp"], shape["data"]
+        if dp > 1 and tr.batch_size % dp == 0:
+            batch_axes = ("data", "fsdp")
+        elif d_only > 1 and tr.batch_size % d_only == 0:
+            batch_axes = "data"
+        else:
+            batch_axes = None
+        tp = shape["tensor"]
+        kv_axis = "tensor" if tp > 1 and cfg.n_kv_heads % tp == 0 else None
+        cache_sharding = NamedSharding(
+            mesh, P(None, batch_axes, None, kv_axis, None)
+        )
+        engine = ServingEngine(
+            family.forward_decode, params, cfg,
+            batch_size=tr.batch_size,
+            max_len=cfg.max_seq_len,
+            stop_token_id=sv.stop_token_id,
+            chunk=sv.chunk,
+            cache_sharding=cache_sharding,
+        )
+        results, metrics = engine.serve(requests)
+    finished = sum(1 for r in results if r is not None)
+    latencies = sorted(r.latency_s for r in results if r is not None)
+    p50 = latencies[len(latencies) // 2] if latencies else 0.0
+    return {
+        **metrics,
+        "mode": "serve",
+        "family": runtime.model.family,
+        "preset": runtime.model.preset,
+        "weights_loaded": weights_loaded,
+        "restored_step": restored_step,
+        "finished_requests": finished,
+        "request_latency_p50_s": round(p50, 4),
+        "batch_rows": tr.batch_size,
         "n_devices": mesh.devices.size,
     }

@@ -48,4 +48,4 @@ def test_examples_load_validate_and_materialize():
             assert res["limits"]["google.com/tpu"] == str(rt.tpu.chips_per_host)
         svcs = materialize_headless_service(tmpl)
         assert len(svcs) == rt.tpu.slice_count, path
-    assert templates == 7
+    assert templates == 8
