@@ -370,6 +370,10 @@ class ServeSpec:
     # overhead (finished rows waste at most chunk-1 slots)
     chunk: int = 8
     stop_token_id: int = -1
+    # > 0 samples every queued request at this temperature (per-request
+    # seeds = the request index; sampling is batch/scheduling-invariant,
+    # runtime/serving.py); 0 = greedy
+    temperature: float = 0.0
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -380,6 +384,8 @@ class ServeSpec:
             "maxNewMax": self.max_new_max,
             "chunk": self.chunk,
         }
+        if self.temperature > 0:
+            d["temperature"] = self.temperature
         if self.stop_token_id >= 0:
             d["stopTokenId"] = self.stop_token_id
         return d
@@ -396,6 +402,7 @@ class ServeSpec:
             stop_token_id=int(
                 -1 if d.get("stopTokenId") is None else d["stopTokenId"]
             ),
+            temperature=float(d.get("temperature", 0.0) or 0.0),
         )
 
 
@@ -593,6 +600,10 @@ class JaxXlaRuntime:
                 )
             if sv.chunk < 1:
                 errs.append(f"serve.chunk must be >= 1, got {sv.chunk}")
+            if sv.temperature < 0:
+                errs.append(
+                    f"serve.temperature must be >= 0, got {sv.temperature}"
+                )
             if self.model.overrides.get("kv_cache_quantized"):
                 errs.append(
                     "mode='serve' supports the fp KV cache only; unset "

@@ -770,6 +770,8 @@ def _run_serve(runtime, family, cfg, mesh):
                     0, cfg.vocab_size, size=p
                 ).astype(_np.int32).tolist(),
                 max_new_tokens=n,
+                temperature=sv.temperature,
+                seed=len(requests),  # per-request stream, deterministic
             ))
         # serving cache layout mirrors the infer path: kv heads over the
         # tensor axis, rows over the data axes (replicated when they don't

@@ -1,4 +1,4 @@
-"""Continuous-batching greedy serving engine (BASELINE config #3).
+"""Continuous-batching serving engine (BASELINE config #3).
 
 Static-batch decode (``autoregressive_generate``) holds every sequence
 until the LAST one finishes: a batch mixing a 10-token reply with a
@@ -23,9 +23,13 @@ fixed-shape decode batch instead — iteration-level scheduling:
     row idles safely at fixed depth regardless of how long it stays
     empty.
 
-Exactness contract: each request's output is EXACTLY the model's greedy
-decode of that prompt in isolation (tests/test_serving.py proves it
-against ``autoregressive_generate`` row for row) — continuous batching
+Exactness contract: a request's output is a function of the request
+alone — never of its row, its batch co-residents, or the engine's batch
+size. At temperature 0 that is EXACTLY the model's greedy decode of the
+prompt in isolation (tests/test_serving.py proves it against
+``autoregressive_generate`` row for row); at temperature > 0 the
+sampling key is (request seed, buffer position), so the sampled stream
+is reproducible and batch-invariant (also tested). Continuous batching
 changes only WHEN work is scheduled, never what is computed.
 
 TPU-shaped: one compiled decode step for the whole serve loop (static
@@ -53,10 +57,22 @@ PREFILL_BUCKET = 64  # prompt lengths round up to this (compile-count bound)
 
 @dataclass
 class ServeRequest:
-    """One queued generation request."""
+    """One queued generation request.
+
+    ``temperature > 0`` samples instead of argmax. The sampling key for
+    the token at buffer position ``pos`` is
+    ``fold_in(fold_in(engine_base_key, seed), pos)`` — a function of the
+    request alone, NOT of scheduling — so a request's output is
+    identical whatever row it lands in, whoever its batch co-residents
+    are, and whatever the engine's batch size is (the same
+    batch-invariance contract as greedy, tested in test_serving.py).
+    Plain temperature only (top-k/top-p truncation stays on the static
+    path)."""
 
     prompt: Sequence[int]
     max_new_tokens: int = 128
+    temperature: float = 0.0
+    seed: int = 0
 
 
 @dataclass
@@ -89,6 +105,7 @@ class ServingEngine:
         stop_token_id: int = -1,
         chunk: int = 8,
         cache_sharding: Optional[Any] = None,
+        sample_seed: int = 0,
     ):
         if getattr(cfg, "kv_cache_quantized", False):
             raise ValueError(
@@ -109,13 +126,27 @@ class ServingEngine:
         self._chunk = int(chunk)
         self._cache_sharding = cache_sharding
         self._prefill_cache: Dict[int, Callable] = {}
+        self._base_key = jax.random.PRNGKey(int(sample_seed))
 
         cfg_ = cfg
         fwd = forward_decode
         C = self._chunk
+        base_key = self._base_key
 
-        def _decode_chunk(params, cache, tok, done):
-            """C greedy steps in ONE dispatch. ``done`` rows emit their
+        def _pick(logits_row, temp, seed, pos):
+            """Per-row token choice: argmax at temp 0, else a categorical
+            sample keyed by (request seed, absolute buffer position) —
+            scheduling never enters the key, so sampling is
+            batch-invariant."""
+            key = jax.random.fold_in(jax.random.fold_in(base_key, seed), pos)
+            safe_t = jnp.maximum(temp, 1e-6)
+            sampled = jax.random.categorical(key, logits_row / safe_t)
+            return jnp.where(
+                temp > 0.0, sampled, jnp.argmax(logits_row, axis=-1)
+            ).astype(jnp.int32)
+
+        def _decode_chunk(params, cache, tok, done, temp, seed):
+            """C decode steps in ONE dispatch. ``done`` rows emit their
             held token and roll their pointer back each step (the write
             lands on the same slot next step — no growth, no overflow)."""
 
@@ -126,7 +157,11 @@ class ServingEngine:
                 cache2["length"] = jnp.where(
                     done, cache["length"], cache2["length"]
                 )
-                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(tok.dtype)
+                # the sampled token's buffer position is the post-feed
+                # length — the key input that makes sampling positional
+                nxt = jax.vmap(_pick)(
+                    logits[:, -1], temp, seed, cache2["length"]
+                ).astype(tok.dtype)
                 nxt = jnp.where(done, tok, nxt)
                 return (cache2, nxt, done), nxt
 
@@ -135,13 +170,21 @@ class ServingEngine:
             )
             return cache, tok, toks  # toks: (C, B)
 
-        def _insert(cache, row, row_k, row_v, length, tok_vec, first_tok):
+        self._pick = _pick
+
+        def _insert(cache, row, row_k, row_v, length, tok_vec, first_tok,
+                    temp_vec, req_temp, seed_vec, req_seed):
             """Scatter one prefilled request into a freed batch row."""
             cache = dict(cache)
             cache["k"] = cache["k"].at[:, row].set(row_k[:, 0])
             cache["v"] = cache["v"].at[:, row].set(row_v[:, 0])
             cache["length"] = cache["length"].at[row].set(length)
-            return cache, tok_vec.at[row].set(first_tok)
+            return (
+                cache,
+                tok_vec.at[row].set(first_tok),
+                temp_vec.at[row].set(req_temp),
+                seed_vec.at[row].set(req_seed),
+            )
 
         # donate the cache (and the token vector in insert): XLA updates
         # the K/V buffers in place instead of copying the multi-GB cache
@@ -154,7 +197,7 @@ class ServingEngine:
             _decode_chunk, donate_argnums=(1,) if donate else ()
         )
         self._insert_fn = jax.jit(
-            _insert, donate_argnums=(0, 5) if donate else ()
+            _insert, donate_argnums=(0, 5, 7, 9) if donate else ()
         )
 
     def _prefill(self, bucket: int) -> Callable:
@@ -168,8 +211,9 @@ class ServingEngine:
             return self._prefill_cache[bucket]
         cfg_, fwd = self._cfg, self._fwd
         max_len = self._max_len
+        pick = self._pick
 
-        def prefill(params, prompt_padded, real_len):
+        def prefill(params, prompt_padded, real_len, temp, seed):
             # single-row caches replicate; the BATCH cache carries the
             # serving sharding and the insert scatter lands into it
             cache = init_kv_cache(
@@ -180,16 +224,19 @@ class ServingEngine:
             last = jnp.take_along_axis(
                 logits, (real_len - 1)[None, None, None].astype(jnp.int32),
                 axis=1,
-            )[:, 0]  # (1, V)
-            first = jnp.argmax(last, axis=-1)[0].astype(prompt_padded.dtype)
+            )[0, 0]  # (V,)
+            # the first generated token sits at buffer position real_len
+            first = pick(last, temp, seed, real_len).astype(
+                prompt_padded.dtype
+            )
             return cache["k"], cache["v"], first
 
         fn = jax.jit(prefill)
         self._prefill_cache[bucket] = fn
         return fn
 
-    def _admit(self, cache, tok_vec, row: int, req: ServeRequest,
-               req_idx: int):
+    def _admit(self, cache, tok_vec, temp_vec, seed_vec, row: int,
+               req: ServeRequest, req_idx: int):
         prompt = np.asarray(req.prompt, dtype=np.int32)
         p = int(prompt.shape[0])
         if p < 1:
@@ -210,16 +257,20 @@ class ServingEngine:
         )
         padded = np.zeros((1, bucket), dtype=np.int32)
         padded[0, :p] = prompt
+        temp = jnp.asarray(req.temperature, jnp.float32)
+        seed = jnp.asarray(req.seed, jnp.int32)
         row_k, row_v, first = self._prefill(bucket)(
-            self._params, jnp.asarray(padded), jnp.asarray(p, jnp.int32)
+            self._params, jnp.asarray(padded), jnp.asarray(p, jnp.int32),
+            temp, seed,
         )
-        cache, tok_vec = self._insert_fn(
+        cache, tok_vec, temp_vec, seed_vec = self._insert_fn(
             cache, jnp.asarray(row, jnp.int32), row_k, row_v,
             jnp.asarray(p, jnp.int32), tok_vec, first,
+            temp_vec, temp, seed_vec, seed,
         )
         state = _RowState(request_idx=req_idx, budget=budget)
         state.emitted.append(int(first))
-        return cache, tok_vec, state
+        return cache, tok_vec, temp_vec, seed_vec, state
 
     def serve(self, requests: Sequence[ServeRequest]):
         """Run the queue to completion → (results, metrics).
@@ -245,10 +296,12 @@ class ServingEngine:
                     min(-(-p // PREFILL_BUCKET) * PREFILL_BUCKET, max_len)
                 )
         dummy_prompt_len = jnp.asarray(1, jnp.int32)
+        zero_t = jnp.asarray(0.0, jnp.float32)
+        zero_s = jnp.asarray(0, jnp.int32)
         for bucket in sorted(buckets):
             self._prefill(bucket)(
                 self._params, jnp.zeros((1, bucket), jnp.int32),
-                dummy_prompt_len,
+                dummy_prompt_len, zero_t, zero_s,
             )
         warm_cache = init_kv_cache(
             cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
@@ -264,7 +317,8 @@ class ServingEngine:
         warm_cache["length"] = jnp.zeros((b,), jnp.int32)
         _, _, toks = self._decode_chunk(
             self._params, warm_cache, jnp.zeros((b,), jnp.int32),
-            jnp.ones((b,), jnp.bool_),
+            jnp.ones((b,), jnp.bool_), jnp.zeros((b,), jnp.float32),
+            jnp.zeros((b,), jnp.int32),
         )
         np.asarray(toks)  # host fetch: the warm-up really completed
         del warm_cache
@@ -282,6 +336,8 @@ class ServingEngine:
                 )
         cache["length"] = jnp.zeros((b,), jnp.int32)  # vector from step 0
         tok_vec = jnp.zeros((b,), jnp.int32)
+        temp_vec = jnp.zeros((b,), jnp.float32)
+        seed_vec = jnp.zeros((b,), jnp.int32)
         rows: List[Optional[_RowState]] = [None] * b
         results: List[Optional[ServeResult]] = [None] * len(requests)
         next_req = 0
@@ -312,8 +368,9 @@ class ServingEngine:
             )
             if free is None:
                 break
-            cache, tok_vec, state = self._admit(
-                cache, tok_vec, free, requests[next_req], next_req
+            cache, tok_vec, temp_vec, seed_vec, state = self._admit(
+                cache, tok_vec, temp_vec, seed_vec, free,
+                requests[next_req], next_req,
             )
             if self._stop >= 0 and state.emitted[-1] == self._stop:
                 state.stopped = True
@@ -328,7 +385,7 @@ class ServingEngine:
                 [r is None or row_done(r) for r in rows], jnp.bool_
             )
             cache, tok_vec, toks = self._decode_chunk(
-                self._params, cache, tok_vec, done_vec
+                self._params, cache, tok_vec, done_vec, temp_vec, seed_vec
             )
             chunks += 1
             scheduled_slots += self._chunk * b
@@ -349,8 +406,11 @@ class ServingEngine:
                     rows[r] = None
                     # admit the next queued request into the freed row
                     while next_req < len(requests):
-                        cache, tok_vec, st2 = self._admit(
-                            cache, tok_vec, r, requests[next_req], next_req
+                        cache, tok_vec, temp_vec, seed_vec, st2 = (
+                            self._admit(
+                                cache, tok_vec, temp_vec, seed_vec, r,
+                                requests[next_req], next_req,
+                            )
                         )
                         if self._stop >= 0 and st2.emitted[-1] == self._stop:
                             st2.stopped = True
