@@ -374,6 +374,11 @@ class ServeSpec:
     # seeds = the request index; sampling is batch/scheduling-invariant,
     # runtime/serving.py); 0 = greedy
     temperature: float = 0.0
+    # literal text prompts (requires model.weights.tokenizer): when set,
+    # the queue serves THESE instead of the synthetic one — numRequests /
+    # promptLength* are ignored, every request gets maxNewMax budget, and
+    # completions are decoded back to text in the metrics
+    prompts: List[str] = field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -388,6 +393,8 @@ class ServeSpec:
             d["temperature"] = self.temperature
         if self.stop_token_id >= 0:
             d["stopTokenId"] = self.stop_token_id
+        if self.prompts:
+            d["prompts"] = list(self.prompts)
         return d
 
     @classmethod
@@ -403,6 +410,7 @@ class ServeSpec:
                 -1 if d.get("stopTokenId") is None else d["stopTokenId"]
             ),
             temperature=float(d.get("temperature", 0.0) or 0.0),
+            prompts=[str(x) for x in (d.get("prompts") or [])],
         )
 
 
@@ -584,25 +592,42 @@ class JaxXlaRuntime:
                     "mode='serve' needs an LM family with a decode path "
                     "(mlp has none)"
                 )
-            if sv.num_requests < 1:
-                errs.append(
-                    f"serve.numRequests must be >= 1, got {sv.num_requests}"
-                )
-            if not (1 <= sv.prompt_length_min <= sv.prompt_length_max):
-                errs.append(
-                    "serve prompt length range invalid: "
-                    f"[{sv.prompt_length_min}, {sv.prompt_length_max}]"
-                )
-            if not (1 <= sv.max_new_min <= sv.max_new_max):
-                errs.append(
-                    "serve maxNew range invalid: "
-                    f"[{sv.max_new_min}, {sv.max_new_max}]"
-                )
+            if sv.prompts:
+                # literal queue: numRequests / promptLength* / maxNewMin
+                # describe the synthetic queue and are ignored; only the
+                # shared budget field matters
+                if sv.max_new_max < 1:
+                    errs.append(
+                        f"serve.maxNewMax must be >= 1, got {sv.max_new_max}"
+                    )
+            else:
+                if sv.num_requests < 1:
+                    errs.append(
+                        f"serve.numRequests must be >= 1, got {sv.num_requests}"
+                    )
+                if not (1 <= sv.prompt_length_min <= sv.prompt_length_max):
+                    errs.append(
+                        "serve prompt length range invalid: "
+                        f"[{sv.prompt_length_min}, {sv.prompt_length_max}]"
+                    )
+                if not (1 <= sv.max_new_min <= sv.max_new_max):
+                    errs.append(
+                        "serve maxNew range invalid: "
+                        f"[{sv.max_new_min}, {sv.max_new_max}]"
+                    )
             if sv.chunk < 1:
                 errs.append(f"serve.chunk must be >= 1, got {sv.chunk}")
             if sv.temperature < 0:
                 errs.append(
                     f"serve.temperature must be >= 0, got {sv.temperature}"
+                )
+            if sv.prompts and (
+                self.model.weights is None
+                or not self.model.weights.tokenizer
+            ):
+                errs.append(
+                    "serve.prompts (literal text) requires "
+                    "model.weights.tokenizer (a tokenizer.json path)"
                 )
             if self.model.overrides.get("kv_cache_quantized"):
                 errs.append(
@@ -626,7 +651,8 @@ class JaxXlaRuntime:
                     pmax = min(
                         sv.prompt_length_max, s_cfg.max_seq_len // 2
                     )  # the runtime clamps prompts the same way
-                    if pmax + sv.chunk + 1 >= s_cfg.max_seq_len:
+                    if (not sv.prompts
+                            and pmax + sv.chunk + 1 >= s_cfg.max_seq_len):
                         errs.append(
                             f"serve shapes don't fit: promptLengthMax "
                             f"({pmax} after the max_seq_len/2 clamp) + "

@@ -710,11 +710,11 @@ def _run_infer(runtime, family, cfg, mesh):
         import numpy as _np
 
         new_ids = [int(t) for t in _np.asarray(out)[0, prompt_len:]]
-        if inf.stop_token_id >= 0 and inf.stop_token_id in new_ids:
-            new_ids = new_ids[: new_ids.index(inf.stop_token_id)]
         text_extra = {
             "prompt_tokens": prompt_len,
-            "completion": tokenizer.decode(new_ids),
+            "completion": _decode_completion(
+                tokenizer, new_ids, inf.stop_token_id
+            ),
         }
     return {
         **spec_extra,
@@ -732,6 +732,15 @@ def _run_infer(runtime, family, cfg, mesh):
         "new_tokens": max_new,
         "n_devices": mesh.devices.size,
     }
+
+
+def _decode_completion(tokenizer, new_ids, stop_token_id: int) -> str:
+    """Generated ids -> text, trimmed at the first stop token (shared by
+    the infer `completion` and serve `completions` fields so their EOS
+    semantics cannot drift apart)."""
+    if stop_token_id >= 0 and stop_token_id in new_ids:
+        new_ids = new_ids[: new_ids.index(stop_token_id)]
+    return tokenizer.decode(new_ids)
 
 
 def _run_serve(runtime, family, cfg, mesh):
@@ -756,23 +765,57 @@ def _run_serve(runtime, family, cfg, mesh):
     tr = runtime.train
     pmax = min(sv.prompt_length_max, cfg.max_seq_len // 2)
     pmin = min(sv.prompt_length_min, pmax)
+    # literal prompts: tokenize BEFORE loading weights (a prompt that
+    # doesn't fit must fail fast), mirroring _run_infer's ordering
+    tokenizer = None
+    literal_ids = []
+    if sv.prompts:
+        w = runtime.model.weights
+        if w is None or not w.tokenizer:
+            raise ValueError(
+                "serve.prompts requires model.weights.tokenizer"
+            )
+        from nexus_tpu.utils.tokenizer import load_tokenizer
+
+        tokenizer = load_tokenizer(w.tokenizer)
+        for i, text in enumerate(sv.prompts):
+            ids = tokenizer.encode(text)
+            if not ids:
+                raise ValueError(f"serve.prompts[{i}] tokenized to zero tokens")
+            # the engine's own rule: budget = max_len - 1 - p - chunk,
+            # rejected when < 1 — fail fast on exactly that boundary
+            if len(ids) > cfg.max_seq_len - 2 - sv.chunk:
+                raise ValueError(
+                    f"serve.prompts[{i}] ({len(ids)} tokens) leaves no "
+                    f"decode budget within max_seq_len {cfg.max_seq_len}"
+                )
+            literal_ids.append(ids)
     with mesh:
         params, weights_loaded, restored_step = _load_infer_params(
             runtime, family, cfg, mesh
         )
         rng = _np.random.RandomState(tr.seed)
         requests = []
-        for _ in range(sv.num_requests):
-            p = int(rng.randint(pmin, pmax + 1))
-            n = int(rng.randint(sv.max_new_min, sv.max_new_max + 1))
-            requests.append(ServeRequest(
-                prompt=rng.randint(
-                    0, cfg.vocab_size, size=p
-                ).astype(_np.int32).tolist(),
-                max_new_tokens=n,
-                temperature=sv.temperature,
-                seed=len(requests),  # per-request stream, deterministic
-            ))
+        if literal_ids:
+            for i, ids in enumerate(literal_ids):
+                requests.append(ServeRequest(
+                    prompt=ids,
+                    max_new_tokens=sv.max_new_max,
+                    temperature=sv.temperature,
+                    seed=i,
+                ))
+        else:
+            for _ in range(sv.num_requests):
+                p = int(rng.randint(pmin, pmax + 1))
+                n = int(rng.randint(sv.max_new_min, sv.max_new_max + 1))
+                requests.append(ServeRequest(
+                    prompt=rng.randint(
+                        0, cfg.vocab_size, size=p
+                    ).astype(_np.int32).tolist(),
+                    max_new_tokens=n,
+                    temperature=sv.temperature,
+                    seed=len(requests),  # per-request stream, deterministic
+                ))
         # serving cache layout mirrors the infer path: kv heads over the
         # tensor axis, rows over the data axes (replicated when they don't
         # tile) — without this the 8B example's multi-GB cache replicates
@@ -802,8 +845,19 @@ def _run_serve(runtime, family, cfg, mesh):
     finished = sum(1 for r in results if r is not None)
     latencies = sorted(r.latency_s for r in results if r is not None)
     p50 = latencies[len(latencies) // 2] if latencies else 0.0
+    text_extra = {}
+    if tokenizer is not None:
+        text_extra = {"completions": [
+            _decode_completion(
+                tokenizer,
+                list(res.tokens[len(req_ids):]) if res else [],
+                sv.stop_token_id,
+            )
+            for req_ids, res in zip(literal_ids, results)
+        ]}
     return {
         **metrics,
+        **text_extra,
         "mode": "serve",
         "family": runtime.model.family,
         "preset": runtime.model.preset,

@@ -257,3 +257,82 @@ def test_serving_sampled_requests_are_batch_invariant():
                                   np.array(outs[1][0][:len(outs[1][5])]))
     # and the seed actually matters: different seed -> different tokens
     assert outs[1][6] != outs[1][5]
+
+
+def test_serving_cross_family_gptneox():
+    """The engine is family-generic: gptneox serves with the same
+    exactness contract (its forward_decode has a different cache-filling
+    block structure than llama's)."""
+    from nexus_tpu.models import gptneox
+
+    cfg = gptneox.config("tiny", dtype=jnp.float32)
+    params = gptneox.init(jax.random.PRNGKey(2), cfg)
+    rng = np.random.RandomState(7)
+    reqs = [
+        ServeRequest(prompt=rng.randint(0, cfg.vocab_size, size=p).tolist(),
+                     max_new_tokens=n)
+        for p, n in ((4, 6), (9, 3), (5, 8))
+    ]
+    engine = ServingEngine(
+        gptneox.forward_decode, params, cfg, batch_size=2, max_len=48,
+        chunk=4,
+    )
+    results, _ = engine.serve(reqs)
+    for req, res in zip(reqs, results):
+        ref = gptneox.generate(
+            params, cfg, jnp.asarray(req.prompt, jnp.int32)[None, :],
+            max_new_tokens=res.new_tokens,
+        )
+        np.testing.assert_array_equal(np.array(res.tokens), np.array(ref[0]))
+
+
+def test_serve_mode_literal_text_prompts(tmp_path):
+    """serve.prompts: literal text through a tokenizer + safetensors
+    weights — the queue serves the given prompts and the metrics carry
+    text completions (the serving mirror of infer.prompt)."""
+    from tests.test_weights import _build_tokenizer_json
+
+    from nexus_tpu.api.runtime_spec import (
+        JaxXlaRuntime, ModelRef, ParallelismSpec, ServeSpec, TpuSliceSpec,
+        TrainSpec, WeightsSpec,
+    )
+    from nexus_tpu.runtime.entrypoints import run_template_runtime
+    from nexus_tpu.runtime.weights import export_hf_llama
+
+    cfg = llama.config("tiny", dtype=jnp.float32)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    ckpt = str(tmp_path / "model.safetensors")
+    export_hf_llama(params, cfg, ckpt)
+    tok_path = _build_tokenizer_json(str(tmp_path / "tokenizer.json"))
+
+    rt = JaxXlaRuntime(
+        mode="serve",
+        model=ModelRef(
+            family="llama", preset="tiny",
+            overrides={"dtype": "float32"},
+            weights=WeightsSpec(path=ckpt, tokenizer=tok_path),
+        ),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1", slice_count=1),
+        parallelism=ParallelismSpec(),
+        train=TrainSpec(batch_size=2, seq_len=32),
+        serve=ServeSpec(
+            prompts=["the quick brown fox", "hello world"],
+            max_new_min=3, max_new_max=6, chunk=4,
+        ),
+    )
+    assert rt.validate() == []
+    m = run_template_runtime(rt)
+    assert m["weights_loaded"] is True
+    assert m["finished_requests"] == 2
+    assert len(m["completions"]) == 2
+    assert all(isinstance(c, str) for c in m["completions"])
+
+    # prompts without a tokenizer is a spec error
+    bad = JaxXlaRuntime(
+        mode="serve",
+        model=ModelRef(family="llama", preset="tiny"),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1", slice_count=1),
+        parallelism=ParallelismSpec(),
+        serve=ServeSpec(prompts=["x"]),
+    )
+    assert any("tokenizer" in e for e in bad.validate())
