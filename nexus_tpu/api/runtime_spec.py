@@ -379,6 +379,21 @@ class ServeSpec:
     # promptLength* are ignored, every request gets maxNewMax budget, and
     # completions are decoded back to text in the metrics
     prompts: List[str] = field(default_factory=list)
+    # > 0 turns the decode chunks SPECULATIVE: numSpeculative tokens per
+    # verify proposed by n-gram prompt lookup from each row's committed
+    # text (runtime/serving.py). Greedy-exact; requires temperature == 0
+    prompt_lookup_ngram: int = 0
+    num_speculative: int = 4
+
+    def serve_slack(self) -> int:
+        """Worst-case per-dispatch cache overrun the engine budgets for
+        (MUST mirror ServingEngine.__init__'s _slack): ``chunk`` plain
+        steps, or ``rounds*(k+1) + k`` under prompt-lookup speculation."""
+        if self.prompt_lookup_ngram > 0:
+            k = max(1, self.num_speculative)
+            rounds = max(1, -(-self.chunk // (k + 1)))
+            return rounds * (k + 1) + k
+        return self.chunk
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -395,6 +410,9 @@ class ServeSpec:
             d["stopTokenId"] = self.stop_token_id
         if self.prompts:
             d["prompts"] = list(self.prompts)
+        if self.prompt_lookup_ngram > 0:
+            d["promptLookupNgram"] = self.prompt_lookup_ngram
+            d["numSpeculative"] = self.num_speculative
         return d
 
     @classmethod
@@ -411,6 +429,10 @@ class ServeSpec:
             ),
             temperature=float(d.get("temperature", 0.0) or 0.0),
             prompts=[str(x) for x in (d.get("prompts") or [])],
+            prompt_lookup_ngram=int(d.get("promptLookupNgram", 0) or 0),
+            num_speculative=int(
+                4 if d.get("numSpeculative") is None else d["numSpeculative"]
+            ),
         )
 
 
@@ -621,6 +643,17 @@ class JaxXlaRuntime:
                 errs.append(
                     f"serve.temperature must be >= 0, got {sv.temperature}"
                 )
+            if sv.prompt_lookup_ngram > 0:
+                if sv.temperature > 0:
+                    errs.append(
+                        "serve.promptLookupNgram requires temperature == 0 "
+                        "(speculative serving is greedy-exact only)"
+                    )
+                if sv.num_speculative < 1:
+                    errs.append(
+                        "serve.numSpeculative must be >= 1, got "
+                        f"{sv.num_speculative}"
+                    )
             if sv.prompts and (
                 self.model.weights is None
                 or not self.model.weights.tokenizer
@@ -652,12 +685,13 @@ class JaxXlaRuntime:
                         sv.prompt_length_max, s_cfg.max_seq_len // 2
                     )  # the runtime clamps prompts the same way
                     if (not sv.prompts
-                            and pmax + sv.chunk + 1 >= s_cfg.max_seq_len):
+                            and pmax + sv.serve_slack() + 1
+                            >= s_cfg.max_seq_len):
                         errs.append(
                             f"serve shapes don't fit: promptLengthMax "
                             f"({pmax} after the max_seq_len/2 clamp) + "
-                            f"chunk ({sv.chunk}) + 1 leaves no decode "
-                            f"budget within max_seq_len "
+                            f"dispatch slack ({sv.serve_slack()}) + 1 "
+                            f"leaves no decode budget within max_seq_len "
                             f"{s_cfg.max_seq_len}"
                         )
         if self.infer.draft is not None and self.mode == "infer":

@@ -782,9 +782,10 @@ def _run_serve(runtime, family, cfg, mesh):
             ids = tokenizer.encode(text)
             if not ids:
                 raise ValueError(f"serve.prompts[{i}] tokenized to zero tokens")
-            # the engine's own rule: budget = max_len - 1 - p - chunk,
+            # the engine's own rule: budget = max_len - 1 - p - slack,
             # rejected when < 1 — fail fast on exactly that boundary
-            if len(ids) > cfg.max_seq_len - 2 - sv.chunk:
+            # (slack > chunk under prompt-lookup speculation)
+            if len(ids) > cfg.max_seq_len - 2 - sv.serve_slack():
                 raise ValueError(
                     f"serve.prompts[{i}] ({len(ids)} tokens) leaves no "
                     f"decode budget within max_seq_len {cfg.max_seq_len}"
@@ -840,6 +841,8 @@ def _run_serve(runtime, family, cfg, mesh):
             stop_token_id=sv.stop_token_id,
             chunk=sv.chunk,
             cache_sharding=cache_sharding,
+            lookup_ngram=sv.prompt_lookup_ngram,
+            num_speculative=sv.num_speculative,
         )
         results, metrics = engine.serve(requests)
     finished = sum(1 for r in results if r is not None)

@@ -106,7 +106,20 @@ class ServingEngine:
         chunk: int = 8,
         cache_sharding: Optional[Any] = None,
         sample_seed: int = 0,
+        lookup_ngram: int = 0,
+        num_speculative: int = 4,
     ):
+        """``lookup_ngram > 0`` switches the decode chunks to SPECULATIVE
+        rounds: each round proposes ``num_speculative`` tokens by n-gram
+        prompt lookup from the row's own committed text (the engine keeps
+        a device-side token buffer per row), verifies them in ONE
+        ``k+1``-wide target forward, and commits the accepted prefix —
+        models/decoding.py's draft-free speculation running under
+        continuous batching. Greedy-exact: outputs equal the plain
+        engine's token for token (tested); a chunk runs
+        ``ceil(chunk / (k+1))`` rounds so its committed-token budget
+        matches a plain chunk's. Greedy only (requests with
+        temperature > 0 are rejected at admission)."""
         if getattr(cfg, "kv_cache_quantized", False):
             raise ValueError(
                 "ServingEngine supports the fp KV cache only; unset "
@@ -127,6 +140,22 @@ class ServingEngine:
         self._cache_sharding = cache_sharding
         self._prefill_cache: Dict[int, Callable] = {}
         self._base_key = jax.random.PRNGKey(int(sample_seed))
+        self._lookup = int(lookup_ngram)
+        self._k = int(num_speculative)
+        if self._lookup and self._k < 1:
+            raise ValueError(
+                f"num_speculative must be >= 1, got {self._k}"
+            )
+        # rounds per dispatch: one round = one target forward committing
+        # 1..k+1 tokens, so this keeps a spec chunk's committed-token
+        # budget comparable to a plain chunk's C single-token steps
+        self._rounds = max(1, -(-self._chunk // (self._k + 1)))
+        # worst-case growth past a row's finish inside one dispatch: the
+        # host only re-evaluates done-ness at chunk boundaries
+        self._slack = (
+            self._rounds * (self._k + 1) + self._k
+            if self._lookup else self._chunk
+        )
 
         cfg_ = cfg
         fwd = forward_decode
@@ -186,6 +215,63 @@ class ServingEngine:
                 seed_vec.at[row].set(req_seed),
             )
 
+        # ---- speculative (prompt-lookup) variants ----
+        k_spec, g_spec, R = self._k, self._lookup, self._rounds
+        rows_idx = jnp.arange(self._b)
+
+        def _spec_chunk(params, cache, tok, done, buf):
+            """R speculative rounds in ONE dispatch: propose k by n-gram
+            lookup in each row's committed text, verify in one k+1-wide
+            forward, commit the accepted prefix (models/decoding.py's
+            prompt-lookup round under per-row freezing)."""
+            from nexus_tpu.models.decoding import (
+                _commit_speculation,
+                _greedy_accept,
+                prompt_lookup_propose,
+            )
+
+            max_len_ = buf.shape[1]
+
+            def round_(carry, _):
+                cache, tok, done, buf = carry
+                last_pos = cache["length"]  # (B,) == tok's buffer position
+                proposals, _found = prompt_lookup_propose(
+                    buf, last_pos, k_spec, g_spec
+                )
+                block = jnp.concatenate([tok[:, None], proposals], axis=1)
+                logits, cache2 = fwd(params, cfg_, block, cache)
+                target_choice = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+                accepted, out = _greedy_accept(proposals, target_choice)
+                accepted = jnp.where(done, 0, accepted)
+                # commit + rollback-by-pointer via the SHARED helper (the
+                # subtle invariants — frozen-row scatter drop, correction
+                # token's K/V arriving on the next feed — live in
+                # models/decoding.py, once)
+                buf, _n_new, new_len = _commit_speculation(
+                    buf, rows_idx, last_pos, ~done, accepted, out, k_spec,
+                    max_len_, cache["length"],
+                )
+                new_tok = jnp.where(done, tok, out[rows_idx, accepted])
+                cache2 = dict(cache2)
+                cache2["length"] = new_len
+                return (cache2, new_tok, done, buf), (out, accepted)
+
+            (cache, tok, done, buf), (outs, accs) = lax.scan(
+                round_, (cache, tok, done, buf), None, length=R
+            )
+            return cache, tok, buf, outs, accs  # (R, B, k+1), (R, B)
+
+        def _insert_spec(cache, row, row_k, row_v, length, tok_vec,
+                         first_tok, temp_vec, req_temp, seed_vec, req_seed,
+                         buf, prompt_row):
+            cache, tok_vec, temp_vec, seed_vec = _insert(
+                cache, row, row_k, row_v, length, tok_vec, first_tok,
+                temp_vec, req_temp, seed_vec, req_seed,
+            )
+            buf = buf.at[row].set(prompt_row)
+            buf = buf.at[row, length].set(first_tok)
+            return cache, tok_vec, temp_vec, seed_vec, buf
+
         # donate the cache (and the token vector in insert): XLA updates
         # the K/V buffers in place instead of copying the multi-GB cache
         # every chunk (same pattern as train/trainer.py's donated state).
@@ -198,6 +284,13 @@ class ServingEngine:
         )
         self._insert_fn = jax.jit(
             _insert, donate_argnums=(0, 5, 7, 9) if donate else ()
+        )
+        self._spec_chunk = jax.jit(
+            _spec_chunk, donate_argnums=(1, 4) if donate else ()
+        )
+        self._insert_spec_fn = jax.jit(
+            _insert_spec,
+            donate_argnums=(0, 5, 7, 9, 11) if donate else (),
         )
 
     def _prefill(self, bucket: int) -> Callable:
@@ -236,20 +329,27 @@ class ServingEngine:
         return fn
 
     def _admit(self, cache, tok_vec, temp_vec, seed_vec, row: int,
-               req: ServeRequest, req_idx: int):
+               req: ServeRequest, req_idx: int, buf=None):
         prompt = np.asarray(req.prompt, dtype=np.int32)
         p = int(prompt.shape[0])
         if p < 1:
             raise ValueError(f"request {req_idx}: empty prompt")
-        # budget: leave the chunk's scheduling slack + 1 below the cache
-        # end so an almost-finished chunk can never run the row past it
+        if self._lookup and req.temperature > 0:
+            raise ValueError(
+                f"request {req_idx}: speculative (prompt-lookup) serving "
+                "is greedy-exact only; temperature must be 0"
+            )
+        # budget: leave the dispatch's worst-case overrun + 1 below the
+        # cache end so an almost-finished chunk can never run the row
+        # past it (plain: chunk steps; speculative: rounds*(k+1) commits
+        # plus the k-wide verify block's K/V writes)
         budget = min(
-            int(req.max_new_tokens), self._max_len - 1 - p - self._chunk
+            int(req.max_new_tokens), self._max_len - 1 - p - self._slack
         )
         if budget < 1:
             raise ValueError(
                 f"request {req_idx}: prompt ({p}) + chunk slack "
-                f"({self._chunk}) leaves no decode budget within "
+                f"({self._slack}) leaves no decode budget within "
                 f"max_len {self._max_len}"
             )
         bucket = min(
@@ -263,14 +363,24 @@ class ServingEngine:
             self._params, jnp.asarray(padded), jnp.asarray(p, jnp.int32),
             temp, seed,
         )
-        cache, tok_vec, temp_vec, seed_vec = self._insert_fn(
-            cache, jnp.asarray(row, jnp.int32), row_k, row_v,
-            jnp.asarray(p, jnp.int32), tok_vec, first,
-            temp_vec, temp, seed_vec, seed,
-        )
+        if self._lookup:
+            prompt_row = np.zeros((self._max_len,), dtype=np.int32)
+            prompt_row[:p] = prompt
+            cache, tok_vec, temp_vec, seed_vec, buf = self._insert_spec_fn(
+                cache, jnp.asarray(row, jnp.int32), row_k, row_v,
+                jnp.asarray(p, jnp.int32), tok_vec, first,
+                temp_vec, temp, seed_vec, seed,
+                buf, jnp.asarray(prompt_row),
+            )
+        else:
+            cache, tok_vec, temp_vec, seed_vec = self._insert_fn(
+                cache, jnp.asarray(row, jnp.int32), row_k, row_v,
+                jnp.asarray(p, jnp.int32), tok_vec, first,
+                temp_vec, temp, seed_vec, seed,
+            )
         state = _RowState(request_idx=req_idx, budget=budget)
         state.emitted.append(int(first))
-        return cache, tok_vec, temp_vec, seed_vec, state
+        return cache, tok_vec, temp_vec, seed_vec, buf, state
 
     def serve(self, requests: Sequence[ServeRequest]):
         """Run the queue to completion → (results, metrics).
@@ -315,12 +425,20 @@ class ServingEngine:
                     warm_cache[key], self._cache_sharding
                 )
         warm_cache["length"] = jnp.zeros((b,), jnp.int32)
-        _, _, toks = self._decode_chunk(
-            self._params, warm_cache, jnp.zeros((b,), jnp.int32),
-            jnp.ones((b,), jnp.bool_), jnp.zeros((b,), jnp.float32),
-            jnp.zeros((b,), jnp.int32),
-        )
-        np.asarray(toks)  # host fetch: the warm-up really completed
+        if self._lookup:
+            _, _, _, outs, _ = self._spec_chunk(
+                self._params, warm_cache, jnp.zeros((b,), jnp.int32),
+                jnp.ones((b,), jnp.bool_),
+                jnp.zeros((b, max_len), jnp.int32),
+            )
+            np.asarray(outs)  # host fetch: the warm-up really completed
+        else:
+            _, _, toks = self._decode_chunk(
+                self._params, warm_cache, jnp.zeros((b,), jnp.int32),
+                jnp.ones((b,), jnp.bool_), jnp.zeros((b,), jnp.float32),
+                jnp.zeros((b,), jnp.int32),
+            )
+            np.asarray(toks)  # host fetch: the warm-up really completed
         del warm_cache
 
         t0 = time.monotonic()
@@ -338,12 +456,18 @@ class ServingEngine:
         tok_vec = jnp.zeros((b,), jnp.int32)
         temp_vec = jnp.zeros((b,), jnp.float32)
         seed_vec = jnp.zeros((b,), jnp.int32)
+        buf = (
+            jnp.zeros((b, max_len), jnp.int32) if self._lookup else None
+        )
         rows: List[Optional[_RowState]] = [None] * b
         results: List[Optional[ServeResult]] = [None] * len(requests)
         next_req = 0
         committed = 0
         scheduled_slots = 0
         chunks = 0
+        target_forwards = 0
+        drafted = 0
+        accepted_total = 0
 
         def finish(state: _RowState) -> None:
             nonlocal committed
@@ -368,9 +492,9 @@ class ServingEngine:
             )
             if free is None:
                 break
-            cache, tok_vec, temp_vec, seed_vec, state = self._admit(
+            cache, tok_vec, temp_vec, seed_vec, buf, state = self._admit(
                 cache, tok_vec, temp_vec, seed_vec, free,
-                requests[next_req], next_req,
+                requests[next_req], next_req, buf=buf,
             )
             if self._stop >= 0 and state.emitted[-1] == self._stop:
                 state.stopped = True
@@ -384,33 +508,59 @@ class ServingEngine:
             done_vec = jnp.asarray(
                 [r is None or row_done(r) for r in rows], jnp.bool_
             )
-            cache, tok_vec, toks = self._decode_chunk(
-                self._params, cache, tok_vec, done_vec, temp_vec, seed_vec
-            )
-            chunks += 1
-            scheduled_slots += self._chunk * b
-            host_toks = np.asarray(toks)  # (C, B)
+            if self._lookup:
+                cache, tok_vec, buf, outs, accs = self._spec_chunk(
+                    self._params, cache, tok_vec, done_vec, buf
+                )
+                chunks += 1
+                # one verify scores k+1 positions; utilization over them
+                # is acceptance-sensitive by design
+                scheduled_slots += self._rounds * (self._k + 1) * b
+                host_outs = np.asarray(outs)   # (R, B, k+1)
+                host_accs = np.asarray(accs)   # (R, B)
+            else:
+                cache, tok_vec, toks = self._decode_chunk(
+                    self._params, cache, tok_vec, done_vec, temp_vec,
+                    seed_vec,
+                )
+                chunks += 1
+                scheduled_slots += self._chunk * b
+                host_toks = np.asarray(toks)  # (C, B)
             for r in range(b):
                 state = rows[r]
                 if state is None:
                     continue
-                for c in range(self._chunk):
-                    if row_done(state):
-                        break
-                    t = int(host_toks[c, r])
-                    state.emitted.append(t)
-                    if self._stop >= 0 and t == self._stop:
-                        state.stopped = True
+                if self._lookup:
+                    for ri in range(self._rounds):
+                        if row_done(state):
+                            break
+                        n = int(host_accs[ri, r]) + 1
+                        target_forwards += 1
+                        drafted += self._k
+                        accepted_total += int(host_accs[ri, r])
+                        for t in host_outs[ri, r, :n]:
+                            if row_done(state):
+                                break
+                            state.emitted.append(int(t))
+                            if self._stop >= 0 and int(t) == self._stop:
+                                state.stopped = True
+                else:
+                    for c in range(self._chunk):
+                        if row_done(state):
+                            break
+                        t = int(host_toks[c, r])
+                        state.emitted.append(t)
+                        if self._stop >= 0 and t == self._stop:
+                            state.stopped = True
                 if row_done(state):
                     finish(state)
                     rows[r] = None
                     # admit the next queued request into the freed row
                     while next_req < len(requests):
-                        cache, tok_vec, temp_vec, seed_vec, st2 = (
-                            self._admit(
-                                cache, tok_vec, temp_vec, seed_vec, r,
-                                requests[next_req], next_req,
-                            )
+                        (cache, tok_vec, temp_vec, seed_vec, buf,
+                         st2) = self._admit(
+                            cache, tok_vec, temp_vec, seed_vec, r,
+                            requests[next_req], next_req, buf=buf,
                         )
                         if self._stop >= 0 and st2.emitted[-1] == self._stop:
                             st2.stopped = True
@@ -433,4 +583,12 @@ class ServingEngine:
             "wall_s": round(wall, 4),
             "tokens_per_sec": round(committed / wall, 2) if wall else 0.0,
         }
+        if self._lookup:
+            metrics["speculative_kind"] = "prompt_lookup"
+            metrics["prompt_lookup_ngram"] = self._lookup
+            metrics["num_speculative"] = self._k
+            metrics["target_forwards"] = target_forwards
+            metrics["acceptance_rate"] = (
+                round(accepted_total / drafted, 4) if drafted else 0.0
+            )
         return results, metrics
