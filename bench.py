@@ -70,8 +70,14 @@ def _validate_flash_on_chip() -> bool:
 
 
 def _run_candidate(preset, steps, batch, seq, attn, remat, progress,
-                   ce_chunk=0):
-    """One sweep candidate → (mfu, metrics) or None on failure/OOM."""
+                   ce_chunk=0, heads=None):
+    """One sweep candidate → (mfu, metrics) or None on failure/OOM.
+
+    ``heads``: optional (n_heads, n_kv_heads) override. The 400m preset's
+    default 16×64 layout leaves half the 128-wide MXU idle in attention;
+    8×128 heads (identical parameter count and FLOPs-per-token accounting
+    — wq/wk/wv shapes are d×(h·hd)) measured 0.597 vs 0.464 MFU on v5e
+    (docs/PERF.md round-3)."""
     from nexus_tpu.api.runtime_spec import (
         JaxXlaRuntime,
         ModelRef,
@@ -84,6 +90,8 @@ def _run_candidate(preset, steps, batch, seq, attn, remat, progress,
     from nexus_tpu.utils.hw import is_tpu
 
     overrides = {"attn_impl": attn}
+    if heads:
+        overrides["n_heads"], overrides["n_kv_heads"] = heads
     if ce_chunk:
         overrides["ce_chunk"] = ce_chunk
     if not is_tpu():
@@ -102,7 +110,8 @@ def _run_candidate(preset, steps, batch, seq, attn, remat, progress,
             batch_size=batch, seq_len=seq, steps=steps, learning_rate=3e-4,
         ),
     )
-    label = f"attn={attn} remat={remat} batch={batch} ce_chunk={ce_chunk}"
+    label = (f"attn={attn} remat={remat} batch={batch} ce_chunk={ce_chunk}"
+             f" heads={heads or 'preset'}")
     progress(f"candidate {label}: running {steps} steps")
     try:
         metrics = run_template_runtime(runtime)
@@ -122,6 +131,7 @@ def _run_candidate(preset, steps, batch, seq, attn, remat, progress,
     metrics["remat"] = remat
     metrics["batch_size"] = batch
     metrics["ce_chunk"] = ce_chunk
+    metrics["heads"] = list(heads) if heads else None
     return mfu, metrics
 
 
@@ -310,6 +320,7 @@ def main() -> int:
             "attn_impl": metrics.get("attn_impl"),
             "remat": metrics.get("remat"),
             "ce_chunk": metrics.get("ce_chunk"),
+            "heads": metrics.get("heads"),
             "steps": metrics.get("steps"),
             "device": device_kind(),
             "n_devices": len(jax.devices()),
@@ -377,7 +388,7 @@ def main() -> int:
     pinned_ce = os.environ.get("NEXUS_BENCH_CE_CHUNK")
     if not on_tpu:
         # CPU smoke: one tiny candidate, no sweep
-        candidates = [("xla", "none", int(pinned_batch or 4), 0)]
+        candidates = [("xla", "none", int(pinned_batch or 4), 0, None)]
     else:
         flash_ok = False
         if pinned_attn in (None, "", "flash"):
@@ -395,36 +406,51 @@ def main() -> int:
         # lost ~2.4% while dense logits fit. The none/bs4 probes stay in
         # the tail — the sweep keeps self-tuning if the attached chip ever
         # has the HBM for them.
+        # MXU-width head layout (8 heads × 128 head_dim at the 400m
+        # preset's d=1024; same parameters, same accounted FLOPs) —
+        # measured winner at 0.597 MFU vs 0.464 for the preset's 16×64.
+        # NEXUS_BENCH_HEADS="hq,hkv" pins a layout; "preset" disables.
+        pinned_heads = os.environ.get("NEXUS_BENCH_HEADS")
+        if pinned_heads == "preset":
+            hd128 = None
+        elif pinned_heads:
+            hd128 = tuple(int(x) for x in pinned_heads.split(","))
+        else:
+            hd128 = (8, 4) if preset == "400m" else None
         if pinned_remat:
-            candidates = [(attn, pinned_remat, b, ce)]
+            candidates = [(attn, pinned_remat, b, ce, hd128)]
         else:
             # a pinned NEXUS_BENCH_CE_CHUNK means "this CE, period" — the
             # dense-CE candidates honor it (like pinned_batch for batch)
             ce_main = ce if pinned_ce else 0
             candidates = [
-                (attn, "dots", b, ce_main),  # measured winner (r3: 0.4656)
-                (attn, "dots", b, ce),       # chunked CE A/B at the winner
-                (attn, "none", b, ce),       # max FLOP efficiency if it fits
+                (attn, "dots", b, ce_main, hd128),  # winner (r3: 0.597)
+                (attn, "dots", b, ce_main, None),   # preset-heads baseline
+                (attn, "dots", b, ce, hd128),       # chunked-CE A/B
+                (attn, "none", b, ce, hd128),       # max FLOP if it fits
             ]
             if not pinned_batch:
                 # a pinned batch means "this batch size, period"; only an
-                # unpinned sweep explores the other batch points. bs4 +
-                # no-remat: activation residency halves vs bs8, which is the
-                # config the HBM estimate says fits when bs8 compile-OOMs
-                # (docs/PERF.md)
-                candidates.append((attn, "none", max(b // 2, 1), ce))
-                candidates.append((attn, "dots", 2 * b, ce_main))
-            seen = set()  # pinned ce collapses the winner/AB pair
+                # unpinned sweep explores the other batch points. bs/2 +
+                # no-remat: activation residency halves, the config the
+                # HBM estimate says fits when bs8 compile-OOMs
+                candidates.append((attn, "dots", 2 * b, ce_main, hd128))
+                candidates.append(
+                    (attn, "none", max(b // 2, 1), ce, hd128)
+                )
+            seen = set()  # pinned ce/heads collapse duplicate candidates
             candidates = [
                 c for c in candidates if not (c in seen or seen.add(c))
             ]
-        # cap sweep size: compile time on the tunnel dominates
-        candidates = candidates[:5]
+        # cap sweep size: compile time on the tunnel dominates (winner
+        # runs first, so a watchdog cut still reports the strong config)
+        candidates = candidates[:6]
 
     best = None
-    for attn, remat, batch, ce_chunk in candidates:
+    for attn, remat, batch, ce_chunk, heads in candidates:
         res = _run_candidate(
-            preset, steps, batch, seq, attn, remat, progress, ce_chunk=ce_chunk
+            preset, steps, batch, seq, attn, remat, progress,
+            ce_chunk=ce_chunk, heads=heads,
         )
         if res is not None and (best is None or res[0] > best[0]):
             best = res
