@@ -14,7 +14,10 @@ just eliminate the candidate. Prints ONE JSON line at the end.
 Env knobs: NEXUS_BENCH_PRESET (default auto), NEXUS_BENCH_STEPS,
 NEXUS_BENCH_BATCH (pins batch; disables the batch sweep), NEXUS_BENCH_SEQ,
 NEXUS_BENCH_ATTN (pins attention impl), NEXUS_BENCH_REMAT
-('none'|'full'|'dots' pins remat), NEXUS_BENCH_DEADLINE_S.
+('none'|'full'|'dots' pins remat), NEXUS_BENCH_CE_CHUNK (pins the
+chunked-CE size), NEXUS_BENCH_HEADS ("hq,hkv" pins the attention head
+layout, "preset" disables the MXU-width-head candidate),
+NEXUS_BENCH_DEADLINE_S.
 """
 
 from __future__ import annotations
@@ -414,7 +417,17 @@ def main() -> int:
         if pinned_heads == "preset":
             hd128 = None
         elif pinned_heads:
-            hd128 = tuple(int(x) for x in pinned_heads.split(","))
+            try:
+                hq_s, hkv_s = pinned_heads.split(",")
+                hd128 = (int(hq_s), int(hkv_s))
+            except ValueError:
+                # a malformed pin must not kill the bench before it emits
+                # its JSON line — fall back to the default lever
+                progress(
+                    f"ignoring malformed NEXUS_BENCH_HEADS={pinned_heads!r}"
+                    " (expected 'hq,hkv' or 'preset')"
+                )
+                hd128 = (8, 4) if preset == "400m" else None
         else:
             hd128 = (8, 4) if preset == "400m" else None
         if pinned_remat:
