@@ -437,9 +437,12 @@ def main() -> int:
             # dense-CE candidates honor it (like pinned_batch for batch)
             ce_main = ce if pinned_ce else 0
             candidates = [
-                (attn, "dots", b, ce_main, hd128),  # winner (r3: 0.597)
+                # winner (r3: 0.617) — 'dots' + saved flash-VJP residuals
+                # skips the backward's attention-forward recompute
+                (attn, "dots_attn", b, ce_main, hd128),
+                (attn, "dots", b, ce_main, hd128),  # remat A/B (0.597)
                 (attn, "dots", b, ce_main, None),   # preset-heads baseline
-                (attn, "dots", b, ce, hd128),       # chunked-CE A/B
+                (attn, "dots_attn", b, ce, hd128),  # chunked-CE A/B
                 (attn, "none", b, ce, hd128),       # max FLOP if it fits
             ]
             if not pinned_batch:
@@ -447,7 +450,7 @@ def main() -> int:
                 # unpinned sweep explores the other batch points. bs/2 +
                 # no-remat: activation residency halves, the config the
                 # HBM estimate says fits when bs8 compile-OOMs
-                candidates.append((attn, "dots", 2 * b, ce_main, hd128))
+                candidates.append((attn, "dots_attn", 2 * b, ce_main, hd128))
                 candidates.append(
                     (attn, "none", max(b // 2, 1), ce, hd128)
                 )

@@ -29,7 +29,9 @@ from jax import lax
 
 from nexus_tpu.ops.attention import attention
 from nexus_tpu.ops.norms import layer_norm
-from nexus_tpu.ops.remat import checkpoint_block
+from jax.ad_checkpoint import checkpoint_name
+
+from nexus_tpu.ops.remat import ATTN_OUT_NAME, checkpoint_block
 from nexus_tpu.ops.ring_attention import ring_attention_sharded
 from nexus_tpu.ops.rope import apply_rope, rope_cos_sin
 
@@ -194,6 +196,8 @@ def _block_with(cfg: GPTNeoXConfig, x: jnp.ndarray,
         layer, cos, sin,
     )
     attn = attend(q, k, v)
+    # named for the 'dots_attn' remat policy (ops/remat.py)
+    attn = checkpoint_name(attn, ATTN_OUT_NAME)
     attn_out = attn.reshape(b, s, d) @ layer["wo"] + layer["b_o"]
 
     h2 = layer_norm(x, layer["ln2"], layer["ln2_b"], cfg.norm_eps)

@@ -24,7 +24,9 @@ from jax import lax
 
 from nexus_tpu.ops.attention import attention
 from nexus_tpu.ops.norms import rms_norm
-from nexus_tpu.ops.remat import checkpoint_block
+from jax.ad_checkpoint import checkpoint_name
+
+from nexus_tpu.ops.remat import ATTN_OUT_NAME, checkpoint_block
 from nexus_tpu.ops.ring_attention import ring_attention_sharded
 from nexus_tpu.ops.rope import apply_rope, rope_cos_sin
 
@@ -172,6 +174,9 @@ def _block(cfg: LlamaConfig, x: jnp.ndarray, layer: Dict[str, jnp.ndarray],
         attn = ring_attention_sharded(q, k, v)
     else:
         attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+    # named for the 'dots_attn' remat policy: attention is not a dot, so
+    # only a name tag lets jax.checkpoint save it (ops/remat.py)
+    attn = checkpoint_name(attn, ATTN_OUT_NAME)
     x = x + attn.reshape(b, s, hq * hd) @ layer["wo"]
 
     h = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)

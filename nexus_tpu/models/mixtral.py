@@ -28,7 +28,9 @@ from nexus_tpu.ops.moe import (
     top_k_routing,
 )
 from nexus_tpu.ops.norms import rms_norm
-from nexus_tpu.ops.remat import checkpoint_block
+from jax.ad_checkpoint import checkpoint_name
+
+from nexus_tpu.ops.remat import ATTN_OUT_NAME, checkpoint_block
 from nexus_tpu.ops.ring_attention import ring_attention_sharded
 from nexus_tpu.ops.rope import apply_rope, rope_cos_sin
 
@@ -219,6 +221,8 @@ def _block(cfg: MixtralConfig, carry, layer, cos, sin):
             q, k, v, causal=True, impl=cfg.attn_impl,
             window=cfg.sliding_window,
         )
+    # named for the 'dots_attn' remat policy (ops/remat.py)
+    attn = checkpoint_name(attn, ATTN_OUT_NAME)
     x = x + attn.reshape(b, s, hq * hd) @ layer["wo"]
 
     h2 = rms_norm(x, layer["ln_mlp"], cfg.norm_eps)

@@ -368,6 +368,7 @@ def _lse_rows(lse: jnp.ndarray, q_shape) -> jnp.ndarray:
 
 def _flash_lse_fwd_rule(q, k, v, opts):
     out, lse = _flash_impl(q, k, v, opts)
+    out, lse = _tag_residuals(out, lse)
     return (out, _lse_rows(lse, q.shape)), (q, k, v, out, lse)
 
 
@@ -386,8 +387,24 @@ def _flash(q, k, v, opts):
     return out
 
 
+def _tag_residuals(out, lse):
+    """Name the flash VJP residuals so the 'dots_attn' remat policy can
+    save them: without this, rematerialized backward passes rerun the
+    whole forward kernel just to rebuild (out, lse) (ops/remat.py).
+    Shared by the plain and lse-returning flash entry points."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    from nexus_tpu.ops.remat import ATTN_LSE_NAME, ATTN_OUT_NAME
+
+    return (
+        checkpoint_name(out, ATTN_OUT_NAME),
+        checkpoint_name(lse, ATTN_LSE_NAME),
+    )
+
+
 def _flash_fwd_rule(q, k, v, opts):
     out, lse = _flash_impl(q, k, v, opts)
+    out, lse = _tag_residuals(out, lse)
     return out, (q, k, v, out, lse)
 
 

@@ -11,7 +11,16 @@ from typing import Callable
 
 import jax
 
-REMAT_POLICIES = ("full", "dots")
+REMAT_POLICIES = ("full", "dots", "dots_attn")
+
+# the model blocks tag their attention output with this name
+# (jax.ad_checkpoint.checkpoint_name) so a name-aware policy can save it
+ATTN_OUT_NAME = "attn_out"
+# ...and the flash custom-VJP tags its residual logsumexp: saving the
+# block-level output alone is NOT enough — autodiff still reruns the
+# kernel to reconstruct the VJP residuals (out, lse), so the names must
+# sit on the residual values inside the fwd rule (ops/attention.py)
+ATTN_LSE_NAME = "attn_lse"
 
 
 def checkpoint_block(fn: Callable, remat_policy: str = "full") -> Callable:
@@ -20,10 +29,25 @@ def checkpoint_block(fn: Callable, remat_policy: str = "full") -> Callable:
     ``full``: recompute everything on backward (min memory, max recompute).
     ``dots``: save matmul outputs, recompute elementwise/norms
     (``dots_with_no_batch_dims_saveable`` — most of the memory win at a few
-    percent recompute)."""
+    percent recompute).
+    ``dots_attn``: ``dots`` PLUS the tagged attention outputs. Flash
+    attention is a pallas_call, not a dot — under plain ``dots`` the
+    backward recomputes the whole forward attention kernel before running
+    the dq/dkv kernels. Saving the (B,S,H,D) attention output (~the size
+    of one activation tensor per layer) skips that recompute."""
     if remat_policy == "dots":
         return jax.checkpoint(
             fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    if remat_policy == "dots_attn":
+        return jax.checkpoint(
+            fn,
+            policy=jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(
+                    ATTN_OUT_NAME, ATTN_LSE_NAME
+                ),
+            ),
         )
     if remat_policy == "full":
         return jax.checkpoint(fn)

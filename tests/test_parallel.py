@@ -533,3 +533,40 @@ def test_1f1b_mixtral_matches_single_path():
             np.asarray(got_leaves[k]), np.asarray(ref),
             rtol=5e-4, atol=5e-5, err_msg=f"grad mismatch at {k}",
         )
+
+
+def test_dots_attn_remat_policy_matches_dots():
+    """'dots_attn' (save the checkpoint_name-tagged attention outputs on
+    top of the dots policy — skips the backward-pass recompute of the
+    whole attention forward, which is a pallas_call and so invisible to
+    the dots policy) is numerically identical to 'dots': same loss, same
+    every-gradient-leaf, for every LM family."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nexus_tpu.models import gptneox, llama, mixtral
+
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 256)
+    # llama additionally runs the FLASH path (interpret mode) so the
+    # custom-VJP residual tags — where the on-chip win actually lives —
+    # are exercised, not just the block-level tag the xla path hits
+    variants = [(llama, "flash"), (llama, None), (mixtral, None),
+                (gptneox, None)]
+    for fam, attn_impl in variants:
+        outs = {}
+        for pol in ("dots", "dots_attn"):
+            kw = {"attn_impl": attn_impl} if attn_impl else {}
+            cfg = fam.config("tiny", dtype=jnp.float32, remat=True,
+                             remat_policy=pol, **kw)
+            params = fam.init(jax.random.PRNGKey(0), cfg)
+            loss, grads = jax.value_and_grad(
+                lambda p: fam.loss_fn(p, cfg, {"tokens": toks})[0]
+            )(params)
+            outs[pol] = (float(loss), grads)
+        assert np.isclose(outs["dots"][0], outs["dots_attn"][0],
+                          rtol=1e-6), (fam, attn_impl)
+        for a, b in zip(jax.tree_util.tree_leaves(outs["dots"][1]),
+                        jax.tree_util.tree_leaves(outs["dots_attn"][1])):
+            np.testing.assert_allclose(np.array(a), np.array(b),
+                                       rtol=1e-5, atol=1e-7,
+                                       err_msg=f"{fam.__name__} {attn_impl}")
