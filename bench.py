@@ -443,7 +443,6 @@ def main() -> int:
                 (attn, "dots", b, ce_main, hd128),  # remat A/B (0.597)
                 (attn, "dots", b, ce_main, None),   # preset-heads baseline
                 (attn, "dots_attn", b, ce, hd128),  # chunked-CE A/B
-                (attn, "none", b, ce, hd128),       # max FLOP if it fits
             ]
             if not pinned_batch:
                 # a pinned batch means "this batch size, period"; only an
@@ -451,6 +450,9 @@ def main() -> int:
                 # no-remat: activation residency halves, the config the
                 # HBM estimate says fits when bs8 compile-OOMs
                 candidates.append((attn, "dots_attn", 2 * b, ce_main, hd128))
+                # the no-remat probe runs at bs/2 (bs8-none has never
+                # compiled on 16 GB; halved residency is the config the
+                # HBM estimate says could fit on a roomier chip)
                 candidates.append(
                     (attn, "none", max(b // 2, 1), ce, hd128)
                 )

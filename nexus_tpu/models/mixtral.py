@@ -58,7 +58,7 @@ class MixtralConfig:
     dtype: Any = jnp.bfloat16
     attn_impl: Optional[str] = None
     remat: bool = False
-    remat_policy: str = "full"  # 'full' | 'dots' (see models/llama.py)
+    remat_policy: str = "full"  # ops/remat.py REMAT_POLICIES (see llama.py)
     ce_chunk: int = 0  # vocab-chunked exact CE (ops/losses.py); 0 = dense
     # sliding-window attention (the Mixtral-8x7B convention, window 4096):
     # each position attends to the newest `sliding_window` positions only;

@@ -34,7 +34,11 @@ def checkpoint_block(fn: Callable, remat_policy: str = "full") -> Callable:
     attention is a pallas_call, not a dot — under plain ``dots`` the
     backward recomputes the whole forward attention kernel before running
     the dq/dkv kernels. Saving the (B,S,H,D) attention output (~the size
-    of one activation tensor per layer) skips that recompute."""
+    of one activation tensor per layer) skips that recompute. Caveat:
+    under RING attention every ring hop's flash call tags its own
+    residuals, so an N-way ring saves up to N pairs per layer — at
+    memory-tight long-context shapes prefer ``dots`` (measured win is on
+    the non-ring flash path, docs/PERF.md)."""
     if remat_policy == "dots":
         return jax.checkpoint(
             fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable

@@ -4,8 +4,9 @@ Usage (on the TPU host):
     python tools/profile_train.py [out_dir]
     python tools/trace_summary.py [out_dir]
 
-Env knobs: P_ATTN (xla|flash), P_REMAT (none|dots|full), P_BATCH, P_SEQ,
-P_PRESET — mirror the bench sweep's candidate axes (bench.py).
+Env knobs: P_ATTN (xla|flash), P_REMAT (none|dots|dots_attn|full),
+P_BATCH, P_SEQ, P_PRESET, P_HEADS ("hq,hkv" head-layout override) —
+mirror the bench sweep's candidate axes (bench.py).
 """
 import os
 import sys
@@ -23,6 +24,10 @@ def main() -> int:
     attn = os.environ.get("P_ATTN", "xla")
     remat = os.environ.get("P_REMAT", "dots")
     overrides = {"attn_impl": attn}
+    heads = os.environ.get("P_HEADS")
+    if heads:
+        hq, hkv = (int(x) for x in heads.split(","))
+        overrides["n_heads"], overrides["n_kv_heads"] = hq, hkv
     if remat == "none":
         overrides["remat"] = False
     else:
