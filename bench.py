@@ -443,6 +443,10 @@ def main() -> int:
                 (attn, "dots", b, ce_main, hd128),  # remat A/B (0.597)
                 (attn, "dots", b, ce_main, None),   # preset-heads baseline
                 (attn, "dots_attn", b, ce, hd128),  # chunked-CE A/B
+                # max-FLOP probe at the pinned/default batch: kept in the
+                # base list so a pinned-batch sweep still self-tunes onto
+                # no-remat when the chip has the HBM for it
+                (attn, "none", b, ce, hd128),
             ]
             if not pinned_batch:
                 # a pinned batch means "this batch size, period"; only an

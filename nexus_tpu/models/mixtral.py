@@ -2,9 +2,11 @@
 by a top-2 routed mixture of experts (BASELINE config #5).
 
 Expert weights carry a leading expert dim annotated with the ``expert``
-logical axis, so under an expert-parallel mesh the three dispatch einsums
-reshard token-major ↔ expert-major — XLA SPMD inserts the all_to_all over
-ICI (SURVEY.md §2c "EP").
+logical axis; under an expert-parallel mesh the einsum dispatch path
+reshards token-major ↔ expert-major — XLA SPMD inserts the all_to_all
+over ICI (SURVEY.md §2c "EP"). Off an EP mesh the runtime auto-selects
+the scatter dispatch instead (quadratic-in-tokens einsum cost; 2.45×
+measured, docs/PERF.md) — ``dispatch_impl`` pins either explicitly.
 """
 
 from __future__ import annotations
@@ -47,11 +49,17 @@ class MixtralConfig:
     n_experts_per_token: int = 2
     capacity_factor: float = 1.25
     router_aux_weight: float = 0.02
-    # 'einsum': dense one-hot dispatch/combine contractions (known-good
-    # SPMD partitioning along the expert axis); 'scatter': O(T·k·D)
-    # scatter/gather data movement instead of O(T²·D) MXU work — same
-    # numbers (ops/moe.py), partitioning quality is compiler-dependent
-    dispatch_impl: str = "einsum"
+    # 'auto' (default): the RUNTIME resolves it from the mesh —
+    # 'scatter' when no expert-parallel axis is active (measured 2.45×
+    # at real step shapes: the einsum dispatch's (T,E,C) cost is
+    # quadratic in tokens, 0.372 vs 0.152 MFU on v5e, docs/PERF.md),
+    # 'einsum' under expert parallelism (its dispatch einsums have
+    # known-good SPMD partitionings with all_to_all over the expert
+    # axis; a sharded scatter's layout is compiler-dependent and has
+    # not been profiled multi-chip). Library callers without a mesh in
+    # hand get the conservative 'einsum'. Same numbers all three ways
+    # (ops/moe.py, tested).
+    dispatch_impl: str = "auto"
     rope_theta: float = 1000000.0
     norm_eps: float = 1e-5
     max_seq_len: int = 8192
@@ -180,22 +188,26 @@ def _moe_ffn(cfg: MixtralConfig, x: jnp.ndarray,
                            cfg.capacity_factor)
     routing = top_k_routing(router_logits, cfg.n_experts_per_token, cap)
 
-    if cfg.dispatch_impl == "scatter":
+    # 'auto' resolves to the conservative einsum path HERE (no mesh in
+    # scope); the runtime rewrites it to a concrete impl from the mesh
+    # before config construction (runtime/entrypoints.py)
+    dispatch = "einsum" if cfg.dispatch_impl == "auto" else cfg.dispatch_impl
+    if dispatch == "scatter":
         expert_in = moe_dispatch_scatter(
             xf, routing, cfg.n_experts, cap
         ).astype(cfg.dtype)
-    elif cfg.dispatch_impl == "einsum":
+    elif dispatch == "einsum":
         expert_in = moe_dispatch_dense(xf, routing).astype(cfg.dtype)
     else:
         raise ValueError(
             f"unknown dispatch_impl {cfg.dispatch_impl!r}; "
-            "expected 'einsum' or 'scatter'"
+            "expected 'auto', 'einsum', or 'scatter'"
         )
     gated = jax.nn.silu(
         jnp.einsum("ecd,edf->ecf", expert_in, layer["w_gate"])
     ) * jnp.einsum("ecd,edf->ecf", expert_in, layer["w_up"])
     expert_out = jnp.einsum("ecf,efd->ecd", gated, layer["w_down"])  # (E, C, D)
-    if cfg.dispatch_impl == "scatter":
+    if dispatch == "scatter":
         out = moe_combine_scatter(expert_out, routing).reshape(b, s, d)
     else:
         out = moe_combine_dense(expert_out, routing).reshape(b, s, d)

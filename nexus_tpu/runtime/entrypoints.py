@@ -98,6 +98,16 @@ def run_template_runtime(
         # a sequence mesh axis means context parallelism: attention must be
         # the ring kernel (exact over sequence shards) unless overridden
         overrides["attn_impl"] = "ring"
+    if runtime.model.family == "mixtral" and "dispatch_impl" not in overrides:
+        # MoE dispatch auto-resolution: scatter where it was measured —
+        # a single-device program (2.45× at step level, docs/PERF.md) —
+        # and einsum's known-good SPMD partitionings on ANY sharded mesh
+        # (EP or not: a sharded scatter's layout is compiler-dependent
+        # and unprofiled multi-chip). An explicit dispatch_impl override
+        # always wins.
+        overrides["dispatch_impl"] = (
+            "scatter" if mesh.devices.size == 1 else "einsum"
+        )
     cfg = family.config(runtime.model.preset, **overrides)
     n_devices = mesh.devices.size
 
@@ -375,6 +385,9 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel=None):
             "(start_step=%d >= %d timed steps this run)",
             runtime.profile.start_step, max(steps_run - 1, 0),
         )
+    if hasattr(cfg, "dispatch_impl"):
+        # the RESOLVED MoE dispatch (auto → scatter/einsum off the mesh)
+        metrics["moe_dispatch"] = cfg.dispatch_impl
     if hasattr(cfg, "param_count"):
         fpt = model_flops_per_token(cfg, tr.seq_len)
         metrics["param_count"] = cfg.param_count()
