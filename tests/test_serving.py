@@ -14,6 +14,8 @@ from types import SimpleNamespace
 import jax
 import jax.numpy as jnp
 import numpy as np
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
 
 from nexus_tpu.models import llama
 from nexus_tpu.runtime.serving import ServeRequest, ServingEngine
@@ -403,3 +405,54 @@ def test_speculative_serving_rejects_sampled_requests():
         raise AssertionError("expected ValueError for sampled request")
     except ValueError as e:
         assert "greedy-exact" in str(e)
+
+
+_req = st.tuples(
+    st.lists(st.integers(0, 12), min_size=1, max_size=9),  # prompt
+    st.integers(1, 14),                                    # max_new
+)
+
+
+@settings(
+    max_examples=20, deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+@given(
+    reqs=st.lists(_req, min_size=1, max_size=7),
+    batch=st.integers(1, 3),
+    chunk=st.integers(1, 6),
+    stop=st.integers(-1, 12),
+    lookup=st.sampled_from([0, 2]),
+)
+def test_serving_property_exactness(reqs, batch, chunk, stop, lookup):
+    """PROPERTY: for ANY queue, batch size, chunk size, stop token, and
+    plain-vs-speculative mode, each request's output equals the cyclic
+    stub model's isolated greedy decode trimmed at stop/budget — the
+    engine's scheduling freedom never changes what is computed."""
+    v = 13
+    cfg, fwd = _cyclic_model(v, stop)
+    engine = ServingEngine(
+        fwd, {}, cfg, batch_size=batch, max_len=96, stop_token_id=stop,
+        chunk=chunk, lookup_ngram=lookup, num_speculative=3,
+    )
+    results, metrics = engine.serve(
+        [ServeRequest(prompt=p, max_new_tokens=n) for p, n in reqs]
+    )
+    for (prompt, max_new), res in zip(reqs, results):
+        assert res is not None
+        # isolated reference on the stub: next = (last + 1) % v
+        expect = []
+        cur = prompt[-1]
+        # engine budget mirror (max_len 96 is roomy; trim defensively)
+        budget = min(max_new, 96 - 1 - len(prompt) - engine._slack)
+        while len(expect) < budget:
+            cur = (cur + 1) % v
+            expect.append(cur)
+            if stop >= 0 and cur == stop:
+                break
+        assert res.tokens == list(prompt) + expect, (
+            prompt, max_new, batch, chunk, stop, lookup
+        )
+    assert metrics["committed_tokens"] == sum(
+        r.new_tokens for r in results
+    )
