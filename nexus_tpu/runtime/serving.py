@@ -37,6 +37,14 @@ shapes), one compiled prefill per prompt-length bucket, admission =
 one scatter. The fp KV-cache layout only (the int8 cache's scale planes
 would double the insert surface; quantized serving stays on the static
 path for now).
+
+Known limitation: admission prefill SERIALIZES with decode — while a
+freed row's next request prefills, the other rows idle (one device, one
+program at a time). At high turnover with long prompts this caps
+utilization; the next step would be chunked prefill (interleaving
+prompt chunks into decode dispatches), which changes the chunk program
+and is not yet worth its complexity at the measured utilizations
+(89% at 4 rows, docs/PERF.md).
 """
 
 from __future__ import annotations
