@@ -146,7 +146,9 @@ class ServingEngine:
         self._stop = int(stop_token_id)
         self._chunk = int(chunk)
         self._cache_sharding = cache_sharding
-        self._prefill_cache: Dict[int, Callable] = {}
+        self._prefill_cache: Dict[Any, Callable] = {}
+        self._warmed: Dict[int, set] = {}  # bucket -> compiled group sizes
+        self._prefill_dispatches = 0
         self._base_key = jax.random.PRNGKey(int(sample_seed))
         self._lookup = int(lookup_ngram)
         self._k = int(num_speculative)
@@ -301,43 +303,55 @@ class ServingEngine:
             donate_argnums=(0, 5, 7, 9, 11) if donate else (),
         )
 
-    def _prefill(self, bucket: int) -> Callable:
-        """Compile-once-per-bucket prefill: right-padded prompt (1, Pb)
-        through one forward; the first generated token reads the logits at
-        the REAL last prompt position. K/V written past real_len is
-        garbage, but each decode step overwrites its slot before the mask
-        can expose it (position p is written at the same step whose query
-        first sees p)."""
-        if bucket in self._prefill_cache:
-            return self._prefill_cache[bucket]
+    def _prefill(self, bucket: int, n: int) -> Callable:
+        """Compile-once-per-(bucket, group-size) prefill: n right-padded
+        prompts (n, Pb) through ONE forward — simultaneously freed rows
+        admit in one dispatch instead of n (prefill serializes with
+        decode, so dispatch count is the admission tax; measured in the
+        16-row probe, docs/PERF.md). Each row's first generated token
+        reads the logits at ITS real last prompt position. K/V written
+        past a row's real_len is garbage, but each decode step overwrites
+        its slot before the mask can expose it (position p is written at
+        the same step whose query first sees p). Group sizes are padded
+        to powers of two (dummy rows: one zero token) to bound the
+        compile count."""
+        key = (bucket, n)
+        if key in self._prefill_cache:
+            return self._prefill_cache[key]
         cfg_, fwd = self._cfg, self._fwd
         max_len = self._max_len
         pick = self._pick
 
-        def prefill(params, prompt_padded, real_len, temp, seed):
-            # single-row caches replicate; the BATCH cache carries the
-            # serving sharding and the insert scatter lands into it
+        def prefill(params, prompts, real_lens, temps, seeds):
+            # group-local cache; the BATCH cache carries the serving
+            # sharding and the insert scatter lands into it
             cache = init_kv_cache(
                 cfg_.n_layers, cfg_.n_kv_heads, cfg_.head_dim, cfg_.dtype,
-                1, max_len,
+                n, max_len,
             )
-            logits, cache = fwd(params, cfg_, prompt_padded, cache)
+            logits, cache = fwd(params, cfg_, prompts, cache)
             last = jnp.take_along_axis(
-                logits, (real_len - 1)[None, None, None].astype(jnp.int32),
+                logits, (real_lens - 1)[:, None, None].astype(jnp.int32),
                 axis=1,
-            )[0, 0]  # (V,)
-            # the first generated token sits at buffer position real_len
-            first = pick(last, temp, seed, real_len).astype(
-                prompt_padded.dtype
+            )[:, 0]  # (n, V)
+            # each first token sits at its row's buffer position real_len
+            firsts = jax.vmap(pick)(last, temps, seeds, real_lens).astype(
+                prompts.dtype
             )
-            return cache["k"], cache["v"], first
+            return cache["k"], cache["v"], firsts
 
         fn = jax.jit(prefill)
-        self._prefill_cache[bucket] = fn
+        self._prefill_cache[key] = fn
         return fn
 
-    def _admit(self, cache, tok_vec, temp_vec, seed_vec, row: int,
-               req: ServeRequest, req_idx: int, buf=None):
+    def _bucket_of(self, p: int) -> int:
+        """Prompt length -> prefill bucket (shared by validation, warm-up,
+        and the initial-wave scan — these MUST agree or warmed compiles
+        desynchronize from admission keys)."""
+        return min(-(-p // PREFILL_BUCKET) * PREFILL_BUCKET, self._max_len)
+
+    def _validate_request(self, req: ServeRequest, req_idx: int):
+        """Per-request admission checks → (prompt, p, budget, bucket)."""
         prompt = np.asarray(req.prompt, dtype=np.int32)
         p = int(prompt.shape[0])
         if p < 1:
@@ -360,35 +374,94 @@ class ServingEngine:
                 f"({self._slack}) leaves no decode budget within "
                 f"max_len {self._max_len}"
             )
-        bucket = min(
-            -(-p // PREFILL_BUCKET) * PREFILL_BUCKET, self._max_len
-        )
-        padded = np.zeros((1, bucket), dtype=np.int32)
-        padded[0, :p] = prompt
-        temp = jnp.asarray(req.temperature, jnp.float32)
-        seed = jnp.asarray(req.seed, jnp.int32)
-        row_k, row_v, first = self._prefill(bucket)(
-            self._params, jnp.asarray(padded), jnp.asarray(p, jnp.int32),
-            temp, seed,
-        )
-        if self._lookup:
-            prompt_row = np.zeros((self._max_len,), dtype=np.int32)
-            prompt_row[:p] = prompt
-            cache, tok_vec, temp_vec, seed_vec, buf = self._insert_spec_fn(
-                cache, jnp.asarray(row, jnp.int32), row_k, row_v,
-                jnp.asarray(p, jnp.int32), tok_vec, first,
-                temp_vec, temp, seed_vec, seed,
-                buf, jnp.asarray(prompt_row),
+        return prompt, p, budget, self._bucket_of(p)
+
+    @staticmethod
+    def _group_pad(n: int) -> int:
+        pad = 1
+        while pad < n:
+            pad *= 2
+        return pad
+
+    def _admit_group(self, cache, tok_vec, temp_vec, seed_vec, buf,
+                     admissions):
+        """Admit several requests with ONE prefill dispatch per prompt
+        bucket (admission serializes with decode, so dispatches are the
+        tax — simultaneously freed rows share a forward). ``admissions``:
+        [(row, req, req_idx), ...]. Returns the updated device state plus
+        [(row, _RowState), ...] in admission order per bucket group."""
+        prepared = [
+            (row, req_idx, req, *self._validate_request(req, req_idx))
+            for row, req, req_idx in admissions
+        ]
+        by_bucket = {}
+        for item in prepared:
+            by_bucket.setdefault(item[6], []).append(item)
+        out = []
+        subgroups = []
+        for bucket, group in by_bucket.items():
+            # split into group sizes the warm-up already compiled: a
+            # mid-run XLA compile (~10 s on the tunnel) costs far more
+            # than the dispatches batching saves. Prefer padding UP to
+            # the smallest warmed size that fits the whole remainder
+            # (dummy rows are cheap; an extra dispatch is not); fall back
+            # to the largest warmed size below it. Size 1 is always warm.
+            warmed = sorted(self._warmed.get(bucket, {1}))
+            i = 0
+            while i < len(group):
+                remaining = len(group) - i
+                geq = [w for w in warmed if w >= remaining]
+                n_pad = (
+                    min(geq) if geq
+                    else max(w for w in warmed if w <= remaining)
+                )
+                take = min(n_pad, remaining)
+                subgroups.append((bucket, group[i:i + take], n_pad))
+                i += take
+        for bucket, group, n_pad in subgroups:
+            prompts = np.zeros((n_pad, bucket), dtype=np.int32)
+            lens = np.ones((n_pad,), dtype=np.int32)  # dummy rows: 1 token
+            temps = np.zeros((n_pad,), dtype=np.float32)
+            seeds = np.zeros((n_pad,), dtype=np.int32)
+            for i, (_row, _ri, req, prompt, p, _b, _bk) in enumerate(group):
+                prompts[i, :p] = prompt
+                lens[i] = p
+                temps[i] = req.temperature
+                seeds[i] = req.seed
+            ks, vs, firsts = self._prefill(bucket, n_pad)(
+                self._params, jnp.asarray(prompts), jnp.asarray(lens),
+                jnp.asarray(temps), jnp.asarray(seeds),
             )
-        else:
-            cache, tok_vec, temp_vec, seed_vec = self._insert_fn(
-                cache, jnp.asarray(row, jnp.int32), row_k, row_v,
-                jnp.asarray(p, jnp.int32), tok_vec, first,
-                temp_vec, temp, seed_vec, seed,
-            )
-        state = _RowState(request_idx=req_idx, budget=budget)
-        state.emitted.append(int(first))
-        return cache, tok_vec, temp_vec, seed_vec, buf, state
+            self._prefill_dispatches += 1
+            firsts_np = np.asarray(firsts)
+            for i, (row, req_idx, req, prompt, p, budget, _bk) in enumerate(
+                group
+            ):
+                first = jnp.asarray(int(firsts_np[i]), jnp.int32)
+                temp = jnp.asarray(req.temperature, jnp.float32)
+                seed = jnp.asarray(req.seed, jnp.int32)
+                if self._lookup:
+                    prompt_row = np.zeros((self._max_len,), dtype=np.int32)
+                    prompt_row[:p] = prompt
+                    (cache, tok_vec, temp_vec, seed_vec,
+                     buf) = self._insert_spec_fn(
+                        cache, jnp.asarray(row, jnp.int32),
+                        ks[:, i:i + 1], vs[:, i:i + 1],
+                        jnp.asarray(p, jnp.int32), tok_vec, first,
+                        temp_vec, temp, seed_vec, seed,
+                        buf, jnp.asarray(prompt_row),
+                    )
+                else:
+                    cache, tok_vec, temp_vec, seed_vec = self._insert_fn(
+                        cache, jnp.asarray(row, jnp.int32),
+                        ks[:, i:i + 1], vs[:, i:i + 1],
+                        jnp.asarray(p, jnp.int32), tok_vec, first,
+                        temp_vec, temp, seed_vec, seed,
+                    )
+                state = _RowState(request_idx=req_idx, budget=budget)
+                state.emitted.append(int(firsts_np[i]))
+                out.append((row, state))
+        return cache, tok_vec, temp_vec, seed_vec, buf, out
 
     def serve(self, requests: Sequence[ServeRequest]):
         """Run the queue to completion → (results, metrics).
@@ -406,21 +479,38 @@ class ServingEngine:
         cfg = self._cfg
 
         # ---- warm-up (outside the timed window) ----
-        buckets = set()
+        # compile every (bucket, 1) the queue can need (steady-state
+        # turnover admits mostly single rows), (bucket, 2) where two
+        # same-bucket requests exist, and the exact group sizes of the
+        # INITIAL admission wave; mid-run waves only ever use these
+        # warmed sizes (the splitter pads up or splits down — no
+        # mid-run compiles)
+        totals = {}
         for req in requests:
-            p = len(req.prompt)
-            if p >= 1:
-                buckets.add(
-                    min(-(-p // PREFILL_BUCKET) * PREFILL_BUCKET, max_len)
-                )
-        dummy_prompt_len = jnp.asarray(1, jnp.int32)
-        zero_t = jnp.asarray(0.0, jnp.float32)
-        zero_s = jnp.asarray(0, jnp.int32)
-        for bucket in sorted(buckets):
-            self._prefill(bucket)(
-                self._params, jnp.zeros((1, bucket), jnp.int32),
-                dummy_prompt_len, zero_t, zero_s,
+            if len(req.prompt) >= 1:
+                bk = self._bucket_of(len(req.prompt))
+                totals[bk] = totals.get(bk, 0) + 1
+        warm_keys = {(bucket, 1) for bucket in totals}
+        if b > 1:  # steady-state turnover often frees 2 rows per chunk —
+            # but a size-2 group needs two same-bucket requests to exist
+            warm_keys |= {
+                (bucket, 2) for bucket, n in totals.items() if n >= 2
+            }
+        initial = {}
+        for req in requests[:b]:
+            if len(req.prompt) >= 1:
+                bk = self._bucket_of(len(req.prompt))
+                initial[bk] = initial.get(bk, 0) + 1
+        for bk, n in initial.items():
+            warm_keys.add((bk, self._group_pad(n)))
+        self._warmed = {}
+        for bucket, n in sorted(warm_keys):
+            self._prefill(bucket, n)(
+                self._params, jnp.zeros((n, bucket), jnp.int32),
+                jnp.ones((n,), jnp.int32), jnp.zeros((n,), jnp.float32),
+                jnp.zeros((n,), jnp.int32),
             )
+            self._warmed.setdefault(bucket, set()).add(n)
         warm_cache = init_kv_cache(
             cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
             b, max_len,
@@ -476,6 +566,7 @@ class ServingEngine:
         target_forwards = 0
         drafted = 0
         accepted_total = 0
+        self._prefill_dispatches = 0
 
         def finish(state: _RowState) -> None:
             nonlocal committed
@@ -492,25 +583,33 @@ class ServingEngine:
         def row_done(state: _RowState) -> bool:
             return state.stopped or len(state.emitted) >= state.budget
 
-        # initial admission (the first token from prefill can already be
-        # the stop token — finish such requests without occupying a row)
-        while next_req < len(requests):
-            free = next(
-                (r for r in range(b) if rows[r] is None), None
-            )
-            if free is None:
-                break
-            cache, tok_vec, temp_vec, seed_vec, buf, state = self._admit(
-                cache, tok_vec, temp_vec, seed_vec, free,
-                requests[next_req], next_req, buf=buf,
-            )
-            if self._stop >= 0 and state.emitted[-1] == self._stop:
-                state.stopped = True
-            if row_done(state):
-                finish(state)
-            else:
-                rows[free] = state
-            next_req += 1
+        def admit_into(free_rows):
+            """Fill free rows from the queue, batching each wave's prefills
+            by bucket (one dispatch per bucket per wave). A request whose
+            FIRST token is already the stop token finishes immediately and
+            its row re-enters the free pool for the next wave."""
+            nonlocal cache, tok_vec, temp_vec, seed_vec, buf, next_req
+            while free_rows and next_req < len(requests):
+                wave = []
+                while free_rows and next_req < len(requests):
+                    wave.append(
+                        (free_rows.pop(0), requests[next_req], next_req)
+                    )
+                    next_req += 1
+                (cache, tok_vec, temp_vec, seed_vec, buf,
+                 admitted) = self._admit_group(
+                    cache, tok_vec, temp_vec, seed_vec, buf, wave,
+                )
+                for row, state in admitted:
+                    if self._stop >= 0 and state.emitted[-1] == self._stop:
+                        state.stopped = True
+                    if row_done(state):
+                        finish(state)
+                        free_rows.append(row)
+                    else:
+                        rows[row] = state
+
+        admit_into([r for r in range(b) if rows[r] is None])
 
         while any(r is not None for r in rows):
             done_vec = jnp.asarray(
@@ -563,21 +662,9 @@ class ServingEngine:
                 if row_done(state):
                     finish(state)
                     rows[r] = None
-                    # admit the next queued request into the freed row
-                    while next_req < len(requests):
-                        (cache, tok_vec, temp_vec, seed_vec, buf,
-                         st2) = self._admit(
-                            cache, tok_vec, temp_vec, seed_vec, r,
-                            requests[next_req], next_req, buf=buf,
-                        )
-                        if self._stop >= 0 and st2.emitted[-1] == self._stop:
-                            st2.stopped = True
-                        next_req += 1
-                        if row_done(st2):
-                            finish(st2)
-                            continue  # one-token request; row still free
-                        rows[r] = st2
-                        break
+            # admit the next queued requests into every row this chunk
+            # freed — ONE batched wave, not one prefill per row
+            admit_into([r for r in range(b) if rows[r] is None])
         wall = time.monotonic() - t0
         metrics = {
             "requests": len(requests),
@@ -590,6 +677,7 @@ class ServingEngine:
             "decode_chunks": chunks,
             "wall_s": round(wall, 4),
             "tokens_per_sec": round(committed / wall, 2) if wall else 0.0,
+            "prefill_dispatches": self._prefill_dispatches,
         }
         if self._lookup:
             metrics["speculative_kind"] = "prompt_lookup"

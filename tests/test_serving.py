@@ -456,3 +456,22 @@ def test_serving_property_exactness(reqs, batch, chunk, stop, lookup):
     assert metrics["committed_tokens"] == sum(
         r.new_tokens for r in results
     )
+
+
+def test_batched_admission_shares_prefill_dispatches():
+    """Simultaneously freed rows admit through ONE prefill dispatch per
+    prompt bucket per wave — the admission tax the 16-row probe measured
+    (docs/PERF.md). Same-bucket queue through 4 rows: the initial wave is
+    1 dispatch, and total dispatches stay far below the request count."""
+    v = 7
+    cfg, fwd = _cyclic_model(v, -1)
+    reqs = [ServeRequest(prompt=[1, 2, 3], max_new_tokens=6)
+            for _ in range(12)]
+    engine = ServingEngine(fwd, {}, cfg, batch_size=4, max_len=64, chunk=6)
+    results, metrics = engine.serve(reqs)
+    for res in results:
+        expect = [(4 + i) % v for i in range(6)]
+        assert res.tokens == [1, 2, 3] + expect
+    # 12 same-bucket requests through 4 rows: 1 initial wave + 2 refill
+    # waves = 3 dispatches (one-by-one admission would need 12)
+    assert metrics["prefill_dispatches"] <= 4, metrics
