@@ -450,13 +450,13 @@ def main() -> int:
             ]
             if not pinned_batch:
                 # a pinned batch means "this batch size, period"; only an
-                # unpinned sweep explores the other batch points. bs/2 +
-                # no-remat: activation residency halves, the config the
-                # HBM estimate says fits when bs8 compile-OOMs
+                # unpinned sweep explores the other batch points.
+                # double-batch probe at the winning remat policy: bigger
+                # matmuls per weight load if the HBM allows it
                 candidates.append((attn, "dots_attn", 2 * b, ce_main, hd128))
                 # the no-remat probe runs at bs/2 (bs8-none has never
-                # compiled on 16 GB; halved residency is the config the
-                # HBM estimate says could fit on a roomier chip)
+                # compiled on 16 GB; halved activation residency is the
+                # config the HBM estimate says could fit)
                 candidates.append(
                     (attn, "none", max(b // 2, 1), ce, hd128)
                 )
@@ -465,8 +465,10 @@ def main() -> int:
                 c for c in candidates if not (c in seen or seen.add(c))
             ]
         # cap sweep size: compile time on the tunnel dominates (winner
-        # runs first, so a watchdog cut still reports the strong config)
-        candidates = candidates[:6]
+        # runs first, so a watchdog cut still reports the strong config).
+        # 7 = the full default candidate list — the cap only bites when a
+        # pinned knob multiplies variants, never the two tail probes
+        candidates = candidates[:7]
 
     best = None
     for attn, remat, batch, ce_chunk, heads in candidates:

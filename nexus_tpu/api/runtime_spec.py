@@ -352,6 +352,23 @@ class InferSpec:
         )
 
 
+def serve_dispatch_slack(
+    chunk: int, prompt_lookup_ngram: int, num_speculative: int
+) -> int:
+    """Worst-case cache-slot overrun of ONE serving dispatch: ``chunk``
+    plain decode steps, or ``rounds*(k+1) + k`` under prompt-lookup
+    speculation (each round commits up to k+1 tokens and the final
+    verify block writes k proposal K/Vs past the last commit). Shared by
+    ServeSpec.serve_slack() (spec-level admission validation) and
+    ServingEngine.__init__ (the engine's own budget rule) — one formula,
+    so the two can never silently diverge."""
+    if prompt_lookup_ngram > 0:
+        k = max(1, num_speculative)
+        rounds = max(1, -(-chunk // (k + 1)))
+        return rounds * (k + 1) + k
+    return chunk
+
+
 @dataclass
 class ServeSpec:
     """Continuous-batching serving (mode='serve', runtime/serving.py): a
@@ -386,14 +403,13 @@ class ServeSpec:
     num_speculative: int = 4
 
     def serve_slack(self) -> int:
-        """Worst-case per-dispatch cache overrun the engine budgets for
-        (MUST mirror ServingEngine.__init__'s _slack): ``chunk`` plain
-        steps, or ``rounds*(k+1) + k`` under prompt-lookup speculation."""
-        if self.prompt_lookup_ngram > 0:
-            k = max(1, self.num_speculative)
-            rounds = max(1, -(-self.chunk // (k + 1)))
-            return rounds * (k + 1) + k
-        return self.chunk
+        """Worst-case per-dispatch cache overrun the engine budgets for —
+        the ONE formula (serve_dispatch_slack, defined above this class)
+        ServingEngine also imports, so spec validation can never diverge
+        from the engine's admission rule."""
+        return serve_dispatch_slack(
+            self.chunk, self.prompt_lookup_ngram, self.num_speculative
+        )
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {

@@ -633,6 +633,18 @@ def prompt_lookup_propose(
     which the acceptance rule also makes safe."""
     b, max_len = buf.shape
     npos = max_len - ngram
+    if npos <= 0:
+        # an ngram as wide as the buffer has no earlier occurrence to
+        # find; without this guard the (B, npos, ngram) window stack
+        # below would be zero-sized and jnp.max would crash on an empty
+        # reduction. Degrade to "no match": repeat the last token.
+        reps = jnp.take_along_axis(
+            buf, jnp.clip(last_pos, 0, max_len - 1)[:, None], axis=1
+        )
+        return (
+            jnp.broadcast_to(reps, (b, k)),
+            jnp.zeros((b,), jnp.bool_),
+        )
     # windows[:, i, g] = buf[:, i + g] — static shifts, no gather
     windows = jnp.stack(
         [buf[:, g:g + npos] for g in range(ngram)], axis=-1

@@ -4,9 +4,12 @@ by a top-2 routed mixture of experts (BASELINE config #5).
 Expert weights carry a leading expert dim annotated with the ``expert``
 logical axis; under an expert-parallel mesh the einsum dispatch path
 reshards token-major ↔ expert-major — XLA SPMD inserts the all_to_all
-over ICI (SURVEY.md §2c "EP"). Off an EP mesh the runtime auto-selects
-the scatter dispatch instead (quadratic-in-tokens einsum cost; 2.45×
-measured, docs/PERF.md) — ``dispatch_impl`` pins either explicitly.
+over ICI (SURVEY.md §2c "EP"). ``dispatch_impl='auto'`` resolves to the
+scatter dispatch on a SINGLE-DEVICE mesh only (quadratic-in-tokens
+einsum cost; 2.45× measured, docs/PERF.md) and to einsum's known-good
+SPMD partitioning on ANY sharded mesh, EP or not (a sharded scatter's
+multi-chip layout is compiler-dependent and unprofiled) —
+``dispatch_impl`` pins either explicitly.
 """
 
 from __future__ import annotations

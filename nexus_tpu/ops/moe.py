@@ -115,11 +115,12 @@ def moe_dispatch_scatter(
     dense path (tests/test_ops.py parity, values and gradients).
 
     Dispatch selection (MixtralConfig.dispatch_impl='auto'): the runtime
-    picks THIS path off the expert-parallel mesh — 2.45x at real step
+    picks THIS path on a single-device mesh only — 2.45x at real step
     shapes, the (T,E,C) einsum cost being quadratic in tokens — and the
-    einsum path ON it (known-good SPMD partitionings with all_to_all
-    along the expert axis; a sharded scatter's partitioning is
-    compiler-dependent and unprofiled multi-chip)."""
+    einsum path on ANY sharded mesh, EP or not (known-good SPMD
+    partitionings with all_to_all along the expert axis; a sharded
+    scatter's partitioning is compiler-dependent and unprofiled
+    multi-chip)."""
     t, k = routing.expert_index.shape
     d = x.shape[-1]
     flat_dest = (

@@ -161,10 +161,13 @@ class ServingEngine:
         # budget comparable to a plain chunk's C single-token steps
         self._rounds = max(1, -(-self._chunk // (self._k + 1)))
         # worst-case growth past a row's finish inside one dispatch: the
-        # host only re-evaluates done-ness at chunk boundaries
-        self._slack = (
-            self._rounds * (self._k + 1) + self._k
-            if self._lookup else self._chunk
+        # host only re-evaluates done-ness at chunk boundaries. The ONE
+        # formula shared with ServeSpec.serve_slack() — spec validation
+        # and the engine's admission rule can't diverge.
+        from nexus_tpu.api.runtime_spec import serve_dispatch_slack
+
+        self._slack = serve_dispatch_slack(
+            self._chunk, self._lookup, self._k
         )
 
         cfg_ = cfg

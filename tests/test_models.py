@@ -668,6 +668,16 @@ def test_prompt_lookup_propose_unit():
     )
     assert not bool(found2[0])
 
+    # ngram >= buffer width: no earlier occurrence can exist; must degrade
+    # to the no-match fallback instead of crashing on an empty reduction
+    buf3 = jnp.asarray([[4, 5, 6, 7]], jnp.int32)
+    for ng in (4, 5):
+        props3, found3 = prompt_lookup_propose(
+            buf3, jnp.asarray([2], jnp.int32), k=3, ngram=ng
+        )
+        assert not bool(found3[0])
+        np.testing.assert_array_equal(np.array(props3[0]), [6, 6, 6])
+
 
 def test_prompt_lookup_generate_exactly_matches_greedy():
     """Draft-free prompt-lookup speculation == plain greedy decode, token

@@ -132,6 +132,30 @@ def test_serving_first_token_stop_and_budget_trim():
     assert metrics["committed_tokens"] == 6
 
 
+def test_engine_slack_matches_spec_slack():
+    """The engine's per-dispatch overrun budget and ServeSpec's
+    validation-time slack come from the one shared formula
+    (api/runtime_spec.py::serve_dispatch_slack) — assert they agree
+    across chunk/speculation combinations so a future divergence (e.g.
+    an engine-local override) trips here instead of failing feasible
+    specs mid-run."""
+    from nexus_tpu.api.runtime_spec import ServeSpec
+
+    cfg = tiny_cfg()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    for chunk, ngram, k in [
+        (8, 0, 4), (1, 0, 4), (8, 3, 4), (8, 3, 1), (3, 2, 7), (16, 4, 2),
+    ]:
+        engine = ServingEngine(
+            llama.forward_decode, params, cfg, batch_size=1, max_len=64,
+            chunk=chunk, lookup_ngram=ngram, num_speculative=k,
+        )
+        spec = ServeSpec(
+            chunk=chunk, prompt_lookup_ngram=ngram, num_speculative=k,
+        )
+        assert engine._slack == spec.serve_slack(), (chunk, ngram, k)
+
+
 def test_serving_rejects_unservable_requests():
     cfg, fwd = _cyclic_model(6, -1)
     engine = ServingEngine(fwd, {}, cfg, batch_size=1, max_len=16, chunk=8)
