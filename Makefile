@@ -2,7 +2,7 @@
 NATIVE_SRC := nexus_tpu/native/src/nexus_core.cpp nexus_tpu/native/src/nexus_data.cpp
 NATIVE_LIB := nexus_tpu/native/libnexus_core.so
 
-.PHONY: all native test bench clean lint
+.PHONY: all native test test-all coverage bench clean lint
 
 all: native
 
@@ -12,7 +12,15 @@ $(NATIVE_LIB): $(NATIVE_SRC)
 	g++ -std=c++17 -O2 -fPIC -shared -pthread -o $@ $^
 
 test: native
+	python -m pytest tests/ -x -q -m "not slow"
+
+test-all: native
 	python -m pytest tests/ -x -q
+
+coverage: native
+	python -m pytest tests/ -q --cov=nexus_tpu \
+	  --cov-report=json:coverage.json --cov-report=term
+	python tools/check_coverage.py coverage.json
 
 bench:
 	python bench.py

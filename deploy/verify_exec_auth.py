@@ -1,0 +1,74 @@
+"""Image-level exec-auth verification (CI runs this INSIDE the built
+container; it also runs on a dev box).
+
+Two assertions mirroring why the reference bundles the AWS CLI in its
+image (/root/reference/.container/Dockerfile:16-31, README.md:30):
+
+  1. the exec-credential plugin binaries a GKE/EKS shard kubeconfig
+     names (``gke-gcloud-auth-plugin``, ``aws``) resolve on PATH —
+     unless AUTH_PLUGINS trimmed them at build time (pass --no-plugins);
+  2. the controller's own ExecCredentialPlugin (cluster/kubeapi.py) can
+     spawn a plugin from PATH and mint a bearer token end to end — a
+     STUB plugin is written to a temp dir, prepended to PATH, and must
+     produce the token through the real subprocess + JSON-parse flow.
+
+    docker run --rm -v $PWD:/src --entrypoint python IMAGE \
+        /src/deploy/verify_exec_auth.py
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import stat
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+if os.path.isdir("/app"):
+    sys.path.insert(0, "/app")  # image layout
+
+from nexus_tpu.cluster.kubeapi import ExecCredentialPlugin  # noqa: E402
+
+STUB = """#!/bin/sh
+echo '{"apiVersion":"client.authentication.k8s.io/v1",\
+"kind":"ExecCredential",\
+"status":{"token":"stub-token-123",\
+"expirationTimestamp":"2099-01-01T00:00:00Z"}}'
+"""
+
+
+def main() -> int:
+    check_binaries = "--no-plugins" not in sys.argv
+    failures = []
+    if check_binaries:
+        for binary in ("aws", "gke-gcloud-auth-plugin"):
+            path = shutil.which(binary)
+            if path:
+                print(f"ok: {binary} -> {path}")
+            else:
+                failures.append(f"{binary} not on PATH")
+    with tempfile.TemporaryDirectory() as tmp:
+        stub = os.path.join(tmp, "stub-auth-plugin")
+        with open(stub, "w") as f:
+            f.write(STUB)
+        os.chmod(stub, os.stat(stub).st_mode | stat.S_IEXEC)
+        os.environ["PATH"] = tmp + os.pathsep + os.environ.get("PATH", "")
+        plugin = ExecCredentialPlugin({"command": "stub-auth-plugin"})
+        token = plugin.token()
+        if token == "stub-token-123":
+            print("ok: ExecCredentialPlugin minted a token via PATH")
+        else:
+            failures.append(f"unexpected token {token!r}")
+    if failures:
+        for f_ in failures:
+            print(f"FAIL: {f_}")
+        return 1
+    print("exec-auth verification passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

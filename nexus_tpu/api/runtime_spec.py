@@ -278,6 +278,11 @@ class InferSpec:
     # literal prompt text; tokenized with model.weights.tokenizer when both
     # are set (otherwise the timing prompt is random ids of promptLength)
     prompt: str = ""
+    # explicit prompt token ids (no tokenizer needed) — e.g. a slice of
+    # the training corpus, so speculation benches decode NATURAL text
+    # continuations instead of random ids. Broadcast across the batch,
+    # mutually exclusive with `prompt`.
+    prompt_token_ids: List[int] = field(default_factory=list)
     # EOS semantics (-1 = decode the full budget). Plain decode freezes a
     # row once it emits this id (no wasted divergence after EOS); the
     # speculative loop keeps its own commit structure (no early freeze),
@@ -312,6 +317,8 @@ class InferSpec:
         }
         if self.prompt:
             d["prompt"] = self.prompt
+        if self.prompt_token_ids:
+            d["promptTokenIds"] = list(self.prompt_token_ids)
         if self.stop_token_id >= 0:
             d["stopTokenId"] = self.stop_token_id
         if self.draft is not None:
@@ -337,6 +344,9 @@ class InferSpec:
             iterations=int(d.get("iterations", 3) or 3),
             temperature=float(d.get("temperature", 0.0) or 0.0),
             prompt=str(d.get("prompt", "") or ""),
+            prompt_token_ids=[
+                int(x) for x in (d.get("promptTokenIds") or [])
+            ],
             stop_token_id=int(
                 -1 if d.get("stopTokenId") is None else d["stopTokenId"]
             ),
@@ -350,6 +360,17 @@ class InferSpec:
             ),
             prompt_lookup_ngram=int(d.get("promptLookupNgram", 0) or 0),
         )
+
+
+def _dtype_bytes(dt) -> int:
+    """Bytes per element of a (possibly jnp) dtype; 2 (bf16) when it
+    can't be resolved — the common compute width."""
+    try:
+        import numpy as _np
+
+        return _np.dtype(dt).itemsize
+    except Exception:  # unregistered/None dtype
+        return 2
 
 
 def serve_dispatch_slack(
@@ -401,6 +422,10 @@ class ServeSpec:
     # text (runtime/serving.py). Greedy-exact; requires temperature == 0
     prompt_lookup_ngram: int = 0
     num_speculative: int = 4
+    # prompt tokens an admitting row streams through the model per decode
+    # step (chunked prefill — admission never stalls the other rows; the
+    # speculative path prefills at numSpeculative+1 per round instead)
+    prefill_chunk: int = 8
 
     def serve_slack(self) -> int:
         """Worst-case per-dispatch cache overrun the engine budgets for —
@@ -429,11 +454,14 @@ class ServeSpec:
         if self.prompt_lookup_ngram > 0:
             d["promptLookupNgram"] = self.prompt_lookup_ngram
             d["numSpeculative"] = self.num_speculative
+        if self.prefill_chunk != 8:
+            d["prefillChunk"] = self.prefill_chunk
         return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ServeSpec":
         return cls(
+            prefill_chunk=int(d.get("prefillChunk", 8) or 8),
             num_requests=int(d.get("numRequests", 32) or 32),
             prompt_length_min=int(d.get("promptLengthMin", 16) or 16),
             prompt_length_max=int(d.get("promptLengthMax", 128) or 128),
@@ -567,6 +595,90 @@ class JaxXlaRuntime:
     checkpoint: CheckpointSpec = field(default_factory=CheckpointSpec)
     profile: ProfileSpec = field(default_factory=ProfileSpec)
 
+    def hbm_budget_gb(self) -> Optional[Dict[str, float]]:
+        """Paper-math per-chip HBM residency estimate for the declared
+        mesh — params + optimizer + activations (train) or params + KV
+        cache (infer/serve), in GB. Returns None when the model doesn't
+        resolve or the family is 'mlp' (too small to matter).
+
+        The model (documented in docs/PERF.md "HBM budget"):
+          * model state (train): params/grads at the compute dtype plus
+            f32 Adam moments = dtype*2 + 8 bytes per parameter, sharded
+            over fsdp x tensor x pipeline (DP replicates);
+          * activations (train): per layer, ~8 d-wide + 3 ff-wide
+            saved tensors per token with no remat (the measured v5e
+            arithmetic — this model correctly predicts the round-3
+            bench: 400m/bs8 'dots' fits 16 GB, 'none' exceeds it),
+            ~60% of that under 'dots'/'dots_attn', and the layer input
+            (1 d-wide) under full-block remat; plus the f32 logits when
+            no ce_chunk override trims them;
+          * KV cache (infer/serve): L*B*S*Hkv*D*2 at the cache dtype,
+            sharded over the axes the runtime actually uses (batch over
+            data axes, kv heads over tensor).
+
+        It is an ESTIMATE (XLA scratch, fragmentation, and fusion
+        headroom are not modeled) — validate() rejects only when it
+        exceeds the full advertised HBM, the unambiguous cases."""
+        if self.model.family == "mlp":
+            return None
+        try:
+            from nexus_tpu.models.registry import get_family
+
+            cfg = get_family(self.model.family).config(
+                self.model.preset, **dict(self.model.overrides)
+            )
+        except Exception:  # unresolvable model is reported elsewhere
+            return None
+        p = self.parallelism
+        n_params = cfg.param_count()
+        dt_bytes = _dtype_bytes(getattr(cfg, "dtype", None))
+        gb = 1024.0 ** 3
+        # fsdp/tensor/pipeline shard dense params; the expert axis shards
+        # MoE expert weights (the bulk of an MoE's parameters) — counting
+        # it keeps the estimate usable for Mixtral-class templates
+        shards = max(1, p.fsdp * p.tensor * p.pipeline * p.expert)
+        out: Dict[str, float] = {}
+        if self.mode == "train":
+            state_bytes = n_params * (2 * dt_bytes + 8) / shards
+            b_chip = max(
+                1, self.train.batch_size // max(1, p.data * p.fsdp)
+            )
+            s_chip = max(
+                1, self.train.seq_len // max(1, p.sequence)
+            )
+            d, ff = cfg.d_model, getattr(cfg, "d_ff", cfg.d_model * 4)
+            layers_chip = max(1, cfg.n_layers // max(1, p.pipeline))
+            per_layer = (8 * d + 3 * ff) * b_chip * s_chip * dt_bytes
+            remat_policy = str(
+                self.model.overrides.get("remat_policy", "")
+            )
+            if self.train.remat or self.model.overrides.get("remat"):
+                if remat_policy in ("dots", "dots_attn"):
+                    per_layer *= 0.6
+                else:  # full-block remat saves the layer INPUT only
+                    per_layer = d * b_chip * s_chip * dt_bytes
+            act_bytes = per_layer * layers_chip / max(1, p.tensor)
+            if not self.model.overrides.get("ce_chunk"):
+                act_bytes += b_chip * s_chip * cfg.vocab_size * 4
+            out["state_gb"] = state_bytes / gb
+            out["activations_gb"] = act_bytes / gb
+        else:
+            out["state_gb"] = n_params * dt_bytes / shards / gb
+            rows = self.train.batch_size
+            hkv = getattr(cfg, "n_kv_heads", None)
+            hd = getattr(cfg, "head_dim", None)
+            if hkv and hd:
+                cache = (
+                    cfg.n_layers * rows * cfg.max_seq_len * hkv * hd
+                    * 2 * dt_bytes
+                )
+                cache_shards = max(1, p.data * p.fsdp * p.tensor)
+                out["kv_cache_gb"] = cache / cache_shards / gb
+        out["total_gb"] = round(sum(out.values()), 3)
+        for k in list(out):
+            out[k] = round(out[k], 3)
+        return out
+
     def validate(self) -> List[str]:
         """Static validation: mesh must tile the slice exactly."""
         errs: List[str] = []
@@ -655,6 +767,11 @@ class JaxXlaRuntime:
                     )
             if sv.chunk < 1:
                 errs.append(f"serve.chunk must be >= 1, got {sv.chunk}")
+            if sv.prefill_chunk < 1:
+                errs.append(
+                    f"serve.prefillChunk must be >= 1, got "
+                    f"{sv.prefill_chunk}"
+                )
             if sv.temperature < 0:
                 errs.append(
                     f"serve.temperature must be >= 0, got {sv.temperature}"
@@ -677,12 +794,6 @@ class JaxXlaRuntime:
                 errs.append(
                     "serve.prompts (literal text) requires "
                     "model.weights.tokenizer (a tokenizer.json path)"
-                )
-            if self.model.overrides.get("kv_cache_quantized"):
-                errs.append(
-                    "mode='serve' supports the fp KV cache only; unset "
-                    "model.overrides.kv_cache_quantized (the engine's "
-                    "row-insert path has no scale planes)"
                 )
             if self.model.family != "mlp":
                 # feasibility: the engine budget-trims against
@@ -751,6 +862,15 @@ class JaxXlaRuntime:
                         f"{t_cfg.vocab_size} (override the draft's "
                         "vocab_size)"
                     )
+        if (
+            self.mode == "infer"
+            and self.infer.prompt
+            and self.infer.prompt_token_ids
+        ):
+            errs.append(
+                "infer.prompt (text) and infer.promptTokenIds are "
+                "mutually exclusive"
+            )
         if self.infer.prompt_lookup_ngram > 0 and self.mode == "infer":
             if self.infer.draft is not None:
                 errs.append(
@@ -775,6 +895,26 @@ class JaxXlaRuntime:
                 "infer.numSpeculative must be >= 1, got "
                 f"{self.infer.num_speculative}"
             )
+        # HBM-budget feasibility (paper math, docs/PERF.md): a template
+        # whose per-chip state + activations exceed the accelerator's
+        # advertised HBM is rejected at admission instead of failing
+        # minutes into materialization (e.g. an 8B train on a single
+        # v5e, or 8B/v5p-64 with no fsdp axis). The estimate ignores
+        # XLA scratch/fragmentation, so only the unambiguous case —
+        # estimate > FULL capacity — is an error.
+        hbm_gb = TPU_GENERATIONS.get(self.tpu.accelerator, {}).get("hbm_gb")
+        if hbm_gb and not errs:
+            budget = self.hbm_budget_gb()
+            if budget and budget["total_gb"] > hbm_gb:
+                detail = ", ".join(
+                    f"{k}={v}" for k, v in budget.items() if k != "total_gb"
+                )
+                errs.append(
+                    f"HBM budget infeasible: estimated {budget['total_gb']}"
+                    f" GB/chip ({detail}) exceeds {self.tpu.accelerator}'s "
+                    f"{hbm_gb} GB; shard more (fsdp/tensor/pipeline), "
+                    "shrink the per-chip batch, or enable remat"
+                )
         return errs
 
     def to_dict(self) -> Dict[str, Any]:

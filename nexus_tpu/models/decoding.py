@@ -125,11 +125,25 @@ def generic_forward_decode(
     final norm (default: Llama-style rms_norm on params['final_norm']).
 
     One compiled block at any depth — same trace-once strategy as the
-    families' forward()."""
+    families' forward().
+
+    Optional cache key ``n_valid`` ((B,) int32, requires vector
+    ``length``): per-row count of REAL tokens in this feed — rows may
+    consume fewer than ``t`` slots (the serving engine's chunked prefill
+    feeds (B, T) windows where decode rows carry 1 real token and
+    admitting rows carry up to T prompt tokens). Slots at j >= n_valid[b]
+    are padding: their K/V writes are dropped (never enter the cache),
+    their logits are garbage the caller must ignore, and the returned
+    ``length`` advances by ``n_valid`` per row, not ``t``. ``n_valid`` is
+    consumed here — it is not part of the returned cache."""
     b, t = tokens.shape
     max_len = cache["k"].shape[2]
     start = cache["length"]
+    n_valid = cache.get("n_valid")  # (B,) real-token counts, or None
+    cache = {k_: v_ for k_, v_ in cache.items() if k_ != "n_valid"}
     vector_len = jnp.ndim(start) == 1  # per-row cache depths (batched spec)
+    if n_valid is not None and not vector_len:
+        raise ValueError("n_valid requires a vector (per-row) cache length")
 
     x = params["embed"].astype(cfg.dtype)[tokens]
     # rope tables for the whole buffer; slice at runtime positions
@@ -152,11 +166,16 @@ def generic_forward_decode(
     def write_cache(buf, new):
         """Append ``new`` (B, t, ...) at each row's depth: contiguous
         dynamic-slice in the scalar case, a per-row scatter (dropped when
-        out of range) in the vector case."""
+        out of range) in the vector case. Padding slots (j >= n_valid[b])
+        are pushed out of range so the drop mode discards them."""
         if not vector_len:
             return lax.dynamic_update_slice_in_dim(buf, new, start, axis=1)
         rows = jnp.arange(b)[:, None]
         pos = start[:, None] + jnp.arange(t)[None, :]
+        if n_valid is not None:
+            pos = jnp.where(
+                jnp.arange(t)[None, :] < n_valid[:, None], pos, max_len
+            )
         return buf.at[rows, pos].set(new, mode="drop")
 
     quantized = "k_scale" in cache
@@ -206,7 +225,9 @@ def generic_forward_decode(
     else:
         x = finalize(params, x)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
-    new_cache = {"k": new_bufs[0], "v": new_bufs[1], "length": start + t}
+    advance = t if n_valid is None else n_valid
+    new_cache = {"k": new_bufs[0], "v": new_bufs[1],
+                 "length": start + advance}
     if quantized:
         new_cache["k_scale"], new_cache["v_scale"] = new_bufs[2], new_bufs[3]
     return logits, new_cache

@@ -563,12 +563,23 @@ def _run_infer(runtime, family, cfg, mesh):
         ids = tokenizer.encode(inf.prompt)
         if not ids:
             raise ValueError("infer.prompt tokenized to zero tokens")
+    elif inf.prompt_token_ids:
+        # explicit ids (no tokenizer) — natural-text prompts for the
+        # speculation benches (e.g. a slice of the training corpus)
+        ids = [int(t) for t in inf.prompt_token_ids]
+        bad = [t for t in ids if not 0 <= t < cfg.vocab_size]
+        if bad:
+            raise ValueError(
+                f"infer.promptTokenIds outside vocab {cfg.vocab_size}: "
+                f"{bad[:5]}"
+            )
+    if ids is not None:
         ids = ids[: ctx - 1]
         prompt_len = len(ids)
         max_new = min(inf.max_new_tokens, ctx - prompt_len - reserve)
         if max_new <= 0:
             raise ValueError(
-                f"infer.prompt ({prompt_len} tokens) leaves no room "
+                f"infer prompt ({prompt_len} tokens) leaves no room "
                 f"for new tokens within max_seq_len {ctx}"
             )
     with mesh:
@@ -856,6 +867,7 @@ def _run_serve(runtime, family, cfg, mesh):
             cache_sharding=cache_sharding,
             lookup_ngram=sv.prompt_lookup_ngram,
             num_speculative=sv.num_speculative,
+            prefill_chunk=sv.prefill_chunk,
         )
         results, metrics = engine.serve(requests)
     finished = sum(1 for r in results if r is not None)

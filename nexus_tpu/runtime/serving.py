@@ -4,17 +4,27 @@ Static-batch decode (``autoregressive_generate``) holds every sequence
 until the LAST one finishes: a batch mixing a 10-token reply with a
 1000-token reply wastes ~half its step-slots, and new requests wait for
 the whole batch to drain. This engine serves a REQUEST QUEUE through a
-fixed-shape decode batch instead — iteration-level scheduling:
+fixed-shape decode batch instead — iteration-level scheduling with
+CHUNKED PREFILL:
 
   * the KV cache runs VECTOR lengths (per-row depths, the same
     models/decoding.py scaffold that batched speculation uses), so every
     row decodes at its own position with its own causal mask and rows
     never interact;
-  * when a row finishes (stop token or budget), the engine PREFILLS the
-    next queued request into a single-row cache and scatters it into the
-    freed row between decode chunks — admission never recompiles the
-    decode step (prompt lengths are bucketed so prefill compiles once
-    per bucket, not once per length);
+  * prompts are NOT prefilled in a separate dispatch. Admission writes
+    the prompt into a per-row token buffer (one tiny scatter), and the
+    decode chunk program itself streams it through the model at
+    ``prefill_chunk`` tokens per step for that row while every other
+    row keeps committing decode tokens — prefill never serializes with
+    decode, the round-3 limitation this design replaces (the old
+    bucketed-prefill engine measured 16 rows SLOWER than 4 because each
+    admission stalled all rows for a full prompt forward + dispatch;
+    docs/PERF.md "serve-row-scaling"). The mechanism is the per-row
+    ``n_valid`` feed width of ``generic_forward_decode``: each step
+    feeds a (B, T) window where decode rows carry 1 real token and
+    admitting rows carry up to T prompt tokens — the extra slots ride
+    the same weight reads a 1-token step already pays for (decode is
+    HBM-bound on parameters, so a modest T is nearly free on TPU);
   * decode runs in chunks of ``chunk`` steps under one dispatch
     (``lax.scan``), the host inspects the emitted tokens at chunk
     boundaries — the scheduling granularity / dispatch overhead
@@ -24,34 +34,31 @@ fixed-shape decode batch instead — iteration-level scheduling:
     empty.
 
 Exactness contract: a request's output is a function of the request
-alone — never of its row, its batch co-residents, or the engine's batch
-size. At temperature 0 that is EXACTLY the model's greedy decode of the
-prompt in isolation (tests/test_serving.py proves it against
-``autoregressive_generate`` row for row); at temperature > 0 the
-sampling key is (request seed, buffer position), so the sampled stream
-is reproducible and batch-invariant (also tested). Continuous batching
-changes only WHEN work is scheduled, never what is computed.
+alone — never of its row, its batch co-residents, the engine's batch
+size, or the prefill chunking. At temperature 0 that is EXACTLY the
+model's greedy decode of the prompt in isolation (tests/test_serving.py
+proves it against ``autoregressive_generate`` row for row — chunked
+prefill computes each prompt query over the same keys with the same
+mask as a monolithic prefill, so the numbers are identical); at
+temperature > 0 the sampling key is (request seed, buffer position), so
+the sampled stream is reproducible and batch-invariant (also tested).
+Continuous batching changes only WHEN work is scheduled, never what is
+computed.
 
-TPU-shaped: one compiled decode step for the whole serve loop (static
-shapes), one compiled prefill per prompt-length bucket, admission =
-one scatter. The fp KV-cache layout only (the int8 cache's scale planes
-would double the insert surface; quantized serving stays on the static
-path for now).
-
-Known limitation: admission prefill SERIALIZES with decode — while a
-freed row's next request prefills, the other rows idle (one device, one
-program at a time). At high turnover with long prompts this caps
-utilization; the next step would be chunked prefill (interleaving
-prompt chunks into decode dispatches), which changes the chunk program
-and is not yet worth its complexity at the measured utilizations
-(89% at 4 rows, docs/PERF.md).
+TPU-shaped: ONE compiled decode-chunk program and ONE tiny insert
+program for the whole serve loop (static shapes) — no per-prompt-length
+bucket compiles, no admission-time forwards. Both cache layouts serve:
+the int8 cache (cfg.kv_cache_quantized) rides the same scaffold —
+chunked prefill means admission never touches K/V, so the scale planes
+need no insert-time handling (the surface that blocked int8 serving in
+the bucketed-prefill design).
 """
 
 from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -59,8 +66,6 @@ import numpy as np
 from jax import lax
 
 from nexus_tpu.models.decoding import init_kv_cache
-
-PREFILL_BUCKET = 64  # prompt lengths round up to this (compile-count bound)
 
 
 @dataclass
@@ -116,8 +121,16 @@ class ServingEngine:
         sample_seed: int = 0,
         lookup_ngram: int = 0,
         num_speculative: int = 4,
+        prefill_chunk: int = 8,
     ):
-        """``lookup_ngram > 0`` switches the decode chunks to SPECULATIVE
+        """``prefill_chunk`` (T): prompt tokens an admitting row consumes
+        per decode step. A T-slot feed costs every row T slots of matmul
+        work, but decode steps are parameter-read-bound, so small T is
+        nearly free while prefilling a P-token prompt in ceil(P/T) steps
+        instead of P (sweepable on-chip; T=1 degrades to pure
+        teacher-forcing admission).
+
+        ``lookup_ngram > 0`` switches the decode chunks to SPECULATIVE
         rounds: each round proposes ``num_speculative`` tokens by n-gram
         prompt lookup from the row's own committed text (the engine keeps
         a device-side token buffer per row), verifies them in ONE
@@ -126,13 +139,10 @@ class ServingEngine:
         continuous batching. Greedy-exact: outputs equal the plain
         engine's token for token (tested); a chunk runs
         ``ceil(chunk / (k+1))`` rounds so its committed-token budget
-        matches a plain chunk's. Greedy only (requests with
-        temperature > 0 are rejected at admission)."""
-        if getattr(cfg, "kv_cache_quantized", False):
-            raise ValueError(
-                "ServingEngine supports the fp KV cache only; unset "
-                "kv_cache_quantized (int8 serving: use the static batch path)"
-            )
+        matches a plain chunk's. Prefilling rows ride the same rounds:
+        their (k+1)-wide verify window carries prompt tokens instead of
+        proposals. Greedy only (requests with temperature > 0 are
+        rejected at admission)."""
         self._fwd = forward_decode
         self._params = params
         self._cfg = cfg
@@ -146,15 +156,17 @@ class ServingEngine:
         self._stop = int(stop_token_id)
         self._chunk = int(chunk)
         self._cache_sharding = cache_sharding
-        self._prefill_cache: Dict[Any, Callable] = {}
-        self._warmed: Dict[int, set] = {}  # bucket -> compiled group sizes
-        self._prefill_dispatches = 0
         self._base_key = jax.random.PRNGKey(int(sample_seed))
         self._lookup = int(lookup_ngram)
         self._k = int(num_speculative)
         if self._lookup and self._k < 1:
             raise ValueError(
                 f"num_speculative must be >= 1, got {self._k}"
+            )
+        self._t = int(prefill_chunk)
+        if self._t < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
             )
         # rounds per dispatch: one round = one target forward committing
         # 1..k+1 tokens, so this keeps a spec chunk's committed-token
@@ -173,6 +185,9 @@ class ServingEngine:
         cfg_ = cfg
         fwd = forward_decode
         C = self._chunk
+        T = self._t
+        B = self._b
+        max_len_ = self._max_len
         base_key = self._base_key
 
         def _pick(logits_row, temp, seed, pos):
@@ -187,105 +202,176 @@ class ServingEngine:
                 temp > 0.0, sampled, jnp.argmax(logits_row, axis=-1)
             ).astype(jnp.int32)
 
-        def _decode_chunk(params, cache, tok, done, temp, seed):
-            """C decode steps in ONE dispatch. ``done`` rows emit their
-            held token and roll their pointer back each step (the write
-            lands on the same slot next step — no growth, no overflow)."""
+        def _decode_chunk(params, cache, tok, ptr, done, buf, plen,
+                          temp, seed):
+            """C steps in ONE dispatch; each step feeds a (B, T) window.
+            Decode rows carry 1 real token (slot 0 = ``tok``), admitting
+            rows carry up to T prompt tokens gathered from ``buf`` at
+            ``ptr`` — the scaffold's per-row ``n_valid`` drops the
+            padding slots' K/V writes and advances each row's cache
+            depth by its real token count. ``done`` rows emit their held
+            token and roll their pointer back each step (the write lands
+            on the same slot next step — no growth, no overflow)."""
 
             def step(carry, _):
-                cache, tok, done = carry
-                logits, cache2 = fwd(params, cfg_, tok[:, None], cache)
+                cache, tok, ptr = carry
+                prefilling = (ptr < plen) & ~done
+                n_valid = jnp.where(
+                    prefilling, jnp.minimum(T, plen - ptr), 1
+                ).astype(jnp.int32)
+                pos = jnp.clip(
+                    ptr[:, None] + jnp.arange(T)[None, :], 0, max_len_ - 1
+                )
+                feed = jnp.where(
+                    prefilling[:, None],
+                    jnp.take_along_axis(buf, pos, axis=1),
+                    tok[:, None],
+                )
+                cache_in = dict(cache)
+                cache_in["n_valid"] = n_valid
+                logits, cache2 = fwd(params, cfg_, feed, cache_in)
                 cache2 = dict(cache2)
                 cache2["length"] = jnp.where(
                     done, cache["length"], cache2["length"]
                 )
                 # the sampled token's buffer position is the post-feed
-                # length — the key input that makes sampling positional
+                # length — the key input that makes sampling positional.
+                # Each row's real last slot is n_valid-1 (slot 0 for
+                # decode rows; the final prompt token for a row that
+                # finishes its prefill this step).
+                pick_logits = jnp.take_along_axis(
+                    logits, (n_valid - 1)[:, None, None].astype(jnp.int32),
+                    axis=1,
+                )[:, 0]
                 nxt = jax.vmap(_pick)(
-                    logits[:, -1], temp, seed, cache2["length"]
+                    pick_logits, temp, seed, cache2["length"]
                 ).astype(tok.dtype)
-                nxt = jnp.where(done, tok, nxt)
-                return (cache2, nxt, done), nxt
+                finish = prefilling & (plen - ptr <= T)
+                emit = (~done) & (finish | ~prefilling)
+                nxt = jnp.where(emit, nxt, tok)
+                ptr2 = jnp.where(prefilling, ptr + n_valid, ptr)
+                return (cache2, nxt, ptr2), (nxt, emit)
 
-            (cache, tok, done), toks = lax.scan(
-                step, (cache, tok, done), None, length=C
+            (cache, tok, ptr), (toks, emits) = lax.scan(
+                step, (cache, tok, ptr), None, length=C
             )
-            return cache, tok, toks  # toks: (C, B)
+            return cache, tok, ptr, toks, emits  # (C, B), (C, B)
 
         self._pick = _pick
 
-        def _insert(cache, row, row_k, row_v, length, tok_vec, first_tok,
-                    temp_vec, req_temp, seed_vec, req_seed):
-            """Scatter one prefilled request into a freed batch row."""
+        def _insert_wave(cache, buf, ptr, plen, temp_vec, seed_vec,
+                         rows, prompts, ps, temps, seeds):
+            """Admit up to B requests in ONE tiny dispatch: write each
+            prompt into its row of the token buffer and reset the row's
+            prefill pointer + cache depth. Unused wave slots carry an
+            out-of-range row index and scatter-drop. The K/V buffers are
+            untouched — stale data beyond a row's (reset) length is
+            invisible to the length-masked attention and is overwritten
+            as the prompt streams in."""
             cache = dict(cache)
-            cache["k"] = cache["k"].at[:, row].set(row_k[:, 0])
-            cache["v"] = cache["v"].at[:, row].set(row_v[:, 0])
-            cache["length"] = cache["length"].at[row].set(length)
-            return (
-                cache,
-                tok_vec.at[row].set(first_tok),
-                temp_vec.at[row].set(req_temp),
-                seed_vec.at[row].set(req_seed),
-            )
+            cache["length"] = cache["length"].at[rows].set(0, mode="drop")
+            buf = buf.at[rows].set(prompts, mode="drop")
+            ptr = ptr.at[rows].set(0, mode="drop")
+            plen = plen.at[rows].set(ps, mode="drop")
+            temp_vec = temp_vec.at[rows].set(temps, mode="drop")
+            seed_vec = seed_vec.at[rows].set(seeds, mode="drop")
+            return cache, buf, ptr, plen, temp_vec, seed_vec
 
         # ---- speculative (prompt-lookup) variants ----
         k_spec, g_spec, R = self._k, self._lookup, self._rounds
-        rows_idx = jnp.arange(self._b)
+        W = k_spec + 1
+        rows_idx = jnp.arange(B)
 
-        def _spec_chunk(params, cache, tok, done, buf):
-            """R speculative rounds in ONE dispatch: propose k by n-gram
-            lookup in each row's committed text, verify in one k+1-wide
-            forward, commit the accepted prefix (models/decoding.py's
-            prompt-lookup round under per-row freezing)."""
+        def _spec_chunk(params, cache, tok, ptr, done, buf, plen):
+            """R speculative rounds in ONE dispatch: decode rows propose
+            k by n-gram lookup in their committed text and verify in one
+            k+1-wide forward; PREFILLING rows ride the same forward with
+            k+1 prompt tokens in their window instead (chunked prefill
+            at T = k+1), emitting their first token the round their
+            prompt completes. Commit + rollback-by-pointer go through
+            models/decoding.py's shared helpers."""
             from nexus_tpu.models.decoding import (
                 _commit_speculation,
                 _greedy_accept,
                 prompt_lookup_propose,
             )
 
-            max_len_ = buf.shape[1]
-
             def round_(carry, _):
-                cache, tok, done, buf = carry
+                cache, tok, ptr, buf = carry
+                prefilling = (ptr < plen) & ~done
+                active = ~done & ~prefilling
                 last_pos = cache["length"]  # (B,) == tok's buffer position
                 proposals, _found = prompt_lookup_propose(
                     buf, last_pos, k_spec, g_spec
                 )
-                block = jnp.concatenate([tok[:, None], proposals], axis=1)
-                logits, cache2 = fwd(params, cfg_, block, cache)
+                pf_pos = jnp.clip(
+                    ptr[:, None] + jnp.arange(W)[None, :], 0, max_len_ - 1
+                )
+                block = jnp.where(
+                    prefilling[:, None],
+                    jnp.take_along_axis(buf, pf_pos, axis=1),
+                    jnp.concatenate([tok[:, None], proposals], axis=1),
+                )
+                n_valid = jnp.where(
+                    prefilling, jnp.minimum(W, plen - ptr), W
+                ).astype(jnp.int32)
+                cache_in = dict(cache)
+                cache_in["n_valid"] = n_valid
+                logits, cache2 = fwd(params, cfg_, block, cache_in)
+                cache2 = dict(cache2)
                 target_choice = jnp.argmax(logits, axis=-1).astype(tok.dtype)
                 accepted, out = _greedy_accept(proposals, target_choice)
-                accepted = jnp.where(done, 0, accepted)
+                accepted = jnp.where(active, accepted, 0)
                 # commit + rollback-by-pointer via the SHARED helper (the
                 # subtle invariants — frozen-row scatter drop, correction
                 # token's K/V arriving on the next feed — live in
-                # models/decoding.py, once)
-                buf, _n_new, new_len = _commit_speculation(
-                    buf, rows_idx, last_pos, ~done, accepted, out, k_spec,
-                    max_len_, cache["length"],
+                # models/decoding.py, once). Non-active rows keep the
+                # scaffold's length (prefill advance) or roll back (done).
+                keep_len = jnp.where(
+                    done, cache["length"], cache2["length"]
                 )
-                new_tok = jnp.where(done, tok, out[rows_idx, accepted])
-                cache2 = dict(cache2)
+                buf, _n_new, new_len = _commit_speculation(
+                    buf, rows_idx, last_pos, active, accepted, out, k_spec,
+                    max_len_, keep_len,
+                )
                 cache2["length"] = new_len
-                return (cache2, new_tok, done, buf), (out, accepted)
+                finish = prefilling & (plen - ptr <= W)
+                # a finishing row's first token reads the logits at its
+                # real last prompt slot, lands in buf[plen] (committed
+                # text the lookup proposer sees), and becomes next
+                # round's feed — its K/V arrives on that feed, the same
+                # invariant as a correction token
+                first_tok = jnp.take_along_axis(
+                    target_choice, (n_valid - 1)[:, None], axis=1
+                )[:, 0]
+                wpos = jnp.where(finish, plen, max_len_ + 1)
+                buf = buf.at[rows_idx, wpos].set(first_tok, mode="drop")
+                new_tok = jnp.where(
+                    active, out[rows_idx, accepted],
+                    jnp.where(finish, first_tok, tok),
+                )
+                ptr2 = jnp.where(prefilling, ptr + n_valid, ptr)
+                # emitted tokens this round: decode rows commit
+                # accepted+1 from `out`; a finishing row emits exactly
+                # its first token (stored into out slot 0 for the host)
+                out = jnp.where(
+                    finish[:, None] & (jnp.arange(W) == 0)[None, :],
+                    first_tok[:, None], out,
+                )
+                n_emit = jnp.where(
+                    active, accepted + 1, jnp.where(finish, 1, 0)
+                )
+                return (cache2, new_tok, ptr2, buf), (
+                    out, accepted, n_emit, active,
+                )
 
-            (cache, tok, done, buf), (outs, accs) = lax.scan(
-                round_, (cache, tok, done, buf), None, length=R
+            (cache, tok, ptr, buf), (outs, accs, n_emits, actives) = (
+                lax.scan(round_, (cache, tok, ptr, buf), None, length=R)
             )
-            return cache, tok, buf, outs, accs  # (R, B, k+1), (R, B)
+            # outs (R, B, k+1); accs/n_emits/actives (R, B)
+            return cache, tok, ptr, buf, outs, accs, n_emits, actives
 
-        def _insert_spec(cache, row, row_k, row_v, length, tok_vec,
-                         first_tok, temp_vec, req_temp, seed_vec, req_seed,
-                         buf, prompt_row):
-            cache, tok_vec, temp_vec, seed_vec = _insert(
-                cache, row, row_k, row_v, length, tok_vec, first_tok,
-                temp_vec, req_temp, seed_vec, req_seed,
-            )
-            buf = buf.at[row].set(prompt_row)
-            buf = buf.at[row, length].set(first_tok)
-            return cache, tok_vec, temp_vec, seed_vec, buf
-
-        # donate the cache (and the token vector in insert): XLA updates
+        # donate the cache (and the spec path's token buffer): XLA updates
         # the K/V buffers in place instead of copying the multi-GB cache
         # every chunk (same pattern as train/trainer.py's donated state).
         # CPU can't donate and would warn on every dispatch — TPU only.
@@ -296,65 +382,15 @@ class ServingEngine:
             _decode_chunk, donate_argnums=(1,) if donate else ()
         )
         self._insert_fn = jax.jit(
-            _insert, donate_argnums=(0, 5, 7, 9) if donate else ()
+            _insert_wave,
+            donate_argnums=(0, 1, 2, 3, 4, 5) if donate else (),
         )
         self._spec_chunk = jax.jit(
-            _spec_chunk, donate_argnums=(1, 4) if donate else ()
+            _spec_chunk, donate_argnums=(1, 5) if donate else ()
         )
-        self._insert_spec_fn = jax.jit(
-            _insert_spec,
-            donate_argnums=(0, 5, 7, 9, 11) if donate else (),
-        )
-
-    def _prefill(self, bucket: int, n: int) -> Callable:
-        """Compile-once-per-(bucket, group-size) prefill: n right-padded
-        prompts (n, Pb) through ONE forward — simultaneously freed rows
-        admit in one dispatch instead of n (prefill serializes with
-        decode, so dispatch count is the admission tax; measured in the
-        16-row probe, docs/PERF.md). Each row's first generated token
-        reads the logits at ITS real last prompt position. K/V written
-        past a row's real_len is garbage, but each decode step overwrites
-        its slot before the mask can expose it (position p is written at
-        the same step whose query first sees p). Group sizes are padded
-        to powers of two (dummy rows: one zero token) to bound the
-        compile count."""
-        key = (bucket, n)
-        if key in self._prefill_cache:
-            return self._prefill_cache[key]
-        cfg_, fwd = self._cfg, self._fwd
-        max_len = self._max_len
-        pick = self._pick
-
-        def prefill(params, prompts, real_lens, temps, seeds):
-            # group-local cache; the BATCH cache carries the serving
-            # sharding and the insert scatter lands into it
-            cache = init_kv_cache(
-                cfg_.n_layers, cfg_.n_kv_heads, cfg_.head_dim, cfg_.dtype,
-                n, max_len,
-            )
-            logits, cache = fwd(params, cfg_, prompts, cache)
-            last = jnp.take_along_axis(
-                logits, (real_lens - 1)[:, None, None].astype(jnp.int32),
-                axis=1,
-            )[:, 0]  # (n, V)
-            # each first token sits at its row's buffer position real_len
-            firsts = jax.vmap(pick)(last, temps, seeds, real_lens).astype(
-                prompts.dtype
-            )
-            return cache["k"], cache["v"], firsts
-
-        fn = jax.jit(prefill)
-        self._prefill_cache[key] = fn
-        return fn
-
-    def _bucket_of(self, p: int) -> int:
-        """Prompt length -> prefill bucket (shared by validation, warm-up,
-        and the initial-wave scan — these MUST agree or warmed compiles
-        desynchronize from admission keys)."""
-        return min(-(-p // PREFILL_BUCKET) * PREFILL_BUCKET, self._max_len)
 
     def _validate_request(self, req: ServeRequest, req_idx: int):
-        """Per-request admission checks → (prompt, p, budget, bucket)."""
+        """Per-request admission checks → (prompt, p, budget)."""
         prompt = np.asarray(req.prompt, dtype=np.int32)
         p = int(prompt.shape[0])
         if p < 1:
@@ -377,146 +413,68 @@ class ServingEngine:
                 f"({self._slack}) leaves no decode budget within "
                 f"max_len {self._max_len}"
             )
-        return prompt, p, budget, self._bucket_of(p)
+        return prompt, p, budget
 
-    @staticmethod
-    def _group_pad(n: int) -> int:
-        pad = 1
-        while pad < n:
-            pad *= 2
-        return pad
-
-    def _admit_group(self, cache, tok_vec, temp_vec, seed_vec, buf,
-                     admissions):
-        """Admit several requests with ONE prefill dispatch per prompt
-        bucket (admission serializes with decode, so dispatches are the
-        tax — simultaneously freed rows share a forward). ``admissions``:
-        [(row, req, req_idx), ...]. Returns the updated device state plus
-        [(row, _RowState), ...] in admission order per bucket group."""
-        prepared = [
-            (row, req_idx, req, *self._validate_request(req, req_idx))
-            for row, req, req_idx in admissions
-        ]
-        by_bucket = {}
-        for item in prepared:
-            by_bucket.setdefault(item[6], []).append(item)
+    def _admit_wave(self, cache, buf, ptr, plen, temp_vec, seed_vec,
+                    admissions):
+        """Admit up to B requests with ONE insert dispatch: stack the
+        wave's prompts into fixed (B, max_len) arrays (unused slots
+        scatter-drop via an out-of-range row index) and write them into
+        the device state. No model forward happens here — the chunk
+        program streams each prompt in-band. ``admissions``:
+        [(row, req, req_idx), ...] → [(row, _RowState), ...]."""
+        b, max_len = self._b, self._max_len
+        rows = np.full((b,), b, dtype=np.int32)  # b == dropped slot
+        prompts = np.zeros((b, max_len), dtype=np.int32)
+        ps = np.zeros((b,), dtype=np.int32)
+        temps = np.zeros((b,), dtype=np.float32)
+        seeds = np.zeros((b,), dtype=np.int32)
         out = []
-        subgroups = []
-        for bucket, group in by_bucket.items():
-            # split into group sizes the warm-up already compiled: a
-            # mid-run XLA compile (~10 s on the tunnel) costs far more
-            # than the dispatches batching saves. Prefer padding UP to
-            # the smallest warmed size that fits the whole remainder
-            # (dummy rows are cheap; an extra dispatch is not); fall back
-            # to the largest warmed size below it. Size 1 is always warm.
-            warmed = sorted(self._warmed.get(bucket, {1}))
-            i = 0
-            while i < len(group):
-                remaining = len(group) - i
-                geq = [w for w in warmed if w >= remaining]
-                n_pad = (
-                    min(geq) if geq
-                    else max(w for w in warmed if w <= remaining)
-                )
-                take = min(n_pad, remaining)
-                subgroups.append((bucket, group[i:i + take], n_pad))
-                i += take
-        for bucket, group, n_pad in subgroups:
-            prompts = np.zeros((n_pad, bucket), dtype=np.int32)
-            lens = np.ones((n_pad,), dtype=np.int32)  # dummy rows: 1 token
-            temps = np.zeros((n_pad,), dtype=np.float32)
-            seeds = np.zeros((n_pad,), dtype=np.int32)
-            for i, (_row, _ri, req, prompt, p, _b, _bk) in enumerate(group):
-                prompts[i, :p] = prompt
-                lens[i] = p
-                temps[i] = req.temperature
-                seeds[i] = req.seed
-            ks, vs, firsts = self._prefill(bucket, n_pad)(
-                self._params, jnp.asarray(prompts), jnp.asarray(lens),
-                jnp.asarray(temps), jnp.asarray(seeds),
-            )
-            self._prefill_dispatches += 1
-            firsts_np = np.asarray(firsts)
-            for i, (row, req_idx, req, prompt, p, budget, _bk) in enumerate(
-                group
-            ):
-                first = jnp.asarray(int(firsts_np[i]), jnp.int32)
-                temp = jnp.asarray(req.temperature, jnp.float32)
-                seed = jnp.asarray(req.seed, jnp.int32)
-                if self._lookup:
-                    prompt_row = np.zeros((self._max_len,), dtype=np.int32)
-                    prompt_row[:p] = prompt
-                    (cache, tok_vec, temp_vec, seed_vec,
-                     buf) = self._insert_spec_fn(
-                        cache, jnp.asarray(row, jnp.int32),
-                        ks[:, i:i + 1], vs[:, i:i + 1],
-                        jnp.asarray(p, jnp.int32), tok_vec, first,
-                        temp_vec, temp, seed_vec, seed,
-                        buf, jnp.asarray(prompt_row),
-                    )
-                else:
-                    cache, tok_vec, temp_vec, seed_vec = self._insert_fn(
-                        cache, jnp.asarray(row, jnp.int32),
-                        ks[:, i:i + 1], vs[:, i:i + 1],
-                        jnp.asarray(p, jnp.int32), tok_vec, first,
-                        temp_vec, temp, seed_vec, seed,
-                    )
-                state = _RowState(request_idx=req_idx, budget=budget)
-                state.emitted.append(int(firsts_np[i]))
-                out.append((row, state))
-        return cache, tok_vec, temp_vec, seed_vec, buf, out
+        for i, (row, req, req_idx) in enumerate(admissions):
+            prompt, p, budget = self._validate_request(req, req_idx)
+            rows[i] = row
+            prompts[i, :p] = prompt
+            ps[i] = p
+            temps[i] = req.temperature
+            seeds[i] = req.seed
+            out.append((row, _RowState(request_idx=req_idx, budget=budget)))
+            self._prefill_steps += -(-p // (
+                (self._k + 1) if self._lookup else self._t
+            ))
+        cache, buf, ptr, plen, temp_vec, seed_vec = self._insert_fn(
+            cache, buf, ptr, plen, temp_vec, seed_vec,
+            jnp.asarray(rows), jnp.asarray(prompts), jnp.asarray(ps),
+            jnp.asarray(temps), jnp.asarray(seeds),
+        )
+        self._insert_dispatches += 1
+        return cache, buf, ptr, plen, temp_vec, seed_vec, out
 
     def serve(self, requests: Sequence[ServeRequest]):
         """Run the queue to completion → (results, metrics).
 
         results[i] corresponds to requests[i]. Metrics: committed vs
         scheduled step-slots (the continuous-batching win is this
-        utilization staying high under uneven lengths), chunk count,
-        wall time, decode tokens/sec over committed tokens.
+        utilization staying high under uneven lengths — in-band prefill
+        steps are scheduled slots, so admission cost shows up here
+        honestly), chunk count, wall time, decode tokens/sec over
+        committed tokens.
 
-        The decode chunk and every prefill bucket the queue will need are
-        compiled BEFORE the clock starts — tokens/sec and the per-request
-        latencies measure serving, not XLA compilation (the infer bench
-        warms the same way)."""
+        The two programs (decode chunk + insert) are compiled BEFORE the
+        clock starts — tokens/sec and the per-request latencies measure
+        serving, not XLA compilation (the infer bench warms the same
+        way)."""
         b, max_len = self._b, self._max_len
         cfg = self._cfg
+        # int8 KV serving rides the same scaffold as static decode: the
+        # chunk program quantizes on write and the insert path never
+        # touches K/V (chunked prefill streams the prompt in-band), so
+        # the scale planes need no admission-time handling at all
+        quantized = bool(getattr(cfg, "kv_cache_quantized", False))
 
         # ---- warm-up (outside the timed window) ----
-        # compile every (bucket, 1) the queue can need (steady-state
-        # turnover admits mostly single rows), (bucket, 2) where two
-        # same-bucket requests exist, and the exact group sizes of the
-        # INITIAL admission wave; mid-run waves only ever use these
-        # warmed sizes (the splitter pads up or splits down — no
-        # mid-run compiles)
-        totals = {}
-        for req in requests:
-            if len(req.prompt) >= 1:
-                bk = self._bucket_of(len(req.prompt))
-                totals[bk] = totals.get(bk, 0) + 1
-        warm_keys = {(bucket, 1) for bucket in totals}
-        if b > 1:  # steady-state turnover often frees 2 rows per chunk —
-            # but a size-2 group needs two same-bucket requests to exist
-            warm_keys |= {
-                (bucket, 2) for bucket, n in totals.items() if n >= 2
-            }
-        initial = {}
-        for req in requests[:b]:
-            if len(req.prompt) >= 1:
-                bk = self._bucket_of(len(req.prompt))
-                initial[bk] = initial.get(bk, 0) + 1
-        for bk, n in initial.items():
-            warm_keys.add((bk, self._group_pad(n)))
-        self._warmed = {}
-        for bucket, n in sorted(warm_keys):
-            self._prefill(bucket, n)(
-                self._params, jnp.zeros((n, bucket), jnp.int32),
-                jnp.ones((n,), jnp.int32), jnp.zeros((n,), jnp.float32),
-                jnp.zeros((n,), jnp.int32),
-            )
-            self._warmed.setdefault(bucket, set()).add(n)
         warm_cache = init_kv_cache(
             cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
-            b, max_len,
+            b, max_len, quantized=quantized,
         )
         if self._cache_sharding is not None:
             # warm with the REAL layout or jit compiles a second program
@@ -526,26 +484,44 @@ class ServingEngine:
                     warm_cache[key], self._cache_sharding
                 )
         warm_cache["length"] = jnp.zeros((b,), jnp.int32)
+        warm_buf = jnp.zeros((b, max_len), jnp.int32)
+
+        def zi():
+            # donation demands DISTINCT buffers per donated argnum (a
+            # shared array would be both donated twice in one call and
+            # dead for the next one) — mint a fresh array per use
+            return jnp.zeros((b,), jnp.int32)
+
+        def zf():
+            return jnp.zeros((b,), jnp.float32)
+
+        # the insert consumes its donated inputs; thread its RETURNS
+        # into the chunk warm-up instead of reusing dead arrays
+        (warm_cache, warm_buf, warm_ptr, warm_plen, warm_temp,
+         warm_seed) = self._insert_fn(
+            warm_cache, warm_buf, zi(), zi(), zf(), zi(),
+            jnp.full((b,), b, jnp.int32),
+            jnp.zeros((b, max_len), jnp.int32), zi(), zf(), zi(),
+        )
         if self._lookup:
-            _, _, _, outs, _ = self._spec_chunk(
-                self._params, warm_cache, jnp.zeros((b,), jnp.int32),
-                jnp.ones((b,), jnp.bool_),
-                jnp.zeros((b, max_len), jnp.int32),
+            out = self._spec_chunk(
+                self._params, warm_cache, zi(), warm_ptr,
+                jnp.ones((b,), jnp.bool_), warm_buf, warm_plen,
             )
-            np.asarray(outs)  # host fetch: the warm-up really completed
+            np.asarray(out[4])  # host fetch: the warm-up really completed
         else:
-            _, _, toks = self._decode_chunk(
-                self._params, warm_cache, jnp.zeros((b,), jnp.int32),
-                jnp.ones((b,), jnp.bool_), jnp.zeros((b,), jnp.float32),
-                jnp.zeros((b,), jnp.int32),
+            out = self._decode_chunk(
+                self._params, warm_cache, zi(), warm_ptr,
+                jnp.ones((b,), jnp.bool_), warm_buf, warm_plen,
+                warm_temp, warm_seed,
             )
-            np.asarray(toks)  # host fetch: the warm-up really completed
-        del warm_cache
+            np.asarray(out[3])  # host fetch: the warm-up really completed
+        del warm_cache, warm_buf, out
 
         t0 = time.monotonic()
         cache = init_kv_cache(
             cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
-            b, max_len,
+            b, max_len, quantized=quantized,
         )
         if self._cache_sharding is not None:
             cache = dict(cache)
@@ -554,12 +530,12 @@ class ServingEngine:
                     cache[key], self._cache_sharding
                 )
         cache["length"] = jnp.zeros((b,), jnp.int32)  # vector from step 0
+        buf = jnp.zeros((b, max_len), jnp.int32)
         tok_vec = jnp.zeros((b,), jnp.int32)
+        ptr_vec = jnp.zeros((b,), jnp.int32)
+        plen_vec = jnp.zeros((b,), jnp.int32)
         temp_vec = jnp.zeros((b,), jnp.float32)
         seed_vec = jnp.zeros((b,), jnp.int32)
-        buf = (
-            jnp.zeros((b, max_len), jnp.int32) if self._lookup else None
-        )
         rows: List[Optional[_RowState]] = [None] * b
         results: List[Optional[ServeResult]] = [None] * len(requests)
         next_req = 0
@@ -569,7 +545,8 @@ class ServingEngine:
         target_forwards = 0
         drafted = 0
         accepted_total = 0
-        self._prefill_dispatches = 0
+        self._insert_dispatches = 0
+        self._prefill_steps = 0
 
         def finish(state: _RowState) -> None:
             nonlocal committed
@@ -587,30 +564,22 @@ class ServingEngine:
             return state.stopped or len(state.emitted) >= state.budget
 
         def admit_into(free_rows):
-            """Fill free rows from the queue, batching each wave's prefills
-            by bucket (one dispatch per bucket per wave). A request whose
-            FIRST token is already the stop token finishes immediately and
-            its row re-enters the free pool for the next wave."""
-            nonlocal cache, tok_vec, temp_vec, seed_vec, buf, next_req
+            """Fill free rows from the queue — one insert dispatch per
+            wave; the prompts stream through the next chunks in-band."""
+            nonlocal cache, buf, ptr_vec, plen_vec, temp_vec, seed_vec
+            nonlocal next_req
+            if not free_rows or next_req >= len(requests):
+                return
+            wave = []
             while free_rows and next_req < len(requests):
-                wave = []
-                while free_rows and next_req < len(requests):
-                    wave.append(
-                        (free_rows.pop(0), requests[next_req], next_req)
-                    )
-                    next_req += 1
-                (cache, tok_vec, temp_vec, seed_vec, buf,
-                 admitted) = self._admit_group(
-                    cache, tok_vec, temp_vec, seed_vec, buf, wave,
-                )
-                for row, state in admitted:
-                    if self._stop >= 0 and state.emitted[-1] == self._stop:
-                        state.stopped = True
-                    if row_done(state):
-                        finish(state)
-                        free_rows.append(row)
-                    else:
-                        rows[row] = state
+                wave.append((free_rows.pop(0), requests[next_req], next_req))
+                next_req += 1
+            (cache, buf, ptr_vec, plen_vec, temp_vec, seed_vec,
+             admitted) = self._admit_wave(
+                cache, buf, ptr_vec, plen_vec, temp_vec, seed_vec, wave,
+            )
+            for row, state in admitted:
+                rows[row] = state
 
         admit_into([r for r in range(b) if rows[r] is None])
 
@@ -619,23 +588,28 @@ class ServingEngine:
                 [r is None or row_done(r) for r in rows], jnp.bool_
             )
             if self._lookup:
-                cache, tok_vec, buf, outs, accs = self._spec_chunk(
-                    self._params, cache, tok_vec, done_vec, buf
+                (cache, tok_vec, ptr_vec, buf, outs, accs, n_emits,
+                 actives) = self._spec_chunk(
+                    self._params, cache, tok_vec, ptr_vec, done_vec, buf,
+                    plen_vec,
                 )
                 chunks += 1
                 # one verify scores k+1 positions; utilization over them
                 # is acceptance-sensitive by design
                 scheduled_slots += self._rounds * (self._k + 1) * b
-                host_outs = np.asarray(outs)   # (R, B, k+1)
-                host_accs = np.asarray(accs)   # (R, B)
+                host_outs = np.asarray(outs)      # (R, B, k+1)
+                host_accs = np.asarray(accs)      # (R, B)
+                host_emits = np.asarray(n_emits)  # (R, B)
+                host_actives = np.asarray(actives)
             else:
-                cache, tok_vec, toks = self._decode_chunk(
-                    self._params, cache, tok_vec, done_vec, temp_vec,
-                    seed_vec,
+                cache, tok_vec, ptr_vec, toks, emits = self._decode_chunk(
+                    self._params, cache, tok_vec, ptr_vec, done_vec,
+                    buf, plen_vec, temp_vec, seed_vec,
                 )
                 chunks += 1
                 scheduled_slots += self._chunk * b
-                host_toks = np.asarray(toks)  # (C, B)
+                host_toks = np.asarray(toks)    # (C, B)
+                host_emits = np.asarray(emits)  # (C, B)
             for r in range(b):
                 state = rows[r]
                 if state is None:
@@ -644,11 +618,11 @@ class ServingEngine:
                     for ri in range(self._rounds):
                         if row_done(state):
                             break
-                        n = int(host_accs[ri, r]) + 1
-                        target_forwards += 1
-                        drafted += self._k
-                        accepted_total += int(host_accs[ri, r])
-                        for t in host_outs[ri, r, :n]:
+                        if host_actives[ri, r]:
+                            target_forwards += 1
+                            drafted += self._k
+                            accepted_total += int(host_accs[ri, r])
+                        for t in host_outs[ri, r, :int(host_emits[ri, r])]:
                             if row_done(state):
                                 break
                             state.emitted.append(int(t))
@@ -658,6 +632,8 @@ class ServingEngine:
                     for c in range(self._chunk):
                         if row_done(state):
                             break
+                        if not host_emits[c, r]:
+                            continue  # the row was prefilling this step
                         t = int(host_toks[c, r])
                         state.emitted.append(t)
                         if self._stop >= 0 and t == self._stop:
@@ -666,7 +642,7 @@ class ServingEngine:
                     finish(state)
                     rows[r] = None
             # admit the next queued requests into every row this chunk
-            # freed — ONE batched wave, not one prefill per row
+            # freed — ONE insert wave, no model forward
             admit_into([r for r in range(b) if rows[r] is None])
         wall = time.monotonic() - t0
         metrics = {
@@ -680,7 +656,11 @@ class ServingEngine:
             "decode_chunks": chunks,
             "wall_s": round(wall, 4),
             "tokens_per_sec": round(committed / wall, 2) if wall else 0.0,
-            "prefill_dispatches": self._prefill_dispatches,
+            "insert_dispatches": self._insert_dispatches,
+            "prefill_steps": self._prefill_steps,
+            "prefill_chunk": (
+                (self._k + 1) if self._lookup else self._t
+            ),
         }
         if self._lookup:
             metrics["speculative_kind"] = "prompt_lookup"

@@ -57,7 +57,7 @@ def _wait(pred, timeout=90.0):
 
 
 @settings(
-    max_examples=8,
+    max_examples=12,
     deadline=None,
     suppress_health_check=[HealthCheck.too_slow],
 )
@@ -148,6 +148,167 @@ def test_any_action_interleaving_converges(actions):
 
         assert _wait(converged), (
             f"never converged; live={live} actions={actions}"
+        )
+    finally:
+        controller.stop()
+
+
+# ---------------------------------------------------------------- placement
+
+# workgroup payloads are always RESOLVABLE by construction (pins name an
+# existing shard; capability sets are satisfiable) — the property under
+# test is that placement narrowing/widening under churn converges, not
+# PlacementError handling (covered deterministically in
+# tests/test_placement.py)
+_WG = "prop-wg"
+_WG_STATES = ("all", "pin0", "pin1", "caps-b")
+
+_p_action = st.one_of(
+    st.tuples(st.just("create"), st.sampled_from(TEMPLATES),
+              st.booleans()),  # payload: references the workgroup?
+    st.tuples(st.just("retag"), st.sampled_from(TEMPLATES),
+              st.integers(min_value=1, max_value=9)),
+    st.tuples(st.just("delete"), st.sampled_from(TEMPLATES), st.none()),
+    st.tuples(st.just("wg-set"), st.just(_WG),
+              st.sampled_from(_WG_STATES)),
+    st.tuples(st.just("wg-delete"), st.just(_WG), st.none()),
+)
+
+
+def _make_placed_template(name, references_wg):
+    tmpl = make_template(name)
+    # the sync-tier factory pins an unresolvable ref ("wg-1" -> all
+    # shards, reference parity); placement churn needs a REAL ref or none
+    tmpl.spec.workgroup_ref.name = _WG if references_wg else ""
+    return tmpl
+
+
+def _make_workgroup(state):
+    from nexus_tpu.api.types import ObjectMeta
+    from nexus_tpu.api.workgroup import (
+        NexusAlgorithmWorkgroup,
+        NexusAlgorithmWorkgroupSpec,
+    )
+
+    cluster = {"pin0": "shard0", "pin1": "shard1"}.get(state, "")
+    caps = {"b": True} if state == "caps-b" else {}
+    return NexusAlgorithmWorkgroup(
+        metadata=ObjectMeta(name=_WG, namespace=NS),
+        spec=NexusAlgorithmWorkgroupSpec(
+            description="prop pool", cluster=cluster, capabilities=caps,
+        ),
+    )
+
+
+@settings(
+    max_examples=10,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+@given(st.lists(_p_action, min_size=4, max_size=14))
+def test_placement_churn_interleaving_converges(actions):
+    """PROPERTY: random interleavings of template churn WITH workgroup
+    create/update/delete and placement narrowing converge — every live
+    template exists exactly on its currently-selected shards (spec
+    parity) and is PRUNED from unselected ones
+    (``_remove_from_unselected_shards``), however the workgroup flapped
+    while syncs were in flight."""
+    from nexus_tpu.api.workgroup import NexusAlgorithmWorkgroup
+
+    ctrl = ClusterStore("controller")
+    stores = {
+        "shard0": ClusterStore("shard0"),
+        "shard1": ClusterStore("shard1"),
+    }
+    shards = [
+        Shard("prop", "shard0", stores["shard0"],
+              capabilities={"a": True}),
+        Shard("prop", "shard1", stores["shard1"],
+              capabilities={"a": True, "b": True}),
+    ]
+    controller = Controller(
+        ctrl, shards, statsd=StatsdClient("prop"), resync_period=0.2
+    )
+    live = {}  # template name -> references workgroup?
+    wg_state = None  # None = workgroup absent
+    controller.run(workers=2)
+    try:
+        for kind, target, payload in actions:
+            if kind == "create" and target not in live:
+                _retry_conflict(
+                    lambda t=target, ref=payload: ctrl.create(
+                        _make_placed_template(t, ref)
+                    ),
+                    attempts=200,
+                )
+                live[target] = payload
+            elif kind == "retag" and target in live:
+                def _do(t=target, rev=payload):
+                    tmpl = ctrl.get(NexusAlgorithmTemplate.KIND, NS, t)
+                    tmpl.spec.container.version_tag = f"v{rev}"
+                    ctrl.update(tmpl)
+                _retry_conflict(_do)
+            elif kind == "delete" and target in live:
+                ctrl.delete(NexusAlgorithmTemplate.KIND, NS, target)
+                del live[target]
+            elif kind == "wg-set":
+                def _do(state=payload):
+                    try:
+                        wg = ctrl.get(NexusAlgorithmWorkgroup.KIND, NS, _WG)
+                        new = _make_workgroup(state)
+                        wg.spec = new.spec
+                        ctrl.update(wg)
+                    except NotFoundError:
+                        ctrl.create(_make_workgroup(state))
+                _retry_conflict(_do, attempts=200)
+                wg_state = payload
+            elif kind == "wg-delete" and wg_state is not None:
+                try:
+                    ctrl.delete(NexusAlgorithmWorkgroup.KIND, NS, _WG)
+                except NotFoundError:
+                    pass
+                wg_state = None
+
+        def expected_shards(references_wg):
+            if not references_wg or wg_state is None or wg_state == "all":
+                return {"shard0", "shard1"}
+            return {
+                "pin0": {"shard0"},
+                "pin1": {"shard1"},
+                "caps-b": {"shard1"},
+            }[wg_state]
+
+        def converged():
+            for name, refs in live.items():
+                src = ctrl.get(NexusAlgorithmTemplate.KIND, NS, name)
+                want = expected_shards(refs)
+                for shard_name, store in stores.items():
+                    if shard_name in want:
+                        try:
+                            got = store.get(
+                                NexusAlgorithmTemplate.KIND, NS, name
+                            )
+                        except NotFoundError:
+                            return False
+                        if got.spec.to_dict() != src.spec.to_dict():
+                            return False
+                    else:
+                        try:
+                            store.get(NexusAlgorithmTemplate.KIND, NS, name)
+                            return False  # must be pruned when unselected
+                        except NotFoundError:
+                            pass
+            for name in set(TEMPLATES) - set(live):
+                for store in stores.values():
+                    try:
+                        store.get(NexusAlgorithmTemplate.KIND, NS, name)
+                        return False
+                    except NotFoundError:
+                        pass
+            return True
+
+        assert _wait(converged), (
+            f"never converged; live={live} wg={wg_state} actions={actions}"
         )
     finally:
         controller.stop()

@@ -69,8 +69,12 @@ def _cyclic_model(v: int, stop: int):
 
     def fwd(params, cfg_, tokens, cache):
         logits = jax.nn.one_hot((tokens + 1) % v, v) * 10.0
-        new = dict(cache)
-        new["length"] = cache["length"] + tokens.shape[1]
+        new = {k: x for k, x in cache.items() if k != "n_valid"}
+        # honor the scaffold's chunked-prefill contract: advance each
+        # row by its real token count, not the feed width
+        nv = cache.get("n_valid")
+        adv = tokens.shape[1] if nv is None else nv
+        new["length"] = cache["length"] + adv
         return logits.astype(jnp.float32), new
 
     return cfg, fwd
@@ -211,16 +215,11 @@ def test_run_template_runtime_serve_mode():
     assert any("LM family" in e for e in errs), errs
     assert any("prompt length range" in e for e in errs), errs
 
-    # pre-launch feasibility: quantized cache and no-budget shapes are
-    # spec errors, not mid-queue runtime aborts
-    quant = JaxXlaRuntime(
-        mode="serve",
-        model=ModelRef(family="llama", preset="tiny",
-                       overrides={"kv_cache_quantized": True}),
-        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1", slice_count=1),
-        parallelism=ParallelismSpec(),
-    )
-    assert any("fp KV cache" in e for e in quant.validate())
+    # pre-launch feasibility: no-budget shapes are spec errors, not
+    # mid-queue runtime aborts (int8 KV serving is supported — the
+    # chunked-prefill insert never touches K/V, so the old fp-only
+    # guard is gone; exactness covered in
+    # test_serving_int8_kv_cache_matches_isolated_decode)
     nofit = JaxXlaRuntime(
         mode="serve",
         model=ModelRef(family="llama", preset="tiny",
@@ -231,6 +230,35 @@ def test_run_template_runtime_serve_mode():
                         chunk=32),
     )
     assert any("no decode budget" in e for e in nofit.validate())
+
+
+def test_serving_int8_kv_cache_matches_isolated_decode():
+    """int8 KV serving (cfg.kv_cache_quantized): the engine's outputs
+    equal the isolated int8 static decode token for token — write-time
+    quantization is per (row, position, head) vector, independent of
+    chunking or scheduling, so continuous batching stays exact against
+    the same-quantization reference."""
+    cfg = tiny_cfg(kv_cache_quantized=True)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(13)
+    reqs = [
+        ServeRequest(prompt=rng.randint(0, cfg.vocab_size, size=p).tolist(),
+                     max_new_tokens=n)
+        for p, n in ((5, 8), (11, 4), (3, 10))
+    ]
+    engine = ServingEngine(
+        llama.forward_decode, params, cfg, batch_size=2, max_len=64,
+        chunk=4, prefill_chunk=3,
+    )
+    results, _ = engine.serve(reqs)
+    for req, res in zip(reqs, results):
+        prompt = jnp.asarray(req.prompt, jnp.int32)[None, :]
+        ref = llama.generate(params, cfg, prompt,
+                             max_new_tokens=res.new_tokens)
+        np.testing.assert_array_equal(
+            np.array(res.tokens), np.array(ref[0]),
+            err_msg=f"prompt len {len(req.prompt)}",
+        )
 
 
 def test_serving_sampled_requests_are_batch_invariant():
@@ -447,17 +475,21 @@ _req = st.tuples(
     chunk=st.integers(1, 6),
     stop=st.integers(-1, 12),
     lookup=st.sampled_from([0, 2]),
+    prefill=st.sampled_from([1, 4, 16]),
 )
-def test_serving_property_exactness(reqs, batch, chunk, stop, lookup):
-    """PROPERTY: for ANY queue, batch size, chunk size, stop token, and
-    plain-vs-speculative mode, each request's output equals the cyclic
-    stub model's isolated greedy decode trimmed at stop/budget — the
-    engine's scheduling freedom never changes what is computed."""
+def test_serving_property_exactness(reqs, batch, chunk, stop, lookup,
+                                    prefill):
+    """PROPERTY: for ANY queue, batch size, chunk size, stop token,
+    plain-vs-speculative mode, and prefill chunk width, each request's
+    output equals the cyclic stub model's isolated greedy decode trimmed
+    at stop/budget — the engine's scheduling freedom never changes what
+    is computed."""
     v = 13
     cfg, fwd = _cyclic_model(v, stop)
     engine = ServingEngine(
         fwd, {}, cfg, batch_size=batch, max_len=96, stop_token_id=stop,
         chunk=chunk, lookup_ngram=lookup, num_speculative=3,
+        prefill_chunk=prefill,
     )
     results, metrics = engine.serve(
         [ServeRequest(prompt=p, max_new_tokens=n) for p, n in reqs]
@@ -482,11 +514,11 @@ def test_serving_property_exactness(reqs, batch, chunk, stop, lookup):
     )
 
 
-def test_batched_admission_shares_prefill_dispatches():
-    """Simultaneously freed rows admit through ONE prefill dispatch per
-    prompt bucket per wave — the admission tax the 16-row probe measured
-    (docs/PERF.md). Same-bucket queue through 4 rows: the initial wave is
-    1 dispatch, and total dispatches stay far below the request count."""
+def test_admission_is_one_insert_wave_no_forwards():
+    """Admission = ONE tiny insert dispatch per wave, never a model
+    forward — the prompts stream through the decode chunks in-band
+    (chunked prefill). 12 requests through 4 rows: the wave count stays
+    far below the request count, and every output is exact."""
     v = 7
     cfg, fwd = _cyclic_model(v, -1)
     reqs = [ServeRequest(prompt=[1, 2, 3], max_new_tokens=6)
@@ -496,6 +528,63 @@ def test_batched_admission_shares_prefill_dispatches():
     for res in results:
         expect = [(4 + i) % v for i in range(6)]
         assert res.tokens == [1, 2, 3] + expect
-    # 12 same-bucket requests through 4 rows: 1 initial wave + 2 refill
-    # waves = 3 dispatches (one-by-one admission would need 12)
-    assert metrics["prefill_dispatches"] <= 4, metrics
+    # 12 same-shape requests through 4 rows admit in a handful of waves
+    # (one-by-one admission would need 12)
+    assert metrics["insert_dispatches"] <= 4, metrics
+    assert metrics["prefill_steps"] >= 12  # every prompt streamed in-band
+
+
+def test_chunked_prefill_interleaves_with_decode():
+    """While one row streams a LONG prompt through the chunk program,
+    the other row keeps committing tokens — the serialization the old
+    bucketed-prefill engine paid is gone. Observable end-to-end: both
+    outputs exact, and the long-prompt request's prefill spans multiple
+    chunks (prefill_steps > chunk) without stalling the short one."""
+    cfg = tiny_cfg()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(11)
+    long_prompt = rng.randint(0, cfg.vocab_size, size=40).tolist()
+    short = rng.randint(0, cfg.vocab_size, size=3).tolist()
+    reqs = [
+        ServeRequest(prompt=short, max_new_tokens=20),
+        ServeRequest(prompt=long_prompt, max_new_tokens=6),
+    ]
+    # prefill_chunk=2: the 40-token prompt needs 20 in-band steps,
+    # spanning several 4-step chunks while row 0 decodes beside it
+    engine = ServingEngine(
+        llama.forward_decode, params, cfg, batch_size=2, max_len=96,
+        chunk=4, prefill_chunk=2,
+    )
+    results, metrics = engine.serve(reqs)
+    assert metrics["prefill_steps"] == 2 + 20
+    for req, res in zip(reqs, results):
+        prompt = jnp.asarray(req.prompt, jnp.int32)[None, :]
+        ref = llama.generate(params, cfg, prompt,
+                             max_new_tokens=res.new_tokens)
+        np.testing.assert_array_equal(np.array(res.tokens), np.array(ref[0]))
+
+
+def test_prefill_chunk_width_never_changes_output():
+    """Exactness across prefill chunk widths: T=1 (pure teacher
+    forcing), T=3 (partial windows), T=64 (whole prompt in one step) all
+    produce identical tokens — chunking computes each prompt query over
+    the same keys with the same mask."""
+    cfg = tiny_cfg()
+    params = llama.init(jax.random.PRNGKey(1), cfg)
+    rng = np.random.RandomState(7)
+    reqs = [
+        ServeRequest(prompt=rng.randint(0, cfg.vocab_size, size=p).tolist(),
+                     max_new_tokens=n, temperature=t, seed=i)
+        for i, (p, n, t) in enumerate(
+            ((5, 8, 0.0), (11, 5, 0.7), (7, 9, 0.0))
+        )
+    ]
+    outs = []
+    for t_width in (1, 3, 64):
+        engine = ServingEngine(
+            llama.forward_decode, params, cfg, batch_size=2, max_len=64,
+            chunk=3, prefill_chunk=t_width,
+        )
+        results, _ = engine.serve(reqs)
+        outs.append([r.tokens for r in results])
+    assert outs[0] == outs[1] == outs[2]
