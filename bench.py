@@ -72,9 +72,53 @@ def _validate_flash_on_chip() -> bool:
         return False
 
 
+def _device_hbm_gb():
+    """Real HBM capacity of the attached chip (GB), or None off-TPU /
+    unknown. Prefers the runtime's own memory_stats; falls back to the
+    generation table keyed by the device kind string."""
+    try:
+        import jax
+
+        from nexus_tpu.utils.hw import is_tpu
+
+        if not is_tpu():
+            return None
+        dev = jax.devices()[0]
+        try:
+            stats = dev.memory_stats() or {}
+            if stats.get("bytes_limit"):
+                return stats["bytes_limit"] / 1024 ** 3
+        except Exception:  # noqa: BLE001 — backend may not expose stats
+            pass
+        # fall back to the ONE generation table the spec-level HBM gate
+        # also reads — a second hardcoded copy here would silently
+        # desynchronize the bench pre-gate from validate()
+        from nexus_tpu.api.runtime_spec import TPU_GENERATIONS
+
+        kind = getattr(dev, "device_kind", "").lower()
+        gen = None
+        if "v5 lite" in kind or "v5e" in kind:
+            gen = "v5e"
+        elif "v5p" in kind or "v5" in kind:
+            gen = "v5p"
+        elif "v6" in kind:
+            gen = "v6e"
+        elif "v4" in kind:
+            gen = "v4"
+        if gen is not None:
+            return float(TPU_GENERATIONS[gen]["hbm_gb"])
+    except Exception:  # noqa: BLE001
+        pass
+    return None
+
+
 def _run_candidate(preset, steps, batch, seq, attn, remat, progress,
-                   ce_chunk=0, heads=None):
-    """One sweep candidate → (mfu, metrics) or None on failure/OOM.
+                   ce_chunk=0, heads=None, hbm_cap_gb=None):
+    """One sweep candidate → (mfu, metrics), None on failure/OOM, or the
+    string 'infeasible' when the HBM budget estimate already exceeds the
+    attached chip's capacity (skipped without burning a doomed compile —
+    round-4 measured ~40 s of tunnel compile time per always-failing
+    remat=none/bs16 probe, twice each with the retry).
 
     ``heads``: optional (n_heads, n_kv_heads) override. The 400m preset's
     default 16×64 layout leaves half the 128-wide MXU idle in attention;
@@ -115,6 +159,17 @@ def _run_candidate(preset, steps, batch, seq, attn, remat, progress,
     )
     label = (f"attn={attn} remat={remat} batch={batch} ce_chunk={ce_chunk}"
              f" heads={heads or 'preset'}")
+    if hbm_cap_gb:
+        try:
+            est = runtime.hbm_budget_gb()
+        except Exception:  # noqa: BLE001 — estimate is advisory
+            est = None
+        if est and est["total_gb"] > hbm_cap_gb:
+            progress(
+                f"candidate {label} skipped: HBM estimate "
+                f"{est['total_gb']} GB > chip {hbm_cap_gb:.0f} GB"
+            )
+            return "infeasible"
     progress(f"candidate {label}: running {steps} steps")
     try:
         metrics = run_template_runtime(runtime)
@@ -273,8 +328,22 @@ def _spec_suite(progress, attn):
     from nexus_tpu.runtime.entrypoints import run_template_runtime
     from nexus_tpu.utils.hw import is_tpu
 
+    import time as _time
+
     on_tpu = is_tpu()
     out = {}
+    t_suite = _time.monotonic()
+    # per-suite wall budget: a wedged tunnel compile must not eat the
+    # whole bench deadline — remaining legs are skipped (and say so)
+    budget_s = float(os.environ.get("NEXUS_BENCH_SPEC_BUDGET_S") or 900)
+
+    def over_budget(label):
+        if _time.monotonic() - t_suite > budget_s:
+            progress(f"speculation suite: budget {budget_s:.0f}s exhausted"
+                     f" — skipping {label}")
+            return True
+        return False
+
     tmp = tempfile.mkdtemp(prefix="nexus_bench_spec_")
     corpus = os.path.join(tmp, "corpus.bin")
     n_tok = _build_repo_corpus(corpus)
@@ -286,7 +355,7 @@ def _spec_suite(progress, attn):
     dsteps = int(os.environ.get("NEXUS_BENCH_SPEC_DRAFT_STEPS")
                  or (400 if on_tpu else 4))
     seq = 1024 if on_tpu else 64
-    max_new = 512 if on_tpu else 48
+    max_new = 256 if on_tpu else 48
     base_overrides = {} if on_tpu else {"dtype": "float32"}
     tpu_spec = TpuSliceSpec(accelerator="v5e", topology="1x1", slice_count=1)
 
@@ -316,14 +385,23 @@ def _spec_suite(progress, attn):
     target_dir = os.path.join(tmp, "target")
     draft_dir = os.path.join(tmp, "draft")
     try:
+        if over_budget("target training"):
+            return out
         train(target_preset, tsteps, target_dir, 8 if on_tpu else 2,
               "dots_attn" if on_tpu else None, f"target {target_preset}")
-        train(draft_preset, dsteps, draft_dir, 8 if on_tpu else 2,
-              None, f"draft {draft_preset}")
     except Exception as e:  # noqa: BLE001 — training failure: skip suite
         progress(f"speculation suite training failed: "
                  f"{type(e).__name__}: {str(e)[:200]}")
         return out
+    draft_ok = False
+    if not over_budget("draft training"):
+        try:
+            train(draft_preset, dsteps, draft_dir, 8 if on_tpu else 2,
+                  None, f"draft {draft_preset}")
+            draft_ok = True
+        except Exception as e:  # noqa: BLE001 — draft leg just drops
+            progress(f"speculation suite draft training failed: "
+                     f"{type(e).__name__}: {str(e)[:200]}")
     prompt_ids = _corpus_prompt(corpus, n_tok // 3, 64)
 
     def infer_leg(label, **infer_kw):
@@ -337,7 +415,7 @@ def _spec_suite(progress, attn):
             checkpoint=CheckpointSpec(enabled=True, directory=target_dir),
             infer=InferSpec(
                 prompt_token_ids=prompt_ids, max_new_tokens=max_new,
-                iterations=2, **infer_kw,
+                iterations=1, **infer_kw,
             ),
         )
         progress(f"speculation suite: {label}")
@@ -355,30 +433,41 @@ def _spec_suite(progress, attn):
         )
         return m
 
-    greedy = infer_leg("greedy (trained target)")
-    if greedy:
-        out["decode_tokens_per_sec_greedy_trained"] = round(
-            greedy["decode_tokens_per_sec"], 1
+    # leg order: greedy (the same-model baseline) → prompt-lookup (the
+    # cheaper-to-compile speculation) → draft-speculative (the heaviest
+    # program last, so a slow tunnel compile can only cost the final leg)
+    if not over_budget("greedy leg"):
+        greedy = infer_leg("greedy (trained target)")
+        if greedy:
+            out["decode_tokens_per_sec_greedy_trained"] = round(
+                greedy["decode_tokens_per_sec"], 1
+            )
+    if not over_budget("prompt-lookup leg"):
+        lookup = infer_leg("prompt-lookup (natural text)",
+                           prompt_lookup_ngram=3)
+        if lookup:
+            out["decode_tokens_per_sec_prompt_lookup"] = round(
+                lookup["decode_tokens_per_sec"], 1
+            )
+            out["prompt_lookup_acceptance_rate"] = lookup.get(
+                "acceptance_rate"
+            )
+    if draft_ok and not over_budget("draft-speculative leg"):
+        spec = infer_leg(
+            "draft-speculative (trained)",
+            draft=ModelRef(family="llama", preset=draft_preset,
+                           overrides=dict(base_overrides)),
+            draft_checkpoint_directory=draft_dir,
+            num_speculative=4,
         )
-    spec = infer_leg(
-        "draft-speculative (trained)",
-        draft=ModelRef(family="llama", preset=draft_preset,
-                       overrides=dict(base_overrides)),
-        draft_checkpoint_directory=draft_dir,
-        num_speculative=4,
-    )
-    if spec:
-        out["decode_tokens_per_sec_speculative"] = round(
-            spec["decode_tokens_per_sec"], 1
-        )
-        out["speculative_acceptance_rate"] = spec.get("acceptance_rate")
-        out["speculative_draft"] = f"{draft_preset}-trained-{dsteps}steps"
-    lookup = infer_leg("prompt-lookup (natural text)", prompt_lookup_ngram=3)
-    if lookup:
-        out["decode_tokens_per_sec_prompt_lookup"] = round(
-            lookup["decode_tokens_per_sec"], 1
-        )
-        out["prompt_lookup_acceptance_rate"] = lookup.get("acceptance_rate")
+        if spec:
+            out["decode_tokens_per_sec_speculative"] = round(
+                spec["decode_tokens_per_sec"], 1
+            )
+            out["speculative_acceptance_rate"] = spec.get("acceptance_rate")
+            out["speculative_draft"] = (
+                f"{draft_preset}-trained-{dsteps}steps"
+            )
     return out
 
 
@@ -499,12 +588,15 @@ def _run_1b_probe(progress, attn, steps):
     logits out of residency (docs/PERF.md HBM budget: dots_attn/bs4
     lands ~15 GB with dense logits — too close to the edge).
     Candidates in strength order; first that completes wins."""
+    cap = _device_hbm_gb()
     for batch, remat, ce in ((4, "dots_attn", 8192), (2, "dots_attn", 8192),
                              (4, "full", 8192)):
         res = _run_candidate(
             "1b", steps, batch, 2048, attn, remat, progress,
-            ce_chunk=ce, heads=None,
+            ce_chunk=ce, heads=None, hbm_cap_gb=cap,
         )
+        if res == "infeasible":
+            continue
         if res is not None:
             mfu, m = res
             return {
@@ -756,11 +848,16 @@ def main() -> int:
     best = None
     cand_run = 0
     cand_failed = 0
+    cand_infeasible = 0
+    hbm_cap = _device_hbm_gb() if on_tpu else None
     for attn, remat, batch, ce_chunk, heads in candidates:
         res = _run_candidate(
             preset, steps, batch, seq, attn, remat, progress,
-            ce_chunk=ce_chunk, heads=heads,
+            ce_chunk=ce_chunk, heads=heads, hbm_cap_gb=hbm_cap,
         )
+        if res == "infeasible":
+            cand_infeasible += 1
+            continue
         if res is None:
             # one retry: the tunnel's compile helper 500s transiently
             # (BENCH_r03 lost several candidates to it silently) — a
@@ -798,9 +895,11 @@ def main() -> int:
         return 1
     result = _result_from(best)
     # sweep honesty: a partially-explored sweep (infra flakes eating
-    # candidates even after their retry) is visible in the output
+    # candidates even after their retry) is visible in the output;
+    # infeasible = skipped by the HBM pre-gate, not attempted
     result["candidates_run"] = cand_run
     result["candidates_failed"] = cand_failed
+    result["candidates_skipped_infeasible"] = cand_infeasible
     if on_tpu and result.get("value"):
         _store_cached_result(result)
 

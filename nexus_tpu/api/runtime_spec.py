@@ -668,9 +668,18 @@ class JaxXlaRuntime:
             hkv = getattr(cfg, "n_kv_heads", None)
             hd = getattr(cfg, "head_dim", None)
             if hkv and hd:
+                # int8 cache: 1 byte/element plus the per-(pos, head)
+                # f32 scale planes (4 bytes per head_dim elements) —
+                # budgeting it at the compute dtype would reject exactly
+                # the configs the flag exists to make fit
+                cache_bytes_per_elem = (
+                    1.0 + 4.0 / hd
+                    if self.model.overrides.get("kv_cache_quantized")
+                    else float(dt_bytes)
+                )
                 cache = (
                     cfg.n_layers * rows * cfg.max_seq_len * hkv * hd
-                    * 2 * dt_bytes
+                    * 2 * cache_bytes_per_elem
                 )
                 cache_shards = max(1, p.data * p.fsdp * p.tensor)
                 out["kv_cache_gb"] = cache / cache_shards / gb

@@ -43,6 +43,33 @@ def init_kv_cache(
     return cache
 
 
+def constrain_kv_sharding(cache: Dict[str, Any], sharding) -> Dict[str, Any]:
+    """Pin the cache layout inside jit: k/v at the caller's 5-D sharding
+    ((L, B, S, Hkv, D) — e.g. kv heads over tensor, batch over data);
+    the int8 cache's f32 scale planes (L, B, S, Hkv) at the same spec
+    minus the trailing head_dim axis. Left unconstrained, the scale
+    planes replicate per chip on a sharded mesh and erode most of the
+    int8 residency win. Shared by the static decode paths and the
+    serving engine. No-op when ``sharding`` is None."""
+    if sharding is None:
+        return cache
+    cache = dict(cache)
+    for key in ("k", "v"):
+        cache[key] = lax.with_sharding_constraint(cache[key], sharding)
+    if "k_scale" in cache:
+        spec = getattr(sharding, "spec", None)
+        mesh = getattr(sharding, "mesh", None)
+        if spec is None or mesh is None:  # non-Named sharding: defer
+            return cache
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        scale_sh = NamedSharding(mesh, P(*tuple(spec)[:4]))
+        for key in ("k_scale", "v_scale"):
+            cache[key] = lax.with_sharding_constraint(cache[key], scale_sh)
+    return cache
+
+
 def _quantize_kv(x: jnp.ndarray):
     """(B, T, H, D) → (int8 values, (B, T, H) f32 per-vector scales)."""
     scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
@@ -258,6 +285,33 @@ def scanned_forward_decode(
     return generic_forward_decode(params, cfg, tokens, cache, layer_fn)
 
 
+PREFILL_CHUNK = 512
+
+
+def _chunked_prefill(forward_decode, params, cfg, prompt, cache,
+                     chunk=PREFILL_CHUNK):
+    """Prefill ``prompt`` (B, P) through ``forward_decode`` in windows of
+    ``chunk`` tokens, returning (last-position logits (B, V), cache).
+
+    A monolithic P-token prefill materializes (B, P, max_len)-shaped
+    attention logits inside the decode scaffold — at 8 rows x 7k prompt
+    x 8k cache that is terabytes and the compile OOMs (measured: the
+    round-4 long-context bench legs died in the compile helper).
+    Chunking bounds the per-forward logits to (B, chunk, max_len) while
+    computing EXACTLY the same values: each query attends to the same
+    keys under the same mask whichever window carries it. At most two
+    program shapes compile (chunk and the remainder)."""
+    b, p = prompt.shape
+    if p <= chunk:
+        logits, cache = forward_decode(params, cfg, prompt, cache)
+        return logits[:, -1], cache
+    logits = None
+    for start in range(0, p, chunk):
+        piece = prompt[:, start:start + chunk]
+        logits, cache = forward_decode(params, cfg, piece, cache)
+    return logits[:, -1], cache
+
+
 def autoregressive_generate(
     forward_decode: Callable,
     params: Dict[str, Any],
@@ -308,14 +362,7 @@ def autoregressive_generate(
         cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype, b, max_len,
         quantized=getattr(cfg, "kv_cache_quantized", False),
     )
-    if cache_sharding is not None:
-        cache = dict(cache)
-        for key_ in ("k", "v"):
-            cache[key_] = lax.with_sharding_constraint(
-                cache[key_], cache_sharding
-            )
-        # the per-vector scales are head_dim-times smaller; leave them to
-        # the compiler rather than reshaping the kv sharding spec
+    cache = constrain_kv_sharding(cache, cache_sharding)
 
     def pick(logits, step_idx):
         k = None if key is None else jax.random.fold_in(key, step_idx)
@@ -323,8 +370,10 @@ def autoregressive_generate(
             logits, key=k, temperature=temperature, top_k=top_k, top_p=top_p
         ).astype(prompt.dtype)
 
-    logits, cache = forward_decode(params, cfg, prompt, cache)
-    next_tok = pick(logits[:, -1], 0)
+    last_logits, cache = _chunked_prefill(
+        forward_decode, params, cfg, prompt, cache
+    )
+    next_tok = pick(last_logits, 0)
     stopping = stop_token_id >= 0
     done0 = (
         next_tok == stop_token_id
@@ -478,24 +527,26 @@ def speculative_generate(
     )
     # same layout contract as autoregressive_generate; each model's cache
     # takes its own sharding (kv-head counts can differ across families)
-    for c, sh in ((t_cache, cache_sharding),
-                  (d_cache, draft_cache_sharding or cache_sharding)):
-        if sh is not None:
-            for key_ in ("k", "v"):
-                c[key_] = lax.with_sharding_constraint(c[key_], sh)
-
-    # prefill both models on the prompt; the target's last logit fixes the
-    # first generated token (identical to plain greedy)
-    t_logits, t_cache = target_forward_decode(
-        target_params, target_cfg, prompt, t_cache
+    t_cache = constrain_kv_sharding(t_cache, cache_sharding)
+    d_cache = constrain_kv_sharding(
+        d_cache, draft_cache_sharding or cache_sharding
     )
-    _, d_cache = draft_forward_decode(draft_params, draft_cfg, prompt, d_cache)
+
+    # prefill both models on the prompt (chunked — long prompts must not
+    # materialize (B, P, max_len) attention logits); the target's last
+    # logit fixes the first generated token (identical to plain greedy)
+    t_last, t_cache = _chunked_prefill(
+        target_forward_decode, target_params, target_cfg, prompt, t_cache
+    )
+    _, d_cache = _chunked_prefill(
+        draft_forward_decode, draft_params, draft_cfg, prompt, d_cache
+    )
     if sampled:
         first_tok = jax.random.categorical(
-            jax.random.fold_in(key, 0), t_logits[:, -1] / temperature
+            jax.random.fold_in(key, 0), t_last / temperature
         ).astype(prompt.dtype)
     else:
-        first_tok = jnp.argmax(t_logits[:, -1], axis=-1).astype(prompt.dtype)
+        first_tok = jnp.argmax(t_last, axis=-1).astype(prompt.dtype)
 
     # token buffer holds prompt + generated (+ scratch for the last round)
     buf = jnp.zeros((b, max_len), prompt.dtype)
@@ -743,15 +794,12 @@ def prompt_lookup_generate(
         cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype, b, max_len,
         quantized=getattr(cfg, "kv_cache_quantized", False),
     )
-    if cache_sharding is not None:
-        cache = dict(cache)
-        for key_ in ("k", "v"):
-            cache[key_] = lax.with_sharding_constraint(
-                cache[key_], cache_sharding
-            )
+    cache = constrain_kv_sharding(cache, cache_sharding)
 
-    logits, cache = forward_decode(params, cfg, prompt, cache)
-    first_tok = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+    last_logits, cache = _chunked_prefill(
+        forward_decode, params, cfg, prompt, cache
+    )
+    first_tok = jnp.argmax(last_logits, axis=-1).astype(prompt.dtype)
 
     buf = jnp.zeros((b, max_len), prompt.dtype)
     buf = lax.dynamic_update_slice_in_dim(buf, prompt, 0, axis=1)

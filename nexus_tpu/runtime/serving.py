@@ -65,7 +65,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from nexus_tpu.models.decoding import init_kv_cache
+from nexus_tpu.models.decoding import (
+    constrain_kv_sharding,
+    init_kv_cache,
+)
 
 
 @dataclass
@@ -185,7 +188,6 @@ class ServingEngine:
         cfg_ = cfg
         fwd = forward_decode
         C = self._chunk
-        T = self._t
         B = self._b
         max_len_ = self._max_len
         base_key = self._base_key
@@ -202,60 +204,75 @@ class ServingEngine:
                 temp > 0.0, sampled, jnp.argmax(logits_row, axis=-1)
             ).astype(jnp.int32)
 
-        def _decode_chunk(params, cache, tok, ptr, done, buf, plen,
-                          temp, seed):
-            """C steps in ONE dispatch; each step feeds a (B, T) window.
-            Decode rows carry 1 real token (slot 0 = ``tok``), admitting
-            rows carry up to T prompt tokens gathered from ``buf`` at
-            ``ptr`` — the scaffold's per-row ``n_valid`` drops the
-            padding slots' K/V writes and advances each row's cache
-            depth by its real token count. ``done`` rows emit their held
-            token and roll their pointer back each step (the write lands
-            on the same slot next step — no growth, no overflow)."""
+        def _make_decode_chunk(T):
+            """Chunk program at feed width T: C steps in ONE dispatch;
+            each step feeds a (B, T) window. Decode rows carry 1 real
+            token (slot 0 = ``tok``), admitting rows carry up to T
+            prompt tokens gathered from ``buf`` at ``ptr`` — the
+            scaffold's per-row ``n_valid`` drops the padding slots' K/V
+            writes and advances each row's cache depth by its real token
+            count. ``done`` rows emit their held token and roll their
+            pointer back each step (the write lands on the same slot
+            next step — no growth, no overflow).
 
-            def step(carry, _):
-                cache, tok, ptr = carry
-                prefilling = (ptr < plen) & ~done
-                n_valid = jnp.where(
-                    prefilling, jnp.minimum(T, plen - ptr), 1
-                ).astype(jnp.int32)
-                pos = jnp.clip(
-                    ptr[:, None] + jnp.arange(T)[None, :], 0, max_len_ - 1
-                )
-                feed = jnp.where(
-                    prefilling[:, None],
-                    jnp.take_along_axis(buf, pos, axis=1),
-                    tok[:, None],
-                )
-                cache_in = dict(cache)
-                cache_in["n_valid"] = n_valid
-                logits, cache2 = fwd(params, cfg_, feed, cache_in)
-                cache2 = dict(cache2)
-                cache2["length"] = jnp.where(
-                    done, cache["length"], cache2["length"]
-                )
-                # the sampled token's buffer position is the post-feed
-                # length — the key input that makes sampling positional.
-                # Each row's real last slot is n_valid-1 (slot 0 for
-                # decode rows; the final prompt token for a row that
-                # finishes its prefill this step).
-                pick_logits = jnp.take_along_axis(
-                    logits, (n_valid - 1)[:, None, None].astype(jnp.int32),
-                    axis=1,
-                )[:, 0]
-                nxt = jax.vmap(_pick)(
-                    pick_logits, temp, seed, cache2["length"]
-                ).astype(tok.dtype)
-                finish = prefilling & (plen - ptr <= T)
-                emit = (~done) & (finish | ~prefilling)
-                nxt = jnp.where(emit, nxt, tok)
-                ptr2 = jnp.where(prefilling, ptr + n_valid, ptr)
-                return (cache2, nxt, ptr2), (nxt, emit)
+            TWO widths compile (T and 1): a T-slot feed costs every row
+            T slots of attention/matmul work, so the host dispatches the
+            wide program only while some row is actually prefilling and
+            the pure-decode program the rest of the time (measured
+            on-chip: the width-16 program more than tripled the plain
+            decode step at 8 rows — docs/PERF.md round-4 serving).
+            Either program is EXACT for any state (a prefilling row
+            under the width-1 program just streams 1 token/step)."""
 
-            (cache, tok, ptr), (toks, emits) = lax.scan(
-                step, (cache, tok, ptr), None, length=C
-            )
-            return cache, tok, ptr, toks, emits  # (C, B), (C, B)
+            def _decode_chunk(params, cache, tok, ptr, done, buf, plen,
+                              temp, seed):
+                def step(carry, _):
+                    cache, tok, ptr = carry
+                    prefilling = (ptr < plen) & ~done
+                    n_valid = jnp.where(
+                        prefilling, jnp.minimum(T, plen - ptr), 1
+                    ).astype(jnp.int32)
+                    pos = jnp.clip(
+                        ptr[:, None] + jnp.arange(T)[None, :],
+                        0, max_len_ - 1,
+                    )
+                    feed = jnp.where(
+                        prefilling[:, None],
+                        jnp.take_along_axis(buf, pos, axis=1),
+                        tok[:, None],
+                    )
+                    cache_in = dict(cache)
+                    cache_in["n_valid"] = n_valid
+                    logits, cache2 = fwd(params, cfg_, feed, cache_in)
+                    cache2 = dict(cache2)
+                    cache2["length"] = jnp.where(
+                        done, cache["length"], cache2["length"]
+                    )
+                    # the sampled token's buffer position is the
+                    # post-feed length — the key input that makes
+                    # sampling positional. Each row's real last slot is
+                    # n_valid-1 (slot 0 for decode rows; the final
+                    # prompt token for a row finishing its prefill).
+                    pick_logits = jnp.take_along_axis(
+                        logits,
+                        (n_valid - 1)[:, None, None].astype(jnp.int32),
+                        axis=1,
+                    )[:, 0]
+                    nxt = jax.vmap(_pick)(
+                        pick_logits, temp, seed, cache2["length"]
+                    ).astype(tok.dtype)
+                    finish = prefilling & (plen - ptr <= T)
+                    emit = (~done) & (finish | ~prefilling)
+                    nxt = jnp.where(emit, nxt, tok)
+                    ptr2 = jnp.where(prefilling, ptr + n_valid, ptr)
+                    return (cache2, nxt, ptr2), (nxt, emit)
+
+                (cache, tok, ptr), (toks, emits) = lax.scan(
+                    step, (cache, tok, ptr), None, length=C
+                )
+                return cache, tok, ptr, toks, emits  # (C, B), (C, B)
+
+            return _decode_chunk
 
         self._pick = _pick
 
@@ -379,7 +396,17 @@ class ServingEngine:
 
         donate = is_tpu()
         self._decode_chunk = jax.jit(
-            _decode_chunk, donate_argnums=(1,) if donate else ()
+            _make_decode_chunk(self._t),
+            donate_argnums=(1,) if donate else (),
+        )
+        # pure-decode program: dispatched whenever no row is prefilling
+        # (the overwhelming share of chunks at steady state)
+        self._decode_chunk_narrow = (
+            jax.jit(
+                _make_decode_chunk(1),
+                donate_argnums=(1,) if donate else (),
+            )
+            if self._t > 1 else self._decode_chunk
         )
         self._insert_fn = jax.jit(
             _insert_wave,
@@ -437,10 +464,10 @@ class ServingEngine:
             ps[i] = p
             temps[i] = req.temperature
             seeds[i] = req.seed
-            out.append((row, _RowState(request_idx=req_idx, budget=budget)))
-            self._prefill_steps += -(-p // (
-                (self._k + 1) if self._lookup else self._t
-            ))
+            steps = -(-p // ((self._k + 1) if self._lookup else self._t))
+            out.append((row, _RowState(request_idx=req_idx, budget=budget),
+                        steps))
+            self._prefill_steps += steps
         cache, buf, ptr, plen, temp_vec, seed_vec = self._insert_fn(
             cache, buf, ptr, plen, temp_vec, seed_vec,
             jnp.asarray(rows), jnp.asarray(prompts), jnp.asarray(ps),
@@ -476,13 +503,10 @@ class ServingEngine:
             cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
             b, max_len, quantized=quantized,
         )
-        if self._cache_sharding is not None:
-            # warm with the REAL layout or jit compiles a second program
-            # for the constrained cache on the first timed chunk
-            for key in ("k", "v"):
-                warm_cache[key] = lax.with_sharding_constraint(
-                    warm_cache[key], self._cache_sharding
-                )
+        # warm with the REAL layout or jit compiles a second program for
+        # the constrained cache on the first timed chunk (scale planes
+        # included — unconstrained they replicate on a sharded mesh)
+        warm_cache = constrain_kv_sharding(warm_cache, self._cache_sharding)
         warm_cache["length"] = jnp.zeros((b,), jnp.int32)
         warm_buf = jnp.zeros((b, max_len), jnp.int32)
 
@@ -516,6 +540,23 @@ class ServingEngine:
                 warm_temp, warm_seed,
             )
             np.asarray(out[3])  # host fetch: the warm-up really completed
+            if self._decode_chunk_narrow is not self._decode_chunk:
+                # the wide warm-up donated its state; mint fresh buffers
+                # for the pure-decode program's compile
+                warm2 = init_kv_cache(
+                    cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
+                    b, max_len, quantized=quantized,
+                )
+                warm2 = constrain_kv_sharding(
+                    warm2, self._cache_sharding
+                )
+                warm2["length"] = jnp.zeros((b,), jnp.int32)
+                out = self._decode_chunk_narrow(
+                    self._params, warm2, zi(), zi(),
+                    jnp.ones((b,), jnp.bool_),
+                    jnp.zeros((b, max_len), jnp.int32), zi(), zf(), zi(),
+                )
+                np.asarray(out[3])
         del warm_cache, warm_buf, out
 
         t0 = time.monotonic()
@@ -523,12 +564,7 @@ class ServingEngine:
             cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
             b, max_len, quantized=quantized,
         )
-        if self._cache_sharding is not None:
-            cache = dict(cache)
-            for key in ("k", "v"):
-                cache[key] = lax.with_sharding_constraint(
-                    cache[key], self._cache_sharding
-                )
+        cache = constrain_kv_sharding(cache, self._cache_sharding)
         cache["length"] = jnp.zeros((b,), jnp.int32)  # vector from step 0
         buf = jnp.zeros((b, max_len), jnp.int32)
         tok_vec = jnp.zeros((b,), jnp.int32)
@@ -537,6 +573,11 @@ class ServingEngine:
         temp_vec = jnp.zeros((b,), jnp.float32)
         seed_vec = jnp.zeros((b,), jnp.int32)
         rows: List[Optional[_RowState]] = [None] * b
+        # host-side mirror of each row's remaining prefill steps (at the
+        # chunk program's feed width) — selects the wide program only
+        # while some row is actually streaming its prompt. Correctness
+        # never depends on it (either program is exact for any state).
+        prefill_left = [0] * b
         results: List[Optional[ServeResult]] = [None] * len(requests)
         next_req = 0
         committed = 0
@@ -578,8 +619,9 @@ class ServingEngine:
              admitted) = self._admit_wave(
                 cache, buf, ptr_vec, plen_vec, temp_vec, seed_vec, wave,
             )
-            for row, state in admitted:
+            for row, state, steps in admitted:
                 rows[row] = state
+                prefill_left[row] = steps
 
         admit_into([r for r in range(b) if rows[r] is None])
 
@@ -602,7 +644,15 @@ class ServingEngine:
                 host_emits = np.asarray(n_emits)  # (R, B)
                 host_actives = np.asarray(actives)
             else:
-                cache, tok_vec, ptr_vec, toks, emits = self._decode_chunk(
+                chunk_fn = (
+                    self._decode_chunk
+                    if any(
+                        prefill_left[r] > 0
+                        for r in range(b) if rows[r] is not None
+                    )
+                    else self._decode_chunk_narrow
+                )
+                cache, tok_vec, ptr_vec, toks, emits = chunk_fn(
                     self._params, cache, tok_vec, ptr_vec, done_vec,
                     buf, plen_vec, temp_vec, seed_vec,
                 )
@@ -610,6 +660,8 @@ class ServingEngine:
                 scheduled_slots += self._chunk * b
                 host_toks = np.asarray(toks)    # (C, B)
                 host_emits = np.asarray(emits)  # (C, B)
+                for r in range(b):
+                    prefill_left[r] = max(0, prefill_left[r] - self._chunk)
             for r in range(b):
                 state = rows[r]
                 if state is None:

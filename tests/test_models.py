@@ -637,6 +637,45 @@ def test_generate_stop_token_freezes_rows():
             np.testing.assert_array_equal(s[b], f[b])
 
 
+def test_chunked_prefill_matches_monolithic():
+    """_chunked_prefill computes exactly the monolithic prefill's last
+    logits and cache (each query attends to the same keys under the same
+    mask whichever window carries it) — the long-context path that keeps
+    a P-token prompt from materializing (B, P, max_len) attention
+    logits in one forward."""
+    from nexus_tpu.models import llama
+    from nexus_tpu.models.decoding import _chunked_prefill, init_kv_cache
+
+    cfg = llama.config("tiny", dtype=jnp.float32)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 11), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+
+    def fresh():
+        return init_kv_cache(cfg.n_layers, cfg.n_kv_heads, cfg.head_dim,
+                             cfg.dtype, 2, 32)
+
+    logits_mono, cache_mono = llama.forward_decode(
+        params, cfg, prompt, fresh()
+    )
+    for chunk in (1, 4, 5, 11, 16):
+        last, cache = _chunked_prefill(
+            llama.forward_decode, params, cfg, prompt, fresh(), chunk=chunk
+        )
+        # tolerances absorb per-shape XLA fusion reassociation (~1e-7
+        # absolute); the downstream argmax/greedy contract is untouched
+        np.testing.assert_allclose(
+            np.array(last), np.array(logits_mono[:, -1]), rtol=1e-4,
+            atol=1e-5, err_msg=f"chunk={chunk}",
+        )
+        assert int(cache["length"]) == 11
+        np.testing.assert_allclose(
+            np.array(cache["k"]), np.array(cache_mono["k"]), rtol=1e-4,
+            atol=1e-5, err_msg=f"chunk={chunk}",
+        )
+
+
 def test_prompt_lookup_propose_unit():
     """The n-gram proposer: latest earlier match wins, the match must end
     inside committed text, and no-match rows fall back to repeating the
