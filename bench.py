@@ -91,21 +91,12 @@ def _device_hbm_gb():
         except Exception:  # noqa: BLE001 — backend may not expose stats
             pass
         # fall back to the ONE generation table the spec-level HBM gate
-        # also reads — a second hardcoded copy here would silently
-        # desynchronize the bench pre-gate from validate()
+        # also reads, via the ONE kind-alias matcher metrics.py maintains
         from nexus_tpu.api.runtime_spec import TPU_GENERATIONS
+        from nexus_tpu.train.metrics import detect_generation
 
-        kind = getattr(dev, "device_kind", "").lower()
-        gen = None
-        if "v5 lite" in kind or "v5e" in kind:
-            gen = "v5e"
-        elif "v5p" in kind or "v5" in kind:
-            gen = "v5p"
-        elif "v6" in kind:
-            gen = "v6e"
-        elif "v4" in kind:
-            gen = "v4"
-        if gen is not None:
+        gen = detect_generation(getattr(dev, "device_kind", ""))
+        if gen is not None and gen in TPU_GENERATIONS:
             return float(TPU_GENERATIONS[gen]["hbm_gb"])
     except Exception:  # noqa: BLE001
         pass
@@ -305,7 +296,7 @@ def _corpus_prompt(corpus_path, offset, length):
     return [int(t) for t in toks[offset:offset + length]]
 
 
-def _spec_suite(progress, attn):
+def _spec_suite(progress, attn, sink=None):
     """Speculation with REAL acceptance (VERDICT r3 item 2): train the
     target and a ~21M draft on the same repo-text corpus, then decode a
     natural corpus prompt three ways — greedy, draft-speculative, and
@@ -331,7 +322,7 @@ def _spec_suite(progress, attn):
     import time as _time
 
     on_tpu = is_tpu()
-    out = {}
+    out = sink if sink is not None else {}  # keys land incrementally
     t_suite = _time.monotonic()
     # per-suite wall budget: a wedged tunnel compile must not eat the
     # whole bench deadline — remaining legs are skipped (and say so)
@@ -518,13 +509,17 @@ def _run_serve_bench(preset, progress, rows=8):
     return m
 
 
-def _decode_suite(preset, progress, attn="xla"):
+def _decode_suite(preset, progress, attn="xla", sink=None):
     """Run the decode variants; returns a flat dict of bench keys.
+
+    ``sink``: optional dict that receives each key AS IT LANDS — the
+    bench watchdog reports it on a deadline cut, so partially-completed
+    suites still surface their real measurements.
 
     The speculative legs train a real target + draft on the repo corpus
     (``_spec_suite``) so the reported acceptance is a trained rate, not
     random-weights mechanism overhead (VERDICT r3 item 2)."""
-    out = {}
+    out = sink if sink is not None else {}
     plain = _run_decode_bench(preset, progress)
     if plain:
         out["decode_tokens_per_sec"] = round(
@@ -576,7 +571,7 @@ def _decode_suite(preset, progress, attn="xla"):
                 / out["decode_tokens_per_sec"], 3,
             )
     if os.environ.get("NEXUS_BENCH_SPEC", "1") not in ("0", "false"):
-        out.update(_spec_suite(progress, attn))
+        _spec_suite(progress, attn, sink=out)
     return out
 
 
@@ -668,6 +663,7 @@ def main() -> int:
     _stage = ["startup"]
     _done = [False]
     _best = [None]  # best (mfu, metrics) observed so far
+    _extra = [{}]  # decode/serve/spec keys as they land (watchdog-safe)
     _seq = [None]  # benchmarked sequence length, once parsed
     # intended config for cache-matching if the watchdog fires before the
     # backend is up (the TPU-default values; overwritten once known)
@@ -711,6 +707,9 @@ def main() -> int:
                 return
             if _best[0] is not None:
                 result = _result_from(_best[0])
+                # decode/serve/speculation keys measured before the cut
+                # ride along — a deadline must not erase real data
+                result.update(_extra[0])
                 result["note"] = (
                     f"deadline {deadline_s}s hit at stage: {_stage[0]}; "
                     "reporting best completed candidate"
@@ -910,7 +909,9 @@ def main() -> int:
     ):
         progress("1b MFU probe")
         try:
-            result.update(_run_1b_probe(progress, attn, steps))
+            probe_1b = _run_1b_probe(progress, attn, steps)
+            _extra[0].update(probe_1b)
+            result.update(probe_1b)
         except Exception as e:  # noqa: BLE001 — never lose the train result
             progress(f"1b probe failed: {type(e).__name__}: {str(e)[:200]}")
 
@@ -929,9 +930,14 @@ def main() -> int:
             result.update(_decode_suite(
                 decode_preset, progress,
                 attn=attn if on_tpu else "xla",
+                sink=_extra[0],
             ))
         except Exception as e:  # noqa: BLE001 — never lose the train result
             progress(f"decode suite failed: {type(e).__name__}: {str(e)[:200]}")
+        # keys that landed in the sink before a mid-suite exception are
+        # real measurements — publish them regardless of how the suite
+        # ended (the watchdog path merges the same sink)
+        result.update(_extra[0])
 
     with _print_lock:
         _done[0] = True

@@ -639,10 +639,10 @@ class ServingEngine:
                 # one verify scores k+1 positions; utilization over them
                 # is acceptance-sensitive by design
                 scheduled_slots += self._rounds * (self._k + 1) * b
-                host_outs = np.asarray(outs)      # (R, B, k+1)
-                host_accs = np.asarray(accs)      # (R, B)
-                host_emits = np.asarray(n_emits)  # (R, B)
-                host_actives = np.asarray(actives)
+                (host_outs, host_accs, host_emits,
+                 host_actives) = jax.device_get(
+                    (outs, accs, n_emits, actives)
+                )  # one batched fetch: (R,B,k+1), (R,B) x3
             else:
                 chunk_fn = (
                     self._decode_chunk
@@ -658,8 +658,9 @@ class ServingEngine:
                 )
                 chunks += 1
                 scheduled_slots += self._chunk * b
-                host_toks = np.asarray(toks)    # (C, B)
-                host_emits = np.asarray(emits)  # (C, B)
+                # one batched device→host fetch (each np.asarray would
+                # pay its own tunnel round-trip)
+                host_toks, host_emits = jax.device_get((toks, emits))
                 for r in range(b):
                     prefill_left[r] = max(0, prefill_left[r] - self._chunk)
             for r in range(b):

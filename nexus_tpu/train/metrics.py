@@ -29,22 +29,32 @@ def model_flops_per_token(cfg, seq_len: Optional[int] = None) -> float:
 llama_flops_per_token = model_flops_per_token
 
 
+def detect_generation(kind: str) -> Optional[str]:
+    """device_kind string → TPU generation key (the ONE place the
+    substring aliases live — 'v5 lite', 'trillium', … — shared by the
+    peak-FLOPs table here and bench.py's HBM pre-gate, which keys
+    api/runtime_spec.py's TPU_GENERATIONS off the result)."""
+    kind = kind.lower()
+    for key, gen in (
+        ("v5 lite", "v5e"), ("v5e", "v5e"),
+        ("v6 lite", "v6e"), ("v6e", "v6e"), ("trillium", "v6e"),
+        ("v5p", "v5p"), ("v5", "v5p"),
+        ("v4", "v4"),
+    ):
+        if key in kind:
+            return gen
+    return None
+
+
 def detect_peak_flops_per_chip(default: float = 275e12) -> float:
     """Peak bf16 FLOP/s of the attached accelerator (by device_kind)."""
     try:
         kind = jax.devices()[0].device_kind.lower()
     except Exception:
         return default
-    table = {
-        "v4": 275e12,
-        "v5 lite": 197e12, "v5e": 197e12,
-        "v5p": 459e12, "v5": 459e12,
-        "v6 lite": 918e12, "v6e": 918e12, "trillium": 918e12,
-    }
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return default
+    table = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+    gen = detect_generation(kind)
+    return table.get(gen, default) if gen else default
 
 
 def mfu(

@@ -1,0 +1,99 @@
+"""On-chip decode-step cost probe for the serving engine.
+
+Times the serving chunk programs directly — width-1 (pure decode) and
+the prefill-width program — at several row counts, plus the static
+batch-1 decode step as the reference. This isolates WHERE serving
+throughput goes: per-step model cost vs feed width vs row count vs
+dispatch/host overhead (the per-chunk host fetch pays one tunnel RTT).
+
+    python tools/probe_serve_step.py            # on the attached TPU
+    NEXUS_PROBE_ROWS=1,8,16 NEXUS_PROBE_CHUNK=32 ...
+
+Prints one JSON line: ms/step per (rows, width) plus derived
+aggregate tokens/sec ceilings (rows / step_time).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from nexus_tpu.utils.hw import device_kind, honor_env_platforms
+
+    honor_env_platforms()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from nexus_tpu.models import llama
+    from nexus_tpu.runtime.serving import ServingEngine
+
+    print(f"[probe] backend: {device_kind()}", file=sys.stderr, flush=True)
+    rows_list = [
+        int(r) for r in
+        (os.environ.get("NEXUS_PROBE_ROWS") or "1,8,16").split(",")
+    ]
+    chunk = int(os.environ.get("NEXUS_PROBE_CHUNK") or 32)
+    max_len = int(os.environ.get("NEXUS_PROBE_MAXLEN") or 1024)
+    preset = os.environ.get("NEXUS_PROBE_PRESET") or "400m"
+    cfg = llama.config(preset)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    out = {"preset": preset, "chunk": chunk, "max_len": max_len}
+
+    for rows in rows_list:
+        for width in (1, 16):
+            eng = ServingEngine(
+                llama.forward_decode, params, cfg, batch_size=rows,
+                max_len=max_len, chunk=chunk, prefill_chunk=width,
+            )
+            fn = (eng._decode_chunk if width > 1
+                  else eng._decode_chunk_narrow)
+            from nexus_tpu.models.decoding import init_kv_cache
+
+            def fresh():
+                c = init_kv_cache(
+                    cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
+                    rows, max_len,
+                )
+                c["length"] = jnp.full((rows,), 128, jnp.int32)
+                return c
+
+            zi = lambda: jnp.zeros((rows,), jnp.int32)  # noqa: E731
+            zf = lambda: jnp.zeros((rows,), jnp.float32)  # noqa: E731
+            buf = jnp.zeros((rows, max_len), jnp.int32)
+            done = jnp.zeros((rows,), jnp.bool_)
+            # compile + warm (fresh donated buffers per call)
+            res = fn(params, fresh(), zi(), zi(), done, buf, zi(),
+                     zf(), zi())
+            np.asarray(res[3])
+            times = []
+            for _ in range(3):
+                cache = fresh()
+                t0 = time.monotonic()
+                res = fn(params, cache, zi(), zi(), done, buf, zi(),
+                         zf(), zi())
+                np.asarray(res[3])  # host fetch closes the window
+                times.append(time.monotonic() - t0)
+            best = min(times)
+            ms_per_step = best / chunk * 1e3
+            key = f"rows{rows}_w{width}"
+            out[f"{key}_ms_per_step"] = round(ms_per_step, 3)
+            out[f"{key}_ceiling_tok_s"] = round(rows / (best / chunk), 1)
+            print(
+                f"[probe] rows={rows} width={width}: "
+                f"{ms_per_step:.2f} ms/step "
+                f"(ceiling {rows / (best / chunk):.0f} tok/s)",
+                file=sys.stderr, flush=True,
+            )
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
