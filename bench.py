@@ -714,6 +714,10 @@ def main() -> int:
                     f"deadline {deadline_s}s hit at stage: {_stage[0]}; "
                     "reporting best completed candidate"
                 )
+                if result.get("value"):
+                    # a watchdog exit is still a real measurement — keep
+                    # the last-known-good cache fresh for future runs
+                    _store_cached_result(result)
             else:
                 err = (
                     f"deadline {deadline_s}s exceeded at stage '{_stage[0]}'"
