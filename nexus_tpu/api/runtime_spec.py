@@ -10,9 +10,13 @@ nodeSelectors — no GPU/NCCL in the loop.
 
 from __future__ import annotations
 
+import logging
 import math
+import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 # Exit code for a SIGTERM-interrupted (preempted) worker run. The worker
 # exits with it only when a resumable checkpoint exists; the materializer
@@ -23,10 +27,18 @@ EXIT_PREEMPTED = 75  # EX_TEMPFAIL
 # Known TPU generations with chips-per-host and per-chip peak bf16 FLOP/s.
 # (Public figures: v4 275e12, v5e 197e12, v5p 459e12, v6e "Trillium" 918e12.)
 TPU_GENERATIONS: Dict[str, Dict[str, Any]] = {
-    "v4": {"chips_per_host": 4, "bf16_flops": 275e12, "hbm_gb": 32},
-    "v5e": {"chips_per_host": 4, "bf16_flops": 197e12, "hbm_gb": 16},
-    "v5p": {"chips_per_host": 4, "bf16_flops": 459e12, "hbm_gb": 95},
-    "v6e": {"chips_per_host": 4, "bf16_flops": 918e12, "hbm_gb": 32},
+    # ici_gbps_link: one-way ICI bandwidth per link in GB/s (public
+    # scaling-book figures); ici_torus_dims: torus dimensionality (3D for
+    # v4/v5p pods, 2D for v5e/v6e). A 1D ring over one axis moves
+    # 2 × ici_gbps_link (bidirectional).
+    "v4": {"chips_per_host": 4, "bf16_flops": 275e12, "hbm_gb": 32,
+           "ici_gbps_link": 45.0, "ici_torus_dims": 3},
+    "v5e": {"chips_per_host": 4, "bf16_flops": 197e12, "hbm_gb": 16,
+            "ici_gbps_link": 45.0, "ici_torus_dims": 2},
+    "v5p": {"chips_per_host": 4, "bf16_flops": 459e12, "hbm_gb": 95,
+            "ici_gbps_link": 90.0, "ici_torus_dims": 3},
+    "v6e": {"chips_per_host": 4, "bf16_flops": 918e12, "hbm_gb": 32,
+            "ici_gbps_link": 90.0, "ici_torus_dims": 2},
 }
 
 
@@ -594,6 +606,12 @@ class JaxXlaRuntime:
     data: DataSpec = field(default_factory=DataSpec)
     checkpoint: CheckpointSpec = field(default_factory=CheckpointSpec)
     profile: ProfileSpec = field(default_factory=ProfileSpec)
+    # HBM-budget admission gate mode: 'error' rejects infeasible templates
+    # at validate(), 'warn' logs instead of rejecting (the escape hatch for
+    # families/remat policies whose activation profile the heuristic
+    # doesn't model — ADVICE r4 #2), 'off' skips the check. The
+    # NEXUS_HBM_GATE env var overrides for operators.
+    hbm_gate: str = "error"
 
     def hbm_budget_gb(self) -> Optional[Dict[str, float]]:
         """Paper-math per-chip HBM residency estimate for the declared
@@ -633,13 +651,30 @@ class JaxXlaRuntime:
         n_params = cfg.param_count()
         dt_bytes = _dtype_bytes(getattr(cfg, "dtype", None))
         gb = 1024.0 ** 3
-        # fsdp/tensor/pipeline shard dense params; the expert axis shards
-        # MoE expert weights (the bulk of an MoE's parameters) — counting
-        # it keeps the estimate usable for Mixtral-class templates
-        shards = max(1, p.fsdp * p.tensor * p.pipeline * p.expert)
+        # fsdp/tensor/pipeline shard ALL params; the expert axis shards
+        # ONLY the MoE expert weights (gate/up/down per expert) — a dense
+        # family's params, and an MoE's attention/embedding/router params,
+        # are replicated across the expert axis, so dividing them by
+        # p.expert would underestimate per-chip state (ADVICE r4 #1)
+        dense_shards = max(1, p.fsdp * p.tensor * p.pipeline)
+        n_experts = int(getattr(cfg, "n_experts", 0) or 0)
+        if n_experts > 1:
+            expert_params = min(
+                cfg.n_layers * n_experts * 3 * cfg.d_model
+                * getattr(cfg, "d_ff", cfg.d_model * 4),
+                n_params,
+            )
+        else:
+            expert_params = 0
+        # per-chip parameter count after sharding (fractional is fine —
+        # this is a bytes estimate, not a tensor shape)
+        params_chip = (
+            (n_params - expert_params) / dense_shards
+            + expert_params / (dense_shards * max(1, p.expert))
+        )
         out: Dict[str, float] = {}
         if self.mode == "train":
-            state_bytes = n_params * (2 * dt_bytes + 8) / shards
+            state_bytes = params_chip * (2 * dt_bytes + 8)
             b_chip = max(
                 1, self.train.batch_size // max(1, p.data * p.fsdp)
             )
@@ -663,7 +698,7 @@ class JaxXlaRuntime:
             out["state_gb"] = state_bytes / gb
             out["activations_gb"] = act_bytes / gb
         else:
-            out["state_gb"] = n_params * dt_bytes / shards / gb
+            out["state_gb"] = params_chip * dt_bytes / gb
             rows = self.train.batch_size
             hkv = getattr(cfg, "n_kv_heads", None)
             hd = getattr(cfg, "head_dim", None)
@@ -687,6 +722,62 @@ class JaxXlaRuntime:
         for k in list(out):
             out[k] = round(out[k], 3)
         return out
+
+    def comm_budget_per_step(self, target_mfu: float = 0.35) -> Optional[
+        Dict[str, float]
+    ]:
+        """Paper-math FSDP comm/compute ratio per train step — the ICI
+        all-gather term docs/PERF.md names as the 8B/v5p-64 north star's
+        binding constraint, quantified (VERDICT r4 item 8).
+
+        Model (the scaling-book recipe): a bf16 FSDP step moves ~3 full
+        parameter volumes per chip over the fsdp ring — forward
+        all-gather, backward re-gather, gradient reduce-scatter — each
+        (N-1)/N x param bytes. The ring rides ONE torus axis at 2x the
+        one-way link bandwidth (bidirectional ring); XLA can split the
+        gather across more axes, so this is the conservative end.
+        Compute time assumes 6*P*tokens_per_chip FLOPs at ``target_mfu``
+        of the generation's peak. ratio << 1 means the collectives fit
+        under XLA's latency hiding; ratio >= 1 means exposed comm no
+        overlap can recover. ``breakeven_tokens_per_chip`` is the
+        per-chip tokens/step where the two curves cross."""
+        if self.mode != "train":
+            return None
+        p = self.parallelism
+        if p.fsdp <= 1:
+            return None
+        gen = TPU_GENERATIONS.get(self.tpu.accelerator)
+        if not gen or "ici_gbps_link" not in gen:
+            return None
+        try:
+            from nexus_tpu.models.registry import get_family
+
+            cfg = get_family(self.model.family).config(
+                self.model.preset, **dict(self.model.overrides)
+            )
+        except Exception:  # unresolvable model is reported elsewhere
+            return None
+        n_params = cfg.param_count()
+        dt_bytes = _dtype_bytes(getattr(cfg, "dtype", None))
+        ring_gb_s = 2.0 * gen["ici_gbps_link"]
+        n = p.fsdp
+        comm_bytes = 3.0 * n_params * dt_bytes * (n - 1) / n
+        comm_s = comm_bytes / (ring_gb_s * 1e9)
+        tokens_chip = max(
+            1, self.train.batch_size // max(1, p.data * p.fsdp)
+        ) * self.train.seq_len
+        flops_s = target_mfu * gen["bf16_flops"]
+        compute_s = 6.0 * n_params * tokens_chip / flops_s
+        return {
+            "comm_gb": round(comm_bytes / 1e9, 3),
+            "ici_ring_gb_s": ring_gb_s,
+            "comm_s": round(comm_s, 6),
+            "compute_s": round(compute_s, 6),
+            "comm_compute_ratio": round(comm_s / compute_s, 4),
+            "breakeven_tokens_per_chip": round(
+                comm_s * flops_s / (6.0 * n_params), 1
+            ),
+        }
 
     def validate(self) -> List[str]:
         """Static validation: mesh must tile the slice exactly."""
@@ -911,19 +1002,32 @@ class JaxXlaRuntime:
         # v5e, or 8B/v5p-64 with no fsdp axis). The estimate ignores
         # XLA scratch/fragmentation, so only the unambiguous case —
         # estimate > FULL capacity — is an error.
+        gate = (
+            os.environ.get("NEXUS_HBM_GATE", "").strip() or self.hbm_gate
+            or "error"
+        ).lower()
+        if gate not in ("error", "warn", "off"):
+            errs.append(
+                f"hbmGate must be 'error', 'warn' or 'off', got {gate!r}"
+            )
+            gate = "error"
         hbm_gb = TPU_GENERATIONS.get(self.tpu.accelerator, {}).get("hbm_gb")
-        if hbm_gb and not errs:
+        if hbm_gb and not errs and gate != "off":
             budget = self.hbm_budget_gb()
             if budget and budget["total_gb"] > hbm_gb:
                 detail = ", ".join(
                     f"{k}={v}" for k, v in budget.items() if k != "total_gb"
                 )
-                errs.append(
+                msg = (
                     f"HBM budget infeasible: estimated {budget['total_gb']}"
                     f" GB/chip ({detail}) exceeds {self.tpu.accelerator}'s "
                     f"{hbm_gb} GB; shard more (fsdp/tensor/pipeline), "
                     "shrink the per-chip batch, or enable remat"
                 )
+                if gate == "warn":
+                    logger.warning("%s (hbmGate=warn: admitting anyway)", msg)
+                else:
+                    errs.append(msg)
         return errs
 
     def to_dict(self) -> Dict[str, Any]:
@@ -940,6 +1044,7 @@ class JaxXlaRuntime:
             "data": self.data.to_dict(),
             "checkpoint": self.checkpoint.to_dict(),
             "profile": self.profile.to_dict(),
+            "hbmGate": self.hbm_gate,
         }
 
     @classmethod
@@ -959,4 +1064,5 @@ class JaxXlaRuntime:
             data=DataSpec.from_dict(d.get("data") or {}),
             checkpoint=CheckpointSpec.from_dict(d.get("checkpoint") or {}),
             profile=ProfileSpec.from_dict(d.get("profile") or {}),
+            hbm_gate=d.get("hbmGate", "error") or "error",
         )

@@ -6,10 +6,40 @@ manifest with ``google.com/tpu`` resources and ``gke-tpu-*`` nodeSelectors;
 the launcher watches a shard for runnable templates and executes them (in
 process for local shards, via the cluster API for real ones); entrypoints
 build the mesh/model/trainer from the spec.
+
+Submodules load lazily (PEP 562): the controller's reconcile path touches
+only the materializer, and importing ``entrypoints`` eagerly here dragged
+the whole JAX/orbax stack (~30 s cold on this image — orbax's
+google-cloud-logging dependency scans every installed distribution) into
+the first template sync, which is exactly the template-to-running p50 the
+control-plane bench measures.
 """
 
-from nexus_tpu.runtime.materializer import materialize_job
-from nexus_tpu.runtime.entrypoints import run_template_runtime
-from nexus_tpu.runtime.launcher import LocalLauncher
+from typing import TYPE_CHECKING
 
 __all__ = ["materialize_job", "run_template_runtime", "LocalLauncher"]
+
+if TYPE_CHECKING:  # pragma: no cover — static-analysis imports only
+    from nexus_tpu.runtime.entrypoints import run_template_runtime
+    from nexus_tpu.runtime.launcher import LocalLauncher
+    from nexus_tpu.runtime.materializer import materialize_job
+
+_EXPORTS = {
+    "materialize_job": ("nexus_tpu.runtime.materializer", "materialize_job"),
+    "run_template_runtime": (
+        "nexus_tpu.runtime.entrypoints", "run_template_runtime",
+    ),
+    "LocalLauncher": ("nexus_tpu.runtime.launcher", "LocalLauncher"),
+}
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    return getattr(importlib.import_module(module_name), attr)

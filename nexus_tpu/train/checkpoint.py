@@ -8,17 +8,26 @@ shardings and restored onto the same or a different mesh.
 
 from __future__ import annotations
 
+import importlib
 import os
 from typing import Any, Optional
 
 import jax
-import orbax.checkpoint as ocp
+
+
+def _ocp():
+    """Orbax, imported on first use: its google-cloud-logging dependency
+    scans every installed distribution on import (~30 s cold on this
+    image), a cost only code that actually checkpoints should pay — never
+    the controller's reconcile path or a checkpoint-less train step."""
+    return importlib.import_module("orbax.checkpoint")
 
 
 class Checkpointer:
     """Thin wrapper over orbax CheckpointManager for TrainState pytrees."""
 
     def __init__(self, directory: str, keep: int = 3):
+        ocp = _ocp()
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
@@ -37,7 +46,7 @@ class Checkpointer:
         step = int(state.step) if step is None else step
         if step in (self._mgr.all_steps() or []):
             return step  # already saved (e.g. preemption save + final save)
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        self._mgr.save(step, args=_ocp().args.StandardSave(state))
         if wait:
             self._mgr.wait_until_finished()
         return step
@@ -52,7 +61,7 @@ class Checkpointer:
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
         restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract_state)
+            step, args=_ocp().args.StandardRestore(abstract_state)
         )
         # Re-pin to the template's shardings: orbax can bring replicated
         # scalars (e.g. optimizer step counts) back on a single device, and
@@ -98,7 +107,7 @@ class Checkpointer:
         else:
             abstract["params"] = abstract_params
         restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract)
+            step, args=_ocp().args.StandardRestore(abstract)
         )
         params = restored.params if attr_layout else restored["params"]
         from nexus_tpu.parallel.sharding import repin_tree

@@ -343,6 +343,116 @@ def test_hbm_budget_feasibility_gate():
     assert "kv_cache_gb" in budget and budget["total_gb"] < 16, budget
 
 
+def test_hbm_budget_expert_axis_only_shards_moe_experts():
+    """ADVICE r4 #1: the expert axis shards ONLY MoE expert weights. A
+    dense llama budget is identical at expert=1 and expert=8; a mixtral
+    budget divides the expert FF weights by the expert axis while the
+    attention/embedding/router params stay replicated across it."""
+    from nexus_tpu.api.runtime_spec import TpuSliceSpec
+    from nexus_tpu.models.registry import get_family
+
+    base = dict(
+        tpu=TpuSliceSpec(accelerator="v5p", topology="2x2x2",
+                         slice_count=1),
+        train=TrainSpec(batch_size=8, seq_len=512, steps=1, remat=True),
+    )
+    dense1 = runtime_block(
+        model=ModelRef(family="llama", preset="400m"),
+        parallelism=ParallelismSpec(), **base,
+    ).hbm_budget_gb()
+    dense8 = runtime_block(
+        model=ModelRef(family="llama", preset="400m"),
+        parallelism=ParallelismSpec(expert=8), **base,
+    ).hbm_budget_gb()
+    assert dense8["state_gb"] == pytest.approx(dense1["state_gb"]), (
+        dense1, dense8,
+    )
+
+    moe1 = runtime_block(
+        model=ModelRef(family="mixtral", preset="8x7b"),
+        parallelism=ParallelismSpec(), **base,
+    ).hbm_budget_gb()
+    moe8 = runtime_block(
+        model=ModelRef(family="mixtral", preset="8x7b"),
+        parallelism=ParallelismSpec(expert=8), **base,
+    ).hbm_budget_gb()
+    assert moe8["state_gb"] < moe1["state_gb"]
+    # exact split: dense params replicated, expert params / 8
+    cfg = get_family("mixtral").config("8x7b")
+    expert_params = cfg.n_layers * cfg.n_experts * 3 * cfg.d_model * cfg.d_ff
+    dense_params = cfg.param_count() - expert_params
+    expected = (dense_params + expert_params / 8) * (2 * 2 + 8) / 1024 ** 3
+    assert moe8["state_gb"] == pytest.approx(expected, rel=1e-3)
+
+
+def test_hbm_gate_modes(monkeypatch):
+    """ADVICE r4 #2: hbmGate='warn' admits an HBM-infeasible template
+    with a logged warning instead of rejecting; 'off' skips the check;
+    an unknown mode is itself a validation error; NEXUS_HBM_GATE
+    overrides the spec for operators."""
+    from nexus_tpu.api.runtime_spec import TpuSliceSpec
+
+    monkeypatch.delenv("NEXUS_HBM_GATE", raising=False)
+
+    def infeasible(**kw):
+        return runtime_block(
+            model=ModelRef(family="llama", preset="8b"),
+            tpu=TpuSliceSpec(accelerator="v5e", topology="1x1",
+                             slice_count=1),
+            parallelism=ParallelismSpec(),
+            train=TrainSpec(batch_size=8, seq_len=2048, steps=1,
+                            remat=True),
+            **kw,
+        )
+
+    assert any("HBM budget infeasible" in e
+               for e in infeasible().validate())
+    assert infeasible(hbm_gate="warn").validate() == []
+    assert infeasible(hbm_gate="off").validate() == []
+    errs = infeasible(hbm_gate="sometimes").validate()
+    assert any("hbmGate" in e for e in errs), errs
+    # env override beats the spec field, both directions
+    monkeypatch.setenv("NEXUS_HBM_GATE", "warn")
+    assert infeasible().validate() == []
+    monkeypatch.setenv("NEXUS_HBM_GATE", "error")
+    assert any("HBM budget infeasible" in e
+               for e in infeasible(hbm_gate="warn").validate())
+    # round-trips through the wire format
+    rt = infeasible(hbm_gate="warn")
+    assert JaxXlaRuntime.from_dict(rt.to_dict()).hbm_gate == "warn"
+
+
+def test_comm_budget_8b_north_star_ici_feasible():
+    """VERDICT r4 item 8: the 8B/v5p-64 north-star config's projected
+    FSDP comm/compute ratio is < 1 — ICI all-gather fits under the
+    compute at 35% MFU (paper-math companion to the HBM gate; model
+    documented in docs/PERF.md)."""
+    from nexus_tpu.api.runtime_spec import TpuSliceSpec
+
+    rt = runtime_block(
+        model=ModelRef(family="llama", preset="8b",
+                       overrides={"remat": True,
+                                  "remat_policy": "dots_attn"}),
+        tpu=TpuSliceSpec(accelerator="v5p", topology="4x4x4",
+                         slice_count=1),
+        parallelism=ParallelismSpec(fsdp=64),
+        train=TrainSpec(batch_size=64, seq_len=8192, steps=1, remat=True),
+    )
+    b = rt.comm_budget_per_step(target_mfu=0.35)
+    assert b is not None
+    assert b["comm_compute_ratio"] < 1.0, b
+    # the crossing point is far below the configured 8192 tokens/chip
+    assert b["breakeven_tokens_per_chip"] < 8192 / 4, b
+    # not applicable without an fsdp axis or off train mode
+    assert runtime_block(
+        model=ModelRef(family="llama", preset="8b"),
+        tpu=TpuSliceSpec(accelerator="v5p", topology="4x4x4",
+                         slice_count=1),
+        parallelism=ParallelismSpec(data=64),
+        train=TrainSpec(batch_size=64, seq_len=8192, steps=1),
+    ).comm_budget_per_step() is None
+
+
 def test_run_template_runtime_gptneox_train():
     """The gptneox family trains through the product runtime path on the
     8-device mesh — same contract as the other LM families."""

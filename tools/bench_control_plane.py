@@ -1,0 +1,214 @@
+"""Hermetic control-plane latency bench: template-to-running p50.
+
+BASELINE config #3 tracks ``template_to_running`` p50 for template-driven
+inference; the controller emits the gauges
+(``controller/controller.py::_observe_template_to_running``) but no artifact
+ever published a number (VERDICT r4 item 7). This tool measures it through
+the REAL controller path, CPU-only, no TPU:
+
+  two in-process API servers (controller + shard) over real HTTP sockets ->
+  production ``KubeClusterStore`` clients -> the real ``Controller`` with
+  its workload plane materializing Jobs on the shard -> a kubelet stand-in
+  marking those Jobs Running (stamping ``status.startTime``) -> the
+  controller's own ``template_to_running_seconds`` gauge per template.
+
+Equivalent discipline in the reference: its e2e suite asserts the
+create->visible-on-shard latency envelope against two kind clusters
+(/root/reference/controller_test.go:1304-1328); here the envelope is
+measured and published rather than asserted.
+
+Prints ONE JSON line: {"metric": "template_to_running_p50_s", ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _make_runtime_template(name: str, ns: str):
+    """A template carrying a jax_xla runtime block so the workload plane
+    engages (Jobs materialized on the shard) — mirrors the shape the
+    workload e2e tier uses (tests/test_workload.py)."""
+    from nexus_tpu.api.runtime_spec import (
+        JaxXlaRuntime,
+        ModelRef,
+        ParallelismSpec,
+        TpuSliceSpec,
+        TrainSpec,
+    )
+    from nexus_tpu.api.template import (
+        ComputeResources,
+        Container,
+        NexusAlgorithmSpec,
+        NexusAlgorithmTemplate,
+        RuntimeEnvironment,
+        WorkgroupRef,
+    )
+    from nexus_tpu.api.types import ObjectMeta
+
+    tmpl = NexusAlgorithmTemplate(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=NexusAlgorithmSpec(
+            container=Container(
+                image="algo", registry="ghcr.io/bench",
+                version_tag="v1.0.0", service_account_name="nexus-sa",
+            ),
+            compute_resources=ComputeResources(
+                cpu_limit="4", memory_limit="8Gi"
+            ),
+            workgroup_ref=WorkgroupRef(
+                name="wg-bench", group="science.sneaksanddata.com",
+                kind="NexusAlgorithmWorkgroup",
+            ),
+            command="python",
+            args=["run.py"],
+            runtime_environment=RuntimeEnvironment(),
+        ),
+    )
+    tmpl.spec.runtime = JaxXlaRuntime(
+        mode="train",
+        model=ModelRef(family="llama", preset="tiny"),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="2x2", slice_count=1),
+        parallelism=ParallelismSpec(data=2, tensor=2),
+        train=TrainSpec(batch_size=8, seq_len=32, steps=2),
+    )
+    return tmpl
+
+
+def run_bench(n_templates: int = 24, workers: int = 2,
+              timeout_s: float = 120.0, stagger_s: float = 0.0) -> dict:
+    from nexus_tpu.api.template import NexusAlgorithmTemplate
+    from nexus_tpu.api.workload import Job
+    from nexus_tpu.cluster.kube import KubeClusterStore
+    from nexus_tpu.controller.controller import Controller
+    from nexus_tpu.shards.shard import Shard
+    from nexus_tpu.testing.fakekube import FakeKubeApiServer
+    from nexus_tpu.utils.telemetry import (
+        METRIC_TEMPLATE_TO_RUNNING,
+        METRIC_TEMPLATE_TO_RUNNING_P50,
+        StatsdClient,
+    )
+
+    ns = "nexus-bench"
+    ctrl_srv = FakeKubeApiServer(name="controller").start()
+    shard_srv = FakeKubeApiServer(name="shard0").start()
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="nexus_cp_bench_")
+    ctrl_cfg = ctrl_srv.write_kubeconfig(f"{tmp}/controller.kubeconfig")
+    shard_cfg = shard_srv.write_kubeconfig(f"{tmp}/shard0.kubeconfig")
+    ctrl_store = KubeClusterStore("controller", ctrl_cfg, namespace=ns)
+    shard_store = KubeClusterStore("shard0", shard_cfg, namespace=ns)
+    statsd = StatsdClient("bench")
+    controller = Controller(
+        ctrl_store, [Shard("bench", "shard0", shard_store)],
+        statsd=statsd, resync_period=5.0,
+    )
+
+    stop = threading.Event()
+
+    def kubelet_standin():
+        """Mark every materialized Job Running (active=1, startTime
+        stamped) the moment it appears on the shard API server — the
+        role a kubelet plays in the reference's kind-cluster e2e."""
+        from datetime import datetime, timezone
+
+        while not stop.is_set():
+            try:
+                jobs = shard_srv.store.list(Job.KIND, ns)
+            except Exception:  # noqa: BLE001 — server warming up
+                jobs = []
+            for job in jobs:
+                if not job.status.active and not job.status.succeeded:
+                    job.status.active = 1
+                    job.status.ready = 1
+                    job.status.start_time = datetime.now(
+                        timezone.utc
+                    ).isoformat()
+                    try:
+                        shard_srv.store.update_status(job)
+                    except Exception:  # noqa: BLE001 — raced an update
+                        pass
+            stop.wait(0.02)
+
+    kubelet = threading.Thread(target=kubelet_standin, daemon=True)
+    t0 = time.monotonic()
+    result: dict = {"metric": "template_to_running_p50_s"}
+    try:
+        controller.run(workers=workers)
+        kubelet.start()
+        for i in range(n_templates):
+            # burst (stagger 0) measures a thundering-herd create; a
+            # stagger spaces arrivals so later samples are steady-state
+            if stagger_s and i:
+                time.sleep(stagger_s)
+            ctrl_store.create(_make_runtime_template(f"algo-{i:03d}", ns))
+        metric_name = f"bench.{METRIC_TEMPLATE_TO_RUNNING}"
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with statsd._lock:
+                samples = [
+                    v for (name, v, _tags) in statsd.history
+                    if name == metric_name
+                ]
+            if len(samples) >= n_templates:
+                break
+            time.sleep(0.05)
+        wall_s = time.monotonic() - t0
+        samples.sort()
+        if not samples:
+            return {**result, "error": "no template_to_running samples",
+                    "wall_s": round(wall_s, 3)}
+        p = lambda q: samples[min(len(samples) - 1,  # noqa: E731
+                                  int(q * len(samples)))]
+        result.update({
+            "value": round(p(0.50), 4),
+            "unit": "seconds",
+            "p90_s": round(p(0.90), 4),
+            "max_s": round(samples[-1], 4),
+            "n_templates": n_templates,
+            "n_samples": len(samples),
+            "workers": workers,
+            "stagger_s": stagger_s,
+            "wall_s": round(wall_s, 3),
+            # the controller's own rolling-p50 gauge agrees by construction
+            "controller_p50_gauge": statsd.gauges.get(
+                f"bench.{METRIC_TEMPLATE_TO_RUNNING_P50}"
+            ),
+        })
+        return result
+    finally:
+        stop.set()
+        try:
+            controller.stop()
+        except Exception:  # noqa: BLE001 — best-effort teardown
+            pass
+        ctrl_store.close()
+        shard_store.close()
+        ctrl_srv.stop()
+        shard_srv.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--templates", type=int, default=24)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--stagger", type=float, default=0.0,
+                    help="seconds between template creates (0 = burst)")
+    args = ap.parse_args(argv)
+    result = run_bench(args.templates, args.workers, args.timeout,
+                       args.stagger)
+    print(json.dumps(result), flush=True)
+    return 0 if "value" in result else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
