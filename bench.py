@@ -631,24 +631,57 @@ def _decode_suite(preset, progress, attn="xla", sink=None):
     (``_spec_suite``) so the reported acceptance is a trained rate, not
     random-weights mechanism overhead (VERDICT r3 item 2)."""
     out = sink if sink is not None else {}
+    from nexus_tpu.utils.hw import is_tpu
+
     plain = _run_decode_bench(preset, progress)
     if plain:
         out["decode_tokens_per_sec"] = round(
             plain["decode_tokens_per_sec"], 1
         )
         out["decode_new_tokens"] = plain.get("new_tokens")
+
+    # Leg order is PRIORITY order (a watchdog cut drops the tail, so the
+    # verdict-gated axes run first): serve 8/16 rows (the >=2x batch-1
+    # gate), then trained speculation w/ acceptance, then the int8 and
+    # long-context curiosity legs.
+    serve = _run_serve_bench(preset, progress, rows=8 if is_tpu() else 2)
+    if serve:
+        out["serve_tokens_per_sec"] = serve.get("tokens_per_sec")
+        out["serve_rows"] = serve.get("batch_rows")
+        out["serve_slot_utilization"] = serve.get("slot_utilization")
+        out["serve_requests"] = serve.get("requests")
+        out["serve_latency_p50_s"] = serve.get("request_latency_p50_s")
+        if out.get("decode_tokens_per_sec"):
+            out["serve_vs_batch1_decode"] = round(
+                serve.get("tokens_per_sec", 0.0)
+                / out["decode_tokens_per_sec"], 3,
+            )
+    # 16-row scaling point (VERDICT r4 item 4: measure the dual-width
+    # engine at 8 AND 16 rows)
+    serve16 = _run_serve_bench(preset, progress, rows=16 if is_tpu() else 4)
+    if serve16:
+        out["serve16_tokens_per_sec"] = serve16.get("tokens_per_sec")
+        out["serve16_rows"] = serve16.get("batch_rows")
+        out["serve16_slot_utilization"] = serve16.get("slot_utilization")
+        if out.get("decode_tokens_per_sec"):
+            out["serve16_vs_batch1_decode"] = round(
+                serve16.get("tokens_per_sec", 0.0)
+                / out["decode_tokens_per_sec"], 3,
+            )
+
+    if os.environ.get("NEXUS_BENCH_SPEC", "1") not in ("0", "false"):
+        _spec_suite(progress, attn, sink=out)
+
     int8 = _run_decode_bench(preset, progress, quantized_kv=True)
     if int8:
         out["decode_tokens_per_sec_int8_kv"] = round(
             int8["decode_tokens_per_sec"], 1
         )
-    from nexus_tpu.utils.hw import is_tpu
-
     # LONG-CONTEXT int8 A/B (VERDICT r3 item 5): batch 8 at a
     # 7.5k-token context — the regime where the static masked attention
     # reads ~3.2 GB of bf16 cache per step (vs 0.7 GB of weights), so
     # halving cache bytes can actually pay. The batch-1/short-prompt
-    # legs above measure the regime where it can't (docs/PERF.md).
+    # leg above measures the regime where it can't (docs/PERF.md).
     if is_tpu():
         long_kw = dict(batch=8, prompt_len=7100, max_new=256,
                        max_seq_len=8192, iters=2)
@@ -668,21 +701,6 @@ def _decode_suite(preset, progress, attn="xla", sink=None):
         out["decode_long_ctx_tokens_per_sec_int8_kv"] = round(
             long_i8["decode_tokens_per_sec"], 1
         )
-
-    serve = _run_serve_bench(preset, progress, rows=8 if is_tpu() else 2)
-    if serve:
-        out["serve_tokens_per_sec"] = serve.get("tokens_per_sec")
-        out["serve_rows"] = serve.get("batch_rows")
-        out["serve_slot_utilization"] = serve.get("slot_utilization")
-        out["serve_requests"] = serve.get("requests")
-        out["serve_latency_p50_s"] = serve.get("request_latency_p50_s")
-        if out.get("decode_tokens_per_sec"):
-            out["serve_vs_batch1_decode"] = round(
-                serve.get("tokens_per_sec", 0.0)
-                / out["decode_tokens_per_sec"], 3,
-            )
-    if os.environ.get("NEXUS_BENCH_SPEC", "1") not in ("0", "false"):
-        _spec_suite(progress, attn, sink=out)
     return out
 
 
@@ -842,6 +860,16 @@ def _control_plane_bench(progress):
         if "value" not in rec:
             progress(f"control-plane bench {name}: {rec.get('error')}")
             continue
+        if rec.get("partial"):
+            # only the fastest completions landed before the tool's
+            # deadline — a low-biased p50 must not enter the artifact
+            progress(
+                f"control-plane bench {name}: PARTIAL "
+                f"({rec['n_samples']}/{rec['n_templates']} samples) — "
+                "not publishing"
+            )
+            _sweep_record("control_plane", f"{name}-partial", rec)
+            continue
         progress(
             f"control-plane bench {name}: p50={rec['value']}s "
             f"p90={rec['p90_s']}s (n={rec['n_samples']})"
@@ -850,9 +878,10 @@ def _control_plane_bench(progress):
         if name == "steady":
             out["template_to_running_p50_s"] = rec["value"]
             out["template_to_running_p90_s"] = rec["p90_s"]
+            out["template_to_running_n"] = rec["n_samples"]
         else:
             out["template_to_running_burst_p50_s"] = rec["value"]
-        out["template_to_running_n"] = rec["n_samples"]
+            out["template_to_running_burst_n"] = rec["n_samples"]
     return out
 
 
@@ -1053,7 +1082,9 @@ def main() -> int:
                 # skips the backward's attention-forward recompute
                 (attn, "dots_attn", b, ce_main, hd128),
                 (attn, "dots", b, ce_main, hd128),  # remat A/B (0.597)
-                (attn, "dots", b, ce_main, None),   # preset-heads baseline
+                # (preset-heads baseline dropped round-5: measured 0.464
+                # vs 0.597 on v5e twice — its ~50 s of tunnel compile now
+                # buys deadline headroom for the serve/spec axes)
                 (attn, "dots_attn", b, ce, hd128),  # chunked-CE A/B
                 # max-FLOP probe at the pinned/default batch: kept in the
                 # base list so a pinned-batch sweep still self-tunes onto

@@ -166,6 +166,11 @@ def run_bench(n_templates: int = 24, workers: int = 2,
         if not samples:
             return {**result, "error": "no template_to_running samples",
                     "wall_s": round(wall_s, 3)}
+        if len(samples) < n_templates:
+            # deadline hit with stragglers outstanding: the surviving
+            # subset is the FASTEST completions, so its p50 is biased low
+            # — flag it so consumers don't publish it as the real p50
+            result["partial"] = True
         p = lambda q: samples[min(len(samples) - 1,  # noqa: E731
                                   int(q * len(samples)))]
         result.update({
