@@ -842,9 +842,14 @@ def _control_plane_bench(progress):
     tool = os.path.join(root, "tools", "bench_control_plane.py")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     n = int(os.environ.get("NEXUS_BENCH_CP_TEMPLATES") or 16)
+    # the tool's INTERNAL deadline must fire before the outer subprocess
+    # timeout (which starts counting at spawn, before interpreter/import
+    # setup) — otherwise a straggling leg is killed without ever emitting
+    # its partial/error record
     legs = (
-        ("steady", ["--templates", str(n), "--stagger", "0.25"]),
-        ("burst", ["--templates", str(n)]),
+        ("steady",
+         ["--templates", str(n), "--stagger", "0.25", "--timeout", "80"]),
+        ("burst", ["--templates", str(n), "--timeout", "80"]),
     )
     for name, argv in legs:
         try:

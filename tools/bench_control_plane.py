@@ -171,8 +171,12 @@ def run_bench(n_templates: int = 24, workers: int = 2,
             # subset is the FASTEST completions, so its p50 is biased low
             # — flag it so consumers don't publish it as the real p50
             result["partial"] = True
-        p = lambda q: samples[min(len(samples) - 1,  # noqa: E731
-                                  int(q * len(samples)))]
+        import math
+
+        # nearest-rank percentile: ceil(q*n)-1 (int(q*n) is one rank high
+        # — at n=16 it would report the 9th value, ~p56, as the median)
+        p = lambda q: samples[max(0,  # noqa: E731
+                                  math.ceil(q * len(samples)) - 1)]
         result.update({
             "value": round(p(0.50), 4),
             "unit": "seconds",
