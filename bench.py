@@ -893,7 +893,12 @@ def _control_plane_bench(progress):
 def main() -> int:
     import jax
 
-    from nexus_tpu.utils.hw import device_kind, honor_env_platforms, is_tpu
+    from nexus_tpu.utils.hw import (
+        device_kind,
+        enable_persistent_compilation_cache,
+        honor_env_platforms,
+        is_tpu,
+    )
 
     honor_env_platforms()
 
@@ -1017,6 +1022,11 @@ def main() -> int:
     progress("initializing backend")
     on_tpu = is_tpu()
     progress(f"backend up: {device_kind()} x{len(jax.devices())}")
+    # persistent XLA compile cache, enabled only now that the backend has
+    # RESOLVED to a real TPU: a cold tunnel compile costs 20-40 s per
+    # program and one bench run compiles ~15 of them — executables cached
+    # by any prior session make the driver's run compile-free
+    enable_persistent_compilation_cache(repo_default=True)
     # platform now KNOWN: settle the session log (on-chip sessions only —
     # a CPU fallback must not pollute the committed docs/ artifact) and
     # stamp the device kind into subsequent records

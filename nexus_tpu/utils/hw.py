@@ -50,6 +50,49 @@ def honor_env_platforms() -> None:
         force_platform(env_plat)
 
 
+def enable_persistent_compilation_cache(
+    cache_dir: Optional[str] = None,
+    min_compile_secs: float = 2.0,
+    repo_default: bool = False,
+) -> Optional[str]:
+    """Point XLA's persistent compilation cache at ``cache_dir`` (or the
+    ``NEXUS_XLA_CACHE_DIR`` env var). Executables serialized by one
+    process are reused by the next — on the tunneled TPU backend a cold
+    compile costs 20-40 s per program, so a shared cache turns repeat
+    bench/probe runs from compile-bound into run-bound. Returns the
+    directory actually configured, or None (disabled/unsupported).
+
+    ``repo_default=True`` supplies the shared repo-local ``.jax_cache``
+    when nothing else is configured — but ONLY on a resolved TPU backend
+    (``is_tpu()``; call sites invoke this after backend init): XLA:CPU
+    AOT reloads warn about machine-feature mismatches (SIGILL risk) and
+    CPU compiles are cheap anyway, so an ambient axon,cpu run that fell
+    back to CPU must not populate the shared cache.
+
+    Must be called before the programs of interest are compiled; safe to
+    call more than once. ``NEXUS_XLA_CACHE_DIR=off`` disables."""
+    cache_dir = cache_dir or os.environ.get("NEXUS_XLA_CACHE_DIR") or ""
+    if cache_dir == "off":
+        return None
+    if not cache_dir:
+        if not (repo_default and is_tpu()):
+            return None
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            ".jax_cache",
+        )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_compile_secs
+        )
+        return cache_dir
+    except Exception:  # noqa: BLE001 — older jax / unsupported backend
+        return None
+
+
 def is_tpu() -> bool:
     try:
         return "tpu" in jax.devices()[0].device_kind.lower()

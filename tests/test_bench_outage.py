@@ -29,6 +29,8 @@ FULL_CACHE = {
     "decode_tokens_per_sec_int8_kv": 180.0,
     "serve_tokens_per_sec": 400.0,
     "serve_vs_batch1_decode": 2.1,
+    "serve16_tokens_per_sec": 520.0,
+    "serve16_vs_batch1_decode": 2.7,
     "decode_tokens_per_sec_speculative": 210.0,
     "speculative_acceptance_rate": 0.55,
     "template_to_running_p50_s": 0.05,
@@ -66,13 +68,35 @@ def test_backend_init_hang_fast_fails_with_full_keyed_lkg(tmp_path):
     lkg = out["last_known_good"]
     for key in (
         "value", "mfu_1b", "decode_tokens_per_sec", "serve_tokens_per_sec",
-        "serve_vs_batch1_decode", "decode_tokens_per_sec_speculative",
+        "serve_vs_batch1_decode", "serve16_tokens_per_sec",
+        "decode_tokens_per_sec_speculative",
         "speculative_acceptance_rate", "template_to_running_p50_s",
     ):
         assert key in lkg, (key, lkg)
     # fast-fail means seconds of probe sub-deadline + interpreter/jax
     # import overhead — nowhere near the 1500 s round-4 burn
     assert wall < 90, wall
+
+
+def test_runtime_package_lazy_exports():
+    """The runtime package's PEP 562 lazy exports resolve to the real
+    objects (the eager imports were dropped to keep orbax/JAX out of the
+    controller's first reconcile — the API surface must not regress)."""
+    import nexus_tpu.runtime as rt
+
+    from nexus_tpu.runtime.entrypoints import run_template_runtime
+    from nexus_tpu.runtime.launcher import LocalLauncher
+    from nexus_tpu.runtime.materializer import materialize_job
+
+    assert rt.run_template_runtime is run_template_runtime
+    assert rt.LocalLauncher is LocalLauncher
+    assert rt.materialize_job is materialize_job
+    try:
+        rt.not_an_export
+    except AttributeError:
+        pass
+    else:  # pragma: no cover
+        raise AssertionError("unknown attribute must raise AttributeError")
 
 
 def test_backend_probe_mismatched_cache_not_reported(tmp_path):

@@ -27,6 +27,11 @@ def main() -> int:
     from nexus_tpu.utils.hw import device_kind, honor_env_platforms
 
     honor_env_platforms()
+    from nexus_tpu.utils.hw import enable_persistent_compilation_cache
+
+    # tunnel-compile cache shared with bench.py (helper no-ops unless the
+    # resolved backend is a real TPU or NEXUS_XLA_CACHE_DIR opts in)
+    enable_persistent_compilation_cache(repo_default=True)
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Round-5 on-chip measurement session: one command, strictly sequential
+# (exactly ONE process talks to the TPU tunnel at a time), every stage
+# with its own deadline, every number landing in a machine-readable
+# artifact as it is measured (docs/sweep_r5.jsonl + per-stage JSON).
+#
+#   bash tools/session_r5.sh [outdir]    # default docs/
+#
+# Stages:
+#   1. python bench.py           — the driver-equivalent full pass
+#                                  (train sweep, 1b, decode, serve 8/16,
+#                                  trained speculation, int8 long-ctx,
+#                                  control-plane p50); populates the
+#                                  full-keyed .bench_cache.json
+#   2. probe_serve_step.py       — width x rows serving step table
+#   3. probe_decode_step.py      — single-stream decode attribution
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-docs}"
+STAMP="$(date -u +%Y%m%dT%H%M%SZ)"
+
+echo "[session] stage 1: full bench (deadline 1500s)" >&2
+python bench.py > "$OUT/bench_session_${STAMP}.json" 2> "$OUT/bench_session_${STAMP}.log"
+rc=$?
+tail -c 2000 "$OUT/bench_session_${STAMP}.json" >&2 || true
+echo "[session] bench rc=$rc" >&2
+if [ "$rc" -ne 0 ]; then
+  echo "[session] bench failed (tunnel down?) — skipping probes" >&2
+  exit "$rc"
+fi
+
+echo "[session] stage 2: serve step probe" >&2
+NEXUS_PROBE_ROWS="${NEXUS_PROBE_ROWS:-1,4,8,16}" \
+timeout 900 python tools/probe_serve_step.py \
+  > "$OUT/probe_serve_${STAMP}.json" 2>> "$OUT/bench_session_${STAMP}.log" \
+  || echo "[session] serve probe failed (rc=$?)" >&2
+
+echo "[session] stage 3: decode attribution probe" >&2
+timeout 900 python tools/probe_decode_step.py \
+  > "$OUT/probe_decode_${STAMP}.json" 2>> "$OUT/bench_session_${STAMP}.log" \
+  || echo "[session] decode probe failed (rc=$?)" >&2
+
+echo "[session] done; artifacts:" >&2
+ls -l "$OUT"/bench_session_${STAMP}.json "$OUT"/probe_*_${STAMP}.json 2>&1 >&2 || true
