@@ -78,6 +78,44 @@ def test_backend_init_hang_fast_fails_with_full_keyed_lkg(tmp_path):
     assert wall < 90, wall
 
 
+def test_persistent_compilation_cache_policy(tmp_path, monkeypatch):
+    """The shared compile-cache helper: explicit dir always configures;
+    'off' disables; the repo default engages only on a resolved TPU
+    backend (tests run on CPU, so repo_default must no-op here and never
+    create the shared .jax_cache)."""
+    import jax
+    import pytest
+
+    from nexus_tpu.utils import hw
+
+    if hw.is_tpu():  # pragma: no cover — conftest forces CPU
+        pytest.skip("CPU-branch assertions; repo default engages on TPU")
+    monkeypatch.delenv("NEXUS_XLA_CACHE_DIR", raising=False)
+    explicit = str(tmp_path / "xla_cache")
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        assert hw.enable_persistent_compilation_cache(explicit) == explicit
+        assert os.path.isdir(explicit)
+        assert jax.config.jax_compilation_cache_dir == explicit
+
+        monkeypatch.setenv("NEXUS_XLA_CACHE_DIR", "off")
+        assert hw.enable_persistent_compilation_cache() is None
+        monkeypatch.delenv("NEXUS_XLA_CACHE_DIR", raising=False)
+
+        # CPU backend: the repo default must not engage (config stays
+        # what the finally-block below will clear, not the repo dir)
+        jax.config.update("jax_compilation_cache_dir", None)
+        assert hw.enable_persistent_compilation_cache(
+            repo_default=True
+        ) is None
+        assert jax.config.jax_compilation_cache_dir is None
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prev_min
+        )
+
+
 def test_runtime_package_lazy_exports():
     """The runtime package's PEP 562 lazy exports resolve to the real
     objects (the eager imports were dropped to keep orbax/JAX out of the
