@@ -455,7 +455,12 @@ def _spec_suite(progress, attn, sink=None):
     dsteps = int(os.environ.get("NEXUS_BENCH_SPEC_DRAFT_STEPS")
                  or (400 if on_tpu else 4))
     seq = 1024 if on_tpu else 64
-    max_new = 256 if on_tpu else 48
+    # 512 new tokens matches the plain decode leg's shape exactly
+    # (prompt 64 + new 512 → the same 576-slot program), so the trained
+    # greedy leg REUSES the already-compiled decode executable (~40 s of
+    # tunnel compile) and compares apples-to-apples with
+    # decode_tokens_per_sec
+    max_new = 512 if on_tpu else 48
     base_overrides = {} if on_tpu else {"dtype": "float32"}
     tpu_spec = _tpu_slice_spec()
 
