@@ -1,8 +1,9 @@
 # Developer entry points.
+SHELL := /bin/bash
 NATIVE_SRC := nexus_tpu/native/src/nexus_core.cpp nexus_tpu/native/src/nexus_data.cpp
 NATIVE_LIB := nexus_tpu/native/libnexus_core.so
 
-.PHONY: all native test test-all coverage bench clean lint
+.PHONY: all native test test-all tier1 coverage bench bench-cp race-smoke clean lint
 
 all: native
 
@@ -22,8 +23,22 @@ coverage: native
 	  --cov-report=json:coverage.json --cov-report=term
 	python tools/check_coverage.py coverage.json
 
+# The ROADMAP tier-1 verify command, verbatim (dollar signs make-escaped).
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
 bench:
 	python bench.py
+
+# Control-plane stage only: steady + burst + sequential-baseline burst legs
+# against in-process API servers — burst p50/p90 and the fan-out speedup are
+# checkable on any CPU box, no TPU tunnel touched.
+bench-cp:
+	NEXUS_BENCH_CONTROL_PLANE=only NEXUS_BENCH_INIT_PROBE=0 JAX_PLATFORMS=cpu python bench.py
+
+# Thread-safety smoke for the store/informer/lister under parallel fan-out.
+race-smoke:
+	python tools/race_smoke_store.py --threads 8 --seconds 3
 
 lint:
 	ruff check nexus_tpu tests || true

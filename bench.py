@@ -851,10 +851,24 @@ def _control_plane_bench(progress):
     # timeout (which starts counting at spawn, before interpreter/import
     # setup) — otherwise a straggling leg is killed without ever emitting
     # its partial/error record
+    # burst legs run against 4 shard API servers with a simulated 50 ms
+    # per-request shard RTT (a remote shard cluster's API server is a real
+    # network round trip away — the in-process servers otherwise hide
+    # exactly the latency the fan-out overlaps): "burst" uses the parallel
+    # shard fan-out + write-skip cache (product default), "burst-seq" pins
+    # the executor to 1 worker and disables the cache — the sequential
+    # pre-change baseline — so the speedup is measured on the same machine
+    # in the same run
     legs = (
         ("steady",
          ["--templates", str(n), "--stagger", "0.25", "--timeout", "80"]),
-        ("burst", ["--templates", str(n), "--timeout", "80"]),
+        ("burst",
+         ["--templates", str(n), "--timeout", "80", "--shards", "4",
+          "--shard-latency", "0.05"]),
+        ("burst-seq",
+         ["--templates", str(n), "--timeout", "100", "--shards", "4",
+          "--shard-latency", "0.05", "--shard-sync-workers", "1",
+          "--no-write-skip"]),
     )
     for name, argv in legs:
         try:
@@ -889,9 +903,21 @@ def _control_plane_bench(progress):
             out["template_to_running_p50_s"] = rec["value"]
             out["template_to_running_p90_s"] = rec["p90_s"]
             out["template_to_running_n"] = rec["n_samples"]
-        else:
+        elif name == "burst":
             out["template_to_running_burst_p50_s"] = rec["value"]
+            out["template_to_running_burst_p90_s"] = rec["p90_s"]
             out["template_to_running_burst_n"] = rec["n_samples"]
+            out["burst_coalesced_total"] = rec.get("coalesced_total")
+        else:  # burst-seq: the sequential fan-out baseline
+            out["template_to_running_burst_seq_p50_s"] = rec["value"]
+    burst = out.get("template_to_running_burst_p50_s")
+    seq = out.get("template_to_running_burst_seq_p50_s")
+    if burst and seq:
+        out["burst_fanout_speedup"] = round(seq / burst, 2)
+        progress(
+            f"control-plane burst fan-out speedup: {out['burst_fanout_speedup']}x "
+            f"(parallel p50={burst}s vs sequential p50={seq}s)"
+        )
     return out
 
 
@@ -1003,6 +1029,18 @@ def main() -> int:
 
     # backend-init probe (concurrent with the hermetic control-plane
     # stage, so its sub-deadline costs ~no wall time on a healthy tunnel)
+    # control-plane-only mode (`make bench-cp`): run ONLY the hermetic
+    # control-plane stage — no backend probe, no TPU, no training bench —
+    # so burst/steady p50/p90 is checkable on any CPU box in ~a minute
+    if os.environ.get("NEXUS_BENCH_CONTROL_PLANE", "") == "only":
+        cp = _control_plane_bench(progress)
+        with _print_lock:
+            _done[0] = True
+        if timer is not None:
+            timer.cancel()
+        _emit({"metric": "control_plane_only", **cp})
+        return 0 if cp else 1
+
     probe = _start_backend_probe(progress)
     if os.environ.get("NEXUS_BENCH_CONTROL_PLANE", "1") not in (
         "0", "false"

@@ -21,7 +21,16 @@ from nexus_tpu.cluster.store import ClusterStore, NotFoundError, WatchEvent
 
 
 class Lister:
-    """Read-only view of an informer's cache, keyed ``namespace/name``."""
+    """Read-only view of an informer's cache, keyed ``namespace/name``.
+
+    Thread-safety contract (audited for the parallel shard fan-out):
+    every cache mutation and read holds ``_lock``; ``_set_if_newer`` keeps
+    writes monotonic by resourceVersion so a worker's stale cache-hot write
+    can never clobber a fresher watch delivery. ``get``/``list`` return the
+    cached object by REFERENCE (client-go lister semantics) — callers must
+    ``deepcopy()`` before mutating, which every write path in the
+    controller does. ``tools/race_smoke_store.py`` hammers this contract
+    from N threads."""
 
     def __init__(self):
         self._lock = threading.RLock()
@@ -99,9 +108,13 @@ class Informer:
         on_update: Optional[Callable[[Any, Any], None]] = None,
         on_delete: Optional[Callable[[Any], None]] = None,
     ) -> None:
-        self._handlers.append(
-            {"add": on_add, "update": on_update, "delete": on_delete}
-        )
+        # registration is guarded and dispatch iterates a snapshot: a
+        # handler registered while a watch/resync thread is mid-dispatch
+        # must not mutate the list under the iteration
+        with self._lock:
+            self._handlers = self._handlers + [
+                {"add": on_add, "update": on_update, "delete": on_delete}
+            ]
 
     # ----------------------------------------------------------------- running
     def start(self) -> None:
@@ -119,7 +132,10 @@ class Informer:
             try:
                 self.lister.get(obj.metadata.namespace, obj.metadata.name)
             except NotFoundError:
-                self.lister._set(obj)
+                # _set_if_newer, not _set: a watch event delivered between
+                # the get() check and here must not be clobbered by the
+                # LIST snapshot's (possibly older) copy
+                self.lister._set_if_newer(obj)
                 self._dispatch_add(obj)
         self._synced.set()
         if self.resync_period > 0:
@@ -164,18 +180,22 @@ class Informer:
             for obj in self.lister.list():
                 self._dispatch_update(obj, obj)
 
+    def _snapshot_handlers(self) -> List[Dict[str, Callable]]:
+        with self._lock:
+            return self._handlers  # rebound on registration, never mutated
+
     def _dispatch_add(self, obj: Any) -> None:
-        for h in self._handlers:
+        for h in self._snapshot_handlers():
             if h["add"]:
                 h["add"](obj)
 
     def _dispatch_update(self, old: Any, new: Any) -> None:
-        for h in self._handlers:
+        for h in self._snapshot_handlers():
             if h["update"]:
                 h["update"](old, new)
 
     def _dispatch_delete(self, obj: Any) -> None:
-        for h in self._handlers:
+        for h in self._snapshot_handlers():
             if h["delete"]:
                 h["delete"](obj)
 

@@ -134,9 +134,21 @@ class KubeClusterStore:
             raise
         return self._from_wire(kind, out)
 
-    def list(self, kind: str, namespace: Optional[str] = None) -> List[APIObject]:
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[APIObject]:
         ns = namespace if namespace is not None else self.namespace
-        out = self.api.get(self._collection_path(kind, ns))
+        params = None
+        if label_selector:
+            params = {
+                "labelSelector": ",".join(
+                    f"{k}={v}" for k, v in sorted(label_selector.items())
+                )
+            }
+        out = self.api.get(self._collection_path(kind, ns), params=params)
         return [self._from_wire(kind, i) for i in out.get("items", [])]
 
     def update(self, obj: APIObject, field_manager: str = "") -> APIObject:

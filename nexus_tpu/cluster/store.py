@@ -185,7 +185,15 @@ class ClusterStore:
                 self._record(Action("get", kind, namespace, name))
             return bucket[name].deepcopy()
 
-    def list(self, kind: str, namespace: Optional[str] = None) -> List[APIObject]:
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[APIObject]:
+        """``label_selector`` filters on label equality (the Kubernetes
+        ``labelSelector=k=v,...`` LIST parameter) — server-side filtering so
+        hot-path listers don't deepcopy and ship the whole namespace."""
         with self._lock:
             out: List[APIObject] = []
             for (k, ns), bucket in self._objects.items():
@@ -193,7 +201,15 @@ class ClusterStore:
                     continue
                 if namespace is not None and ns != namespace:
                     continue
-                out.extend(o.deepcopy() for o in bucket.values())
+                for o in bucket.values():
+                    if label_selector:
+                        labels = o.metadata.labels or {}
+                        if any(
+                            labels.get(lk) != lv
+                            for lk, lv in label_selector.items()
+                        ):
+                            continue
+                    out.append(o.deepcopy())
             if self.record_reads:
                 self._record(Action("list", kind, namespace or "", ""))
             return out

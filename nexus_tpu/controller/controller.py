@@ -24,6 +24,17 @@ Behavioral spec (reproduced, not translated, from the reference
   * Rogue detection: a shard resource with zero owner references is "rogue" —
     warning event + error; owned-by-someone-else → adopt by appending this
     template's owner reference (controller.go:484-502).
+
+Concurrency model (beyond the reference, which loops shards sequentially):
+per-shard work in ``template_sync_handler`` / ``workgroup_sync_handler`` /
+the delete fan-outs runs on a bounded per-controller
+:class:`~nexus_tpu.controller.sharding.ShardSyncExecutor`; the first shard
+error cooperatively cancels unstarted siblings and every error aggregates
+into one ``SyncError`` → one rate-limited requeue, exactly like the
+sequential path. A content-hash
+:class:`~nexus_tpu.controller.sharding.WriteSkipCache` lets re-reconciles
+of unchanged templates/secrets/configmaps skip the per-shard compare/write
+entirely (see docs/reconciler-concurrency.md).
 """
 
 from __future__ import annotations
@@ -63,11 +74,20 @@ from nexus_tpu.controller.events import (
     REASON_SYNCED,
     EventRecorder,
 )
+from nexus_tpu.controller.sharding import (
+    ShardFanOutError,
+    ShardSyncExecutor,
+    WriteSkipCache,
+    stable_hash,
+)
 from nexus_tpu.shards.shard import Shard
 from nexus_tpu.utils.telemetry import (
+    METRIC_COALESCED_TOTAL,
     METRIC_RECONCILE_LATENCY,
+    METRIC_SHARD_SYNC_LATENCY,
     METRIC_TEMPLATE_TO_RUNNING,
     METRIC_TEMPLATE_TO_RUNNING_P50,
+    METRIC_WORKQUEUE_DEPTH,
     METRIC_WORKQUEUE_LENGTH,
     StatsdClient,
     get_client,
@@ -112,9 +132,26 @@ class Controller:
         use_finalizers: bool = True,
         resync_period: float = 30.0,
         queue_backend: str = "auto",
+        shard_sync_workers: int = 0,
+        write_skip_cache: bool = True,
     ):
         self.store = controller_store
         self.shards = list(shards)
+        # Parallel shard fan-out: one bounded executor per controller shared
+        # by all reconcile workers. 0 = auto-size (resolved in run(), where
+        # the reconcile worker count is known: shards x workers, capped —
+        # sizing to shard count alone makes concurrent reconciles queue for
+        # fan-out slots and halves the win); 1 = strictly sequential
+        # reference behavior.
+        self._shard_sync_workers_auto = shard_sync_workers <= 0
+        if shard_sync_workers <= 0:
+            shard_sync_workers = min(8, max(1, len(self.shards)))
+        self.shard_executor = ShardSyncExecutor(shard_sync_workers)
+        # Content-hash write-skip cache: unchanged specs/data skip the
+        # per-shard compare + write entirely (invalidated automatically by
+        # shard-side resourceVersion changes, explicitly on deletes/rogues).
+        self.write_skip_cache = WriteSkipCache()
+        self._write_skip = bool(write_skip_cache)
         self.informers = informer_factory or InformerFactory(
             controller_store, resync_period=resync_period
         )
@@ -280,7 +317,8 @@ class Controller:
             # the sync handler already removed the template from every shard
             return
         logger.info("template %s deleted, removing from shards", obj.key())
-        for shard in self.shards:
+
+        def delete_from_shard(shard: Shard) -> None:
             try:
                 shard.delete_template(obj)
             except NotFoundError:
@@ -292,9 +330,22 @@ class Controller:
                 logger.exception(
                     "error deleting template from shard %s", shard.name
                 )
+            self.write_skip_cache.invalidate_object(
+                shard.name, NexusAlgorithmTemplate.KIND,
+                obj.metadata.namespace, obj.metadata.name,
+            )
+
+        # every shard is attempted even if one fails (fn swallows errors)
+        self._fan_out(self.shards, delete_from_shard, fail_fast=False)
+        self.write_skip_cache.invalidate_owner(obj.metadata.uid)
 
     # --------------------------------------------------------------- work loop
-    def run(self, workers: int = 2, wait_cache_sync_timeout: float = 30.0) -> None:
+    def run(
+        self,
+        workers: int = 2,
+        wait_cache_sync_timeout: float = 30.0,
+        warmup_timeout: float = 20.0,
+    ) -> None:
         """Start informers, gate on cache sync, spawn worker threads
         (reference: controller.go:851-884)."""
         if self.work_queue.shutting_down():
@@ -303,15 +354,24 @@ class Controller:
                 "Controller"
             )
         logger.info("starting nexus controller (%d workers)", workers)
+        if self._shard_sync_workers_auto and len(self.shards) > 1:
+            # every reconcile worker fans out to all shards concurrently;
+            # the pool must hold workers x shards tasks to keep them all
+            # in flight (bounded so a large fleet can't spawn unbounded
+            # threads)
+            self.shard_executor.max_workers = min(
+                32, max(1, len(self.shards)) * max(1, workers)
+            )
         # Warm the model registry off the critical path: template
         # admission (validate -> hbm_budget_gb -> get_family) imports the
         # JAX model stack on first use (~1.3 s cold), and paying that
         # inside the first template's reconcile lands straight in the
         # template-to-running latency (BASELINE config #3's p50).
-        threading.Thread(
+        warmup = threading.Thread(
             target=self._warm_admission_imports,
             name="nexus-warmup", daemon=True,
-        ).start()
+        )
+        warmup.start()
         self.informers.start()
         for shard in self.shards:
             shard.start()
@@ -322,6 +382,17 @@ class Controller:
                 raise RuntimeError(
                     f"failed to wait for shard {shard.name} caches to sync"
                 )
+        # Readiness gate: don't accept work until admission is warm —
+        # otherwise a burst arriving right after startup serializes behind
+        # the cold import INSIDE the first reconciles' latency (observed as
+        # two ~1.1 s reconciles that drag the whole burst's t2r p50).
+        # Bounded: a wedged import must not block the controller forever.
+        warmup.join(timeout=max(warmup_timeout, 0.0))
+        if warmup.is_alive():
+            logger.warning(
+                "admission warmup still running after %.0fs; starting "
+                "workers anyway", warmup_timeout,
+            )
         logger.info("informer caches synced; starting workers")
         self._stop.clear()
         for i in range(workers):
@@ -346,9 +417,35 @@ class Controller:
         for t in self._workers:
             t.join(timeout=5.0)
         self._workers = []
+        self.shard_executor.shutdown()
         self.informers.stop()
         for shard in self.shards:
             shard.informers.stop()
+
+    # ----------------------------------------------------------- shard fan-out
+    def _fan_out(self, shards: Sequence[Shard], fn, fail_fast: bool = True):
+        """Run ``fn(shard)`` across shards on the bounded executor, timing
+        each task into the per-shard ``shard_sync_latency`` gauge. Errors
+        (aggregated across shards) surface as a single :class:`SyncError`
+        so the work loop's failure protocol — requeue with backoff — fires
+        exactly once per reconcile, as in the sequential reference path."""
+
+        def timed(shard: Shard):
+            start = time.monotonic()
+            try:
+                return fn(shard)
+            finally:
+                self.statsd.gauge_duration(
+                    METRIC_SHARD_SYNC_LATENCY, start,
+                    tags=[f"shard:{shard.name}"],
+                )
+
+        try:
+            return self.shard_executor.map_shards(
+                shards, timed, fail_fast=fail_fast
+            )
+        except ShardFanOutError as e:
+            raise SyncError(str(e)) from e.first
 
     def _worker_loop(self) -> None:
         # wait.UntilWithContext semantics: crash-guard the loop, restart after 1s
@@ -387,7 +484,15 @@ class Controller:
             self.statsd.gauge_duration(
                 METRIC_RECONCILE_LATENCY, start, tags=[f"object_type:{item.obj_type}"]
             )
-            self.statsd.gauge(METRIC_WORKQUEUE_LENGTH, len(self.work_queue))
+            # same value under two names: workqueue_length is the
+            # reference-parity series, workqueue_depth the coalescing
+            # queue's native pair with coalesced_total
+            depth = self.work_queue.depth()
+            self.statsd.gauge(METRIC_WORKQUEUE_LENGTH, depth)
+            self.statsd.gauge(METRIC_WORKQUEUE_DEPTH, depth)
+            coalesced = getattr(self.work_queue, "coalesced_total", None)
+            if coalesced is not None:
+                self.statsd.gauge(METRIC_COALESCED_TOTAL, coalesced())
         return True
 
     def _finalize_template_delete(self, template: NexusAlgorithmTemplate) -> None:
@@ -398,11 +503,21 @@ class Controller:
         controller.go:195-205, is fire-and-forget; SURVEY.md §7 hard
         part (f))."""
         logger.info("finalizing delete of template %s", template.key())
-        for shard in self.shards:
+
+        def delete_from_shard(shard: Shard) -> None:
             try:
                 shard.delete_template(template)
             except NotFoundError:
                 pass  # already gone from this shard
+            self.write_skip_cache.invalidate_object(
+                shard.name, NexusAlgorithmTemplate.KIND,
+                template.metadata.namespace, template.metadata.name,
+            )
+
+        # fail_fast=False: cover every reachable shard before the requeue —
+        # the finalizer retry then only has the failed shard(s) left to clean
+        self._fan_out(self.shards, delete_from_shard, fail_fast=False)
+        self.write_skip_cache.invalidate_owner(template.metadata.uid)
         updated = template.deepcopy()
         updated.metadata.finalizers = [
             f for f in updated.metadata.finalizers if f != FINALIZER
@@ -555,6 +670,62 @@ class Controller:
                 lister._set_if_newer(stored)
 
     # ------------------------------------------------------- dependent syncing
+    def _sync_template_spec_to_shard(
+        self,
+        template: NexusAlgorithmTemplate,
+        shard: Shard,
+        spec_hash: str,
+    ) -> NexusAlgorithmTemplate:
+        """Create-or-update the template on one shard (reference:
+        controller.go:790-806), with a write-skip fast path: when the source
+        spec hash AND the shard copy's resourceVersion both match the last
+        converged sync, the deep-compare and write are skipped outright.
+        Any shard-side edit bumps the resourceVersion → automatic miss."""
+        namespace, name = template.namespace, template.name
+        shard_template: Optional[NexusAlgorithmTemplate]
+        try:
+            shard_template = shard.template_lister.get(namespace, name)  # type: ignore[assignment]
+        except NotFoundError:
+            shard_template = None
+
+        if (
+            shard_template is not None
+            and self._write_skip
+            and self.write_skip_cache.check(
+                shard.name, NexusAlgorithmTemplate.KIND, namespace, name,
+                spec_hash, shard_template.metadata.resource_version,
+            )
+        ):
+            return shard_template
+
+        if shard_template is not None and not deep_equal(
+            shard_template.spec, template.spec
+        ):
+            logger.debug(
+                "spec drift for template %s on shard %s, updating",
+                name,
+                shard.name,
+            )
+            shard_template = shard.update_template(
+                shard_template, template.spec, FIELD_MANAGER
+            )
+            shard.template_lister._set_if_newer(shard_template)
+        elif shard_template is None:
+            logger.debug(
+                "template %s not found in shard %s, creating", name, shard.name
+            )
+            shard_template = shard.create_template(
+                template.name, template.namespace, template.spec, FIELD_MANAGER
+            )
+            shard.template_lister._set_if_newer(shard_template)
+
+        if self._write_skip:
+            self.write_skip_cache.store(
+                shard.name, NexusAlgorithmTemplate.KIND, namespace, name,
+                spec_hash, shard_template.metadata.resource_version,
+            )
+        return shard_template
+
     def _sync_dependents_to_shard(
         self,
         kind: str,
@@ -574,6 +745,9 @@ class Controller:
         shard_lister = shard.secret_lister if is_secret else shard.config_map_lister
         create = shard.create_secret if is_secret else shard.create_config_map
         update = shard.update_secret if is_secret else shard.update_config_map
+        # write-skip entries are verified per owning template: a hit for one
+        # owner must not let another owner skip its own adoption write
+        owner_uid = controller_template.metadata.uid
 
         for name in names:
             try:
@@ -588,9 +762,24 @@ class Controller:
                 )
                 raise SyncError(msg)
 
+            data_hash = stable_hash(source.data) if self._write_skip else ""
             try:
                 shard_obj = shard_lister.get(shard_template.namespace, name)
             except NotFoundError:
+                shard_obj = None
+
+            if (
+                shard_obj is not None
+                and self._write_skip
+                and self.write_skip_cache.check(
+                    shard.name, kind, shard_template.namespace, name,
+                    data_hash, shard_obj.metadata.resource_version,
+                    owner_uid,
+                )
+            ):
+                continue  # converged at this exact content + shard rv
+
+            if shard_obj is None:
                 try:
                     shard_obj = create(shard_template, source, FIELD_MANAGER)
                 except Exception as e:
@@ -608,6 +797,10 @@ class Controller:
             try:
                 missing_owner = self._is_missing_ownership(shard_obj, shard_template)
             except SyncError as e:
+                # rogue object: make sure no stale converged entry survives
+                self.write_skip_cache.invalidate_object(
+                    shard.name, kind, shard_template.namespace, name
+                )
                 self.recorder.event(
                     controller_template,
                     EVENT_TYPE_WARNING,
@@ -626,6 +819,13 @@ class Controller:
                 logger.debug("ownership missing for %s %s, updating", kind, name)
                 shard_obj = update(shard_obj, None, shard_template, FIELD_MANAGER)
                 shard_lister._set_if_newer(shard_obj)
+
+            if self._write_skip:
+                self.write_skip_cache.store(
+                    shard.name, kind, shard_template.namespace, name,
+                    data_hash, shard_obj.metadata.resource_version,
+                    owner_uid,
+                )
 
     # ------------------------------------------------------------ sync handlers
     def _resolve_placement(self, template: NexusAlgorithmTemplate) -> List[Shard]:
@@ -666,6 +866,13 @@ class Controller:
             logger.info(
                 "template %s/%s no longer exists; dropping", namespace, name
             )
+            # the delete fan-outs already invalidate, but a template that
+            # vanished without passing through them (e.g. lister raced the
+            # finalizer) must not strand converged entries
+            for shard in self.shards:
+                self.write_skip_cache.invalidate_object(
+                    shard.name, NexusAlgorithmTemplate.KIND, namespace, name
+                )
             return
 
         if self.use_finalizers:
@@ -692,36 +899,12 @@ class Controller:
             except NotFoundError:
                 workgroup = None
 
-        workload_phases: dict = {}
-        workload_starts: dict = {}
-        for shard in placed_shards:
-            shard_template: Optional[NexusAlgorithmTemplate]
-            try:
-                shard_template = shard.template_lister.get(namespace, name)  # type: ignore[assignment]
-            except NotFoundError:
-                shard_template = None
+        spec_hash = stable_hash(template.spec) if self._write_skip else ""
 
-            if shard_template is not None and not deep_equal(
-                shard_template.spec, template.spec
-            ):
-                logger.debug(
-                    "spec drift for template %s on shard %s, updating",
-                    name,
-                    shard.name,
-                )
-                shard_template = shard.update_template(
-                    shard_template, template.spec, FIELD_MANAGER
-                )
-                shard.template_lister._set_if_newer(shard_template)
-            elif shard_template is None:
-                logger.debug(
-                    "template %s not found in shard %s, creating", name, shard.name
-                )
-                shard_template = shard.create_template(
-                    template.name, template.namespace, template.spec, FIELD_MANAGER
-                )
-                shard.template_lister._set_if_newer(shard_template)
-
+        def sync_one_shard(shard: Shard):
+            shard_template = self._sync_template_spec_to_shard(
+                template, shard, spec_hash
+            )
             self._sync_dependents_to_shard(
                 Secret.KIND,
                 shard_template.get_secret_names(),
@@ -736,18 +919,28 @@ class Controller:
                 shard_template,
                 shard,
             )
-
             if template.spec.runtime is not None:
-                phase, started_at = self._sync_workload_to_shard(
+                return self._sync_workload_to_shard(
                     template, shard_template, shard, workgroup
                 )
-                workload_phases[shard.name] = phase
-                workload_starts[shard.name] = started_at
-            else:
-                # runtime block removed: stop + clean up previously
-                # materialized workloads (they'd otherwise burn TPU until the
-                # template itself is deleted)
-                self._remove_workload_from_shard(template, shard)
+            # runtime block removed: stop + clean up previously
+            # materialized workloads (they'd otherwise burn TPU until the
+            # template itself is deleted)
+            self._remove_workload_from_shard(template, shard)
+            return None
+
+        results = self._fan_out(placed_shards, sync_one_shard)
+
+        # per-shard bookkeeping rebuilt in placed-shard order so status and
+        # events stay deterministic regardless of task completion order
+        workload_phases: dict = {}
+        workload_starts: dict = {}
+        for shard, result in zip(placed_shards, results):
+            if result is None:
+                continue
+            phase, started_at = result
+            workload_phases[shard.name] = phase
+            workload_starts[shard.name] = started_at
 
         self._remove_from_unselected_shards(template, placed_shards)
 
@@ -792,7 +985,7 @@ class Controller:
         change produces different Job specs, which replaces the failed Job
         and relaunches every slice (the JobSet failurePolicy equivalent).
         """
-        from nexus_tpu.api.workload import Job, aggregate_phase
+        from nexus_tpu.api.workload import Job, Service, aggregate_phase
         from nexus_tpu.runtime.materializer import (
             materialize_headless_service,
             materialize_job,
@@ -807,17 +1000,39 @@ class Controller:
             )
             raise SyncError(str(e)) from e
 
-        for manifest in svc_manifests:
-            shard.apply_service(shard_template, manifest, FIELD_MANAGER)
-
         ns = template.namespace
-        current: dict = {}
-        for manifest in job_manifests:
-            name = manifest["metadata"]["name"]
-            try:
-                current[name] = shard.store.get(Job.KIND, ns, name)
-            except NotFoundError:
-                current[name] = None
+        # One label-filtered LIST per kind replaces the per-object GETs this
+        # loop (and the prune pass below) used to issue — against a remote
+        # shard every round trip is a cross-cluster RTT, and the server-side
+        # selector keeps the payload O(this template's slices), not
+        # O(namespace) (the burst hot path is CPU-bound on conversions).
+        from nexus_tpu.runtime.materializer import LABEL_TEMPLATE as _LT
+
+        selector = {
+            LABEL_CONTROLLER_APP: CONTROLLER_APP_NAME,
+            _LT: template.name,
+        }
+        jobs_by_name = {
+            o.metadata.name: o
+            for o in shard.store.list(Job.KIND, ns, label_selector=selector)
+        }
+        svcs_by_name = {
+            o.metadata.name: o
+            for o in shard.store.list(
+                Service.KIND, ns, label_selector=selector
+            )
+        }
+
+        for manifest in svc_manifests:
+            shard.apply_service(
+                shard_template, manifest, FIELD_MANAGER,
+                existing=svcs_by_name.get(manifest["metadata"]["name"]),
+            )
+
+        current: dict = {
+            m["metadata"]["name"]: jobs_by_name.get(m["metadata"]["name"])
+            for m in job_manifests
+        }
 
         def _is_current(job, manifest) -> bool:
             return job is not None and deep_equal(
@@ -852,7 +1067,9 @@ class Controller:
                     job = None
                 phases.append("Failed" if name in failed_current else "Pending")
                 continue
-            applied = shard.apply_job(shard_template, manifest, FIELD_MANAGER)
+            applied = shard.apply_job(
+                shard_template, manifest, FIELD_MANAGER, existing=job
+            )
             phases.append(applied.phase())
             starts.append(applied.status.start_time)
 
@@ -863,6 +1080,10 @@ class Controller:
             template, shard,
             {m["metadata"]["name"] for m in job_manifests}
             | {m["metadata"]["name"] for m in svc_manifests},
+            listed={
+                Job.KIND: list(jobs_by_name.values()),
+                Service.KIND: list(svcs_by_name.values()),
+            },
         )
 
         phase = aggregate_phase(phases)
@@ -886,13 +1107,23 @@ class Controller:
         return phase, started_at
 
     def _prune_stale_workload(
-        self, template: NexusAlgorithmTemplate, shard: Shard, keep: set
+        self,
+        template: NexusAlgorithmTemplate,
+        shard: Shard,
+        keep: set,
+        listed: Optional[dict] = None,
     ) -> None:
+        """``listed`` (kind -> objects) reuses the caller's LIST snapshot;
+        without it each kind is listed here (one extra round trip each)."""
         from nexus_tpu.api.workload import Job, Service
         from nexus_tpu.runtime.materializer import LABEL_TEMPLATE
 
         for kind in (Job.KIND, Service.KIND):
-            for obj in shard.store.list(kind, template.namespace):
+            objs = (
+                listed[kind] if listed is not None
+                else shard.store.list(kind, template.namespace)
+            )
+            for obj in objs:
                 labels = obj.metadata.labels or {}
                 if (
                     labels.get(LABEL_CONTROLLER_APP) == CONTROLLER_APP_NAME
@@ -917,19 +1148,20 @@ class Controller:
         from nexus_tpu.api.workload import Job, Service
         from nexus_tpu.runtime.materializer import LABEL_TEMPLATE
 
+        selector = {
+            LABEL_CONTROLLER_APP: CONTROLLER_APP_NAME,
+            LABEL_TEMPLATE: template.name,
+        }
         for kind in (Job.KIND, Service.KIND):
-            for obj in shard.store.list(kind, template.namespace):
-                labels = obj.metadata.labels or {}
-                if (
-                    labels.get(LABEL_CONTROLLER_APP) == CONTROLLER_APP_NAME
-                    and labels.get(LABEL_TEMPLATE) == template.name
-                ):
-                    try:
-                        shard.store.delete(
-                            kind, obj.namespace, obj.metadata.name
-                        )
-                    except NotFoundError:
-                        pass
+            for obj in shard.store.list(
+                kind, template.namespace, label_selector=selector
+            ):
+                try:
+                    shard.store.delete(
+                        kind, obj.namespace, obj.metadata.name
+                    )
+                except NotFoundError:
+                    pass
 
     def _observe_template_to_running(
         self,
@@ -994,18 +1226,18 @@ class Controller:
         Only copies stamped with our provenance label are touched — foreign
         templates sharing the name are left alone."""
         placed_names = {s.name for s in placed_shards}
-        for shard in self.shards:
-            if shard.name in placed_names:
-                continue
+        unselected = [s for s in self.shards if s.name not in placed_names]
+
+        def remove_stale(shard: Shard) -> None:
             try:
                 stale = shard.template_lister.get(
                     template.namespace, template.name
                 )
             except NotFoundError:
-                continue
+                return
             labels = stale.metadata.labels or {}
             if labels.get(LABEL_CONTROLLER_APP) != CONTROLLER_APP_NAME:
-                continue
+                return
             logger.info(
                 "removing template %s from shard %s (no longer selected by "
                 "placement)", template.key(), shard.name,
@@ -1015,6 +1247,15 @@ class Controller:
             except NotFoundError:
                 pass
             shard.template_lister._delete(stale)
+            self.write_skip_cache.invalidate_object(
+                shard.name, NexusAlgorithmTemplate.KIND,
+                template.namespace, template.name,
+            )
+            self.write_skip_cache.invalidate_owner(
+                template.metadata.uid, shard.name
+            )
+
+        self._fan_out(unselected, remove_stale)
 
     def workgroup_sync_handler(self, namespace: str, name: str) -> None:
         """Workgroup reconcile: same shape, no dependents (reference:
@@ -1025,16 +1266,34 @@ class Controller:
             logger.info(
                 "workgroup %s/%s no longer exists; dropping", namespace, name
             )
+            # drop its converged entries, or deleted workgroups leak one
+            # cache entry per shard forever in a long-running controller
+            for shard in self.shards:
+                self.write_skip_cache.invalidate_object(
+                    shard.name, NexusAlgorithmWorkgroup.KIND, namespace, name
+                )
             return
 
         workgroup = self._report_workgroup_init_condition(workgroup)
 
-        for shard in self.shards:
+        spec_hash = stable_hash(workgroup.spec) if self._write_skip else ""
+
+        def sync_one_shard(shard: Shard) -> None:
             shard_wg: Optional[NexusAlgorithmWorkgroup]
             try:
                 shard_wg = shard.workgroup_lister.get(namespace, name)  # type: ignore[assignment]
             except NotFoundError:
                 shard_wg = None
+
+            if (
+                shard_wg is not None
+                and self._write_skip
+                and self.write_skip_cache.check(
+                    shard.name, NexusAlgorithmWorkgroup.KIND, namespace, name,
+                    spec_hash, shard_wg.metadata.resource_version,
+                )
+            ):
+                return
 
             if shard_wg is not None and not deep_equal(shard_wg.spec, workgroup.spec):
                 logger.debug(
@@ -1051,6 +1310,14 @@ class Controller:
                     workgroup.name, workgroup.namespace, workgroup.spec, FIELD_MANAGER
                 )
                 shard.workgroup_lister._set_if_newer(shard_wg)
+
+            if self._write_skip:
+                self.write_skip_cache.store(
+                    shard.name, NexusAlgorithmWorkgroup.KIND, namespace, name,
+                    spec_hash, shard_wg.metadata.resource_version,
+                )
+
+        self._fan_out(self.shards, sync_one_shard)
 
         workgroup = self._report_workgroup_synced_condition(workgroup)
         self.recorder.event(

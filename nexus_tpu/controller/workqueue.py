@@ -30,6 +30,9 @@ class WorkQueue:
         self._dirty: Set[Any] = set()
         self._processing: Set[Any] = set()
         self._shutting_down = False
+        # burst coalescing bookkeeping: every add absorbed by the dirty-set
+        # dedup is a duplicate key coalesced into the one already waiting
+        self._coalesced_total = 0
         # delayed adds
         self._delay_heap: List[Tuple[float, int, Any]] = []
         self._delay_seq = 0
@@ -41,6 +44,7 @@ class WorkQueue:
             if self._shutting_down:
                 return
             if item in self._dirty:
+                self._coalesced_total += 1
                 return
             self._dirty.add(item)
             if item in self._processing:
@@ -77,6 +81,17 @@ class WorkQueue:
     def __len__(self) -> int:
         with self._cond:
             return len(self._queue)
+
+    def depth(self) -> int:
+        """Current waiting depth — the ``workqueue_depth`` gauge."""
+        return len(self)
+
+    def coalesced_total(self) -> int:
+        """Duplicate keys absorbed by dedup since construction — the
+        ``coalesced_total`` gauge. A burst of M events for N distinct keys
+        coalesces into N reconciles and M-N counted duplicates."""
+        with self._cond:
+            return self._coalesced_total
 
     def shutting_down(self) -> bool:
         with self._cond:

@@ -52,6 +52,8 @@ def build_controller(config: AppConfig, controller_store: Optional[ClusterStore]
         use_finalizers=config.use_finalizers,
         resync_period=config.resync_period_seconds,
         queue_backend=config.queue_backend,
+        shard_sync_workers=config.shard_sync_workers,
+        write_skip_cache=config.write_skip_cache,
     )
 
 

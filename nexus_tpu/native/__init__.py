@@ -121,6 +121,8 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.ncq_num_requeues.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.ncq_len.restype = ctypes.c_int
     lib.ncq_len.argtypes = [ctypes.c_void_p]
+    lib.ncq_coalesced_total.restype = ctypes.c_longlong
+    lib.ncq_coalesced_total.argtypes = [ctypes.c_void_p]
     lib.ncq_tracked.restype = ctypes.c_int
     lib.ncq_tracked.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.ncq_shut_down.argtypes = [ctypes.c_void_p]
@@ -252,6 +254,14 @@ class NativeRateLimitingQueue:
 
     def __len__(self) -> int:
         return int(self._lib.ncq_len(self._q))
+
+    def depth(self) -> int:
+        return len(self)
+
+    def coalesced_total(self) -> int:
+        """Duplicate keys absorbed by the native dedup (exact counter,
+        maintained inside ``add_locked`` in nexus_core.cpp)."""
+        return int(self._lib.ncq_coalesced_total(self._q))
 
     def shutting_down(self) -> bool:
         return bool(self._lib.ncq_shutting_down(self._q))

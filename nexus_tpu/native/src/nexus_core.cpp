@@ -192,6 +192,11 @@ class WorkQueue {
     return static_cast<int>(queue_.size());
   }
 
+  long long coalesced_total() {
+    std::lock_guard<std::mutex> g(mu_);
+    return static_cast<long long>(coalesced_);
+  }
+
   bool shutting_down() {
     std::lock_guard<std::mutex> g(mu_);
     return shutting_down_;
@@ -207,7 +212,10 @@ class WorkQueue {
  private:
   void add_locked(const std::string& key) {
     if (shutting_down_) return;
-    if (dirty_.count(key)) return;  // dedup waiting keys
+    if (dirty_.count(key)) {  // dedup waiting keys — burst coalescing
+      ++coalesced_;
+      return;
+    }
     dirty_.insert(key);
     if (processing_.count(key)) return;  // park until done()
     queue_.push_back(key);
@@ -262,6 +270,7 @@ class WorkQueue {
       delay_heap_;
   std::unordered_map<std::string, int> delayed_count_;
   uint64_t seq_ = 0;
+  uint64_t coalesced_ = 0;
   bool shutting_down_ = false;
   std::thread delay_thread_;
 
@@ -311,6 +320,10 @@ int ncq_num_requeues(void* q, const char* key) {
 
 int ncq_len(void* q) { return static_cast<WorkQueue*>(q)->len(); }
 
+long long ncq_coalesced_total(void* q) {
+  return static_cast<WorkQueue*>(q)->coalesced_total();
+}
+
 int ncq_tracked(void* q, const char* key) {
   return static_cast<WorkQueue*>(q)->tracked(key) ? 1 : 0;
 }
@@ -321,6 +334,6 @@ int ncq_shutting_down(void* q) {
   return static_cast<WorkQueue*>(q)->shutting_down() ? 1 : 0;
 }
 
-int ncq_abi_version() { return 1; }
+int ncq_abi_version() { return 2; }
 
 }  // extern "C"

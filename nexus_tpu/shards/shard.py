@@ -34,7 +34,7 @@ from nexus_tpu.api.workgroup import (
 )
 from nexus_tpu.api.workload import Job, Service
 from nexus_tpu.cluster.informer import InformerFactory, Lister
-from nexus_tpu.cluster.store import ClusterStore, NotFoundError
+from nexus_tpu.cluster.store import ClusterStore, ConflictError, NotFoundError
 
 
 class Shard:
@@ -240,32 +240,56 @@ class Shard:
         return self._update_dependent(config_map, data, owner, field_manager)  # type: ignore[return-value]
 
     # -------------------------------------------------------------- workloads
+    _UNRESOLVED = object()  # sentinel: caller did not pre-resolve `existing`
+
     def apply_job(
         self,
         owner: NexusAlgorithmTemplate,
         manifest: Dict,
         field_manager: str = "",
+        existing=_UNRESOLVED,
     ) -> Job:
         """Create-or-update a materialized Job on this shard.
 
         Job specs are immutable after creation in Kubernetes (other than
         suspend/parallelism); on pod-template drift the old Job is deleted
         and recreated — the same converge contract the template sync uses,
-        adapted to batch/v1 semantics."""
+        adapted to batch/v1 semantics.
+
+        ``existing`` lets a caller that already listed the shard's Jobs
+        (the reconcile hot path batches one LIST per kind per shard) hand
+        over the current object (or ``None``), skipping the per-job GET
+        round trip."""
         job = Job.from_manifest(manifest)
         job.metadata.labels.update(self.provenance_labels())
         job.metadata.owner_references = [self._template_owner_ref(owner)]
-        try:
-            existing = self.store.get(
-                Job.KIND, job.metadata.namespace, job.metadata.name
-            )
-        except NotFoundError:
-            return self.store.create(job, field_manager=field_manager)  # type: ignore[return-value]
+        if existing is Shard._UNRESOLVED:
+            try:
+                existing = self.store.get(
+                    Job.KIND, job.metadata.namespace, job.metadata.name
+                )
+            except NotFoundError:
+                existing = None
+        if existing is None:
+            try:
+                return self.store.create(job, field_manager=field_manager)  # type: ignore[return-value]
+            except ConflictError:
+                # name collision with an object the caller's label-filtered
+                # LIST could not see (foreign/unlabeled same-name Job):
+                # point-GET it and converge below instead of requeue-looping
+                existing = self.store.get(
+                    Job.KIND, job.metadata.namespace, job.metadata.name
+                )
         from nexus_tpu.api.types import deep_equal
 
         if deep_equal(existing.spec, job.spec):
             return existing  # type: ignore[return-value]
-        self.store.delete(Job.KIND, job.metadata.namespace, job.metadata.name)
+        try:
+            self.store.delete(
+                Job.KIND, job.metadata.namespace, job.metadata.name
+            )
+        except NotFoundError:
+            pass  # raced a concurrent delete; create below converges
         return self.store.create(job, field_manager=field_manager)  # type: ignore[return-value]
 
     def apply_service(
@@ -273,16 +297,26 @@ class Shard:
         owner: NexusAlgorithmTemplate,
         manifest: Dict,
         field_manager: str = "",
+        existing=_UNRESOLVED,
     ) -> Service:
         svc = Service.from_manifest(manifest)
         svc.metadata.labels.update(self.provenance_labels())
         svc.metadata.owner_references = [self._template_owner_ref(owner)]
-        try:
-            existing = self.store.get(
-                Service.KIND, svc.metadata.namespace, svc.metadata.name
-            )
-        except NotFoundError:
-            return self.store.create(svc, field_manager=field_manager)  # type: ignore[return-value]
+        if existing is Shard._UNRESOLVED:
+            try:
+                existing = self.store.get(
+                    Service.KIND, svc.metadata.namespace, svc.metadata.name
+                )
+            except NotFoundError:
+                existing = None
+        if existing is None:
+            try:
+                return self.store.create(svc, field_manager=field_manager)  # type: ignore[return-value]
+            except ConflictError:
+                # same label-blind collision fallback as apply_job
+                existing = self.store.get(
+                    Service.KIND, svc.metadata.namespace, svc.metadata.name
+                )
         from nexus_tpu.api.types import deep_equal
 
         if deep_equal(existing.spec, svc.spec):

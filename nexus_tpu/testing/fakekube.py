@@ -84,8 +84,13 @@ class FakeKubeApiServer:
     """HTTP API server over a ClusterStore. Start/stop per test."""
 
     def __init__(self, store: Optional[ClusterStore] = None, name: str = "fake",
-                 required_token: str = ""):
+                 required_token: str = "", latency_s: float = 0.0):
         self.store = store or ClusterStore(name)
+        # Simulated request RTT (control-plane bench realism: a remote shard
+        # cluster's API server is a network round trip away, not a
+        # same-process call). Applied to every non-watch request, slept
+        # before handling — real wall time, GIL released.
+        self.latency_s = float(latency_s)
         self.events: List[Dict[str, Any]] = []  # posted v1 Events
         # when set, every request must carry `Authorization: Bearer <this>`
         # (exercises the client's auth plumbing, incl. exec plugins)
@@ -193,6 +198,12 @@ class FakeKubeApiServer:
                     },
                 )
 
+            def _simulate_rtt(self):
+                if server.latency_s > 0:
+                    import time
+
+                    time.sleep(server.latency_s)
+
             def _route(self):
                 """path → (kind, namespace, name|None, subresource|None)."""
                 parsed = urlparse(self.path)
@@ -239,6 +250,9 @@ class FakeKubeApiServer:
             def do_GET(self):  # noqa: N802
                 if not self._authorized():
                     return
+                params = parse_qs(urlparse(self.path).query)
+                if params.get("watch", ["0"])[0] not in ("1", "true"):
+                    self._simulate_rtt()
                 route = self._route()
                 if route is None:
                     if urlparse(self.path).path == "/-/compact":
@@ -258,8 +272,18 @@ class FakeKubeApiServer:
                         # an rv newer than the snapshot would make watch
                         # resumption skip the in-between events (RLock, so
                         # the nested list() locking is fine)
+                        selector = None
+                        raw_sel = params.get("labelSelector", [""])[0]
+                        if raw_sel:
+                            selector = dict(
+                                part.split("=", 1)
+                                for part in raw_sel.split(",")
+                                if "=" in part
+                            )
                         with server.store._lock:
-                            items = server.store.list(kind, ns)
+                            items = server.store.list(
+                                kind, ns, label_selector=selector
+                            )
                             rv = str(server.store._rv_counter)
                         self._send_json(
                             200,
@@ -279,6 +303,7 @@ class FakeKubeApiServer:
             def do_POST(self):  # noqa: N802
                 if not self._authorized():
                     return
+                self._simulate_rtt()
                 route = self._route()
                 if route is None:
                     self._status(404, "NotFound", f"no route {self.path}")
@@ -302,6 +327,7 @@ class FakeKubeApiServer:
             def do_PUT(self):  # noqa: N802
                 if not self._authorized():
                     return
+                self._simulate_rtt()
                 route = self._route()
                 if route is None or route[2] is None:
                     self._status(404, "NotFound", f"no route {self.path}")
@@ -328,6 +354,7 @@ class FakeKubeApiServer:
             def do_DELETE(self):  # noqa: N802
                 if not self._authorized():
                     return
+                self._simulate_rtt()
                 route = self._route()
                 if route is None or route[2] is None:
                     self._status(404, "NotFound", f"no route {self.path}")
@@ -390,10 +417,20 @@ class FakeKubeApiServer:
                                 },
                             )
                             break
+                        # entries are rv-ascending (appended in commit
+                        # order): bisect past the cursor instead of
+                        # re-scanning the whole window on every wakeup —
+                        # O(window) scans per event per watcher dominated
+                        # the server's CPU under burst load
+                        import bisect
+
+                        start = bisect.bisect_right(
+                            hist.entries, cursor, key=lambda e: e[0]
+                        )
                         pending = [
                             e
-                            for e in hist.entries
-                            if e[0] > cursor and e[1] == kind and e[2] == ns
+                            for e in hist.entries[start:]
+                            if e[1] == kind and e[2] == ns
                         ]
                         if not pending:
                             hist.lock.wait(

@@ -41,6 +41,13 @@ class AppConfig:
     use_finalizers: bool = True
     resync_period_seconds: float = 30.0
     queue_backend: str = "auto"  # auto | native (C++) | python
+    # Parallel shard fan-out: size of the bounded per-controller shard-sync
+    # executor. 0 = auto (min(8, shard count)); 1 = sequential reference
+    # behavior; N>1 = explicit bound on concurrent per-shard syncs.
+    shard_sync_workers: int = 0
+    # Content-hash write-skip cache: unchanged specs/data skip the per-shard
+    # compare + write on re-reconciles (resync churn, burst duplicates).
+    write_skip_cache: bool = True
     # Datadog log sink (the slog-datadog equivalent, reference main.go:43):
     # api key enables shipping logs to the intake; site picks the region;
     # endpoint overrides the intake URL outright (tests / proxies).

@@ -22,6 +22,15 @@ from typing import Dict, List, Optional, Tuple, Union
 
 METRIC_RECONCILE_LATENCY = "reconcile_latency"
 METRIC_WORKQUEUE_LENGTH = "workqueue_length"
+# Burst-visibility gauges for the parallel reconcile hot path:
+# ``workqueue_depth`` is the same series as ``workqueue_length`` under the
+# name the coalescing queue exposes natively; ``coalesced_total`` counts
+# duplicate keys absorbed by the queue's dedup during bursts; and
+# ``shard_sync_latency`` times each per-shard fan-out task (tagged
+# ``shard:<name>``) so slow shards are visible individually.
+METRIC_WORKQUEUE_DEPTH = "workqueue_depth"
+METRIC_COALESCED_TOTAL = "workqueue_coalesced_total"
+METRIC_SHARD_SYNC_LATENCY = "shard_sync_latency"
 # TPU-native workload-plane metrics (the BASELINE config #3 north-star
 # latency): seconds from template creation to its materialized Jobs first
 # observed Running, per template + rolling p50 across templates.

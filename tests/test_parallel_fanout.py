@@ -1,0 +1,495 @@
+"""Parallel shard fan-out + write-skip cache semantics.
+
+Covers the concurrency contract of the reconcile hot path
+(docs/reconciler-concurrency.md):
+  * per-shard work genuinely runs concurrently on the bounded executor;
+  * first-error fail-fast → single aggregated SyncError → one rate-limited
+    requeue;
+  * no partial-write leaks: every object a failed fan-out did land on a
+    shard carries complete provenance labels, and failed shards are
+    untouched;
+  * write-skip cache: hit on unchanged re-sync, miss on source change,
+    invalidation on shard-side drift and rogue/adoption;
+  * workqueue burst coalescing counters (python + native backends).
+"""
+
+import threading
+
+import pytest
+
+from nexus_tpu.api.template import (
+    ComputeResources,
+    Container,
+    NexusAlgorithmSpec,
+    NexusAlgorithmTemplate,
+    RuntimeEnvironment,
+    WorkgroupRef,
+)
+from nexus_tpu.api.types import (
+    CONTROLLER_APP_NAME,
+    EnvFromSource,
+    LABEL_CONFIGURATION_OWNER,
+    LABEL_CONTROLLER_APP,
+    ObjectMeta,
+    Secret,
+)
+from nexus_tpu.cluster.store import ClusterStore
+from nexus_tpu.controller.controller import (
+    Controller,
+    Element,
+    SyncError,
+    TYPE_TEMPLATE,
+)
+from nexus_tpu.controller.events import FakeRecorder
+from nexus_tpu.controller.sharding import (
+    ShardFanOutError,
+    ShardSyncExecutor,
+    WriteSkipCache,
+    stable_hash,
+)
+from nexus_tpu.shards.shard import Shard
+from nexus_tpu.utils.telemetry import METRIC_SHARD_SYNC_LATENCY, StatsdClient
+
+NS = "nexus"
+ALIAS = "fanout-cluster"
+
+
+def make_template(name="algo-1", secrets=()):
+    mapped = [EnvFromSource(secret_ref=s) for s in secrets]
+    return NexusAlgorithmTemplate(
+        metadata=ObjectMeta(name=name, namespace=NS),
+        spec=NexusAlgorithmSpec(
+            container=Container(
+                image="algo", registry="ghcr.io/test", version_tag="v1.0.0",
+                service_account_name="nexus-sa",
+            ),
+            compute_resources=ComputeResources(cpu_limit="4", memory_limit="8Gi"),
+            workgroup_ref=WorkgroupRef(
+                name="wg-1", group="science.sneaksanddata.com",
+                kind="NexusAlgorithmWorkgroup",
+            ),
+            command="python",
+            args=["run.py"],
+            runtime_environment=RuntimeEnvironment(
+                mapped_environment_variables=mapped
+            ),
+        ),
+    )
+
+
+def make_secret(name="secret-1", data=None):
+    return Secret(metadata=ObjectMeta(name=name, namespace=NS),
+                  data=dict(data or {"key": "value"}))
+
+
+class Fixture:
+    def __init__(self, n_shards=3, shard_sync_workers=0):
+        self.controller_store = ClusterStore("controller")
+        self.shard_stores = [ClusterStore(f"shard{i}") for i in range(n_shards)]
+        self.shards = [
+            Shard(ALIAS, f"shard{i}", s) for i, s in enumerate(self.shard_stores)
+        ]
+        self.recorder = FakeRecorder()
+        self.statsd = StatsdClient("test")
+        self.controller = Controller(
+            self.controller_store,
+            self.shards,
+            recorder=self.recorder,
+            statsd=self.statsd,
+            use_finalizers=False,
+            shard_sync_workers=shard_sync_workers,
+        )
+
+    def seed_controller(self, *objs):
+        self.controller_store.seed(*objs)
+        c = self.controller
+        listers = {
+            NexusAlgorithmTemplate.KIND: c.template_lister,
+            Secret.KIND: c.secret_lister,
+        }
+        for obj in objs:
+            stored = self.controller_store.get(
+                obj.KIND, obj.metadata.namespace, obj.metadata.name
+            )
+            listers[obj.KIND].add(stored)
+
+    def resync_listers(self):
+        c = self.controller
+        for kind, lister in (
+            (NexusAlgorithmTemplate.KIND, c.template_lister),
+            (Secret.KIND, c.secret_lister),
+        ):
+            for obj in self.controller_store.list(kind):
+                lister.add(obj)
+        for shard, store in zip(self.shards, self.shard_stores):
+            for kind, lister in (
+                (NexusAlgorithmTemplate.KIND, shard.template_lister),
+                (Secret.KIND, shard.secret_lister),
+            ):
+                for obj in store.list(kind):
+                    lister.add(obj)
+
+    def clear_actions(self):
+        self.controller_store.clear_actions()
+        for s in self.shard_stores:
+            s.clear_actions()
+
+
+# ------------------------------------------------------------------ executor
+
+
+def test_executor_sequential_fail_fast_stops_at_first_error():
+    ex = ShardSyncExecutor(max_workers=1)
+
+    class S:
+        def __init__(self, name):
+            self.name = name
+
+    calls = []
+
+    def fn(shard):
+        calls.append(shard.name)
+        if shard.name == "s1":
+            raise RuntimeError("boom")
+
+    with pytest.raises(ShardFanOutError) as ei:
+        ex.map_shards([S("s0"), S("s1"), S("s2")], fn)
+    # sequential: s2 never started after s1 failed
+    assert calls == ["s0", "s1"]
+    assert ei.value.errors[0][0] == "s1"
+    assert isinstance(ei.value.first, RuntimeError)
+
+
+def test_executor_parallel_aggregates_errors_in_shard_order():
+    ex = ShardSyncExecutor(max_workers=4)
+
+    class S:
+        def __init__(self, name):
+            self.name = name
+
+    def fn(shard):
+        if shard.name in ("s1", "s3"):
+            raise RuntimeError(f"{shard.name} down")
+        return shard.name
+
+    # fail_fast=False attempts every shard: both errors aggregate in
+    # input-shard order regardless of completion order
+    with pytest.raises(ShardFanOutError) as ei:
+        ex.map_shards([S(f"s{i}") for i in range(4)], fn, fail_fast=False)
+    assert [name for name, _ in ei.value.errors] == ["s1", "s3"]
+    assert "s1 down" in str(ei.value)
+
+    # fail_fast=True: at least the first error surfaces; siblings that had
+    # not started yet are cooperatively skipped, never silently succeed
+    with pytest.raises(ShardFanOutError) as ei:
+        ex.map_shards([S(f"s{i}") for i in range(4)], fn)
+    assert ei.value.errors[0][0] in ("s1", "s3")
+    ex.shutdown()
+
+
+def test_executor_results_preserve_input_order():
+    ex = ShardSyncExecutor(max_workers=4)
+
+    class S:
+        def __init__(self, name, delay):
+            self.name = name
+            self.delay = delay
+
+    import time
+
+    def fn(shard):
+        time.sleep(shard.delay)
+        return shard.name
+
+    # slowest first: completion order inverts input order
+    shards = [S("a", 0.05), S("b", 0.02), S("c", 0.0)]
+    assert ex.map_shards(shards, fn) == ["a", "b", "c"]
+    ex.shutdown()
+
+
+def test_fan_out_runs_concurrently():
+    """All shards must be in-flight simultaneously: each shard's create
+    blocks on a barrier that only opens when every shard has arrived."""
+    f = Fixture(n_shards=3)
+    f.seed_controller(make_template())
+    barrier = threading.Barrier(3, timeout=5.0)
+
+    originals = [s.create_template for s in f.shards]
+
+    def make_blocked(orig):
+        def blocked(name, namespace, spec, field_manager=""):
+            barrier.wait()  # raises BrokenBarrierError if run sequentially
+            return orig(name, namespace, spec, field_manager)
+
+        return blocked
+
+    for shard, orig in zip(f.shards, originals):
+        shard.create_template = make_blocked(orig)
+
+    f.controller.template_sync_handler(NS, "algo-1")
+    for store in f.shard_stores:
+        assert store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    # per-shard latency gauges emitted for every shard
+    shard_tags = {
+        tags for (name, _v, tags) in f.statsd.history
+        if name == f"test.{METRIC_SHARD_SYNC_LATENCY}"
+    }
+    assert {("shard:shard0",), ("shard:shard1",), ("shard:shard2",)} <= shard_tags
+
+
+# ------------------------------------------------------- fail-fast semantics
+
+
+def test_fanout_failure_raises_single_sync_error_and_requeues():
+    f = Fixture(n_shards=3)
+    f.seed_controller(make_template())
+
+    def broken(*a, **k):
+        raise RuntimeError("shard1 unreachable")
+
+    f.shards[1].create_template = broken
+
+    with pytest.raises(SyncError) as ei:
+        f.controller.template_sync_handler(NS, "algo-1")
+    assert "shard1" in str(ei.value)
+
+    # through the work loop: failure → one rate-limited requeue
+    item = Element(NS, "algo-1", TYPE_TEMPLATE)
+    f.controller.work_queue.add(item)
+    assert f.controller.process_next_work_item(timeout=1.0)
+    assert f.controller.work_queue.num_requeues(item) == 1
+
+
+def test_fanout_failure_no_partial_provenance_leaks():
+    """Shards that did receive writes before a sibling failed must carry
+    COMPLETE provenance labels; the failed shard stays untouched."""
+    f = Fixture(n_shards=3)
+    f.seed_controller(make_template(secrets=["secret-1"]), make_secret())
+
+    def broken(*a, **k):
+        raise RuntimeError("shard2 unreachable")
+
+    f.shards[2].create_template = broken
+
+    with pytest.raises(SyncError):
+        f.controller.template_sync_handler(NS, "algo-1")
+
+    expected = {
+        LABEL_CONTROLLER_APP: CONTROLLER_APP_NAME,
+        LABEL_CONFIGURATION_OWNER: ALIAS,
+    }
+    for store in f.shard_stores[:2]:
+        for kind in (NexusAlgorithmTemplate.KIND, Secret.KIND):
+            for obj in store.list(kind, NS):
+                assert obj.metadata.labels == expected
+    assert f.shard_stores[2].list(NexusAlgorithmTemplate.KIND, NS) == []
+    assert f.shard_stores[2].list(Secret.KIND, NS) == []
+
+    # the template was NOT reported synced anywhere
+    ctrl = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    assert ctrl.status.synced_to_clusters == []
+
+    # heal the shard → retry converges everywhere
+    f.shards[2].create_template = Shard.create_template.__get__(f.shards[2])
+    f.resync_listers()
+    f.controller.template_sync_handler(NS, "algo-1")
+    for store in f.shard_stores:
+        assert store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    ctrl = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    assert ctrl.status.synced_to_clusters == ["shard0", "shard1", "shard2"]
+
+
+# --------------------------------------------------------- write-skip cache
+
+
+def test_write_skip_hit_on_unchanged_resync():
+    f = Fixture(n_shards=2)
+    f.seed_controller(make_template(secrets=["secret-1"]), make_secret())
+    f.controller.template_sync_handler(NS, "algo-1")
+    f.resync_listers()
+    f.clear_actions()
+
+    before = f.controller.write_skip_cache.stats()
+    f.controller.template_sync_handler(NS, "algo-1")
+    after = f.controller.write_skip_cache.stats()
+
+    assert f.controller_store.actions == []
+    for store in f.shard_stores:
+        assert store.actions == []
+    # per shard: template + secret = 2 hits x 2 shards
+    assert after["hits"] - before["hits"] == 4
+
+
+def test_write_skip_miss_on_source_content_change():
+    f = Fixture(n_shards=1)
+    f.seed_controller(make_template(secrets=["secret-1"]), make_secret())
+    f.controller.template_sync_handler(NS, "algo-1")
+    f.resync_listers()
+
+    sec = f.controller_store.get(Secret.KIND, NS, "secret-1")
+    sec.data = {"key": "CHANGED"}
+    f.controller_store.update(sec)
+    f.resync_listers()
+    f.clear_actions()
+
+    f.controller.template_sync_handler(NS, "algo-1")
+    assert f.shard_stores[0].get(Secret.KIND, NS, "secret-1").data == {
+        "key": "CHANGED"
+    }
+
+
+def test_write_skip_invalidated_on_shard_drift():
+    """Out-of-band shard edit bumps the shard resourceVersion → the cached
+    entry no longer matches → full compare path repairs the drift."""
+    f = Fixture(n_shards=1)
+    f.seed_controller(make_template(secrets=["secret-1"]), make_secret())
+    f.controller.template_sync_handler(NS, "algo-1")
+    f.resync_listers()
+
+    tampered = f.shard_stores[0].get(Secret.KIND, NS, "secret-1")
+    tampered.data = {"key": "TAMPERED"}
+    f.shard_stores[0].update(tampered)
+    f.resync_listers()
+    f.clear_actions()
+
+    f.controller.template_sync_handler(NS, "algo-1")
+    repaired = f.shard_stores[0].get(Secret.KIND, NS, "secret-1")
+    assert repaired.data == {"key": "value"}
+
+
+def test_write_skip_does_not_mask_rogue_detection():
+    """A converged sync, then owner references stripped on the shard copy:
+    the rv bump invalidates the hit and the rogue check must fire."""
+    f = Fixture(n_shards=1)
+    f.seed_controller(make_template(secrets=["secret-1"]), make_secret())
+    f.controller.template_sync_handler(NS, "algo-1")
+    f.resync_listers()
+
+    shard_sec = f.shard_stores[0].get(Secret.KIND, NS, "secret-1")
+    shard_sec.metadata.owner_references = []
+    f.shard_stores[0].update(shard_sec)
+    f.resync_listers()
+
+    with pytest.raises(SyncError):
+        f.controller.template_sync_handler(NS, "algo-1")
+    # the rogue object's cache entries were dropped
+    assert f.controller.write_skip_cache.stats()["invalidations"] >= 1
+
+
+def test_write_skip_entries_are_owner_scoped():
+    """Template A's converged entry for a shared secret must not let
+    template B skip appending its own owner reference."""
+    f = Fixture(n_shards=1)
+    f.seed_controller(
+        make_template("algo-1", secrets=["shared"]),
+        make_template("algo-2", secrets=["shared"]),
+        make_secret("shared"),
+    )
+    f.controller.template_sync_handler(NS, "algo-1")
+    f.resync_listers()
+    f.controller.template_sync_handler(NS, "algo-2")
+    f.resync_listers()
+
+    shard_sec = f.shard_stores[0].get(Secret.KIND, NS, "shared")
+    t1 = f.shard_stores[0].get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    t2 = f.shard_stores[0].get(NexusAlgorithmTemplate.KIND, NS, "algo-2")
+    uids = {r.uid for r in shard_sec.metadata.owner_references}
+    assert uids == {t1.metadata.uid, t2.metadata.uid}
+
+
+def test_write_skip_invalidated_on_template_delete():
+    f = Fixture(n_shards=2)
+    f.seed_controller(make_template(secrets=["secret-1"]), make_secret())
+    f.controller.template_sync_handler(NS, "algo-1")
+    f.resync_listers()
+    assert f.controller.write_skip_cache.stats()["entries"] > 0
+
+    tmpl = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
+    f.controller.handle_object_delete(tmpl)
+    assert f.controller.write_skip_cache.stats()["entries"] == 0
+
+
+def test_stable_hash_tracks_deep_equal():
+    t1, t2 = make_template("a"), make_template("a")
+    assert stable_hash(t1.spec) == stable_hash(t2.spec)
+    t2.spec.container.version_tag = "v2.0.0"
+    assert stable_hash(t1.spec) != stable_hash(t2.spec)
+    assert stable_hash({"a": 1, "b": 2}) == stable_hash({"b": 2, "a": 1})
+    assert stable_hash({"a": 1}) != stable_hash({"a": "1"})
+
+
+def test_write_skip_cache_unit():
+    c = WriteSkipCache()
+    assert not c.check("s0", "Secret", NS, "x", "h1", "5", "uid-a")
+    c.store("s0", "Secret", NS, "x", "h1", "5", "uid-a")
+    assert c.check("s0", "Secret", NS, "x", "h1", "5", "uid-a")
+    assert not c.check("s0", "Secret", NS, "x", "h2", "5", "uid-a")  # content
+    assert not c.check("s0", "Secret", NS, "x", "h1", "6", "uid-a")  # rv
+    assert not c.check("s0", "Secret", NS, "x", "h1", "5", "uid-b")  # owner
+    c.invalidate_object("s0", "Secret", NS, "x")
+    assert not c.check("s0", "Secret", NS, "x", "h1", "5", "uid-a")
+    c.store("s0", "Secret", NS, "x", "h1", "5", "uid-a")
+    c.store("s1", "Secret", NS, "x", "h1", "7", "uid-a")
+    c.invalidate_owner("uid-a", "s1")
+    assert c.check("s0", "Secret", NS, "x", "h1", "5", "uid-a")
+    assert not c.check("s1", "Secret", NS, "x", "h1", "7", "uid-a")
+
+
+def test_apply_job_converges_on_unlabeled_name_collision():
+    """A foreign same-name Job without provenance labels is invisible to the
+    label-filtered LIST; apply_job(existing=None) must fall back to a point
+    GET and converge (delete+recreate) instead of requeue-looping on 409."""
+    from nexus_tpu.api.workload import Job
+
+    store = ClusterStore("shard0")
+    shard = Shard(ALIAS, "shard0", store)
+    foreign = Job.from_manifest({
+        "metadata": {"name": "algo-s0", "namespace": NS},
+        "spec": {"template": {"spec": {"containers": []}}},
+    })
+    store.create(foreign)  # no provenance labels
+
+    owner = make_template()
+    manifest = {
+        "metadata": {"name": "algo-s0", "namespace": NS},
+        "spec": {"template": {"spec": {"containers": [{"name": "c"}]}}},
+    }
+    applied = shard.apply_job(owner, manifest, "fm", existing=None)
+    assert applied.spec == manifest["spec"]
+    assert applied.metadata.labels[LABEL_CONTROLLER_APP] == CONTROLLER_APP_NAME
+
+
+# ----------------------------------------------------------- queue coalescing
+
+
+def test_python_workqueue_coalesces_duplicate_keys():
+    from nexus_tpu.controller.ratelimit import default_controller_rate_limiter
+    from nexus_tpu.controller.workqueue import RateLimitingQueue
+
+    q = RateLimitingQueue(default_controller_rate_limiter(0.01, 1.0, 50, 100))
+    for _ in range(5):
+        q.add("k1")
+    q.add("k2")
+    assert q.depth() == 2
+    assert q.coalesced_total() == 4
+    # a key being processed coalesces re-adds into the dirty set, not a
+    # second queue entry
+    item, _ = q.get(timeout=1.0)
+    q.add(item)
+    q.add(item)  # second re-add while processing IS a coalesced duplicate
+    assert q.coalesced_total() == 5
+    q.shut_down()
+
+
+def test_native_workqueue_coalesces_duplicate_keys():
+    from nexus_tpu.native import NativeRateLimitingQueue, available
+
+    if not available():
+        pytest.skip("native queue unavailable")
+    q = NativeRateLimitingQueue(0.01, 1.0, 50, 100)
+    for _ in range(5):
+        q.add("k1")
+    q.add("k2")
+    assert q.depth() == 2
+    assert q.coalesced_total() == 4
+    q.shut_down()

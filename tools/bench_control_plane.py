@@ -83,7 +83,9 @@ def _make_runtime_template(name: str, ns: str):
 
 
 def run_bench(n_templates: int = 24, workers: int = 2,
-              timeout_s: float = 120.0, stagger_s: float = 0.0) -> dict:
+              timeout_s: float = 120.0, stagger_s: float = 0.0,
+              n_shards: int = 1, shard_sync_workers: int = 0,
+              write_skip: bool = True, shard_latency_s: float = 0.0) -> dict:
     from nexus_tpu.api.template import NexusAlgorithmTemplate
     from nexus_tpu.api.workload import Job
     from nexus_tpu.cluster.kube import KubeClusterStore
@@ -98,21 +100,50 @@ def run_bench(n_templates: int = 24, workers: int = 2,
 
     ns = "nexus-bench"
     ctrl_srv = FakeKubeApiServer(name="controller").start()
-    shard_srv = FakeKubeApiServer(name="shard0").start()
+    # shard servers optionally simulate a cross-cluster RTT per request —
+    # the thing the in-process servers otherwise hide (a remote shard's API
+    # server is a network round trip away, which is exactly what the
+    # parallel fan-out overlaps)
+    shard_srvs = [
+        FakeKubeApiServer(name=f"shard{i}", latency_s=shard_latency_s).start()
+        for i in range(n_shards)
+    ]
     import tempfile
 
     tmp = tempfile.mkdtemp(prefix="nexus_cp_bench_")
     ctrl_cfg = ctrl_srv.write_kubeconfig(f"{tmp}/controller.kubeconfig")
-    shard_cfg = shard_srv.write_kubeconfig(f"{tmp}/shard0.kubeconfig")
     ctrl_store = KubeClusterStore("controller", ctrl_cfg, namespace=ns)
-    shard_store = KubeClusterStore("shard0", shard_cfg, namespace=ns)
+    shard_stores = []
+    for i, srv in enumerate(shard_srvs):
+        cfg = srv.write_kubeconfig(f"{tmp}/shard{i}.kubeconfig")
+        shard_stores.append(KubeClusterStore(f"shard{i}", cfg, namespace=ns))
     statsd = StatsdClient("bench")
     controller = Controller(
-        ctrl_store, [Shard("bench", "shard0", shard_store)],
+        ctrl_store,
+        [Shard("bench", f"shard{i}", s) for i, s in enumerate(shard_stores)],
         statsd=statsd, resync_period=5.0,
+        # 1 = the strictly sequential reference fan-out (baseline mode);
+        # 0 = auto-sized parallel fan-out (the product default)
+        shard_sync_workers=shard_sync_workers,
+        write_skip_cache=write_skip,
     )
 
     stop = threading.Event()
+    pending_jobs: list = []
+    pending_cv = threading.Condition()
+
+    def watch_jobs(srv):
+        """Event-driven kubelet stand-in feed: a Job appearing on the shard
+        API server queues it for the marker thread (polling with full LISTs
+        burned ~30% of a core at burst scale and skewed the measurement)."""
+
+        def on_event(ev):
+            if ev.type in ("ADDED", "MODIFIED"):
+                with pending_cv:
+                    pending_jobs.append((srv, ev.obj))
+                    pending_cv.notify()
+
+        srv.store.subscribe(Job.KIND, on_event)
 
     def kubelet_standin():
         """Mark every materialized Job Running (active=1, startTime
@@ -121,23 +152,25 @@ def run_bench(n_templates: int = 24, workers: int = 2,
         from datetime import datetime, timezone
 
         while not stop.is_set():
-            try:
-                jobs = shard_srv.store.list(Job.KIND, ns)
-            except Exception:  # noqa: BLE001 — server warming up
-                jobs = []
-            for job in jobs:
-                if not job.status.active and not job.status.succeeded:
-                    job.status.active = 1
-                    job.status.ready = 1
-                    job.status.start_time = datetime.now(
-                        timezone.utc
-                    ).isoformat()
-                    try:
-                        shard_srv.store.update_status(job)
-                    except Exception:  # noqa: BLE001 — raced an update
-                        pass
-            stop.wait(0.02)
+            with pending_cv:
+                if not pending_jobs:
+                    pending_cv.wait(timeout=0.25)
+                batch, pending_jobs[:] = list(pending_jobs), []
+            for srv, job in batch:
+                if job.status.active or job.status.succeeded:
+                    continue
+                job.status.active = 1
+                job.status.ready = 1
+                job.status.start_time = datetime.now(
+                    timezone.utc
+                ).isoformat()
+                try:
+                    srv.store.update_status(job)
+                except Exception:  # noqa: BLE001 — raced an update
+                    pass
 
+    for srv in shard_srvs:
+        watch_jobs(srv)
     kubelet = threading.Thread(target=kubelet_standin, daemon=True)
     t0 = time.monotonic()
     result: dict = {"metric": "template_to_running_p50_s"}
@@ -177,6 +210,7 @@ def run_bench(n_templates: int = 24, workers: int = 2,
         # — at n=16 it would report the 9th value, ~p56, as the median)
         p = lambda q: samples[max(0,  # noqa: E731
                                   math.ceil(q * len(samples)) - 1)]
+        coalesced = getattr(controller.work_queue, "coalesced_total", None)
         result.update({
             "value": round(p(0.50), 4),
             "unit": "seconds",
@@ -185,8 +219,14 @@ def run_bench(n_templates: int = 24, workers: int = 2,
             "n_templates": n_templates,
             "n_samples": len(samples),
             "workers": workers,
+            "n_shards": n_shards,
+            "shard_sync_workers": controller.shard_executor.max_workers,
             "stagger_s": stagger_s,
+            "shard_latency_s": shard_latency_s,
             "wall_s": round(wall_s, 3),
+            # burst-visibility counters from the reconcile hot path
+            "coalesced_total": coalesced() if coalesced is not None else None,
+            "write_skip": controller.write_skip_cache.stats(),
             # the controller's own rolling-p50 gauge agrees by construction
             "controller_p50_gauge": statsd.gauges.get(
                 f"bench.{METRIC_TEMPLATE_TO_RUNNING_P50}"
@@ -200,9 +240,11 @@ def run_bench(n_templates: int = 24, workers: int = 2,
         except Exception:  # noqa: BLE001 — best-effort teardown
             pass
         ctrl_store.close()
-        shard_store.close()
+        for s in shard_stores:
+            s.close()
         ctrl_srv.stop()
-        shard_srv.stop()
+        for srv in shard_srvs:
+            srv.stop()
 
 
 def main(argv=None) -> int:
@@ -212,9 +254,22 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=120.0)
     ap.add_argument("--stagger", type=float, default=0.0,
                     help="seconds between template creates (0 = burst)")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="number of in-process shard API servers")
+    ap.add_argument("--shard-sync-workers", type=int, default=0,
+                    help="shard fan-out bound: 0 = auto (parallel), "
+                         "1 = sequential reference baseline")
+    ap.add_argument("--no-write-skip", action="store_true",
+                    help="disable the content-hash write-skip cache "
+                         "(pre-change baseline mode)")
+    ap.add_argument("--shard-latency", type=float, default=0.0,
+                    help="simulated per-request RTT to shard API servers, "
+                         "seconds (models remote shard clusters)")
     args = ap.parse_args(argv)
     result = run_bench(args.templates, args.workers, args.timeout,
-                       args.stagger)
+                       args.stagger, args.shards, args.shard_sync_workers,
+                       write_skip=not args.no_write_skip,
+                       shard_latency_s=args.shard_latency)
     print(json.dumps(result), flush=True)
     return 0 if "value" in result else 1
 

@@ -1,0 +1,167 @@
+"""Race smoke: hammer one ClusterStore + informer/lister from N threads.
+
+Part of the parallel-fan-out thread-safety audit (see
+docs/reconciler-concurrency.md): the reconcile hot path now issues
+concurrent per-shard writes from a bounded executor, so the in-process
+store, the watch dispatch, and the monotonic lister cache are exercised
+here exactly the way the controller exercises them — concurrent
+create/update/delete against shared keys, with an informer
+subscribed and a second thread doing cache-hot ``_set_if_newer`` writes.
+
+Invariants checked:
+  * no exception other than the expected optimistic-concurrency set
+    (ConflictError / AlreadyExistsError / NotFoundError) escapes any thread;
+  * resourceVersions observed per key through the lister never go backwards
+    (the ``_set_if_newer`` monotonicity contract);
+  * after the storm quiesces, the lister cache converges to exactly the
+    store's surviving objects (no stale entries, no lost deletes).
+
+Exit code 0 = clean, 1 = violation (details printed).
+
+Usage: python tools/race_smoke_store.py [--threads 8] [--seconds 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nexus_tpu.api.types import ObjectMeta, Secret  # noqa: E402
+from nexus_tpu.cluster.informer import InformerFactory  # noqa: E402
+from nexus_tpu.cluster.store import (  # noqa: E402
+    AlreadyExistsError,
+    ClusterStore,
+    ConflictError,
+    NotFoundError,
+)
+
+NS = "race"
+KEYS = [f"secret-{i}" for i in range(8)]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--seconds", type=float, default=3.0)
+    args = ap.parse_args(argv)
+
+    store = ClusterStore("race-smoke")
+    informers = InformerFactory(store, resync_period=0.05)
+    informer = informers.informer(Secret.KIND)
+    lister = informer.lister
+
+    # event handlers registered from a side thread WHILE dispatch runs —
+    # the registration-vs-dispatch race the informer must tolerate
+    dispatched = [0]
+
+    def count(*_a):
+        dispatched[0] += 1
+
+    informer.add_event_handler(on_add=count, on_update=count, on_delete=count)
+    informers.start()
+
+    stop = threading.Event()
+    violations: list = []
+    rv_seen: dict = {}
+    rv_lock = threading.Lock()
+
+    def check_monotonic(name: str) -> None:
+        try:
+            obj = lister.get(NS, name)
+        except NotFoundError:
+            return
+        rv = int(obj.metadata.resource_version)
+        with rv_lock:
+            prev = rv_seen.get(name, 0)
+            if rv < prev:
+                violations.append(
+                    f"lister rv went backwards for {name}: {prev} -> {rv}"
+                )
+            else:
+                rv_seen[name] = rv
+
+    def hammer(seed: int) -> None:
+        rng = random.Random(seed)
+        while not stop.is_set():
+            name = rng.choice(KEYS)
+            op = rng.random()
+            try:
+                if op < 0.25:
+                    store.create(
+                        Secret(
+                            metadata=ObjectMeta(name=name, namespace=NS),
+                            data={"v": str(rng.random())},
+                        )
+                    )
+                elif op < 0.70:
+                    obj = store.get(Secret.KIND, NS, name)
+                    obj.data = {"v": str(rng.random())}
+                    store.update(obj)
+                elif op < 0.80:
+                    store.delete(Secret.KIND, NS, name)
+                elif op < 0.90:
+                    # cache-hot write racing the watch thread — the
+                    # controller's post-write _set_if_newer pattern
+                    obj = store.get(Secret.KIND, NS, name)
+                    lister._set_if_newer(obj)
+                else:
+                    store.list(Secret.KIND, NS)
+                check_monotonic(name)
+            except (ConflictError, AlreadyExistsError, NotFoundError, KeyError):
+                pass  # expected optimistic-concurrency outcomes
+            except Exception as e:  # noqa: BLE001 — the smoke's whole point
+                violations.append(f"unexpected {type(e).__name__}: {e}")
+                return
+
+    # late-registration thread: keeps adding handlers mid-storm
+    def register_loop() -> None:
+        while not stop.is_set():
+            informer.add_event_handler(on_update=count)
+            time.sleep(0.05)
+
+    threads = [
+        threading.Thread(target=hammer, args=(i,), daemon=True)
+        for i in range(args.threads)
+    ] + [threading.Thread(target=register_loop, daemon=True)]
+    for t in threads:
+        t.start()
+    time.sleep(args.seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+
+    # quiesce: let the watch queue drain, then compare cache vs store
+    time.sleep(0.3)
+    informers.stop()
+    store_names = {
+        o.metadata.name for o in store.list(Secret.KIND, NS)
+    }
+    cache_names = {o.metadata.name for o in lister.list(NS)}
+    if store_names != cache_names:
+        violations.append(
+            f"lister diverged from store: cache-only="
+            f"{sorted(cache_names - store_names)} "
+            f"store-only={sorted(store_names - cache_names)}"
+        )
+
+    if violations:
+        print("RACE SMOKE FAILED:")
+        for v in violations[:20]:
+            print(f"  - {v}")
+        return 1
+    print(
+        f"race smoke clean: {args.threads} threads x {args.seconds}s, "
+        f"{dispatched[0]} events dispatched, "
+        f"{len(store_names)} objects surviving"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
