@@ -3,7 +3,7 @@ SHELL := /bin/bash
 NATIVE_SRC := nexus_tpu/native/src/nexus_core.cpp nexus_tpu/native/src/nexus_data.cpp
 NATIVE_LIB := nexus_tpu/native/libnexus_core.so
 
-.PHONY: all native test test-all tier1 coverage bench bench-cp race-smoke clean lint
+.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve race-smoke clean lint
 
 all: native
 
@@ -35,6 +35,12 @@ bench:
 # checkable on any CPU box, no TPU tunnel touched.
 bench-cp:
 	NEXUS_BENCH_CONTROL_PLANE=only NEXUS_BENCH_INIT_PROBE=0 JAX_PLATFORMS=cpu python bench.py
+
+# Serving stage only: the paged-KV ledger (bytes/request + bytes/token vs
+# the dense layout) and the rows=4 vs rows=16 scaling point — CPU-runnable,
+# no TPU tunnel touched (deep-verifiable serving workstream, VERDICT r5).
+bench-serve:
+	NEXUS_BENCH_SERVE=only NEXUS_BENCH_INIT_PROBE=0 JAX_PLATFORMS=cpu python bench.py
 
 # Thread-safety smoke for the store/informer/lister under parallel fan-out.
 race-smoke:

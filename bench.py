@@ -23,7 +23,8 @@ Outage hardening (round 5): NEXUS_BENCH_INIT_PROBE[_S|_CMD] control the
 backend-init probe that fast-fails a wedged tunnel within its own short
 sub-deadline; NEXUS_BENCH_CACHE points the last-known-good cache (which
 carries EVERY measured axis, not just the train headline);
-NEXUS_BENCH_SWEEP_LOG the per-measurement session log ('off' disables;
+NEXUS_BENCH_SWEEP_LOG the per-measurement session log ('0'/'off'/'false'
+disables;
 default docs/sweep_r5.jsonl on TPU); NEXUS_BENCH_CONTROL_PLANE=0 skips
 the hermetic template-to-running p50 stage; NEXUS_BENCH_CP_TEMPLATES its
 queue size.
@@ -578,13 +579,20 @@ def _spec_suite(progress, attn, sink=None):
     return out
 
 
-def _run_serve_bench(preset, progress, rows=8):
+def _run_serve_bench(preset, progress, rows=8, kv_block_size=None,
+                     chunk=32):
     """Continuous-batching serving throughput at ``rows`` decode rows —
     the VERDICT r3 gate: aggregate tokens/sec vs batch-1 plain decode
     (target >= 2x at 8 rows, chunked prefill keeping admission off the
     critical path). Uneven synthetic queue (prompts 64-256, budgets
     64-512), max_seq_len trimmed so the static cache matches the queue's
-    real envelope instead of the preset's 4k."""
+    real envelope instead of the preset's 4k.
+
+    ``kv_block_size``: None rides the ServeSpec default (paged, 32-slot
+    blocks); 0 pins the legacy dense layout (the KV-bytes A/B baseline);
+    any other value pins that block size. The returned metrics carry the
+    engine's KV ledger (kv_bytes_per_request / per_committed_token /
+    reduction_vs_dense)."""
     from nexus_tpu.api.runtime_spec import (
         JaxXlaRuntime,
         ModelRef,
@@ -598,7 +606,12 @@ def _run_serve_bench(preset, progress, rows=8):
     overrides = {"max_seq_len": 1024}
     if not is_tpu():
         overrides["dtype"] = "float32"
-    label = f"serve preset={preset} rows={rows}"
+    serve_kw = {}
+    layout = "paged"
+    if kv_block_size is not None:
+        serve_kw["kv_block_size"] = kv_block_size
+        layout = "dense" if kv_block_size == 0 else f"paged{kv_block_size}"
+    label = f"serve preset={preset} rows={rows} kv={layout}"
     runtime = JaxXlaRuntime(
         mode="serve",
         model=ModelRef(family="llama", preset=preset, overrides=overrides),
@@ -608,7 +621,7 @@ def _run_serve_bench(preset, progress, rows=8):
         serve=ServeSpec(
             num_requests=4 * rows, prompt_length_min=64,
             prompt_length_max=256, max_new_min=64, max_new_max=512,
-            chunk=32, prefill_chunk=16,
+            chunk=chunk, prefill_chunk=16, **serve_kw,
         ),
     )
     progress(f"candidate {label}")
@@ -619,10 +632,67 @@ def _run_serve_bench(preset, progress, rows=8):
         return None
     progress(
         f"candidate {label}: {m.get('tokens_per_sec', 0):.1f} tok/s "
-        f"util={m.get('slot_utilization', 0):.3f}"
+        f"util={m.get('slot_utilization', 0):.3f} "
+        f"kv/tok={m.get('kv_bytes_per_committed_token', 0):.0f}B"
     )
     _sweep_record("serve", label, m)
     return m
+
+
+def _serve_only_stage(progress):
+    """Serve-only stage (`make bench-serve`, NEXUS_BENCH_SERVE=only):
+    the paged-KV ledger and the row-scaling point, CPU-runnable — the
+    deep verification lane VERDICT r5 asked for (a dead TPU tunnel must
+    not stall the serving workstream). Four legs on the uneven synthetic
+    queue: paged rows=4/16 (the sweep_r3 `serve-row-scaling` pair that
+    REGRESSED under the bucketed-prefill engine) and dense rows=4/16
+    (the KV-bytes baseline). Headlines: kv_bytes_per_request reduction
+    vs the dense batch × max_seq_len layout (target >= 2x) and
+    rows16/rows4 aggregate tok/s (target >= 1x)."""
+    from nexus_tpu.utils.hw import is_tpu
+
+    preset = os.environ.get("NEXUS_BENCH_PRESET") or (
+        "400m" if is_tpu() else "tiny"
+    )
+    block = int(os.environ.get("NEXUS_BENCH_SERVE_BLOCK") or 16)
+    chunk = int(os.environ.get("NEXUS_BENCH_SERVE_CHUNK") or 16)
+    out = {"preset": preset, "kv_block_size": block, "chunk": chunk}
+    legs = {}
+    for rows in (4, 16):
+        for bs in (block, 0):
+            m = _run_serve_bench(
+                preset, progress, rows=rows, kv_block_size=bs, chunk=chunk,
+            )
+            if m:
+                legs[(rows, bs)] = m
+                tag = f"{'paged' if bs else 'dense'}_rows{rows}"
+                out[f"{tag}_tokens_per_sec"] = m.get("tokens_per_sec")
+                out[f"{tag}_slot_utilization"] = m.get("slot_utilization")
+                out[f"{tag}_kv_bytes_per_request"] = m.get(
+                    "kv_bytes_per_request"
+                )
+                out[f"{tag}_kv_bytes_per_committed_token"] = m.get(
+                    "kv_bytes_per_committed_token"
+                )
+                out[f"{tag}_kv_pool_bytes"] = m.get("kv_pool_bytes")
+    p4, p16 = legs.get((4, block)), legs.get((16, block))
+    d4 = legs.get((4, 0))
+    if p4 and d4:
+        out["kv_bytes_per_request_reduction"] = round(
+            d4["kv_bytes_per_request"]
+            / max(1.0, p4["kv_bytes_per_request"]), 3,
+        )
+        out["kv_bytes_per_token_reduction"] = round(
+            d4["kv_bytes_per_committed_token"]
+            / max(1.0, p4["kv_bytes_per_committed_token"]), 3,
+        )
+    if p4 and p16:
+        # > 1.0 reverses the sweep_r3 regression (181.6 vs 242.5 tok/s)
+        out["rows16_vs_rows4_tokens_per_sec"] = round(
+            p16.get("tokens_per_sec", 0.0)
+            / max(1e-9, p4.get("tokens_per_sec", 0.0)), 3,
+        )
+    return out if legs else {}
 
 
 def _decode_suite(preset, progress, attn="xla", sink=None):
@@ -1023,7 +1093,11 @@ def main() -> int:
     _default_sweep = os.path.join(_bench_root, "docs", "sweep_r5.jsonl")
     _env_log = os.environ.get("NEXUS_BENCH_SWEEP_LOG")
     if _env_log:
-        _SWEEP_LOG[0] = None if _env_log in ("0", "off") else _env_log
+        # disable sentinels match the sibling NEXUS_BENCH_* envs
+        # ('0'/'false'), plus the documented 'off' (ADVICE r5)
+        _SWEEP_LOG[0] = (
+            None if _env_log in ("0", "off", "false") else _env_log
+        )
     else:
         _SWEEP_LOG[0] = "pending"  # resolved once the platform is known
 
@@ -1040,6 +1114,18 @@ def main() -> int:
             timer.cancel()
         _emit({"metric": "control_plane_only", **cp})
         return 0 if cp else 1
+
+    # serve-only mode (`make bench-serve`): the paged-KV ledger + the
+    # rows=4 vs rows=16 scaling point on whatever backend JAX_PLATFORMS
+    # resolves to — CPU included, no TPU probe, no training sweep
+    if os.environ.get("NEXUS_BENCH_SERVE", "") == "only":
+        sv = _serve_only_stage(progress)
+        with _print_lock:
+            _done[0] = True
+        if timer is not None:
+            timer.cancel()
+        _emit({"metric": "serve_only", **sv})
+        return 0 if sv else 1
 
     probe = _start_backend_probe(progress)
     if os.environ.get("NEXUS_BENCH_CONTROL_PLANE", "1") not in (

@@ -385,6 +385,24 @@ def _dtype_bytes(dt) -> int:
         return 2
 
 
+def _expert_param_split(cfg) -> "tuple[int, int]":
+    """(dense_params, expert_params) for a resolved model config: the
+    expert axis shards only the per-expert MLP weights (gate/up/down),
+    never attention/embedding/router. Shared by hbm_budget_gb and
+    comm_budget_per_step so the two budgets count the same split."""
+    n_params = cfg.param_count()
+    n_experts = int(getattr(cfg, "n_experts", 0) or 0)
+    if n_experts > 1:
+        expert_params = min(
+            cfg.n_layers * n_experts * 3 * cfg.d_model
+            * getattr(cfg, "d_ff", cfg.d_model * 4),
+            n_params,
+        )
+    else:
+        expert_params = 0
+    return n_params - expert_params, expert_params
+
+
 def serve_dispatch_slack(
     chunk: int, prompt_lookup_ngram: int, num_speculative: int
 ) -> int:
@@ -438,6 +456,50 @@ class ServeSpec:
     # step (chunked prefill — admission never stalls the other rows; the
     # speculative path prefills at numSpeculative+1 per round instead)
     prefill_chunk: int = 8
+    # paged KV cache (runtime/serving.py): positions per K/V block; 0
+    # keeps the legacy dense batch × max_seq_len rows (the A/B baseline)
+    kv_block_size: int = 32
+    # block-pool size: 0 = auto — sized to the queue's worst-case
+    # per-request envelope (kv_pool_blocks below), which is what makes
+    # admission HBM-aware instead of slot-count-based
+    kv_num_blocks: int = 0
+
+    def kv_request_cap(self, max_seq_len: int) -> int:
+        """Worst-case cache positions ONE synthetic-queue request can
+        ever touch: clamped prompt max + trimmed budget + dispatch slack
+        + the held token's slot — the spec-level mirror of
+        ``ServingEngine._row_cap`` evaluated at the queue's extremes, so
+        it dominates every admissible request. The ONE envelope formula
+        shared by kv_pool_blocks and validate()'s explicit-pool check."""
+        slack = self.serve_slack()
+        pmax = min(self.prompt_length_max, max_seq_len // 2)
+        budget = max(
+            1, min(self.max_new_max, max_seq_len - 1 - pmax - slack)
+        )
+        return min(max_seq_len, pmax + budget + slack + 1)
+
+    def kv_pool_blocks(self, rows: int, max_seq_len: int) -> int:
+        """Resolve the serve block-pool size (usable blocks, excluding
+        the engine's scratch block): the explicit ``kvNumBlocks`` when
+        set, else the queue envelope — ``rows`` requests at the WORST
+        per-request need (kv_request_cap), never more than the
+        dense-equivalent capacity. The ONE sizing formula shared by the
+        HBM gate (hbm_budget_gb) and the serve entrypoint, so validation
+        and the engine's actual pool can never diverge. 0 when the spec
+        runs the dense layout."""
+        bs = self.kv_block_size
+        if bs <= 0:
+            return 0
+        dense_blocks = rows * (-(-max_seq_len // bs))
+        if self.kv_num_blocks > 0:
+            return self.kv_num_blocks
+        if self.prompts:
+            # literal queue: prompt lengths unknown until tokenization —
+            # size for the dense envelope (still paged mechanics; the
+            # engine's lazy growth keeps residency at actual lengths)
+            return dense_blocks
+        cap = self.kv_request_cap(max_seq_len)
+        return min(dense_blocks, rows * (-(-cap // bs)))
 
     def serve_slack(self) -> int:
         """Worst-case per-dispatch cache overrun the engine budgets for —
@@ -468,12 +530,21 @@ class ServeSpec:
             d["numSpeculative"] = self.num_speculative
         if self.prefill_chunk != 8:
             d["prefillChunk"] = self.prefill_chunk
+        if self.kv_block_size != 32:
+            d["kvBlockSize"] = self.kv_block_size
+        if self.kv_num_blocks:
+            d["kvNumBlocks"] = self.kv_num_blocks
         return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ServeSpec":
         return cls(
             prefill_chunk=int(d.get("prefillChunk", 8) or 8),
+            # NOT `or 32`: kvBlockSize=0 (dense layout) must survive
+            kv_block_size=int(
+                32 if d.get("kvBlockSize") is None else d["kvBlockSize"]
+            ),
+            kv_num_blocks=int(d.get("kvNumBlocks", 0) or 0),
             num_requests=int(d.get("numRequests", 32) or 32),
             prompt_length_min=int(d.get("promptLengthMin", 16) or 16),
             prompt_length_max=int(d.get("promptLengthMax", 128) or 128),
@@ -648,7 +719,6 @@ class JaxXlaRuntime:
         except Exception:  # unresolvable model is reported elsewhere
             return None
         p = self.parallelism
-        n_params = cfg.param_count()
         dt_bytes = _dtype_bytes(getattr(cfg, "dtype", None))
         gb = 1024.0 ** 3
         # fsdp/tensor/pipeline shard ALL params; the expert axis shards
@@ -657,19 +727,12 @@ class JaxXlaRuntime:
         # are replicated across the expert axis, so dividing them by
         # p.expert would underestimate per-chip state (ADVICE r4 #1)
         dense_shards = max(1, p.fsdp * p.tensor * p.pipeline)
-        n_experts = int(getattr(cfg, "n_experts", 0) or 0)
-        if n_experts > 1:
-            expert_params = min(
-                cfg.n_layers * n_experts * 3 * cfg.d_model
-                * getattr(cfg, "d_ff", cfg.d_model * 4),
-                n_params,
-            )
-        else:
-            expert_params = 0
+        dense_params, expert_params = _expert_param_split(cfg)
+        n_params = dense_params + expert_params
         # per-chip parameter count after sharding (fractional is fine —
         # this is a bytes estimate, not a tensor shape)
         params_chip = (
-            (n_params - expert_params) / dense_shards
+            dense_params / dense_shards
             + expert_params / (dense_shards * max(1, p.expert))
         )
         out: Dict[str, float] = {}
@@ -712,11 +775,30 @@ class JaxXlaRuntime:
                     if self.model.overrides.get("kv_cache_quantized")
                     else float(dt_bytes)
                 )
+                if self.mode == "serve" and self.serve.kv_block_size > 0:
+                    # paged serve: the engine holds a block POOL sized
+                    # to the queue envelope (+ its scratch block), not
+                    # batch × max_seq_len dense rows — the gate admits
+                    # serve templates by what the pool actually costs
+                    # (the spec-level half of HBM-aware admission; the
+                    # engine's block allocator enforces it per request)
+                    positions = (
+                        self.serve.kv_pool_blocks(rows, cfg.max_seq_len)
+                        + 1
+                    ) * self.serve.kv_block_size
+                    # the shared pool REPLICATES over the data/fsdp axes
+                    # (any row reads any block; entrypoints pins
+                    # P(None, None, None, tensor, None)) — only kv heads
+                    # shard, so dividing by data*fsdp here would admit
+                    # configs that OOM per chip
+                    cache_shards = max(1, p.tensor)
+                else:
+                    positions = rows * cfg.max_seq_len
+                    cache_shards = max(1, p.data * p.fsdp * p.tensor)
                 cache = (
-                    cfg.n_layers * rows * cfg.max_seq_len * hkv * hd
+                    cfg.n_layers * positions * hkv * hd
                     * 2 * cache_bytes_per_elem
                 )
-                cache_shards = max(1, p.data * p.fsdp * p.tensor)
                 out["kv_cache_gb"] = cache / cache_shards / gb
         out["total_gb"] = round(sum(out.values()), 3)
         for k in list(out):
@@ -730,13 +812,18 @@ class JaxXlaRuntime:
         all-gather term docs/PERF.md names as the 8B/v5p-64 north star's
         binding constraint, quantified (VERDICT r4 item 8).
 
-        Model (the scaling-book recipe): a bf16 FSDP step moves ~3 full
-        parameter volumes per chip over the fsdp ring — forward
+        Model (the scaling-book recipe): a bf16 FSDP step moves ~3
+        gathered parameter volumes per chip over the fsdp ring — forward
         all-gather, backward re-gather, gradient reduce-scatter — each
-        (N-1)/N x param bytes. The ring rides ONE torus axis at 2x the
-        one-way link bandwidth (bidirectional ring); XLA can split the
-        gather across more axes, so this is the conservative end.
-        Compute time assumes 6*P*tokens_per_chip FLOPs at ``target_mfu``
+        (N-1)/N x the bytes the chip's tensor/pipeline group actually
+        owns: on a MIXED mesh the fsdp axis only gathers params already
+        divided across tensor x pipeline (and, for MoE expert weights,
+        the expert axis) — the previous full-volume figure was valid
+        only on a pure-FSDP mesh (ADVICE r5). The ring rides ONE torus
+        axis at 2x the one-way link bandwidth (bidirectional ring); XLA
+        can split the gather across more axes, so this is the
+        conservative end. Compute time is the chip's own share:
+        6*P*tokens_per_chip / (tensor*pipeline) FLOPs at ``target_mfu``
         of the generation's peak. ratio << 1 means the collectives fit
         under XLA's latency hiding; ratio >= 1 means exposed comm no
         overlap can recover. ``breakeven_tokens_per_chip`` is the
@@ -757,17 +844,23 @@ class JaxXlaRuntime:
             )
         except Exception:  # unresolvable model is reported elsewhere
             return None
-        n_params = cfg.param_count()
+        dense_params, expert_params = _expert_param_split(cfg)
+        n_params = dense_params + expert_params
         dt_bytes = _dtype_bytes(getattr(cfg, "dtype", None))
         ring_gb_s = 2.0 * gen["ici_gbps_link"]
         n = p.fsdp
-        comm_bytes = 3.0 * n_params * dt_bytes * (n - 1) / n
+        tp_pp = max(1, p.tensor * p.pipeline)
+        gathered_params = (
+            dense_params / tp_pp
+            + expert_params / (tp_pp * max(1, p.expert))
+        )
+        comm_bytes = 3.0 * gathered_params * dt_bytes * (n - 1) / n
         comm_s = comm_bytes / (ring_gb_s * 1e9)
         tokens_chip = max(
             1, self.train.batch_size // max(1, p.data * p.fsdp)
         ) * self.train.seq_len
         flops_s = target_mfu * gen["bf16_flops"]
-        compute_s = 6.0 * n_params * tokens_chip / flops_s
+        compute_s = 6.0 * n_params * tokens_chip / (tp_pp * flops_s)
         return {
             "comm_gb": round(comm_bytes / 1e9, 3),
             "ici_ring_gb_s": ring_gb_s,
@@ -775,7 +868,7 @@ class JaxXlaRuntime:
             "compute_s": round(compute_s, 6),
             "comm_compute_ratio": round(comm_s / compute_s, 4),
             "breakeven_tokens_per_chip": round(
-                comm_s * flops_s / (6.0 * n_params), 1
+                comm_s * tp_pp * flops_s / (6.0 * n_params), 1
             ),
         }
 
@@ -872,6 +965,21 @@ class JaxXlaRuntime:
                     f"serve.prefillChunk must be >= 1, got "
                     f"{sv.prefill_chunk}"
                 )
+            if sv.kv_block_size < 0:
+                errs.append(
+                    f"serve.kvBlockSize must be >= 0 (0 = dense layout), "
+                    f"got {sv.kv_block_size}"
+                )
+            if sv.kv_num_blocks < 0:
+                errs.append(
+                    f"serve.kvNumBlocks must be >= 0 (0 = auto), got "
+                    f"{sv.kv_num_blocks}"
+                )
+            if sv.kv_num_blocks > 0 and sv.kv_block_size <= 0:
+                errs.append(
+                    "serve.kvNumBlocks requires kvBlockSize > 0 (a dense "
+                    "cache has no block pool to size)"
+                )
             if sv.temperature < 0:
                 errs.append(
                     f"serve.temperature must be >= 0, got {sv.temperature}"
@@ -921,6 +1029,20 @@ class JaxXlaRuntime:
                             f"leaves no decode budget within max_seq_len "
                             f"{s_cfg.max_seq_len}"
                         )
+                    if sv.kv_num_blocks > 0 and sv.kv_block_size > 0:
+                        # an EXPLICIT pool must fit the queue's largest
+                        # possible request, or the engine can never admit
+                        # it (eviction-free admission fails fast instead
+                        # of hanging; auto pools size to the envelope)
+                        cap = sv.kv_request_cap(s_cfg.max_seq_len)
+                        need = -(-cap // sv.kv_block_size)
+                        if not sv.prompts and need > sv.kv_num_blocks:
+                            errs.append(
+                                f"serve.kvNumBlocks ({sv.kv_num_blocks}) "
+                                f"cannot hold the queue's largest request "
+                                f"({need} blocks of {sv.kv_block_size} "
+                                f"for its {cap}-position envelope)"
+                            )
         if self.infer.draft is not None and self.mode == "infer":
             from nexus_tpu.models.registry import get_family, list_families
 

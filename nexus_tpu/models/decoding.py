@@ -1,7 +1,9 @@
 """Shared autoregressive decode driver for the decoder model families.
 
 Each family supplies its ``forward_decode(params, cfg, tokens, cache)``;
-the KV-cache layout ((L, B, S, Hkv, D) ring-free append buffer) and the
+the KV-cache layouts — the dense (L, B, S, Hkv, D) ring-free append
+buffer and the paged (L, num_blocks, block_size, Hkv, D) block pool read
+through a per-row block table (``init_paged_kv_cache``) — and the
 prefill + ``lax.scan`` greedy/sampled generation loop are identical across
 families and live here once.
 """
@@ -14,6 +16,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from nexus_tpu.ops.attention import (
+    decode_attention as _decode_attention,
+    paged_decode_attention,
+)
 from nexus_tpu.ops.norms import rms_norm
 from nexus_tpu.ops.rope import apply_rope, rope_cos_sin
 from nexus_tpu.ops.sampling import sample_logits
@@ -80,54 +86,44 @@ def _quantize_kv(x: jnp.ndarray):
     return q, scale
 
 
-def _decode_attention(
-    q: jnp.ndarray, k_buf: jnp.ndarray, v_buf: jnp.ndarray,
-    start: jnp.ndarray, window: int = 0,
-    k_scale=None, v_scale=None,
-) -> jnp.ndarray:
-    """Length-masked attention of q's tokens over the full cache buffer.
+def init_paged_kv_cache(
+    n_layers: int, n_kv_heads: int, head_dim: int, dtype,
+    batch: int, num_blocks: int, block_size: int,
+    blocks_per_row: int, quantized: bool = False,
+) -> Dict[str, Any]:
+    """PAGED KV cache: a static pool of ``num_blocks`` K/V blocks of
+    ``block_size`` positions per layer, plus a per-row ``block_table``
+    ((batch, blocks_per_row) int32 pool indices) mapping each row's
+    virtual positions onto pool blocks. A row's virtual capacity is
+    ``blocks_per_row * block_size``; it only OWNS the blocks its table
+    maps, so pool residency tracks actual sequence lengths instead of
+    ``batch × max_len`` worst cases (the serving engine's HBM-aware
+    admission allocates/frees blocks host-side; models/decoding.py's
+    scaffold reads/writes through the table transparently).
 
-    Static shapes (the mask, not a slice, hides unwritten cache tail) — one
-    compiled program regardless of decode position. GQA runs as grouped
-    einsums against the raw (B, L, Hkv, D) cache: no ``jnp.repeat``
-    materialization, so per-step HBM traffic is the cache itself, not
-    n_rep copies of it (the decode-throughput driver for config #3).
-
-    ``start``: scalar (all rows at one depth) or (B,) vector (per-row
-    depths — the batched-speculation cache, where each sequence committed
-    a different number of tokens)."""
-    b, t, hq, hd = q.shape
-    max_len = k_buf.shape[1]
-    hkv = k_buf.shape[2]
-    n_rep = hq // hkv
-    if k_scale is not None:
-        # int8 cache: dequantize at the model's compute width (bf16), not
-        # f32 — if XLA fails to fuse the convert+scale into the dot read,
-        # the materialized temporary is then no wider than the fp cache
-        k_buf = (
-            k_buf.astype(jnp.float32) * k_scale[..., None]
-        ).astype(q.dtype)
-        v_buf = (
-            v_buf.astype(jnp.float32) * v_scale[..., None]
-        ).astype(q.dtype)
-    qg = q.reshape(b, t, hkv, n_rep, hd)
-    logits = jnp.einsum(
-        "btgrd,bkgd->bgrtk", qg, k_buf, preferred_element_type=jnp.float32
-    ) * hd ** -0.5  # (B, Hkv, rep, T, L)
-    starts = jnp.broadcast_to(jnp.asarray(start), (b,))  # scalar or (B,)
-    q_pos = starts[:, None] + jnp.arange(t)[None, :]  # (B, t)
-    visible = (
-        jnp.arange(max_len)[None, None, :] <= q_pos[..., None]
-    )  # (B, t, max_len)
-    if window > 0:  # sliding-window attention: newest `window` positions
-        visible = visible & (
-            jnp.arange(max_len)[None, None, :] > q_pos[..., None] - window
-        )
-    mask_value = -0.7 * float(jnp.finfo(jnp.float32).max)
-    logits = jnp.where(visible[:, None, None], logits, mask_value)
-    probs = jax.nn.softmax(logits, axis=-1).astype(v_buf.dtype)
-    out = jnp.einsum("bgrtk,bkgd->btgrd", probs, v_buf)
-    return out.reshape(b, t, hq, hd).astype(q.dtype)
+    The table is initialized to ``num_blocks - 1`` — by convention the
+    allocator treats the LAST pool block as a scratch block that is never
+    handed out, so unmapped table entries and released rows write/read
+    there harmlessly (reads of scratch are always length-masked).
+    ``quantized`` mirrors ``init_kv_cache``: int8 K/V with per-(position,
+    head) f32 scale planes of shape (L, num_blocks, block_size, Hkv)."""
+    shape = (n_layers, num_blocks, block_size, n_kv_heads, head_dim)
+    cache: Dict[str, Any] = {
+        "length": jnp.zeros((batch,), jnp.int32),
+        "block_table": jnp.full(
+            (batch, blocks_per_row), num_blocks - 1, jnp.int32
+        ),
+    }
+    if quantized:
+        cache["k"] = jnp.zeros(shape, jnp.int8)
+        cache["v"] = jnp.zeros(shape, jnp.int8)
+        scale_shape = (n_layers, num_blocks, block_size, n_kv_heads)
+        cache["k_scale"] = jnp.zeros(scale_shape, jnp.float32)
+        cache["v_scale"] = jnp.zeros(scale_shape, jnp.float32)
+    else:
+        cache["k"] = jnp.zeros(shape, dtype)
+        cache["v"] = jnp.zeros(shape, dtype)
+    return cache
 
 
 def generic_forward_decode(
@@ -162,15 +158,39 @@ def generic_forward_decode(
     are padding: their K/V writes are dropped (never enter the cache),
     their logits are garbage the caller must ignore, and the returned
     ``length`` advances by ``n_valid`` per row, not ``t``. ``n_valid`` is
-    consumed here — it is not part of the returned cache."""
+    consumed here — it is not part of the returned cache.
+
+    Cache key ``block_table`` ((B, M) int32) switches the cache to the
+    PAGED layout (init_paged_kv_cache): K/V buffers are block POOLS
+    ((L, num_blocks, block_size, Hkv, D)) and each row's virtual
+    position p lives at pool block ``block_table[b, p // block_size]``,
+    offset ``p % block_size``. Reads gather the row's blocks into the
+    dense virtual view (ops/attention.py::paged_decode_attention), writes
+    scatter through the table; everything else — masks, rope, n_valid,
+    per-row lengths — is IDENTICAL to the dense vector-length path, so
+    the exactness contract carries over unchanged. The table is part of
+    the cache dict and is passed through to the returned cache (the host
+    owns its contents; requires vector ``length``)."""
     b, t = tokens.shape
-    max_len = cache["k"].shape[2]
     start = cache["length"]
     n_valid = cache.get("n_valid")  # (B,) real-token counts, or None
+    block_table = cache.get("block_table")  # (B, M) pool ids, or None
+    paged = block_table is not None
+    if paged:
+        num_blocks, block_size = cache["k"].shape[1], cache["k"].shape[2]
+        # virtual per-row capacity — every dense-path position bound
+        # below works against it unchanged
+        max_len = block_table.shape[1] * block_size
+    else:
+        max_len = cache["k"].shape[2]
     cache = {k_: v_ for k_, v_ in cache.items() if k_ != "n_valid"}
     vector_len = jnp.ndim(start) == 1  # per-row cache depths (batched spec)
     if n_valid is not None and not vector_len:
         raise ValueError("n_valid requires a vector (per-row) cache length")
+    if paged and not vector_len:
+        raise ValueError(
+            "a paged KV cache requires a vector (per-row) cache length"
+        )
 
     x = params["embed"].astype(cfg.dtype)[tokens]
     # rope tables for the whole buffer; slice at runtime positions
@@ -193,12 +213,28 @@ def generic_forward_decode(
     def write_cache(buf, new):
         """Append ``new`` (B, t, ...) at each row's depth: contiguous
         dynamic-slice in the scalar case, a per-row scatter (dropped when
-        out of range) in the vector case. Padding slots (j >= n_valid[b])
-        are pushed out of range so the drop mode discards them."""
+        out of range) in the vector case, a through-the-table scatter
+        into the block pool in the paged case. Padding slots
+        (j >= n_valid[b]) are pushed out of range so the drop mode
+        discards them."""
+        pos = start[:, None] + jnp.arange(t)[None, :] if vector_len else None
+        if paged:
+            # virtual position -> (pool block, offset); positions past
+            # the row's virtual capacity or the feed's n_valid scatter to
+            # an out-of-range pool index and drop
+            keep = pos < max_len
+            if n_valid is not None:
+                keep = keep & (jnp.arange(t)[None, :] < n_valid[:, None])
+            blk = jnp.take_along_axis(
+                block_table,
+                jnp.clip(pos // block_size, 0, block_table.shape[1] - 1),
+                axis=1,
+            )
+            phys = jnp.where(keep, blk, num_blocks)
+            return buf.at[phys, pos % block_size].set(new, mode="drop")
         if not vector_len:
             return lax.dynamic_update_slice_in_dim(buf, new, start, axis=1)
         rows = jnp.arange(b)[:, None]
-        pos = start[:, None] + jnp.arange(t)[None, :]
         if n_valid is not None:
             pos = jnp.where(
                 jnp.arange(t)[None, :] < n_valid[:, None], pos, max_len
@@ -227,6 +263,11 @@ def generic_forward_decode(
                 ks_buf = write_cache(ks_cache, ks)
                 vs_buf = write_cache(vs_cache, vs)
                 calls.append((k_buf, v_buf, ks_buf, vs_buf))
+                if paged:
+                    return paged_decode_attention(
+                        q, k_buf, v_buf, block_table, start, window=window,
+                        k_scale=ks_buf, v_scale=vs_buf,
+                    )
                 return _decode_attention(
                     q, k_buf, v_buf, start, window=window,
                     k_scale=ks_buf, v_scale=vs_buf,
@@ -234,6 +275,10 @@ def generic_forward_decode(
             k_buf = write_cache(k_cache, k)
             v_buf = write_cache(v_cache, v)
             calls.append((k_buf, v_buf))
+            if paged:
+                return paged_decode_attention(
+                    q, k_buf, v_buf, block_table, start, window=window
+                )
             return _decode_attention(q, k_buf, v_buf, start, window=window)
 
         x = layer_fn(cfg, x, layer, attend, cos, sin)
@@ -255,6 +300,8 @@ def generic_forward_decode(
     advance = t if n_valid is None else n_valid
     new_cache = {"k": new_bufs[0], "v": new_bufs[1],
                  "length": start + advance}
+    if paged:
+        new_cache["block_table"] = block_table
     if quantized:
         new_cache["k_scale"], new_cache["v_scale"] = new_bufs[2], new_bufs[3]
     return logits, new_cache

@@ -920,6 +920,107 @@ def _flash_bwd_impl(q, k, v, out, lse, g, opts, g_lse=None):
     return dq, dk, dv
 
 
+# ------------------------------------------------- KV-cache decode attention
+#
+# The length-masked cache-read attention the incremental-decode scaffold
+# (models/decoding.py::generic_forward_decode) dispatches per layer. Two
+# layouts share the math:
+#   * dense:  each row owns a contiguous (max_len, Hkv, D) stripe of a
+#     (B, max_len, Hkv, D) buffer — the original layout, still used by the
+#     static decode paths (one sequence per row for its whole life);
+#   * paged:  K/V live in a (num_blocks, block_size, Hkv, D) POOL and each
+#     row maps virtual positions onto pool blocks through a (B, M) block
+#     table — the serving engine's layout, where rows hold only the blocks
+#     their actual sequence needs instead of a worst-case max_len stripe.
+# The paged read gathers the row's blocks into the same (B, S, Hkv, D)
+# virtual view the dense mask logic already handles: static shapes, one
+# compiled decode program regardless of per-row depths or table contents.
+
+
+def decode_attention(
+    q: jnp.ndarray, k_buf: jnp.ndarray, v_buf: jnp.ndarray,
+    start: jnp.ndarray, window: int = 0,
+    k_scale=None, v_scale=None,
+) -> jnp.ndarray:
+    """Length-masked attention of q's tokens over the full cache buffer.
+
+    Static shapes (the mask, not a slice, hides unwritten cache tail) — one
+    compiled program regardless of decode position. GQA runs as grouped
+    einsums against the raw (B, L, Hkv, D) cache: no ``jnp.repeat``
+    materialization, so per-step HBM traffic is the cache itself, not
+    n_rep copies of it (the decode-throughput driver for config #3).
+
+    ``start``: scalar (all rows at one depth) or (B,) vector (per-row
+    depths — the batched-speculation cache, where each sequence committed
+    a different number of tokens)."""
+    b, t, hq, hd = q.shape
+    max_len = k_buf.shape[1]
+    hkv = k_buf.shape[2]
+    n_rep = hq // hkv
+    if k_scale is not None:
+        # int8 cache: dequantize at the model's compute width (bf16), not
+        # f32 — if XLA fails to fuse the convert+scale into the dot read,
+        # the materialized temporary is then no wider than the fp cache
+        k_buf = (
+            k_buf.astype(jnp.float32) * k_scale[..., None]
+        ).astype(q.dtype)
+        v_buf = (
+            v_buf.astype(jnp.float32) * v_scale[..., None]
+        ).astype(q.dtype)
+    qg = q.reshape(b, t, hkv, n_rep, hd)
+    logits = jnp.einsum(
+        "btgrd,bkgd->bgrtk", qg, k_buf, preferred_element_type=jnp.float32
+    ) * hd ** -0.5  # (B, Hkv, rep, T, L)
+    starts = jnp.broadcast_to(jnp.asarray(start), (b,))  # scalar or (B,)
+    q_pos = starts[:, None] + jnp.arange(t)[None, :]  # (B, t)
+    visible = (
+        jnp.arange(max_len)[None, None, :] <= q_pos[..., None]
+    )  # (B, t, max_len)
+    if window > 0:  # sliding-window attention: newest `window` positions
+        visible = visible & (
+            jnp.arange(max_len)[None, None, :] > q_pos[..., None] - window
+        )
+    mask_value = -0.7 * float(jnp.finfo(jnp.float32).max)
+    logits = jnp.where(visible[:, None, None], logits, mask_value)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v_buf.dtype)
+    out = jnp.einsum("bgrtk,bkgd->btgrd", probs, v_buf)
+    return out.reshape(b, t, hq, hd).astype(q.dtype)
+
+
+def gather_kv_blocks(pool: jnp.ndarray, block_table: jnp.ndarray) -> jnp.ndarray:
+    """(N, Bs, ...) block pool + (B, M) table → (B, M·Bs, ...) per-row
+    virtual view, rows' blocks concatenated in table order. The gather is
+    the whole paged↔dense bridge: the result has exactly the dense
+    layout's per-row axis, so mask/rope/write semantics need no second
+    implementation. Table entries for unmapped tails may point anywhere
+    in range (conventionally the scratch block) — those virtual positions
+    sit at or beyond the row's length and the mask hides them."""
+    b, m = block_table.shape
+    gathered = pool[block_table]  # (B, M, Bs, ...)
+    return gathered.reshape((b, m * pool.shape[1]) + pool.shape[2:])
+
+
+def paged_decode_attention(
+    q: jnp.ndarray, k_pool: jnp.ndarray, v_pool: jnp.ndarray,
+    block_table: jnp.ndarray, start: jnp.ndarray, window: int = 0,
+    k_scale=None, v_scale=None,
+) -> jnp.ndarray:
+    """``decode_attention`` reading through a paged block pool.
+
+    q: (B, T, Hq, D); k_pool/v_pool: (num_blocks, block_size, Hkv, D);
+    block_table: (B, M) int32 pool indices; start: (B,) per-row depths
+    (paged caches always run vector lengths). Scale planes (int8 cache)
+    are (num_blocks, block_size, Hkv) and gather through the same table.
+    """
+    k_buf = gather_kv_blocks(k_pool, block_table)
+    v_buf = gather_kv_blocks(v_pool, block_table)
+    ks = gather_kv_blocks(k_scale, block_table) if k_scale is not None else None
+    vs = gather_kv_blocks(v_scale, block_table) if v_scale is not None else None
+    return decode_attention(
+        q, k_buf, v_buf, start, window=window, k_scale=ks, v_scale=vs
+    )
+
+
 def attention(
     q: jnp.ndarray,
     k: jnp.ndarray,

@@ -855,9 +855,18 @@ def _run_serve(runtime, family, cfg, mesh):
             batch_axes = None
         tp = shape["tensor"]
         kv_axis = "tensor" if tp > 1 and cfg.n_kv_heads % tp == 0 else None
-        cache_sharding = NamedSharding(
-            mesh, P(None, batch_axes, None, kv_axis, None)
-        )
+        if sv.kv_block_size > 0:
+            # paged pool layout (L, num_blocks, block_size, Hkv, D): any
+            # row can read any block, so the pool axis stays unsharded —
+            # only kv heads ride the tensor axis (batch sharding of a
+            # shared pool would make every gather a cross-chip reshuffle)
+            cache_sharding = NamedSharding(
+                mesh, P(None, None, None, kv_axis, None)
+            )
+        else:
+            cache_sharding = NamedSharding(
+                mesh, P(None, batch_axes, None, kv_axis, None)
+            )
         engine = ServingEngine(
             family.forward_decode, params, cfg,
             batch_size=tr.batch_size,
@@ -868,6 +877,12 @@ def _run_serve(runtime, family, cfg, mesh):
             lookup_ngram=sv.prompt_lookup_ngram,
             num_speculative=sv.num_speculative,
             prefill_chunk=sv.prefill_chunk,
+            kv_block_size=sv.kv_block_size,
+            # the ONE sizing formula validate()'s HBM gate also uses —
+            # pool capacity and admission can't drift from the spec
+            kv_num_blocks=sv.kv_pool_blocks(
+                tr.batch_size, cfg.max_seq_len
+            ),
         )
         results, metrics = engine.serve(requests)
     finished = sum(1 for r in results if r is not None)

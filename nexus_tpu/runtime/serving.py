@@ -11,6 +11,14 @@ CHUNKED PREFILL:
     models/decoding.py scaffold that batched speculation uses), so every
     row decodes at its own position with its own causal mask and rows
     never interact;
+  * the cache is PAGED by default (kv_block_size > 0): K/V live in a
+    static block pool read through per-row block tables, a host-side
+    free-list allocator (BlockAllocator) maps blocks lazily as rows
+    grow, and admission is HBM-AWARE — a request enters only when the
+    pool can reserve its prompt + budget + slack in blocks (refundable
+    headroom; eviction-free by construction), so admitted residency
+    tracks actual sequence lengths instead of batch × max_len worst
+    cases. One compiled program still serves every table state;
   * prompts are NOT prefilled in a separate dispatch. Admission writes
     the prompt into a per-row token buffer (one tiny scatter), and the
     decode chunk program itself streams it through the model at
@@ -68,7 +76,105 @@ from jax import lax
 from nexus_tpu.models.decoding import (
     constrain_kv_sharding,
     init_kv_cache,
+    init_paged_kv_cache,
 )
+
+
+class BlockAllocator:
+    """Host-side free-list allocator over the paged KV block pool.
+
+    Reservation-based and EVICTION-FREE: ``admit`` succeeds only when the
+    pool can promise a row's whole worst-case block count up front (its
+    prompt plus its trimmed decode budget plus the dispatch slack — the
+    refundable headroom), so an admitted row can ALWAYS grow to its cap
+    without evicting anyone. Physical blocks are drawn lazily against
+    that reservation (``_BlockLease.grow_to``, once per dispatch), so
+    pool RESIDENCY tracks actual sequence lengths; the headroom a row
+    never materializes — and everything it did — returns to the pool at
+    ``release`` (stop-token finishes refund their unused budget).
+
+    Invariant: ``len(_free) >= _reserved`` at all times (admission gates
+    on ``available_blocks``), which is exactly why an in-reservation
+    ``grow_to`` can never fail mid-generation."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # pop() from the tail → blocks hand out in ascending id order
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._reserved = 0  # promised to admitted rows, not yet allocated
+        self.peak_allocated = 0
+
+    def blocks_for(self, positions: int) -> int:
+        """Blocks covering ``positions`` cache slots."""
+        return max(0, -(-int(positions) // self.block_size))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def allocated_blocks(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    @property
+    def available_blocks(self) -> int:
+        """Blocks admissible to NEW rows (free minus outstanding
+        reservations — the admission gate's currency)."""
+        return len(self._free) - self._reserved
+
+    def admit(self, need_blocks: int) -> Optional["_BlockLease"]:
+        """Reserve ``need_blocks`` for one row; None when the pool can't
+        promise them (the caller keeps the request queued — admission is
+        FIFO, so a refused head request waits for refunds rather than
+        being overtaken)."""
+        if need_blocks > self.available_blocks:
+            return None
+        self._reserved += need_blocks
+        return _BlockLease(self, need_blocks)
+
+    def _alloc_one(self) -> int:
+        blk = self._free.pop()
+        self._reserved -= 1  # reservation converts to allocation
+        self.peak_allocated = max(self.peak_allocated, self.allocated_blocks)
+        return blk
+
+
+class _BlockLease:
+    """One admitted row's slice of the pool: its reservation plus the
+    blocks physically mapped so far (in virtual-position order — entry i
+    backs positions [i*block_size, (i+1)*block_size))."""
+
+    def __init__(self, allocator: BlockAllocator, reservation: int):
+        self._a = allocator
+        self.reservation = int(reservation)
+        self.blocks: List[int] = []
+        self._released = False
+
+    def grow_to(self, n_blocks: int) -> List[int]:
+        """Ensure at least ``n_blocks`` blocks are mapped (clamped to the
+        reservation — by construction callers never need more) and return
+        the full mapping."""
+        if self._released:
+            raise RuntimeError("grow_to on a released lease")
+        n = min(int(n_blocks), self.reservation)
+        while len(self.blocks) < n:
+            self.blocks.append(self._a._alloc_one())
+        return self.blocks
+
+    def release(self) -> None:
+        """Refund everything: mapped blocks back to the free list, the
+        never-materialized headroom back to the admission budget."""
+        if self._released:
+            return
+        self._released = True
+        self._a._free.extend(self.blocks)
+        self._a._reserved -= self.reservation - len(self.blocks)
+        self.blocks = []
 
 
 @dataclass
@@ -125,6 +231,8 @@ class ServingEngine:
         lookup_ngram: int = 0,
         num_speculative: int = 4,
         prefill_chunk: int = 8,
+        kv_block_size: int = 32,
+        kv_num_blocks: int = 0,
     ):
         """``prefill_chunk`` (T): prompt tokens an admitting row consumes
         per decode step. A T-slot feed costs every row T slots of matmul
@@ -145,7 +253,23 @@ class ServingEngine:
         matches a plain chunk's. Prefilling rows ride the same rounds:
         their (k+1)-wide verify window carries prompt tokens instead of
         proposals. Greedy only (requests with temperature > 0 are
-        rejected at admission)."""
+        rejected at admission).
+
+        ``kv_block_size > 0`` (the default) runs the PAGED KV cache: K/V
+        live in a static pool of ``kv_num_blocks`` blocks of
+        ``kv_block_size`` positions per layer, each row reading/writing
+        through a block table (models/decoding.py). Admission becomes
+        HBM-aware: a request is admitted only when the pool can reserve
+        its prompt + trimmed budget + dispatch slack in blocks
+        (refundable headroom — eviction-free by construction; see
+        BlockAllocator), and blocks are mapped lazily as the row actually
+        grows, so pool residency tracks real sequence lengths.
+        ``kv_num_blocks = 0`` sizes the pool capacity-equivalent to the
+        dense layout (batch × ceil(max_len/block) + scratch) — identical
+        admission behavior, paged mechanics; pass a smaller pool to
+        actually cap HBM (the serve entrypoint sizes it to the queue
+        envelope). ``kv_block_size = 0`` keeps the legacy dense
+        ``batch × max_len`` rows (the A/B baseline)."""
         self._fwd = forward_decode
         self._params = params
         self._cfg = cfg
@@ -171,6 +295,28 @@ class ServingEngine:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {prefill_chunk}"
             )
+        self._block_size = int(kv_block_size)
+        if self._block_size < 0:
+            raise ValueError(
+                f"kv_block_size must be >= 0, got {kv_block_size}"
+            )
+        self._paged = self._block_size > 0
+        if self._paged:
+            # per-row virtual capacity in blocks (the block-table width)
+            self._blocks_per_row = -(-self._max_len // self._block_size)
+            # usable pool blocks; the cache carries ONE extra scratch
+            # block (id == num_blocks) that the allocator never hands
+            # out — unmapped table tails and released rows point there
+            self._num_blocks = int(kv_num_blocks) or (
+                self._b * self._blocks_per_row
+            )
+            if self._num_blocks < 1:
+                raise ValueError(
+                    f"kv_num_blocks must be >= 1, got {kv_num_blocks}"
+                )
+        else:
+            self._blocks_per_row = 0
+            self._num_blocks = 0
         # rounds per dispatch: one round = one target forward committing
         # 1..k+1 tokens, so this keeps a spec chunk's committed-token
         # budget comparable to a plain chunk's C single-token steps
@@ -440,7 +586,26 @@ class ServingEngine:
                 f"({self._slack}) leaves no decode budget within "
                 f"max_len {self._max_len}"
             )
+        if self._paged:
+            # a request whose worst-case block need exceeds the whole
+            # pool can NEVER be admitted — an error now, not a hang later
+            need = -(-self._row_cap(p, budget) // self._block_size)
+            if need > self._num_blocks:
+                raise ValueError(
+                    f"request {req_idx}: needs {need} KV blocks "
+                    f"(prompt {p} + budget {budget} + slack "
+                    f"{self._slack}) but the pool has only "
+                    f"{self._num_blocks}; raise kv_num_blocks or shrink "
+                    "the request"
+                )
         return prompt, p, budget
+
+    def _row_cap(self, p: int, budget: int) -> int:
+        """Worst-case cache positions one admitted request can ever
+        touch: prompt + trimmed budget + one dispatch's overrun + the
+        held token's slot. The reservation unit of HBM-aware admission —
+        always <= max_len by the budget trim above."""
+        return min(self._max_len, p + budget + self._slack + 1)
 
     def _admit_wave(self, cache, buf, ptr, plen, temp_vec, seed_vec,
                     admissions):
@@ -449,7 +614,9 @@ class ServingEngine:
         scatter-drop via an out-of-range row index) and write them into
         the device state. No model forward happens here — the chunk
         program streams each prompt in-band. ``admissions``:
-        [(row, req, req_idx), ...] → [(row, _RowState), ...]."""
+        [(row, req, req_idx, prompt, p, budget), ...] (pre-validated by
+        the caller, which gates on the block pool first) →
+        [(row, _RowState), ...]."""
         b, max_len = self._b, self._max_len
         rows = np.full((b,), b, dtype=np.int32)  # b == dropped slot
         prompts = np.zeros((b, max_len), dtype=np.int32)
@@ -457,8 +624,9 @@ class ServingEngine:
         temps = np.zeros((b,), dtype=np.float32)
         seeds = np.zeros((b,), dtype=np.int32)
         out = []
-        for i, (row, req, req_idx) in enumerate(admissions):
-            prompt, p, budget = self._validate_request(req, req_idx)
+        for i, (row, req, req_idx, prompt, p, budget) in enumerate(
+            admissions
+        ):
             rows[i] = row
             prompts[i, :p] = prompt
             ps[i] = p
@@ -498,16 +666,30 @@ class ServingEngine:
         # the scale planes need no admission-time handling at all
         quantized = bool(getattr(cfg, "kv_cache_quantized", False))
 
+        def fresh_cache():
+            """The serve cache at its REAL layout (paged pool + scratch
+            block, or the legacy dense rows) with the caller's sharding
+            constraint pinned — used for warm-up AND the timed run so
+            both compile the same program."""
+            if self._paged:
+                c = init_paged_kv_cache(
+                    cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
+                    b, self._num_blocks + 1, self._block_size,
+                    self._blocks_per_row, quantized=quantized,
+                )
+            else:
+                c = init_kv_cache(
+                    cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
+                    b, max_len, quantized=quantized,
+                )
+                c["length"] = jnp.zeros((b,), jnp.int32)
+            return constrain_kv_sharding(c, self._cache_sharding)
+
         # ---- warm-up (outside the timed window) ----
-        warm_cache = init_kv_cache(
-            cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
-            b, max_len, quantized=quantized,
-        )
         # warm with the REAL layout or jit compiles a second program for
         # the constrained cache on the first timed chunk (scale planes
         # included — unconstrained they replicate on a sharded mesh)
-        warm_cache = constrain_kv_sharding(warm_cache, self._cache_sharding)
-        warm_cache["length"] = jnp.zeros((b,), jnp.int32)
+        warm_cache = fresh_cache()
         warm_buf = jnp.zeros((b, max_len), jnp.int32)
 
         def zi():
@@ -543,14 +725,7 @@ class ServingEngine:
             if self._decode_chunk_narrow is not self._decode_chunk:
                 # the wide warm-up donated its state; mint fresh buffers
                 # for the pure-decode program's compile
-                warm2 = init_kv_cache(
-                    cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
-                    b, max_len, quantized=quantized,
-                )
-                warm2 = constrain_kv_sharding(
-                    warm2, self._cache_sharding
-                )
-                warm2["length"] = jnp.zeros((b,), jnp.int32)
+                warm2 = fresh_cache()
                 out = self._decode_chunk_narrow(
                     self._params, warm2, zi(), zi(),
                     jnp.ones((b,), jnp.bool_),
@@ -560,12 +735,7 @@ class ServingEngine:
         del warm_cache, warm_buf, out
 
         t0 = time.monotonic()
-        cache = init_kv_cache(
-            cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
-            b, max_len, quantized=quantized,
-        )
-        cache = constrain_kv_sharding(cache, self._cache_sharding)
-        cache["length"] = jnp.zeros((b,), jnp.int32)  # vector from step 0
+        cache = fresh_cache()  # vector length from step 0
         buf = jnp.zeros((b, max_len), jnp.int32)
         tok_vec = jnp.zeros((b,), jnp.int32)
         ptr_vec = jnp.zeros((b,), jnp.int32)
@@ -589,6 +759,62 @@ class ServingEngine:
         self._insert_dispatches = 0
         self._prefill_steps = 0
 
+        # ---- paged-pool bookkeeping (all host-side) ----
+        # per-position cache bytes across layers and k+v (+ the int8
+        # scale planes) — the currency of the KV metrics
+        if quantized:
+            pos_bytes = cfg.n_layers * cfg.n_kv_heads * (
+                cfg.head_dim * 1 + 4
+            ) * 2
+        else:
+            pos_bytes = (
+                cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
+                * int(np.dtype(cfg.dtype).itemsize) * 2
+            )
+        alloc = (
+            BlockAllocator(self._num_blocks, self._block_size)
+            if self._paged else None
+        )
+        leases: List[Optional[_BlockLease]] = [None] * b
+        caps = [0] * b  # _row_cap per active row
+        plen_host = [0] * b  # prompt length per active row
+        scratch = self._num_blocks  # the one block the allocator never owns
+        table_np = np.full(
+            (b, self._blocks_per_row or 1), scratch, dtype=np.int32
+        )
+        reserved_blocks_total = 0  # Σ per-admission reservations
+        alloc_block_steps = 0  # Σ per-chunk allocated blocks (residency)
+        table_dirty = [True]  # admission/finish/growth since last push
+
+        def grow_and_push_tables():
+            """Map every active row's next-dispatch coverage (its length
+            can grow by at most ``slack`` past prompt + emitted within
+            one dispatch — the same bound the budget trim uses) and push
+            the table to the device cache. In-reservation growth can
+            never fail (BlockAllocator invariant), which is what makes
+            admission eviction-free. Steady-state chunks (no admission,
+            no finish, no block-boundary crossing) skip the upload — the
+            chunk program passes the table through its returned cache,
+            so the device copy stays valid until the host changes it."""
+            nonlocal cache
+            for r in range(b):
+                state = rows[r]
+                if state is None or leases[r] is None:
+                    continue
+                cover = min(
+                    caps[r],
+                    plen_host[r] + len(state.emitted) + self._slack,
+                )
+                before = len(leases[r].blocks)
+                blks = leases[r].grow_to(alloc.blocks_for(cover))
+                if len(blks) != before:
+                    table_np[r, : len(blks)] = blks
+                    table_dirty[0] = True
+            if table_dirty[0]:
+                cache = dict(cache)
+                cache["block_table"] = jnp.asarray(table_np)
+                table_dirty[0] = False
+
         def finish(state: _RowState) -> None:
             nonlocal committed
             committed += len(state.emitted)
@@ -606,26 +832,59 @@ class ServingEngine:
 
         def admit_into(free_rows):
             """Fill free rows from the queue — one insert dispatch per
-            wave; the prompts stream through the next chunks in-band."""
+            wave; the prompts stream through the next chunks in-band.
+            Paged: each admission must RESERVE its worst-case block count
+            first (HBM-aware gate). Admission stays FIFO — a refused head
+            request waits for refunds (rows finishing return blocks)
+            instead of being overtaken by a smaller one; progress is
+            guaranteed because an idle engine has its whole pool free and
+            _validate_request rejects requests that exceed it outright."""
             nonlocal cache, buf, ptr_vec, plen_vec, temp_vec, seed_vec
-            nonlocal next_req
+            nonlocal next_req, reserved_blocks_total
             if not free_rows or next_req >= len(requests):
                 return
             wave = []
+            wave_meta = []  # (row, p, budget, lease) alongside the wave
             while free_rows and next_req < len(requests):
-                wave.append((free_rows.pop(0), requests[next_req], next_req))
+                req = requests[next_req]
+                prompt, p, budget = self._validate_request(req, next_req)
+                lease = None
+                if self._paged:
+                    need = alloc.blocks_for(self._row_cap(p, budget))
+                    lease = alloc.admit(need)
+                    if lease is None:
+                        break  # pool full: head of the queue waits
+                    reserved_blocks_total += need
+                row = free_rows.pop(0)
+                wave.append((row, req, next_req, prompt, p, budget))
+                wave_meta.append((row, p, budget, lease))
                 next_req += 1
+            if not wave:
+                return
             (cache, buf, ptr_vec, plen_vec, temp_vec, seed_vec,
              admitted) = self._admit_wave(
                 cache, buf, ptr_vec, plen_vec, temp_vec, seed_vec, wave,
             )
-            for row, state, steps in admitted:
+            for (row, state, steps), (_, p, budget, lease) in zip(
+                admitted, wave_meta
+            ):
                 rows[row] = state
                 prefill_left[row] = steps
+                if self._paged:
+                    leases[row] = lease
+                    caps[row] = self._row_cap(p, budget)
+                    plen_host[row] = p
+                    table_np[row, :] = scratch
+                    table_dirty[0] = True
 
         admit_into([r for r in range(b) if rows[r] is None])
 
         while any(r is not None for r in rows):
+            if self._paged:
+                # map the blocks this dispatch can touch, then sample the
+                # pool's residency for the bytes-per-token metric
+                grow_and_push_tables()
+                alloc_block_steps += alloc.allocated_blocks
             done_vec = jnp.asarray(
                 [r is None or row_done(r) for r in rows], jnp.bool_
             )
@@ -694,6 +953,16 @@ class ServingEngine:
                 if row_done(state):
                     finish(state)
                     rows[r] = None
+                    if self._paged and leases[r] is not None:
+                        # refund the row's blocks AND its never-used
+                        # headroom; point the table row at scratch so
+                        # the (frozen, rolled-back) slot writes a done
+                        # row still issues can't touch a block that is
+                        # re-allocated to someone else
+                        leases[r].release()
+                        leases[r] = None
+                        table_np[r, :] = scratch
+                        table_dirty[0] = True
             # admit the next queued requests into every row this chunk
             # freed — ONE insert wave, no model forward
             admit_into([r for r in range(b) if rows[r] is None])
@@ -715,6 +984,43 @@ class ServingEngine:
                 (self._k + 1) if self._lookup else self._t
             ),
         }
+        # ---- KV-cache economics (the paged-vs-dense ledger) ----
+        # bytes-per-request compares what one admitted request COSTS the
+        # cache: its block reservation (paged) vs a whole max_len row
+        # (dense); bytes-per-committed-token integrates actual residency
+        # over the run's dispatches. Dense numbers use the same formulas
+        # so an A/B of the two layouts reads off directly.
+        block_bytes = pos_bytes * (self._block_size or 0)
+        dense_row_bytes = pos_bytes * max_len
+        metrics["kv_layout"] = "paged" if self._paged else "dense"
+        metrics["kv_dense_bytes_per_request"] = dense_row_bytes
+        if self._paged:
+            metrics["kv_block_size"] = self._block_size
+            metrics["kv_num_blocks"] = self._num_blocks
+            metrics["kv_pool_bytes"] = (self._num_blocks + 1) * block_bytes
+            metrics["kv_peak_allocated_blocks"] = alloc.peak_allocated
+            metrics["kv_peak_allocated_bytes"] = (
+                alloc.peak_allocated * block_bytes
+            )
+            metrics["kv_bytes_per_request"] = (
+                round(reserved_blocks_total * block_bytes / len(requests), 1)
+                if requests else 0.0
+            )
+            metrics["kv_bytes_per_committed_token"] = (
+                round(alloc_block_steps * block_bytes / committed, 1)
+                if committed else 0.0
+            )
+        else:
+            metrics["kv_pool_bytes"] = b * dense_row_bytes
+            metrics["kv_bytes_per_request"] = dense_row_bytes
+            metrics["kv_bytes_per_committed_token"] = (
+                round(chunks * b * dense_row_bytes / committed, 1)
+                if committed else 0.0
+            )
+        metrics["kv_reduction_vs_dense"] = (
+            round(dense_row_bytes / metrics["kv_bytes_per_request"], 3)
+            if metrics["kv_bytes_per_request"] else 1.0
+        )
         if self._lookup:
             metrics["speculative_kind"] = "prompt_lookup"
             metrics["prompt_lookup_ngram"] = self._lookup

@@ -11,8 +11,15 @@ reference) and fully garbage-collects every deleted one.
 
 import time
 
-from hypothesis import HealthCheck, given, settings
-from hypothesis import strategies as st
+import pytest
+
+# every test here is hypothesis-driven — on a checkout without it the
+# module must SKIP, not fail collection (the tier-1 lane collects slow
+# modules even though it deselects their tests)
+pytest.importorskip("hypothesis")
+
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
 
 from nexus_tpu.api.template import NexusAlgorithmTemplate
 from nexus_tpu.api.types import Secret

@@ -14,8 +14,15 @@ from types import SimpleNamespace
 import jax
 import jax.numpy as jnp
 import numpy as np
-from hypothesis import HealthCheck, given, settings
-from hypothesis import strategies as st
+
+try:  # optional: the property tier needs hypothesis, the rest doesn't —
+    # a checkout without it must still COLLECT this module cleanly
+    from hypothesis import HealthCheck, given, settings
+    from hypothesis import strategies as st
+
+    HAVE_HYPOTHESIS = True
+except ImportError:  # pragma: no cover
+    HAVE_HYPOTHESIS = False
 
 from nexus_tpu.models import llama
 from nexus_tpu.runtime.serving import ServeRequest, ServingEngine
@@ -261,6 +268,110 @@ def test_serving_int8_kv_cache_matches_isolated_decode():
         )
 
 
+def test_paged_layout_greedy_parity_across_block_sizes():
+    """Paged-vs-dense on the REAL model: the same uneven queue through
+    dense rows and paged pools at several block sizes (including one
+    forcing many blocks per row and a tight pool that throttles
+    admission) equals the isolated greedy decode row-for-row — the block
+    table is pure bookkeeping, never semantics."""
+    cfg = tiny_cfg()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(21)
+    reqs = [
+        ServeRequest(prompt=rng.randint(0, cfg.vocab_size, size=p).tolist(),
+                     max_new_tokens=n)
+        for p, n in ((5, 9), (11, 4), (3, 13), (8, 7))
+    ]
+    refs = [
+        llama.generate(
+            params, cfg, jnp.asarray(r.prompt, jnp.int32)[None, :],
+            max_new_tokens=r.max_new_tokens,
+        )
+        for r in reqs
+    ]
+    for kw in (
+        {"kv_block_size": 0},                       # dense baseline
+        {"kv_block_size": 8},                       # many blocks/row
+        {"kv_block_size": 8, "kv_num_blocks": 5},   # admission-throttled
+        {"kv_block_size": 64},                      # one block per row
+    ):
+        engine = ServingEngine(
+            llama.forward_decode, params, cfg, batch_size=2, max_len=64,
+            chunk=4, **kw,
+        )
+        results, metrics = engine.serve(reqs)
+        for ref, res in zip(refs, results):
+            np.testing.assert_array_equal(
+                np.array(res.tokens), np.array(ref[0]), err_msg=str(kw)
+            )
+        assert metrics["kv_layout"] == (
+            "dense" if not kw["kv_block_size"] else "paged"
+        )
+
+
+def test_paged_int8_kv_blocks_match_isolated_decode():
+    """int8 K/V on paged blocks: write-time quantization is per (row,
+    position, head) vector, so scattering those vectors through a block
+    table (small blocks, block-boundary crossings mid-prompt and
+    mid-decode) changes nothing — outputs equal the isolated int8 static
+    decode token for token."""
+    cfg = tiny_cfg(kv_cache_quantized=True)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(13)
+    reqs = [
+        ServeRequest(prompt=rng.randint(0, cfg.vocab_size, size=p).tolist(),
+                     max_new_tokens=n)
+        for p, n in ((5, 8), (11, 4), (3, 10))
+    ]
+    engine = ServingEngine(
+        llama.forward_decode, params, cfg, batch_size=2, max_len=64,
+        chunk=4, prefill_chunk=3, kv_block_size=8,
+    )
+    results, metrics = engine.serve(reqs)
+    assert metrics["kv_layout"] == "paged"
+    for req, res in zip(reqs, results):
+        prompt = jnp.asarray(req.prompt, jnp.int32)[None, :]
+        ref = llama.generate(params, cfg, prompt,
+                             max_new_tokens=res.new_tokens)
+        np.testing.assert_array_equal(
+            np.array(res.tokens), np.array(ref[0]),
+            err_msg=f"prompt len {len(req.prompt)}",
+        )
+
+
+def test_paged_sampled_requests_are_layout_and_batch_invariant():
+    """temperature > 0 on the paged layout: the sampling key is (request
+    seed, buffer position) — block size, pool size, and batch size are
+    scheduling, so the SAME request yields the SAME stream through a
+    1-row dense engine, a 3-row small-block engine, and a throttled
+    pool."""
+    cfg = tiny_cfg()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(17)
+    reqs = [
+        ServeRequest(
+            prompt=rng.randint(0, cfg.vocab_size, size=p).tolist(),
+            max_new_tokens=n, temperature=t, seed=s,
+        )
+        for p, n, t, s in (
+            (5, 8, 0.8, 1), (7, 6, 0.0, 0), (4, 10, 1.3, 2), (6, 7, 0.8, 3),
+        )
+    ]
+    outs = []
+    for b, kw in (
+        (1, {"kv_block_size": 0}),
+        (3, {"kv_block_size": 8}),
+        (2, {"kv_block_size": 8, "kv_num_blocks": 6}),
+    ):
+        engine = ServingEngine(
+            llama.forward_decode, params, cfg, batch_size=b, max_len=64,
+            chunk=4, **kw,
+        )
+        results, _ = engine.serve(reqs)
+        outs.append([r.tokens for r in results])
+    assert outs[0] == outs[1] == outs[2]
+
+
 def test_serving_sampled_requests_are_batch_invariant():
     """temperature > 0: the sampling key is (request seed, buffer
     position) — never the row, the co-residents, or the engine batch
@@ -459,26 +570,8 @@ def test_speculative_serving_rejects_sampled_requests():
         assert "greedy-exact" in str(e)
 
 
-_req = st.tuples(
-    st.lists(st.integers(0, 12), min_size=1, max_size=9),  # prompt
-    st.integers(1, 14),                                    # max_new
-)
-
-
-@settings(
-    max_examples=20, deadline=None,
-    suppress_health_check=[HealthCheck.too_slow],
-)
-@given(
-    reqs=st.lists(_req, min_size=1, max_size=7),
-    batch=st.integers(1, 3),
-    chunk=st.integers(1, 6),
-    stop=st.integers(-1, 12),
-    lookup=st.sampled_from([0, 2]),
-    prefill=st.sampled_from([1, 4, 16]),
-)
-def test_serving_property_exactness(reqs, batch, chunk, stop, lookup,
-                                    prefill):
+def _serving_property_exactness(reqs, batch, chunk, stop, lookup,
+                                prefill):
     """PROPERTY: for ANY queue, batch size, chunk size, stop token,
     plain-vs-speculative mode, and prefill chunk width, each request's
     output equals the cyclic stub model's isolated greedy decode trimmed
@@ -512,6 +605,40 @@ def test_serving_property_exactness(reqs, batch, chunk, stop, lookup,
     assert metrics["committed_tokens"] == sum(
         r.new_tokens for r in results
     )
+
+
+if HAVE_HYPOTHESIS:
+    _req = st.tuples(
+        st.lists(st.integers(0, 12), min_size=1, max_size=9),  # prompt
+        st.integers(1, 14),                                    # max_new
+    )
+
+    @settings(
+        max_examples=20, deadline=None,
+        suppress_health_check=[HealthCheck.too_slow],
+    )
+    @given(
+        reqs=st.lists(_req, min_size=1, max_size=7),
+        batch=st.integers(1, 3),
+        chunk=st.integers(1, 6),
+        stop=st.integers(-1, 12),
+        lookup=st.sampled_from([0, 2]),
+        prefill=st.sampled_from([1, 4, 16]),
+    )
+    def test_serving_property_exactness(reqs, batch, chunk, stop, lookup,
+                                        prefill):
+        _serving_property_exactness(reqs, batch, chunk, stop, lookup,
+                                    prefill)
+else:
+    def test_serving_property_exactness():
+        # hypothesis missing: run one representative hand-picked case per
+        # mode instead of silently skipping the exactness property
+        _serving_property_exactness(
+            [([3, 1, 4], 9), ([2], 14), ([5, 6], 1)], 2, 3, 4, 0, 4
+        )
+        _serving_property_exactness(
+            [([3, 1, 4], 9), ([2], 14)], 2, 3, -1, 2, 1
+        )
 
 
 def test_admission_is_one_insert_wave_no_forwards():

@@ -97,7 +97,12 @@ def main() -> int:
         c = init_kv_cache(
             cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype, 1, ctx,
         )
-        c["length"] = jnp.full((1,), ctx // 2, jnp.int32)
+        # SCALAR cache length (ADVICE r5): the batch-1 decode leg this
+        # probe attributes runs the scalar-length cache, whose write is a
+        # contiguous dynamic_update_slice — a (1,)-vector length would
+        # compile the per-row scatter program instead and attribute the
+        # wrong step cost
+        c["length"] = jnp.asarray(ctx // 2, jnp.int32)
         return c
 
     tok = jnp.zeros((1, 1), jnp.int32)

@@ -53,19 +53,39 @@ def main() -> int:
 
     for rows in rows_list:
         for width in (1, 16):
+            # NEXUS_PROBE_KV_BLOCK: block size of the paged cache the
+            # engine now serves by default (0 probes the legacy dense
+            # layout) — the probe must time the LAYOUT the engine runs
+            kvb = int(os.environ.get("NEXUS_PROBE_KV_BLOCK") or 32)
             eng = ServingEngine(
                 llama.forward_decode, params, cfg, batch_size=rows,
                 max_len=max_len, chunk=chunk, prefill_chunk=width,
+                kv_block_size=kvb,
             )
             fn = (eng._decode_chunk if width > 1
                   else eng._decode_chunk_narrow)
-            from nexus_tpu.models.decoding import init_kv_cache
+            from nexus_tpu.models.decoding import (
+                init_kv_cache,
+                init_paged_kv_cache,
+            )
 
             def fresh():
-                c = init_kv_cache(
-                    cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
-                    rows, max_len,
-                )
+                if kvb > 0:
+                    m = -(-max_len // kvb)
+                    nb = rows * m  # capacity-equivalent pool (+1 scratch)
+                    c = init_paged_kv_cache(
+                        cfg.n_layers, cfg.n_kv_heads, cfg.head_dim,
+                        cfg.dtype, rows, nb + 1, kvb, m,
+                    )
+                    # fully-mapped tables: the steady-state gather cost
+                    c["block_table"] = jnp.arange(
+                        rows * m, dtype=jnp.int32
+                    ).reshape(rows, m)
+                else:
+                    c = init_kv_cache(
+                        cfg.n_layers, cfg.n_kv_heads, cfg.head_dim,
+                        cfg.dtype, rows, max_len,
+                    )
                 c["length"] = jnp.full((rows,), 128, jnp.int32)
                 return c
 
