@@ -3,7 +3,7 @@ SHELL := /bin/bash
 NATIVE_SRC := nexus_tpu/native/src/nexus_core.cpp nexus_tpu/native/src/nexus_data.cpp
 NATIVE_LIB := nexus_tpu/native/libnexus_core.so
 
-.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve race-smoke clean lint
+.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-failover chaos-smoke race-smoke clean lint
 
 all: native
 
@@ -41,6 +41,18 @@ bench-cp:
 # no TPU tunnel touched (deep-verifiable serving workstream, VERDICT r5).
 bench-serve:
 	NEXUS_BENCH_SERVE=only NEXUS_BENCH_INIT_PROBE=0 JAX_PLATFORMS=cpu python bench.py
+
+# Failover stage only: time-to-recover p50 through kill-worker → detector
+# confirmation → re-place → checkpoint resume, against in-process shards —
+# CPU-only, no TPU tunnel touched (docs/failover.md).
+bench-failover:
+	NEXUS_BENCH_FAILOVER=only NEXUS_BENCH_INIT_PROBE=0 JAX_PLATFORMS=cpu python bench.py
+
+# Chaos smoke (fast lane): the failover test module alone — detector flap
+# suppression, API-outage vs lease-expiry disambiguation, chaos hooks, and
+# the end-to-end kill → resume-on-second-shard path.
+chaos-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_failover.py -q
 
 # Thread-safety smoke for the store/informer/lister under parallel fan-out.
 race-smoke:

@@ -991,6 +991,47 @@ def _control_plane_bench(progress):
     return out
 
 
+def _failover_bench(progress):
+    """Hermetic failover stage (`make bench-failover`,
+    NEXUS_BENCH_FAILOVER=only): time-to-recover p50 through the real
+    detector + planner + placement against in-process shards with
+    simulated workers — kill → confirm → re-place → resume, CPU-only,
+    no TPU tunnel touched. Returns bench keys, {} on failure."""
+    import subprocess
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    tool = os.path.join(root, "tools", "bench_failover.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    trials = int(os.environ.get("NEXUS_BENCH_FAILOVER_TRIALS") or 5)
+    try:
+        proc = subprocess.run(
+            [sys.executable, tool, "--trials", str(trials),
+             "--timeout", "30"],
+            capture_output=True, text=True, timeout=180, env=env,
+        )
+        rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 — hermetic leg must not kill bench
+        progress(f"failover bench failed: {type(e).__name__}: {str(e)[:160]}")
+        return {}
+    if "value" not in rec:
+        progress(f"failover bench: {rec.get('error')}")
+        return {}
+    progress(
+        f"failover bench: time-to-recover p50={rec['value']}s "
+        f"(detection p50={rec.get('detection_p50_s')}s, "
+        f"steps lost mean={rec.get('failover_steps_lost_mean')}, "
+        f"n={rec['n_trials']})"
+    )
+    _sweep_record("failover", "kill-worker", rec)
+    return {
+        "failover_time_to_recover_p50_s": rec["value"],
+        "failover_time_to_recover_p90_s": rec.get("p90_s"),
+        "failover_detection_p50_s": rec.get("detection_p50_s"),
+        "failover_steps_lost_mean": rec.get("failover_steps_lost_mean"),
+        "failover_trials": rec.get("n_trials"),
+    }
+
+
 def main() -> int:
     import jax
 
@@ -1114,6 +1155,18 @@ def main() -> int:
             timer.cancel()
         _emit({"metric": "control_plane_only", **cp})
         return 0 if cp else 1
+
+    # failover-only mode (`make bench-failover`): time-to-recover through
+    # the chaos-kill → detector → planner → resume pipeline — CPU-only,
+    # checkable on any box in ~half a minute
+    if os.environ.get("NEXUS_BENCH_FAILOVER", "") == "only":
+        fo = _failover_bench(progress)
+        with _print_lock:
+            _done[0] = True
+        if timer is not None:
+            timer.cancel()
+        _emit({"metric": "failover_only", **fo})
+        return 0 if fo else 1
 
     # serve-only mode (`make bench-serve`): the paged-KV ledger + the
     # rows=4 vs rows=16 scaling point on whatever backend JAX_PLATFORMS

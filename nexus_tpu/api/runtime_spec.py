@@ -601,6 +601,10 @@ class CheckpointSpec:
     interval_steps: int = 100
     keep: int = 3
     resume: bool = True
+    # "orbax" (sharding-aware, async — the default) or "npz" (dependency-
+    # free with a params-only fast restore; the CPU-lane / failover-bench
+    # format). See train/checkpoint.py::make_checkpointer.
+    format: str = "orbax"
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -609,6 +613,7 @@ class CheckpointSpec:
             "intervalSteps": self.interval_steps,
             "keep": self.keep,
             "resume": self.resume,
+            "format": self.format,
         }
 
     @classmethod
@@ -619,6 +624,7 @@ class CheckpointSpec:
             interval_steps=int(d.get("intervalSteps", 100) or 100),
             keep=int(d.get("keep", 3) or 3),
             resume=bool(d.get("resume", True)),
+            format=d.get("format", "orbax") or "orbax",
         )
 
 
@@ -916,6 +922,11 @@ class JaxXlaRuntime:
                 errs.append(
                     f"profile.numSteps must be >= 1, got {self.profile.num_steps}"
                 )
+        if self.checkpoint.format not in ("orbax", "npz"):
+            errs.append(
+                f"unknown checkpoint.format {self.checkpoint.format!r} "
+                "(orbax | npz)"
+            )
         if self.data.kind not in ("synthetic", "tokens"):
             errs.append(f"unknown data.kind {self.data.kind!r}")
         elif self.data.kind == "tokens":

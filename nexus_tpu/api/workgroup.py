@@ -51,6 +51,14 @@ class NexusAlgorithmWorkgroupSpec:
     affinity: Optional[Dict[str, Any]] = None
     # TPU-native extension: which slice shapes this workgroup can host.
     tpu_slice_pools: List[Dict[str, Any]] = field(default_factory=list)
+    # Placement mode across the matching shards:
+    #   "all" — reference parity: the template (and its workload) fans out
+    #           to EVERY matching shard;
+    #   "any" — single-home: exactly one matching shard runs the workload,
+    #           chosen by rendezvous hashing (minimal movement under shard
+    #           churn) with controller-side stickiness, and failover
+    #           (nexus_tpu/ha/) migrates it when that shard fails.
+    scheduling: str = "all"
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -60,6 +68,7 @@ class NexusAlgorithmWorkgroupSpec:
             "tolerations": [t.to_dict() for t in self.tolerations],
             "affinity": self.affinity,
             "tpuSlicePools": list(self.tpu_slice_pools),
+            "scheduling": self.scheduling,
         }
 
     @classmethod
@@ -71,6 +80,7 @@ class NexusAlgorithmWorkgroupSpec:
             tolerations=[Toleration.from_dict(t) for t in (d.get("tolerations") or [])],
             affinity=d.get("affinity"),
             tpu_slice_pools=list(d.get("tpuSlicePools") or []),
+            scheduling=d.get("scheduling", "all") or "all",
         )
 
 

@@ -68,6 +68,7 @@ from nexus_tpu.controller.events import (
     MSG_RESOURCE_MISSING,
     MSG_RESOURCE_OPERATION_FAILED,
     MSG_RESOURCE_SYNCED,
+    REASON_ERR_PLACEMENT,
     REASON_ERR_RESOURCE_EXISTS,
     REASON_ERR_RESOURCE_MISSING,
     REASON_ERR_RESOURCE_SYNC,
@@ -80,6 +81,7 @@ from nexus_tpu.controller.sharding import (
     WriteSkipCache,
     stable_hash,
 )
+from nexus_tpu.ha.failover import FailoverConfig, FailoverManager
 from nexus_tpu.shards.shard import Shard
 from nexus_tpu.utils.telemetry import (
     METRIC_COALESCED_TOTAL,
@@ -134,6 +136,7 @@ class Controller:
         queue_backend: str = "auto",
         shard_sync_workers: int = 0,
         write_skip_cache: bool = True,
+        failover: Optional[FailoverConfig] = None,
     ):
         self.store = controller_store
         self.shards = list(shards)
@@ -194,6 +197,19 @@ class Controller:
         self._t2r_lock = threading.Lock()
         self._t2r_emitted: set = set()
         self._t2r_samples: List[float] = []
+        # Shard health + single-home placement state (nexus_tpu/ha/):
+        # every shard starts healthy; the FailoverManager (when configured)
+        # flips health on confirmed API outages. _home is the sticky
+        # assignment for workgroup scheduling="any" templates; _home_avoid
+        # pins the shard a workload last died on so failover placement
+        # cannot hand it straight back.
+        self._health_lock = threading.Lock()
+        self.shard_health: dict = {s.name: True for s in self.shards}
+        self._home: dict = {}
+        self._home_avoid: dict = {}
+        self.failover_manager: Optional[FailoverManager] = (
+            FailoverManager(self, failover) if failover is not None else None
+        )
 
     # ------------------------------------------------------------ registration
     def _register_handlers(self) -> None:
@@ -338,6 +354,7 @@ class Controller:
         # every shard is attempted even if one fails (fn swallows errors)
         self._fan_out(self.shards, delete_from_shard, fail_fast=False)
         self.write_skip_cache.invalidate_owner(obj.metadata.uid)
+        self._drop_home(obj.metadata.namespace, obj.metadata.name)
 
     # --------------------------------------------------------------- work loop
     def run(
@@ -401,6 +418,38 @@ class Controller:
             )
             t.start()
             self._workers.append(t)
+        if self.failover_manager is not None:
+            # after caches + workers: failover enqueues templates and reads
+            # listers, both of which need the controller fully up
+            self.failover_manager.start()
+
+    # ---------------------------------------------------------- shard health
+    def set_shard_health(self, shard_name: str, healthy: bool) -> None:
+        with self._health_lock:
+            self.shard_health[shard_name] = healthy
+
+    def healthy_shards(self) -> List[Shard]:
+        with self._health_lock:
+            return [s for s in self.shards if self.shard_health.get(s.name, True)]
+
+    def home_of(self, namespace: str, name: str) -> Optional[str]:
+        """Sticky single-home assignment (workgroup scheduling="any")."""
+        with self._health_lock:
+            return self._home.get((namespace, name))
+
+    def evict_home(self, namespace: str, name: str, shard_name: str) -> None:
+        """Failover hook: forget the sticky assignment and avoid the shard
+        the workload just died on when the next placement runs."""
+        with self._health_lock:
+            key = (namespace, name)
+            if self._home.get(key) == shard_name:
+                del self._home[key]
+            self._home_avoid[key] = shard_name
+
+    def _drop_home(self, namespace: str, name: str) -> None:
+        with self._health_lock:
+            self._home.pop((namespace, name), None)
+            self._home_avoid.pop((namespace, name), None)
 
     @staticmethod
     def _warm_admission_imports() -> None:
@@ -412,6 +461,8 @@ class Controller:
             logger.debug("admission import warmup failed", exc_info=True)
 
     def stop(self) -> None:
+        if self.failover_manager is not None:
+            self.failover_manager.stop()
         self._stop.set()
         self.work_queue.shut_down()
         for t in self._workers:
@@ -518,6 +569,7 @@ class Controller:
         # the finalizer retry then only has the failed shard(s) left to clean
         self._fan_out(self.shards, delete_from_shard, fail_fast=False)
         self.write_skip_cache.invalidate_owner(template.metadata.uid)
+        self._drop_home(template.metadata.namespace, template.metadata.name)
         updated = template.deepcopy()
         updated.metadata.finalizers = [
             f for f in updated.metadata.finalizers if f != FINALIZER
@@ -833,10 +885,22 @@ class Controller:
 
         Reference parity: no resolvable workgroup → every shard
         (controller.go:790). TPU extension (BASELINE config #5): a resolved
-        workgroup's cluster/capabilities select the matching slice pools;
-        unsatisfiable constraints are a warning event + SyncError (requeue).
+        workgroup's cluster/capabilities select the matching slice pools.
+        Failover extension (nexus_tpu/ha/): only shards the failure
+        detector currently considers healthy are candidates, and workgroup
+        ``scheduling: any`` single-homes the template (sticky rendezvous
+        pick, migrated on confirmed failure).
+
+        Unsatisfiable constraints surface as a Ready=False status condition
+        + warning Event (REASON_ERR_PLACEMENT), then a SyncError → requeue —
+        operators can see exactly why a constrained template never lands
+        instead of a silent infinite requeue loop.
         """
-        from nexus_tpu.controller.placement import PlacementError, select_shards
+        from nexus_tpu.controller.placement import (
+            PlacementError,
+            select_home,
+            select_shards,
+        )
 
         ref = template.spec.workgroup_ref
         workgroup = None
@@ -848,15 +912,72 @@ class Controller:
             except NotFoundError:
                 workgroup = None
         try:
-            return select_shards(template, workgroup, self.shards)
+            candidates = self.healthy_shards()
+            if self.shards and not candidates:
+                raise PlacementError(
+                    "no healthy shard connected (failure detector marked "
+                    f"all {len(self.shards)} shard(s) unhealthy)"
+                )
+            sched = (
+                (workgroup.spec.scheduling or "all").lower()
+                if workgroup is not None else "all"
+            )
+            if sched not in ("all", "any"):
+                # loud, not silent: an unvalidated typo falling back to
+                # fan-out would run N concurrent copies of a workload the
+                # user intended to single-home, racing on its checkpoints
+                raise PlacementError(
+                    f"workgroup {workgroup.name!r} has unknown scheduling "
+                    f"{workgroup.spec.scheduling!r} (all | any)"
+                )
+            if workgroup is not None and sched == "any":
+                key = (template.namespace, template.name)
+                with self._health_lock:
+                    current = self._home.get(key)
+                    avoid = self._home_avoid.get(key)
+                home = select_home(
+                    template, workgroup, candidates,
+                    current=current, avoid=avoid,
+                )
+                with self._health_lock:
+                    self._home[key] = home.name
+                return [home]
+            return select_shards(template, workgroup, candidates)
         except PlacementError as e:
+            self._report_template_placement_error(template, str(e))
             self.recorder.event(
                 template,
                 EVENT_TYPE_WARNING,
-                REASON_ERR_RESOURCE_SYNC,
+                REASON_ERR_PLACEMENT,
                 str(e),
             )
             raise SyncError(str(e)) from e
+
+    def _report_template_placement_error(
+        self, template: NexusAlgorithmTemplate, msg: str
+    ) -> None:
+        """Surface an unsatisfiable placement as a Ready=False condition so
+        the template's status answers "why is this not running" directly.
+        DeepEqual-guarded: the condition is written once per distinct
+        message, not on every requeue of the backoff loop. Best-effort — a
+        status write failure must not mask the PlacementError itself."""
+        if not template.status.conditions:
+            return  # init condition not reported yet; next reconcile will
+        updated = template.deepcopy()
+        prev_ltt = updated.status.conditions[0].last_transition_time
+        updated.status.conditions[0] = new_resource_ready_condition(
+            prev_ltt, False, f"Placement failed: {msg}"
+        )
+        if deep_equal(template.status, updated.status):
+            return
+        updated.status.conditions[0].last_transition_time = utcnow()
+        try:
+            stored = self.store.update_status(
+                updated, field_manager=FIELD_MANAGER
+            )
+            self.template_lister._set_if_newer(stored)
+        except Exception:  # noqa: BLE001 — the SyncError carries the cause
+            logger.debug("placement-error status write failed", exc_info=True)
 
     def template_sync_handler(self, namespace: str, name: str) -> None:
         """Core reconcile (reference: controller.go:761-845)."""
@@ -873,6 +994,7 @@ class Controller:
                 self.write_skip_cache.invalidate_object(
                     shard.name, NexusAlgorithmTemplate.KIND, namespace, name
                 )
+            self._drop_home(namespace, name)
             return
 
         if self.use_finalizers:
@@ -1224,9 +1346,19 @@ class Controller:
         placement no longer selects (e.g. the template fanned out everywhere
         before its workgroup synced, then the workgroup narrowed placement).
         Only copies stamped with our provenance label are touched — foreign
-        templates sharing the name are left alone."""
+        templates sharing the name are left alone. Shards the failure
+        detector currently marks unhealthy are skipped: their API is (or
+        may be) unreachable, and failing the whole reconcile over a cleanup
+        write to a dead cluster would starve the healthy placement — the
+        shard-recovered path re-enqueues every template, and this removal
+        then converges."""
         placed_names = {s.name for s in placed_shards}
-        unselected = [s for s in self.shards if s.name not in placed_names]
+        with self._health_lock:
+            health = dict(self.shard_health)
+        unselected = [
+            s for s in self.shards
+            if s.name not in placed_names and health.get(s.name, True)
+        ]
 
         def remove_stale(shard: Shard) -> None:
             try:

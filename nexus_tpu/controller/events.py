@@ -23,6 +23,9 @@ REASON_SYNCED = "Synced"
 REASON_ERR_RESOURCE_EXISTS = "ErrResourceExists"
 REASON_ERR_RESOURCE_MISSING = "ErrResourceMissing"
 REASON_ERR_RESOURCE_SYNC = "ErrResourceSyncError"
+# Placement could not match any (healthy) shard — surfaced as a template
+# status condition + Event instead of a silent requeue loop.
+REASON_ERR_PLACEMENT = "ErrPlacement"
 
 # Message formats (reference: controller.go:72-84)
 MSG_RESOURCE_EXISTS = (

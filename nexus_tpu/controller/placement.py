@@ -22,6 +22,7 @@ Matching rules, applied in order:
 
 from __future__ import annotations
 
+import hashlib
 from typing import List, Optional, Sequence
 
 from nexus_tpu.api.template import NexusAlgorithmTemplate
@@ -73,3 +74,52 @@ def select_shards(
                 "all of them"
             )
     return selected
+
+
+def rendezvous_pick(key: str, shards: Sequence[Shard]) -> Shard:
+    """Highest-random-weight (rendezvous) choice of one shard for ``key``.
+
+    The churn-minimal single-home placement rule: every (template, shard)
+    pair gets a stable pseudo-random weight, and the template lands on its
+    max-weight shard. Removing a shard (failure) only moves the templates
+    that were homed on it; every other assignment is unchanged — the
+    placement-under-churn property the failover planner relies on so one
+    shard outage doesn't reshuffle the whole fleet.
+    """
+    if not shards:
+        raise PlacementError("rendezvous placement over zero shards")
+
+    def weight(shard: Shard) -> bytes:
+        return hashlib.blake2b(
+            f"{key}\x00{shard.name}".encode(), digest_size=8
+        ).digest()
+
+    return max(shards, key=weight)
+
+
+def select_home(
+    template: NexusAlgorithmTemplate,
+    workgroup: Optional[NexusAlgorithmWorkgroup],
+    shards: Sequence[Shard],
+    current: Optional[str] = None,
+    avoid: Optional[str] = None,
+) -> Shard:
+    """Single-home placement (workgroup ``scheduling: any``).
+
+    Constraint-filter via :func:`select_shards`, then:
+      1. stickiness — keep ``current`` while it is still eligible (a healthy
+         running workload is never migrated by a placement recomputation);
+      2. ``avoid`` — the shard the workload just failed on is skipped when
+         any alternative exists (failover must not hand the job back);
+      3. rendezvous hash over the survivors.
+    """
+    eligible = select_shards(template, workgroup, shards)
+    # avoid beats stickiness: if the current assignment IS the shard the
+    # workload just died on (a reconcile raced the eviction and wrote it
+    # back), honoring it would hand the job straight back to the corpse
+    if current is not None and current != avoid:
+        for s in eligible:
+            if s.name == current:
+                return s
+    pool = [s for s in eligible if s.name != avoid] or eligible
+    return rendezvous_pick(template.metadata.uid or template.key(), pool)

@@ -250,6 +250,18 @@ class WriteSkipCache:
                 del self._entries[k]
             self.invalidations += len(stale)
 
+    def invalidate_shard(self, shard: str) -> None:
+        """Drop EVERY entry for one shard — the unhealthy→healthy transition
+        hook: a shard that reconnects after an outage may have been
+        restored/rebuilt and lost writes this cache still believes are
+        converged, so every skip decision for it is suspect until re-verified
+        by a full compare (the next reconcile repopulates the entries)."""
+        with self._lock:
+            stale = [k for k in self._entries if k[0] == shard]
+            for k in stale:
+                del self._entries[k]
+            self.invalidations += len(stale)
+
     def invalidate_owner(self, owner_uid: str,
                          shard: Optional[str] = None) -> None:
         """Drop every entry verified on behalf of one template (template

@@ -1,0 +1,57 @@
+"""Shard health & job failover: heartbeat leases, failure detection, and
+checkpoint-resume migration across shard clusters (TPU slice pools).
+
+Layer map:
+  lease.py    — worker-side heartbeat protocol (ConfigMap-backed)
+  detector.py — per-shard deadline failure detector (flap-suppressed,
+                API-unreachable vs worker-lease-expired)
+  failover.py — planner: confirmed failure → re-place excluding unhealthy
+                shards → resume from the latest durable checkpoint
+
+See docs/failover.md for the protocol, tuning knobs, and runbook.
+"""
+
+from nexus_tpu.ha.detector import (
+    API_UNREACHABLE,
+    EVENT_LEASE_EXPIRED,
+    EVENT_LEASE_RECOVERED,
+    EVENT_SHARD_RECOVERED,
+    EVENT_SHARD_UNHEALTHY,
+    EXPIRED,
+    FRESH,
+    HEALTHY,
+    SUSPECT,
+    DetectorEvent,
+    FailureDetector,
+)
+from nexus_tpu.ha.failover import FailoverConfig, FailoverManager
+from nexus_tpu.ha.lease import (
+    LABEL_HEARTBEAT,
+    HeartbeatLease,
+    LeaseRenewer,
+    freeze_heartbeat,
+    heartbeat_name,
+    list_heartbeats,
+)
+
+__all__ = [
+    "API_UNREACHABLE",
+    "EVENT_LEASE_EXPIRED",
+    "EVENT_LEASE_RECOVERED",
+    "EVENT_SHARD_RECOVERED",
+    "EVENT_SHARD_UNHEALTHY",
+    "EXPIRED",
+    "FRESH",
+    "HEALTHY",
+    "SUSPECT",
+    "DetectorEvent",
+    "FailureDetector",
+    "FailoverConfig",
+    "FailoverManager",
+    "LABEL_HEARTBEAT",
+    "HeartbeatLease",
+    "LeaseRenewer",
+    "freeze_heartbeat",
+    "heartbeat_name",
+    "list_heartbeats",
+]

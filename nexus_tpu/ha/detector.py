@@ -1,0 +1,246 @@
+"""Per-shard failure detector: deadline-based with flap suppression.
+
+Two distinct failure modes, deliberately kept apart because they demand
+different planner responses:
+
+  * **API unreachable** — the probe itself (a heartbeat LIST against the
+    shard's API server) raises. The shard may be partitioned while its
+    workers keep training happily, so confirmation requires
+    ``api_failure_threshold`` consecutive probe errors, probing backs off
+    exponentially while the outage lasts (no retry storm into a dead
+    tunnel), and the planner both excludes the shard from placement and
+    abandons (rather than deletes) its Jobs.
+  * **Worker lease expired** — the API answers but a worker's heartbeat
+    stopped moving. The shard itself stays healthy; only that workload is
+    failed over, and its dead Job CAN be deleted (the API is up).
+
+Flap suppression in both directions: a single missed renewal (one TTL
+window) only makes a lease SUSPECT — confirmation needs
+``suspect_misses`` full windows; an unreachable shard needs
+``recovery_probes`` consecutive clean probes before it is trusted again
+(so a flapping tunnel cannot thrash placement).
+
+The detector is a pure state machine over injected observations with an
+injectable clock — every path unit-tests in milliseconds without threads
+or sleeps. The FailoverManager (ha/failover.py) owns the probe loop.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from nexus_tpu.ha.lease import HeartbeatLease
+
+# shard states
+HEALTHY = "Healthy"
+API_UNREACHABLE = "ApiUnreachable"
+# lease states
+FRESH = "Fresh"
+SUSPECT = "Suspect"
+EXPIRED = "Expired"
+
+# event kinds
+EVENT_SHARD_UNHEALTHY = "shard_unhealthy"
+EVENT_SHARD_RECOVERED = "shard_recovered"
+EVENT_LEASE_EXPIRED = "lease_expired"
+EVENT_LEASE_RECOVERED = "lease_recovered"
+
+
+@dataclass
+class DetectorEvent:
+    kind: str
+    shard: str
+    lease: Optional[HeartbeatLease] = None
+    # seconds from the first missed deadline (or first probe error) to
+    # confirmation — the detection half of time-to-recover
+    detection_seconds: float = 0.0
+
+
+@dataclass
+class _LeaseTrack:
+    renew_value: str = ""
+    observed_at: float = 0.0  # local monotonic clock, last CHANGE observed
+    state: str = FRESH
+    last: Optional[HeartbeatLease] = None
+
+
+@dataclass
+class _ShardTrack:
+    state: str = HEALTHY
+    consecutive_errors: int = 0
+    consecutive_ok: int = 0
+    first_error_at: float = 0.0
+    backoff: float = 0.0
+    next_probe_at: float = 0.0
+    leases: Dict[str, _LeaseTrack] = field(default_factory=dict)
+
+
+class FailureDetector:
+    """Deadline failure detector over heartbeat observations.
+
+    Drive it with one of::
+
+        events = detector.observe(shard_name, heartbeats)
+        events = detector.observe_api_error(shard_name, err)
+
+    per probe; consult :meth:`next_probe_delay` for the (backoff-aware)
+    wait before the next probe of that shard.
+    """
+
+    def __init__(
+        self,
+        ttl_seconds: float = 15.0,
+        suspect_misses: int = 2,
+        api_failure_threshold: int = 3,
+        probe_interval: float = 5.0,
+        backoff_max: float = 60.0,
+        recovery_probes: int = 2,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if suspect_misses < 1:
+            raise ValueError("suspect_misses must be >= 1")
+        if api_failure_threshold < 1:
+            raise ValueError("api_failure_threshold must be >= 1")
+        self.ttl_seconds = float(ttl_seconds)
+        self.suspect_misses = int(suspect_misses)
+        self.api_failure_threshold = int(api_failure_threshold)
+        self.probe_interval = float(probe_interval)
+        self.backoff_max = float(backoff_max)
+        self.recovery_probes = int(recovery_probes)
+        self.clock = clock
+        self._shards: Dict[str, _ShardTrack] = {}
+
+    # ------------------------------------------------------------------- state
+    def _track(self, shard: str) -> _ShardTrack:
+        return self._shards.setdefault(shard, _ShardTrack())
+
+    def shard_state(self, shard: str) -> str:
+        return self._track(shard).state
+
+    def lease_state(self, shard: str, namespace: str, template: str) -> str:
+        lt = self._track(shard).leases.get(f"{namespace}/{template}")
+        return lt.state if lt is not None else FRESH
+
+    def last_heartbeat(self, shard: str, namespace: str, template: str
+                       ) -> Optional[HeartbeatLease]:
+        """Last heartbeat observed for a workload on a shard — the real
+        progress record the planner should report for shard-level failures
+        (the probe that confirmed an outage never saw a fresh lease)."""
+        lt = self._track(shard).leases.get(f"{namespace}/{template}")
+        return lt.last if lt is not None else None
+
+    def next_probe_delay(self, shard: str) -> float:
+        """Seconds to wait before probing this shard again — the base
+        interval while healthy, exponentially backed off while unreachable
+        (capped at ``backoff_max``)."""
+        t = self._track(shard)
+        return t.backoff if t.backoff > 0 else self.probe_interval
+
+    # ------------------------------------------------------------ observations
+    def observe_api_error(self, shard: str, err: Optional[BaseException] = None
+                          ) -> List[DetectorEvent]:
+        now = self.clock()
+        t = self._track(shard)
+        t.consecutive_ok = 0
+        t.consecutive_errors += 1
+        if t.consecutive_errors == 1:
+            t.first_error_at = now
+        # exponential backoff while the outage lasts: interval, 2x, 4x, ...
+        t.backoff = min(
+            self.backoff_max,
+            self.probe_interval * (2 ** (t.consecutive_errors - 1)),
+        )
+        events: List[DetectorEvent] = []
+        if (
+            t.state != API_UNREACHABLE
+            and t.consecutive_errors >= self.api_failure_threshold
+        ):
+            t.state = API_UNREACHABLE
+            events.append(DetectorEvent(
+                EVENT_SHARD_UNHEALTHY, shard,
+                detection_seconds=max(now - t.first_error_at, 0.0),
+            ))
+        return events
+
+    def observe(self, shard: str, heartbeats: List[HeartbeatLease]
+                ) -> List[DetectorEvent]:
+        """A successful probe: the shard API answered with its heartbeats."""
+        now = self.clock()
+        t = self._track(shard)
+        events: List[DetectorEvent] = []
+
+        # ---- shard-level recovery (flap-suppressed)
+        t.consecutive_errors = 0
+        t.consecutive_ok += 1
+        # an ANSWERING API ends the backoff immediately (backoff protects a
+        # dead endpoint from a retry storm, not a live one) — probation
+        # probes run at the normal cadence so recovery isn't starved by the
+        # outage's final backoff value
+        t.backoff = 0.0
+        if t.state == API_UNREACHABLE:
+            if t.consecutive_ok >= self.recovery_probes:
+                t.state = HEALTHY
+                # a reconnected shard may have lost state; re-baseline every
+                # lease observation so stale renew values don't instantly
+                # re-confirm expiry
+                for lt in t.leases.values():
+                    lt.observed_at = now
+                events.append(DetectorEvent(EVENT_SHARD_RECOVERED, shard))
+            else:
+                return events  # still on probation: don't judge leases yet
+
+        # ---- per-lease deadlines
+        seen = set()
+        for hb in heartbeats:
+            key = f"{hb.namespace}/{hb.template}"
+            seen.add(key)
+            lt = t.leases.get(key)
+            if lt is None:
+                lt = t.leases[key] = _LeaseTrack(
+                    renew_value=hb.renew_time, observed_at=now, last=hb,
+                )
+                continue
+            lt.last = hb
+            if hb.done:
+                # graceful completion: silence is expected from here on
+                if lt.state != FRESH:
+                    lt.state = FRESH
+                lt.renew_value = hb.renew_time
+                lt.observed_at = now
+                continue
+            if hb.renew_time != lt.renew_value:
+                was = lt.state
+                lt.renew_value = hb.renew_time
+                lt.observed_at = now
+                lt.state = FRESH
+                if was == EXPIRED:
+                    events.append(DetectorEvent(EVENT_LEASE_RECOVERED, shard, hb))
+                continue
+            ttl = hb.ttl_seconds or self.ttl_seconds
+            age = now - lt.observed_at
+            misses = int(age // ttl) if ttl > 0 else 0
+            if misses <= 0:
+                continue
+            if misses < self.suspect_misses:
+                # one missed renewal is NOT a failure — a single slow write,
+                # a GC pause, or a throttled renewer all look exactly like
+                # this (the flap the suppression exists for)
+                if lt.state == FRESH:
+                    lt.state = SUSPECT
+                continue
+            if lt.state != EXPIRED:
+                lt.state = EXPIRED
+                events.append(DetectorEvent(
+                    EVENT_LEASE_EXPIRED, shard, hb,
+                    # from the first missed deadline to this confirmation
+                    detection_seconds=max(age - ttl, 0.0),
+                ))
+
+        # leases that vanished from the listing (ConfigMap deleted — job
+        # cleaned up or failed over) simply stop being tracked
+        for key in list(t.leases):
+            if key not in seen:
+                del t.leases[key]
+        return events

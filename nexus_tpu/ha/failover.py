@@ -1,0 +1,348 @@
+"""Failover planner: confirmed failure → re-place → checkpoint-exact resume.
+
+The FailoverManager owns the probe loop that feeds the per-shard
+:class:`~nexus_tpu.ha.detector.FailureDetector` and executes its verdicts:
+
+  * **lease expired** (worker dead, shard API fine): compute the restore
+    point from the template's checkpoint directory
+    (``train.checkpoint.latest_step`` — durable steps only, partial saves
+    excluded), stamp it on the template as the restore-step annotation
+    (materializer → ``NEXUS_RESTORE_STEP``), delete the dead Job and the
+    stale heartbeat on the failed shard, evict the template's sticky home
+    so placement re-runs *excluding* the shard it just died on, and
+    enqueue the template — the normal reconcile then re-materializes it on
+    a healthy shard.
+  * **shard API unreachable**: mark the shard unhealthy (placement skips
+    it; ``_remove_from_unselected_shards`` defers its cleanup), then fail
+    over every template homed there the same way — except dead Jobs are
+    *abandoned*, not deleted (the API is down; provenance labels let the
+    normal reconcile prune them when the shard returns).
+  * **shard recovered**: mark healthy, drop the shard's WriteSkipCache
+    entries (a reconnected shard may have lost state the cache still
+    believes is written), and enqueue every template so the level-
+    triggered reconcile re-converges it.
+
+Telemetry: ``shard_healthy`` (per shard), ``failovers_total``,
+``failover_detection_seconds``, ``failover_steps_lost``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from nexus_tpu.api.template import NexusAlgorithmTemplate
+from nexus_tpu.cluster.store import ConflictError, NotFoundError
+from nexus_tpu.ha.detector import (
+    EVENT_LEASE_EXPIRED,
+    EVENT_LEASE_RECOVERED,
+    EVENT_SHARD_RECOVERED,
+    EVENT_SHARD_UNHEALTHY,
+    DetectorEvent,
+    FailureDetector,
+)
+from nexus_tpu.ha.lease import HeartbeatLease, heartbeat_name, list_heartbeats
+from nexus_tpu.utils.telemetry import (
+    METRIC_FAILOVER_DETECTION_SECONDS,
+    METRIC_FAILOVER_STEPS_LOST,
+    METRIC_FAILOVERS_TOTAL,
+    METRIC_SHARD_HEALTHY,
+)
+
+logger = logging.getLogger("nexus_tpu.ha")
+
+REASON_FAILOVER = "FailedOver"
+REASON_SHARD_UNHEALTHY = "ShardUnhealthy"
+
+
+@dataclass
+class FailoverConfig:
+    """Detector/planner tuning knobs (helm: controller.failover*)."""
+
+    heartbeat_ttl: float = 15.0
+    probe_interval: float = 5.0
+    suspect_misses: int = 2
+    api_failure_threshold: int = 3
+    backoff_max: float = 60.0
+    recovery_probes: int = 2
+
+
+class FailoverManager:
+    """Probe loop + planner, owned by (and wired through) the Controller."""
+
+    def __init__(self, controller, config: Optional[FailoverConfig] = None,
+                 clock=time.monotonic):
+        self.controller = controller
+        self.config = config or FailoverConfig()
+        self.detector = FailureDetector(
+            ttl_seconds=self.config.heartbeat_ttl,
+            suspect_misses=self.config.suspect_misses,
+            api_failure_threshold=self.config.api_failure_threshold,
+            probe_interval=self.config.probe_interval,
+            backoff_max=self.config.backoff_max,
+            recovery_probes=self.config.recovery_probes,
+            clock=clock,
+        )
+        self.clock = clock
+        self.failovers_total = 0
+        self._next_probe: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        for shard in self.controller.shards:
+            self.controller.statsd.gauge(
+                METRIC_SHARD_HEALTHY, 1.0, tags=[f"shard:{shard.name}"]
+            )
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="nexus-failover"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # ------------------------------------------------------------ probe loop
+    def _run(self) -> None:
+        tick = max(0.02, min(self.config.probe_interval / 4.0, 0.5))
+        while not self._stop.wait(tick):
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — the monitor must outlive bugs
+                logger.exception("failover probe iteration failed")
+
+    def probe_once(self) -> None:
+        """Probe every shard whose (backoff-aware) deadline has passed."""
+        now = self.clock()
+        for shard in self.controller.shards:
+            if now < self._next_probe.get(shard.name, 0.0):
+                continue
+            try:
+                heartbeats = list_heartbeats(shard.store)
+            except Exception as e:  # noqa: BLE001 — API outage is an observation
+                events = self.detector.observe_api_error(shard.name, e)
+            else:
+                events = self.detector.observe(shard.name, heartbeats)
+            self._next_probe[shard.name] = (
+                self.clock() + self.detector.next_probe_delay(shard.name)
+            )
+            for event in events:
+                self._handle(shard, event)
+
+    # --------------------------------------------------------------- planner
+    def _handle(self, shard, event: DetectorEvent) -> None:
+        if event.kind == EVENT_LEASE_EXPIRED:
+            logger.warning(
+                "heartbeat lease for %s/%s expired on shard %s "
+                "(confirmed after %.2fs, last step %d)",
+                event.lease.namespace, event.lease.template, shard.name,
+                event.detection_seconds, event.lease.step,
+            )
+            self._fail_over_template(shard, event.lease, event, api_ok=True)
+        elif event.kind == EVENT_SHARD_UNHEALTHY:
+            logger.warning(
+                "shard %s API unreachable (confirmed after %.2fs); "
+                "excluding from placement and failing its workloads over",
+                shard.name, event.detection_seconds,
+            )
+            self.controller.set_shard_health(shard.name, False)
+            self.controller.statsd.gauge(
+                METRIC_SHARD_HEALTHY, 0.0, tags=[f"shard:{shard.name}"]
+            )
+            for template in self._templates_on_shard(shard.name):
+                # the detector's last observation carries the real progress
+                # (step) — fabricating a fresh lease would report 0 steps
+                # lost for every API-outage failover
+                lease = self.detector.last_heartbeat(
+                    shard.name, template.metadata.namespace,
+                    template.metadata.name,
+                ) or HeartbeatLease(
+                    template=template.metadata.name,
+                    namespace=template.metadata.namespace,
+                )
+                self._fail_over_template(shard, lease, event, api_ok=False)
+        elif event.kind == EVENT_SHARD_RECOVERED:
+            logger.info("shard %s recovered; re-converging", shard.name)
+            self.controller.set_shard_health(shard.name, True)
+            self.controller.statsd.gauge(
+                METRIC_SHARD_HEALTHY, 1.0, tags=[f"shard:{shard.name}"]
+            )
+            # a reconnected shard may have lost state the write-skip cache
+            # still believes is written — every entry for it is suspect
+            self.controller.write_skip_cache.invalidate_shard(shard.name)
+            for template in self.controller.template_lister.list(None):
+                self.controller.enqueue_resource(template)
+        elif event.kind == EVENT_LEASE_RECOVERED:
+            logger.info(
+                "heartbeat for %s/%s on shard %s resumed renewing",
+                event.lease.namespace, event.lease.template, shard.name,
+            )
+
+    def _templates_on_shard(self, shard_name: str):
+        out = []
+        for template in self.controller.template_lister.list(None):
+            if template.spec.runtime is None:
+                continue
+            if template.status.workload_phase == "Succeeded":
+                # a completed workload has nothing to fail over — re-running
+                # it on another shard would burn TPU on a finished job
+                continue
+            synced = template.status.synced_to_clusters or []
+            home = self.controller.home_of(
+                template.metadata.namespace, template.metadata.name
+            )
+            if shard_name in synced or home == shard_name:
+                out.append(template)
+        return out
+
+    def _fail_over_template(self, shard, lease: HeartbeatLease,
+                            event: DetectorEvent, api_ok: bool) -> None:
+        from nexus_tpu.controller.events import EVENT_TYPE_WARNING
+
+        try:
+            template = self.controller.template_lister.get(
+                lease.namespace, lease.template
+            )
+        except NotFoundError:
+            # template gone (deleted mid-run): just clean the stale lease
+            if api_ok:
+                self._cleanup_failed_shard(shard, lease)
+            return
+        if template.spec.runtime is None:
+            return
+        home = self.controller.home_of(
+            template.metadata.namespace, template.metadata.name
+        )
+        synced = template.status.synced_to_clusters or []
+        if (
+            template.status.workload_phase == "Succeeded"
+            or (home is not None and home != shard.name
+                and shard.name not in synced)
+        ):
+            # stale lease: the workload finished, or it was already failed
+            # over elsewhere and this shard's abandoned heartbeat only
+            # expired now (e.g. the shard just recovered from an outage).
+            # Failing over a healthy/finished workload would re-run it —
+            # just reap the leftovers.
+            if api_ok:
+                self._cleanup_failed_shard(shard, lease)
+            return
+
+        restore_step = self._restore_step(template)
+        steps_lost = max(lease.step - (restore_step or 0), 0)
+        self.failovers_total += 1
+        self.controller.statsd.gauge(
+            METRIC_FAILOVERS_TOTAL, self.failovers_total
+        )
+        self.controller.statsd.gauge(
+            METRIC_FAILOVER_DETECTION_SECONDS, event.detection_seconds,
+            tags=[f"shard:{shard.name}"],
+        )
+        self.controller.statsd.gauge(
+            METRIC_FAILOVER_STEPS_LOST, steps_lost,
+            tags=[f"template:{template.metadata.name}"],
+        )
+
+        # FIRST: placement must not hand the job back to the shard it died
+        # on — and every write below (annotation, job delete) can trigger a
+        # concurrent reconcile, so the eviction has to land before any of
+        # them or a racing reconcile re-places on the dead shard
+        self.controller.evict_home(
+            template.metadata.namespace, template.metadata.name, shard.name
+        )
+        if restore_step is not None:
+            template = self._annotate_restore_step(template, restore_step) or template
+        if api_ok:
+            # worker dead but shard API up: reap the dead Job so it stops
+            # holding TPU, and the stale heartbeat so the detector forgets it
+            self._cleanup_failed_shard(shard, lease)
+        self.controller.recorder.event(
+            template, EVENT_TYPE_WARNING, REASON_FAILOVER,
+            f"Workload on shard {shard.name!r} "
+            f"{'lost its worker (lease expired)' if api_ok else 'abandoned (shard API unreachable)'}"
+            f"; re-placing with restore step "
+            f"{restore_step if restore_step is not None else 'none (fresh start)'}"
+            f" ({steps_lost} steps lost)",
+        )
+        self.controller.enqueue_resource(template)
+
+    # ------------------------------------------------------------- mechanics
+    @staticmethod
+    def _restore_step(template: NexusAlgorithmTemplate) -> Optional[int]:
+        ck = template.spec.runtime.checkpoint
+        if not (ck.enabled and ck.directory):
+            return None
+        from nexus_tpu.train.checkpoint import latest_step
+
+        return latest_step(ck.directory)
+
+    def _annotate_restore_step(
+        self, template: NexusAlgorithmTemplate, step: int
+    ) -> Optional[NexusAlgorithmTemplate]:
+        from nexus_tpu.runtime.materializer import ANNOTATION_RESTORE_STEP
+
+        for _ in range(3):  # optimistic-concurrency retries
+            try:
+                fresh = self.controller.store.get(
+                    NexusAlgorithmTemplate.KIND,
+                    template.metadata.namespace, template.metadata.name,
+                )
+            except NotFoundError:
+                return None
+            if fresh.metadata.annotations.get(ANNOTATION_RESTORE_STEP) == str(step):
+                return fresh  # already stamped (repeat confirmation)
+            updated = fresh.deepcopy()
+            updated.metadata.annotations[ANNOTATION_RESTORE_STEP] = str(step)
+            try:
+                stored = self.controller.store.update(updated)
+            except ConflictError:
+                continue
+            self.controller.template_lister._set_if_newer(stored)
+            return stored
+        logger.warning(
+            "could not stamp restore-step annotation on %s/%s (conflicts); "
+            "the re-placed worker will auto-resume from latest instead",
+            template.metadata.namespace, template.metadata.name,
+        )
+        return None
+
+    def _cleanup_failed_shard(self, shard, lease: HeartbeatLease) -> None:
+        """Best-effort: delete the dead Jobs + stale heartbeat on the failed
+        shard (lease-expiry path only — the shard API is known reachable)."""
+        from nexus_tpu.api.types import (
+            CONTROLLER_APP_NAME,
+            ConfigMap,
+            LABEL_CONTROLLER_APP,
+        )
+        from nexus_tpu.api.workload import Job
+        from nexus_tpu.runtime.materializer import LABEL_TEMPLATE
+
+        selector = {
+            LABEL_CONTROLLER_APP: CONTROLLER_APP_NAME,
+            LABEL_TEMPLATE: lease.template,
+        }
+        try:
+            for job in shard.store.list(
+                Job.KIND, lease.namespace, label_selector=selector
+            ):
+                try:
+                    shard.store.delete(Job.KIND, job.metadata.namespace,
+                                       job.metadata.name)
+                except NotFoundError:
+                    pass
+            shard.store.delete(
+                ConfigMap.KIND, lease.namespace, heartbeat_name(lease.template)
+            )
+        except NotFoundError:
+            pass
+        except Exception:  # noqa: BLE001 — cleanup is advisory
+            logger.debug("failed-shard cleanup on %s incomplete", shard.name,
+                         exc_info=True)

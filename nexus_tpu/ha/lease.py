@@ -1,0 +1,233 @@
+"""Heartbeat lease protocol — the worker-liveness half of shard failover.
+
+A running worker renews a **heartbeat lease** at every training-step
+boundary; the controller-side failure detector (ha/detector.py) judges
+worker liveness from how long ago it last *observed* the lease move. The
+lease is a plain ConfigMap (not coordination.k8s.io/v1 Lease) on purpose:
+it rides the existing shard clients and fakekube routes unchanged, it is
+visible to `kubectl get cm`, and it can carry workload progress (the last
+completed step) that the failover planner uses to compute
+``failover_steps_lost``.
+
+Clock discipline mirrors controller/leaderelect.py: nobody compares their
+wall clock to the timestamp *in* the lease — the detector only measures
+how long ago it last saw ``renewTime`` CHANGE (local monotonic clock), so
+wall-clock skew between worker pods and the controller cannot produce
+false expiries.
+
+Data contract (ConfigMap ``hb-<template>`` in the template's namespace,
+labeled ``science.sneaksanddata.com/heartbeat=true``):
+
+  holder      — worker identity (shard + pid/thread)
+  renewTime   — RFC3339, informational only (see clock note above)
+  step        — last completed training step (int as str)
+  ttlSeconds  — the renew deadline the worker signed up for
+  phase       — "running" | "done"; "done" is the graceful-completion
+                marker, after which expiry is meaningless
+  frozen      — chaos hook (testing/fakekube.py): "true" makes the
+                renewer stop touching the lease, simulating a wedged
+                worker without killing it
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from nexus_tpu.api.types import GROUP, ConfigMap, ObjectMeta
+
+logger = logging.getLogger("nexus_tpu.ha")
+
+LABEL_HEARTBEAT = f"{GROUP}/heartbeat"
+HB_PREFIX = "hb-"
+
+PHASE_RUNNING = "running"
+PHASE_DONE = "done"
+
+
+def heartbeat_name(template_name: str) -> str:
+    return HB_PREFIX + template_name
+
+
+def _now_str() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="microseconds"
+    )
+
+
+@dataclass
+class HeartbeatLease:
+    """Typed view over a heartbeat ConfigMap's data."""
+
+    template: str
+    namespace: str
+    holder: str = ""
+    renew_time: str = ""
+    step: int = 0
+    ttl_seconds: float = 15.0
+    phase: str = PHASE_RUNNING
+
+    @property
+    def done(self) -> bool:
+        return self.phase == PHASE_DONE
+
+    @classmethod
+    def from_config_map(cls, cm: ConfigMap) -> "HeartbeatLease":
+        data = cm.data or {}
+        name = cm.metadata.name
+        template = name[len(HB_PREFIX):] if name.startswith(HB_PREFIX) else name
+        try:
+            step = int(data.get("step", "0") or 0)
+        except ValueError:
+            step = 0
+        try:
+            ttl = float(data.get("ttlSeconds", "15") or 15)
+        except ValueError:
+            ttl = 15.0
+        return cls(
+            template=template,
+            namespace=cm.metadata.namespace,
+            holder=data.get("holder", ""),
+            renew_time=data.get("renewTime", ""),
+            step=step,
+            ttl_seconds=ttl,
+            phase=data.get("phase", PHASE_RUNNING) or PHASE_RUNNING,
+        )
+
+
+def list_heartbeats(store, namespace: Optional[str] = None) -> List[HeartbeatLease]:
+    """One label-filtered LIST per probe — the detector's only read. Any
+    store error propagates to the caller: the detector counts it as an
+    API-unreachable observation, NOT as lease expiry (the two failure
+    modes have different confirmation deadlines and different planner
+    responses)."""
+    return [
+        HeartbeatLease.from_config_map(cm)
+        for cm in store.list(
+            ConfigMap.KIND, namespace, label_selector={LABEL_HEARTBEAT: "true"}
+        )
+    ]
+
+
+class LeaseRenewer:
+    """Worker-side heartbeat writer.
+
+    ``renew(step)`` is called at every step boundary (Trainer ``on_step``)
+    but self-throttles to one write per ``ttl/3`` seconds so sub-millisecond
+    CPU steps don't turn the shard API into a write firehose — three renew
+    opportunities per deadline window is the classic lease margin
+    (leaderelect.py uses the same 15s/5s ratio).
+
+    Renewal is best-effort by design: one failed or skipped write is
+    exactly what the detector's flap suppression absorbs. Only repeated
+    silence (``suspect_misses`` full TTL windows) confirms a failure.
+    """
+
+    def __init__(
+        self,
+        store,
+        namespace: str,
+        template_name: str,
+        holder: str = "",
+        ttl_seconds: float = 15.0,
+    ):
+        self.store = store
+        self.namespace = namespace
+        self.name = heartbeat_name(template_name)
+        self.holder = holder or f"worker-{threading.get_ident()}"
+        self.ttl_seconds = float(ttl_seconds)
+        self._min_interval = self.ttl_seconds / 3.0
+        self._last_renew = 0.0
+        self._frozen = False
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------ writes
+    def renew(self, step: int) -> bool:
+        """Renew the lease if the throttle window has elapsed. Returns True
+        when a write was attempted (successful or not)."""
+        now = time.monotonic()
+        with self._lock:
+            if self._frozen:
+                return False
+            if now - self._last_renew < self._min_interval:
+                return False
+            self._last_renew = now
+        self._write(step, PHASE_RUNNING)
+        return True
+
+    def complete(self, step: int = -1) -> None:
+        """Graceful-completion marker: a final write with phase=done so the
+        detector never misreads a finished job's silence as a failure."""
+        with self._lock:
+            if self._frozen:
+                return
+        self._write(step, PHASE_DONE)
+
+    def _write(self, step: int, phase: str) -> None:
+        from nexus_tpu.cluster.store import ConflictError, NotFoundError
+
+        data = {
+            "holder": self.holder,
+            "renewTime": _now_str(),
+            "ttlSeconds": str(self.ttl_seconds),
+            "phase": phase,
+        }
+        if step >= 0:
+            data["step"] = str(int(step))
+        for _ in range(2):  # one conflict retry; then give up until next tick
+            try:
+                existing = self.store.get(ConfigMap.KIND, self.namespace, self.name)
+            except NotFoundError:
+                existing = None
+            except Exception:  # noqa: BLE001 — liveness writes must not kill training
+                logger.debug("heartbeat get failed", exc_info=True)
+                return
+            try:
+                if existing is None:
+                    self.store.create(ConfigMap(
+                        metadata=ObjectMeta(
+                            name=self.name,
+                            namespace=self.namespace,
+                            labels={LABEL_HEARTBEAT: "true"},
+                        ),
+                        data=data,
+                    ))
+                else:
+                    if (existing.data or {}).get("frozen") == "true":
+                        # chaos hook: a frozen lease is never renewed again —
+                        # the injected "worker wedged" condition
+                        with self._lock:
+                            self._frozen = True
+                        return
+                    updated = existing.deepcopy()
+                    if "step" not in data and "step" in (existing.data or {}):
+                        data["step"] = existing.data["step"]
+                    updated.data = data
+                    updated.metadata.labels[LABEL_HEARTBEAT] = "true"
+                    self.store.update(updated)
+                return
+            except ConflictError:
+                continue  # re-get and retry once
+            except Exception:  # noqa: BLE001
+                logger.debug("heartbeat write failed", exc_info=True)
+                return
+
+
+def freeze_heartbeat(store, namespace: str, template_name: str) -> None:
+    """Chaos hook ("expire lease"): mark the heartbeat frozen so the worker's
+    renewer stops touching it and the detector sees it expire — a wedged
+    worker simulated without killing anything."""
+    from nexus_tpu.cluster.store import NotFoundError
+
+    name = heartbeat_name(template_name)
+    try:
+        cm = store.get(ConfigMap.KIND, namespace, name)
+    except NotFoundError:
+        return
+    updated = cm.deepcopy()
+    updated.data = dict(updated.data or {}, frozen="true")
+    store.update(updated)

@@ -42,6 +42,18 @@ def build_controller(config: AppConfig, controller_store: Optional[ClusterStore]
         if config.shard_config_path
         else []
     )
+    failover = None
+    if config.failover_enabled:
+        from nexus_tpu.ha.failover import FailoverConfig
+
+        failover = FailoverConfig(
+            heartbeat_ttl=config.heartbeat_ttl_seconds,
+            probe_interval=config.failover_probe_interval_seconds,
+            suspect_misses=config.failover_suspect_misses,
+            api_failure_threshold=config.failover_api_failure_threshold,
+            backoff_max=config.failover_backoff_max_seconds,
+            recovery_probes=config.failover_recovery_probes,
+        )
     return Controller(
         controller_store=controller_store,
         shards=shards,
@@ -54,6 +66,7 @@ def build_controller(config: AppConfig, controller_store: Optional[ClusterStore]
         queue_backend=config.queue_backend,
         shard_sync_workers=config.shard_sync_workers,
         write_skip_cache=config.write_skip_cache,
+        failover=failover,
     )
 
 

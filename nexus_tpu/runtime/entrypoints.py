@@ -27,7 +27,7 @@ from nexus_tpu.parallel.mesh import (
     plan_for_devices,
 )
 from nexus_tpu.parallel.sharding import batch_spec
-from nexus_tpu.train.checkpoint import Checkpointer
+from nexus_tpu.train.checkpoint import make_checkpointer
 from nexus_tpu.train.data import (
     Prefetcher,
     corpus_batches,
@@ -72,12 +72,22 @@ def run_template_runtime(
     devices: Optional[Sequence] = None,
     max_steps: Optional[int] = None,
     cancel=None,
+    heartbeat=None,
+    restore_step: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Execute a runtime block; returns a JSON-serializable metrics dict.
 
     ``cancel``: a utils.signals.CancelToken — set on SIGTERM (slice
     preemption); training stops at the next step boundary with a final
-    checkpoint so the requeued job resumes."""
+    checkpoint so the requeued job resumes (``cancel.hard`` skips the
+    final save — the chaos "kill worker" / no-grace preemption path).
+
+    ``heartbeat``: step-boundary liveness callback (the failover lease
+    renewer — ha/lease.py); called with the host-side completed-step count.
+
+    ``restore_step``: pin the resume point to an exact durable checkpoint
+    step (the failover planner's restore-step annotation → the
+    materializer's ``NEXUS_RESTORE_STEP`` env) instead of latest."""
     family = get_family(runtime.model.family)
     overrides = dict(runtime.model.overrides)
     # train.remat is the spec-level knob; model.overrides.remat (with
@@ -115,7 +125,10 @@ def run_template_runtime(
         return _run_infer(runtime, family, cfg, mesh)
     if runtime.mode == "serve":
         return _run_serve(runtime, family, cfg, mesh)
-    return _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel)
+    return _run_train(
+        runtime, family, cfg, mesh, n_devices, max_steps, cancel,
+        heartbeat=heartbeat, restore_step=restore_step,
+    )
 
 
 def _schedule_bubble(schedule: str, n_micro: int, n_stages: int) -> float:
@@ -127,7 +140,8 @@ def _schedule_bubble(schedule: str, n_micro: int, n_stages: int) -> float:
     return (n_stages - 1) / (n_micro + n_stages - 1)
 
 
-def _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel=None):
+def _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel=None,
+               heartbeat=None, restore_step=None):
     tr = runtime.train
     steps = min(tr.steps, max_steps) if max_steps else tr.steps
     optimizer = build_optimizer(
@@ -306,13 +320,23 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel=None):
         checkpointer = None
         start_step = 0
         if runtime.checkpoint.enabled and runtime.checkpoint.directory:
-            checkpointer = Checkpointer(
-                runtime.checkpoint.directory, keep=runtime.checkpoint.keep
+            checkpointer = make_checkpointer(
+                runtime.checkpoint.directory, keep=runtime.checkpoint.keep,
+                fmt=runtime.checkpoint.format,
             )
             if runtime.checkpoint.resume and checkpointer.latest_step() is not None:
-                state = checkpointer.restore(state)
+                # restore_step pins the resume point to an exact durable
+                # step (the failover planner's choice); default is latest
+                state = checkpointer.restore(state, step=restore_step)
                 start_step = int(state.step)
                 logger.info("resumed from checkpoint step %d", start_step)
+
+        # heartbeat steps must be GLOBAL (comparable with checkpoint step
+        # numbers — failover_steps_lost subtracts them): the Trainer only
+        # knows its run-local completed count, so offset by the resume point
+        hb = heartbeat
+        if heartbeat is not None and start_step:
+            hb = lambda completed: heartbeat(start_step + completed)  # noqa: E731
 
         prof = runtime.profile
         trainer = Trainer(
@@ -332,6 +356,7 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel=None):
             # (tools/sweep_levers.py); unset → Trainer's platform default
             run_ahead=int(os.environ.get("NEXUS_RUN_AHEAD", "0") or 0)
             or None,
+            on_step=hb,
         )
         try:
             # 2 untimed warmup steps: the first execution is the compile, and
@@ -346,12 +371,18 @@ def _run_train(runtime, family, cfg, mesh, n_devices, max_steps, cancel=None):
                 prefetcher.close()
         checkpoint_saved = False
         if checkpointer is not None:
-            # final save — doubles as the preemption save when the run was
-            # interrupted (resume point for the rescheduled pod)
-            jax.block_until_ready(trainer.state)
-            checkpointer.save(trainer.state, wait=True)
-            checkpointer.close()
-            checkpoint_saved = True
+            if getattr(cancel, "hard", False):
+                # hard kill (chaos / no-grace preemption): no final save —
+                # recovery must come from the last INTERVAL checkpoint, the
+                # case the failover steps_lost metric measures
+                checkpointer.close()
+            else:
+                # final save — doubles as the preemption save when the run
+                # was interrupted (resume point for the rescheduled pod)
+                jax.block_until_ready(trainer.state)
+                checkpointer.save(trainer.state, wait=True)
+                checkpointer.close()
+                checkpoint_saved = True
 
     metrics: Dict[str, Any] = {
         "mode": "train",
@@ -427,7 +458,9 @@ def _load_infer_params(runtime, family, cfg, mesh):
     ck = runtime.checkpoint
     checkpointer = None
     if ck.enabled and ck.directory:
-        checkpointer = Checkpointer(ck.directory, keep=ck.keep)
+        # restore is layout-sniffed ("auto"): an infer template must load
+        # whatever format the training run actually wrote
+        checkpointer = make_checkpointer(ck.directory, keep=ck.keep, fmt="auto")
         if checkpointer.latest_step() is None:
             checkpointer = None
     if checkpointer is None:
@@ -471,7 +504,7 @@ def _load_draft_params(runtime, draft_family, draft_cfg, mesh, key):
         # typo'd path must not be mkdir'd, and a read-only inference mount
         # must reach the random-init fallback rather than an OSError
         if os.path.isdir(ck_dir):
-            checkpointer = Checkpointer(ck_dir)
+            checkpointer = make_checkpointer(ck_dir, fmt="auto")
             step = checkpointer.latest_step()
             if step is not None:
                 params = checkpointer.restore_params(

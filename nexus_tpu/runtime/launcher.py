@@ -42,13 +42,26 @@ class LocalLauncher:
         recorder: Optional[EventRecorder] = None,
         max_steps: Optional[int] = None,
         devices=None,
+        heartbeat_ttl: float = 0.0,
+        step_pace_s: float = 0.0,
     ):
         self.store = store
         self.recorder = recorder or EventRecorder(component="nexus-local-launcher")
         self.max_steps = max_steps
         self.devices = devices
+        # heartbeat_ttl > 0 wires the failover lease protocol (ha/lease.py):
+        # each running job renews its heartbeat ConfigMap in this store at
+        # every step boundary — the launcher plays the worker pod's renewer
+        # the way it already plays the kubelet for job status.
+        self.heartbeat_ttl = float(heartbeat_ttl)
+        # step_pace_s > 0 sleeps at each step boundary — tests and the
+        # failover bench use it to give CPU-instant toy steps a realistic
+        # wall-clock duration (a kill must be able to land mid-run).
+        self.step_pace_s = float(step_pace_s)
         self._seen_generations: Dict[str, int] = {}
         self._threads: Dict[str, threading.Thread] = {}
+        # per-running-job cancel tokens — the chaos "kill worker" hook
+        self._cancels: Dict[str, Any] = {}
         # newest template revision that arrived while its job was running;
         # re-launched when the running job finishes
         self._pending: Dict[str, NexusAlgorithmTemplate] = {}
@@ -57,15 +70,25 @@ class LocalLauncher:
 
     # ---------------------------------------------------------------- control
     def start(self) -> None:
+        from nexus_tpu.api.workload import Job
+
         self.store.subscribe(NexusAlgorithmTemplate.KIND, self._on_event)
+        # a NEW Job object for a template whose worker is not running means
+        # the controller re-materialized it (failover re-placement onto
+        # this shard, or a converge after the planner reaped a dead Job) —
+        # the generation dedup must not swallow that relaunch
+        self.store.subscribe(Job.KIND, self._on_job_event)
         for tmpl in self.store.list(NexusAlgorithmTemplate.KIND):
             self._maybe_launch(tmpl)
 
     def stop(self, wait: bool = True, timeout: float = 60.0) -> None:
         import time
 
+        from nexus_tpu.api.workload import Job
+
         self._stop.set()
         self.store.unsubscribe(NexusAlgorithmTemplate.KIND, self._on_event)
+        self.store.unsubscribe(Job.KIND, self._on_job_event)
         if wait:
             # loop: a deferred pending-relaunch racing _stop may insert one
             # more thread after the first snapshot; re-snapshot until quiet,
@@ -88,6 +111,19 @@ class LocalLauncher:
                 for t in threads:
                     t.join(timeout=max(0.05, remaining / len(threads)))
 
+    def kill(self, template_key: str, hard: bool = True) -> bool:
+        """Chaos hook ("kill worker"): cancel the running job for a template
+        key (``namespace/name``). ``hard=True`` skips the graceful-shutdown
+        courtesies (final checkpoint, heartbeat done-marker) — the realistic
+        no-grace preemption the failover subsystem exists to recover from.
+        Returns True if a running job was signalled."""
+        with self._lock:
+            cancel = self._cancels.get(template_key)
+        if cancel is None:
+            return False
+        cancel.cancel(hard=hard)
+        return True
+
     def wait_idle(self, timeout: float = 120.0) -> bool:
         import time
 
@@ -105,6 +141,33 @@ class LocalLauncher:
             return
         if event.type in ("ADDED", "MODIFIED"):
             self._maybe_launch(event.obj)
+
+    def _on_job_event(self, event: WatchEvent) -> None:
+        """A materialized Job (re)appeared: if its template's worker is not
+        running, the generation was executed before but the intent is
+        clearly to run again (failover re-placement onto this same shard
+        re-creates the Job without any template change) — reset the dedup
+        and launch."""
+        if self._stop.is_set() or event.type != "ADDED":
+            return
+        from nexus_tpu.runtime.materializer import LABEL_TEMPLATE
+
+        name = (event.obj.metadata.labels or {}).get(LABEL_TEMPLATE, "")
+        if not name:
+            return
+        try:
+            tmpl = self.store.get(
+                NexusAlgorithmTemplate.KIND, event.obj.metadata.namespace, name
+            )
+        except NotFoundError:
+            return
+        key = tmpl.key()
+        with self._lock:
+            running = self._threads.get(key)
+            if running is not None and running.is_alive():
+                return  # normal converge while the worker is up
+            self._seen_generations.pop(key, None)
+        self._maybe_launch(tmpl)
 
     def _maybe_launch(self, tmpl: NexusAlgorithmTemplate) -> None:
         if tmpl.spec.runtime is None:
@@ -127,6 +190,9 @@ class LocalLauncher:
                     self._pending[key] = tmpl
                 return
             self._seen_generations[key] = gen
+            from nexus_tpu.utils.signals import CancelToken
+
+            self._cancels[key] = CancelToken()
             t = threading.Thread(
                 target=self._execute, args=(tmpl,), daemon=True,
                 name=f"nexus-job-{tmpl.metadata.name}",
@@ -143,12 +209,35 @@ class LocalLauncher:
             with self._lock:
                 if self._threads.get(key) is threading.current_thread():
                     del self._threads[key]
+                    self._cancels.pop(key, None)
                 pending = self._pending.pop(key, None)
             if pending is not None and not self._stop.is_set():
                 self._maybe_launch(pending)
 
     def _execute_inner(self, tmpl: NexusAlgorithmTemplate) -> None:
+        import time
+
         name = tmpl.metadata.name
+        with self._lock:
+            cancel = self._cancels.get(tmpl.key())
+        renewer = None
+        if self.heartbeat_ttl > 0:
+            from nexus_tpu.ha.lease import LeaseRenewer
+
+            renewer = LeaseRenewer(
+                self.store,
+                namespace=tmpl.metadata.namespace,
+                template_name=name,
+                holder=f"local-{self.store.name}",
+                ttl_seconds=self.heartbeat_ttl,
+            )
+
+        def on_step(step: int) -> None:
+            if renewer is not None:
+                renewer.renew(step)
+            if self.step_pace_s > 0:
+                time.sleep(self.step_pace_s)
+
         try:
             # production code path: manifest materialization must succeed
             jobs = materialize_job(tmpl, shard_name=self.store.name)
@@ -158,9 +247,34 @@ class LocalLauncher:
                 f"({tmpl.spec.runtime.mode} {tmpl.spec.runtime.model.family})",
             )
             self._set_job_statuses(tmpl, jobs, "Running")
-            metrics = run_template_runtime(
-                tmpl.spec.runtime, devices=self.devices, max_steps=self.max_steps
+            # failover resume pin: the planner's restore-step annotation
+            # (same contract the materializer turns into NEXUS_RESTORE_STEP
+            # for real pods)
+            from nexus_tpu.runtime.materializer import ANNOTATION_RESTORE_STEP
+
+            raw_restore = (tmpl.metadata.annotations or {}).get(
+                ANNOTATION_RESTORE_STEP, ""
             )
+            metrics = run_template_runtime(
+                tmpl.spec.runtime, devices=self.devices,
+                max_steps=self.max_steps, cancel=cancel,
+                heartbeat=on_step if (renewer or self.step_pace_s) else None,
+                restore_step=int(raw_restore) if raw_restore else None,
+            )
+            if metrics.get("interrupted"):
+                # killed / preempted mid-run: the job did NOT complete — no
+                # done-marker on the heartbeat (a hard kill stops renewing
+                # outright, which is exactly what the detector must see)
+                self._write_result(tmpl, "Failed", metrics, jobs)
+                self._set_job_statuses(tmpl, jobs, "Failed")
+                self.recorder.event(
+                    tmpl, EVENT_TYPE_WARNING, REASON_JOB_FAILED,
+                    f"Template {name!r} interrupted at step "
+                    f"{metrics.get('steps')} (killed/preempted)",
+                )
+                return
+            if renewer is not None:
+                renewer.complete(int(metrics.get("steps", -1) or -1))
             self._write_result(tmpl, "Succeeded", metrics, jobs)
             self._set_job_statuses(tmpl, jobs, "Succeeded")
             self.recorder.event(
@@ -170,6 +284,11 @@ class LocalLauncher:
             )
         except Exception as e:
             logger.exception("job for template %s failed", name)
+            if renewer is not None:
+                # a worker that REPORTED failure is not a liveness failure:
+                # mark the lease done so Job retry policy (not the failover
+                # detector) owns what happens next
+                renewer.complete()
             self._write_result(
                 tmpl, "Failed", {"error": str(e), "traceback": traceback.format_exc()[-2000:]}, []
             )

@@ -22,6 +22,12 @@ from nexus_tpu.api.workgroup import NexusAlgorithmWorkgroup
 LABEL_TEMPLATE = f"{GROUP}/template"
 LABEL_SLICE_INDEX = f"{GROUP}/slice-index"
 ANNOTATION_RUNTIME = f"{GROUP}/runtime"
+# Failover (ha/failover.py): the planner stamps the latest durable
+# checkpoint step on the template; the materializer turns it into the
+# worker's NEXUS_RESTORE_STEP env so the re-placed Job resumes from that
+# exact step. Carried in template *metadata* (not spec) — it is
+# controller-operational state, not user intent.
+ANNOTATION_RESTORE_STEP = f"{GROUP}/restore-step"
 
 
 def _slice_job_name(template: NexusAlgorithmTemplate, slice_count: int,
@@ -96,7 +102,19 @@ def materialize_job(
             # derive from the Indexed-Job pod index (JOB_COMPLETION_INDEX)
             {"name": "JAX_COORDINATOR_ADDRESS", "value": f"{coordinator}:8476"},
             {"name": "TPU_WORKER_HOSTNAMES", "value": ""},
+            # heartbeat lease identity (ha/lease.py); the shard-API
+            # credential (NEXUS_HB_KUBECONFIG) is deployment-provided via
+            # the template's environment variables
+            {"name": "NEXUS_HB_TEMPLATE", "value": template.metadata.name},
+            {"name": "NEXUS_HB_NAMESPACE", "value": template.metadata.namespace},
         ]
+        restore_step = (template.metadata.annotations or {}).get(
+            ANNOTATION_RESTORE_STEP, ""
+        )
+        if restore_step:
+            runtime_env.append(
+                {"name": "NEXUS_RESTORE_STEP", "value": restore_step}
+            )
         pod_spec: Dict[str, Any] = {
             "serviceAccountName": template.spec.container.service_account_name or None,
             "restartPolicy": "Never",

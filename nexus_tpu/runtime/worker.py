@@ -11,6 +11,14 @@ env vars; this module is their single consumer:
   JAX_COORDINATOR_ADDRESS  — pod 0 of slice 0 (host:port)
   JOB_COMPLETION_INDEX     — Indexed-Job host index within this slice
   NEXUS_RESULT_PATH        — optional path to also write the metrics JSON
+  NEXUS_RESTORE_STEP       — failover: pin resume to this exact durable
+                             checkpoint step (the planner's restore-step
+                             annotation, stamped by the materializer)
+  NEXUS_HB_KUBECONFIG      — failover: when set (+ template name/namespace
+                             below), process 0 renews the heartbeat lease
+                             (ha/lease.py) against this shard API at every
+                             step boundary
+  NEXUS_HB_TEMPLATE / NEXUS_HB_NAMESPACE / NEXUS_HB_TTL_SECONDS
 
 Flow (SURVEY.md §7.2): derive (process_id, num_processes) from the slice /
 host indices → ``jax.distributed.initialize`` when multi-process → build the
@@ -133,7 +141,34 @@ def run_from_env(environ: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
     except ValueError:  # not on the main thread (tests drive run_from_env)
         cancel = None
 
-    metrics = run_template_runtime(runtime, cancel=cancel)
+    restore_step: Optional[int] = None
+    if env.get("NEXUS_RESTORE_STEP", "") != "":
+        restore_step = int(env["NEXUS_RESTORE_STEP"])
+
+    heartbeat = None
+    renewer = None
+    if env.get("NEXUS_HB_KUBECONFIG") and identity.process_id == 0:
+        # process 0 heartbeats for the whole job (one lease per template —
+        # detecting any wedged host is the Job's backoff policy's problem;
+        # the lease answers "is this workload making step progress")
+        from nexus_tpu.cluster.kube import KubeClusterStore
+        from nexus_tpu.ha.lease import LeaseRenewer
+
+        renewer = LeaseRenewer(
+            KubeClusterStore("hb", env["NEXUS_HB_KUBECONFIG"]),
+            namespace=env.get("NEXUS_HB_NAMESPACE", "default"),
+            template_name=env.get("NEXUS_HB_TEMPLATE", "unknown"),
+            holder=f"{env.get('NEXUS_SHARD_NAME', '')}"
+                   f"-p{identity.process_id}-{os.getpid()}",
+            ttl_seconds=float(env.get("NEXUS_HB_TTL_SECONDS", "15") or 15),
+        )
+        heartbeat = renewer.renew
+
+    metrics = run_template_runtime(
+        runtime, cancel=cancel, heartbeat=heartbeat, restore_step=restore_step
+    )
+    if renewer is not None and not metrics.get("interrupted"):
+        renewer.complete(int(metrics.get("steps", -1) or -1))
     metrics["shard"] = env.get("NEXUS_SHARD_NAME", "")
     metrics["process_id"] = identity.process_id
     metrics["num_processes"] = identity.num_processes

@@ -13,6 +13,19 @@ unmodified. Two of these make a two-cluster e2e
 Storage/semantics come from :class:`~nexus_tpu.cluster.store.ClusterStore`
 (optimistic concurrency, finalizers, owner-reference GC) — the server is a
 wire-protocol shim, not a second implementation.
+
+Fault injection (the failover subsystem's chaos surface — no hardware, no
+real outage needed):
+
+  * :class:`ChaosHooks` — deterministic per-verb/per-kind rules (error N
+    times, delay, drop the connection) consulted by every HTTP handler;
+    ``server.chaos.add("error", verbs="get,list")`` simulates a shard API
+    outage the failure detector must confirm and back off from.
+  * :class:`ChaosClusterStore` — the same rules over an in-process
+    ClusterStore, for tests/benches that skip the HTTP layer.
+  * ``kill worker`` lives on the LocalLauncher (``launcher.kill``) and
+    ``expire lease`` on ha.lease.freeze_heartbeat — re-exported here so
+    testing code has one chaos namespace.
 """
 
 from __future__ import annotations
@@ -33,6 +46,8 @@ from nexus_tpu.cluster.store import (
     ConflictError,
     NotFoundError,
 )
+# chaos-namespace re-export: "expire lease" lives with the lease protocol
+from nexus_tpu.ha.lease import freeze_heartbeat  # noqa: F401
 
 _TYPES = {
     "secrets": Secret,
@@ -53,6 +68,134 @@ _LIST_KINDS = {
     NexusAlgorithmTemplate.KIND: "NexusAlgorithmTemplateList",
     NexusAlgorithmWorkgroup.KIND: "NexusAlgorithmWorkgroupList",
 }
+
+
+class ChaosRule:
+    """One deterministic fault: match (verb, kind) → act, ``count`` times.
+
+    ``mode``: "error" (HTTP 5xx / raised OSError), "delay" (sleep
+    ``delay_s`` then proceed), "drop" (close the connection / raise
+    ConnectionError — the half-open-socket failure TCP clients hate most).
+    ``count`` -1 means forever; otherwise each match consumes one charge,
+    so "fail the next 3 LISTs then recover" is a one-liner.
+    """
+
+    def __init__(self, mode: str, verbs: str = "*", kinds: str = "*",
+                 count: int = -1, error_code: int = 503, delay_s: float = 0.0):
+        if mode not in ("error", "delay", "drop"):
+            raise ValueError(f"unknown chaos mode {mode!r}")
+        self.mode = mode
+        self.verbs = {v.strip().lower() for v in verbs.split(",")}
+        self.kinds = {k.strip() for k in kinds.split(",")}
+        self.count = count
+        self.error_code = error_code
+        self.delay_s = delay_s
+        self.hits = 0
+
+    def matches(self, verb: str, kind: str) -> bool:
+        if self.count == 0:
+            return False
+        if "*" not in self.verbs and verb.lower() not in self.verbs:
+            return False
+        if "*" not in self.kinds and kind not in self.kinds:
+            return False
+        return True
+
+    def consume(self) -> None:
+        self.hits += 1
+        if self.count > 0:
+            self.count -= 1
+
+
+class ChaosHooks:
+    """Rule registry shared by the HTTP server and ChaosClusterStore."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rules: List[ChaosRule] = []
+
+    def add(self, mode: str, verbs: str = "*", kinds: str = "*",
+            count: int = -1, error_code: int = 503,
+            delay_s: float = 0.0) -> ChaosRule:
+        rule = ChaosRule(mode, verbs=verbs, kinds=kinds, count=count,
+                         error_code=error_code, delay_s=delay_s)
+        with self._lock:
+            self.rules.append(rule)
+        return rule
+
+    def clear(self) -> None:
+        with self._lock:
+            self.rules = []
+
+    def intercept(self, verb: str, kind: str) -> Optional[ChaosRule]:
+        """First matching rule, its charge consumed. Delay rules sleep here
+        (then fall through to normal handling); error/drop rules are
+        returned for the caller to act on."""
+        with self._lock:
+            rule = next(
+                (r for r in self.rules if r.matches(verb, kind)), None
+            )
+            if rule is not None:
+                rule.consume()
+        if rule is not None and rule.mode == "delay":
+            import time
+
+            time.sleep(rule.delay_s)
+            return None
+        return rule
+
+
+class ChaosClusterStore:
+    """ClusterStore proxy applying :class:`ChaosHooks` to every verb — the
+    in-process twin of the HTTP server's fault injection, so detector /
+    failover tests can wedge a shard without running a server. Shares the
+    underlying store's objects and watch feed; only the *client-visible*
+    verbs (the ones a remote API call would pay for) are interceptable."""
+
+    def __init__(self, store: ClusterStore, chaos: Optional[ChaosHooks] = None):
+        self._store = store
+        self.chaos = chaos or ChaosHooks()
+
+    def _gate(self, verb: str, kind: str) -> None:
+        rule = self.chaos.intercept(verb, kind)
+        if rule is None:
+            return
+        if rule.mode == "drop":
+            raise ConnectionResetError(
+                f"chaos: connection dropped ({verb} {kind})"
+            )
+        raise OSError(f"chaos: injected {rule.error_code} ({verb} {kind})")
+
+    # ------------------------------------------------------ intercepted verbs
+    def create(self, obj, field_manager: str = ""):
+        self._gate("create", obj.KIND)
+        return self._store.create(obj, field_manager=field_manager)
+
+    def get(self, kind: str, namespace: str, name: str):
+        self._gate("get", kind)
+        return self._store.get(kind, namespace, name)
+
+    def list(self, kind: str, namespace=None, label_selector=None):
+        self._gate("list", kind)
+        return self._store.list(kind, namespace, label_selector=label_selector)
+
+    def update(self, obj, field_manager: str = ""):
+        self._gate("update", obj.KIND)
+        return self._store.update(obj, field_manager=field_manager)
+
+    def update_status(self, obj, field_manager: str = ""):
+        self._gate("update", obj.KIND)
+        return self._store.update_status(obj, field_manager=field_manager)
+
+    def delete(self, kind: str, namespace: str, name: str):
+        self._gate("delete", kind)
+        return self._store.delete(kind, namespace, name)
+
+    # ------------------------------------------------------------ passthrough
+    def __getattr__(self, attr):
+        # subscribe/unsubscribe/seed/name/actions/_lock/... — everything
+        # that is not a remote API verb goes straight through
+        return getattr(self._store, attr)
 
 
 class _History:
@@ -95,6 +238,8 @@ class FakeKubeApiServer:
         # when set, every request must carry `Authorization: Bearer <this>`
         # (exercises the client's auth plumbing, incl. exec plugins)
         self.required_token = required_token
+        # fault-injection rules consulted by every handler (see ChaosHooks)
+        self.chaos = ChaosHooks()
         self.history = _History()
         for plural, typ in _TYPES.items():
             self.store.subscribe(typ.KIND, self._make_recorder(typ.KIND))
@@ -235,6 +380,28 @@ class FakeKubeApiServer:
                 raw = self.rfile.read(length) if length else b"{}"
                 return json.loads(raw or b"{}")
 
+            def _chaos(self, verb: str, kind: str) -> bool:
+                """Apply fault-injection rules; True = request consumed."""
+                rule = server.chaos.intercept(verb, kind)
+                if rule is None:
+                    return False
+                if rule.mode == "drop":
+                    # no response at all: the client sees the connection
+                    # reset mid-request (the rudest real-world failure)
+                    import socket as _socket
+
+                    self.close_connection = True
+                    try:
+                        self.connection.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    return True
+                self._status(
+                    rule.error_code, "ServiceUnavailable",
+                    f"chaos: injected failure ({verb} {kind})",
+                )
+                return True
+
             def _authorized(self) -> bool:
                 """401 unless the request carries the server's bearer token
                 (no-op when the server doesn't require one)."""
@@ -264,7 +431,11 @@ class FakeKubeApiServer:
                 kind, ns, name, _sub = route
                 params = parse_qs(urlparse(self.path).query)
                 if name is None and params.get("watch", ["0"])[0] in ("1", "true"):
+                    if self._chaos("watch", kind):
+                        return
                     self._do_watch(kind, ns, params)
+                    return
+                if self._chaos("list" if name is None else "get", kind):
                     return
                 try:
                     if name is None:
@@ -309,6 +480,8 @@ class FakeKubeApiServer:
                     self._status(404, "NotFound", f"no route {self.path}")
                     return
                 kind, ns, _name, _sub = route
+                if self._chaos("create", kind):
+                    return
                 body = self._read_body()
                 if kind == "__events__":
                     server.events.append(body)
@@ -333,6 +506,8 @@ class FakeKubeApiServer:
                     self._status(404, "NotFound", f"no route {self.path}")
                     return
                 kind, ns, name, sub = route
+                if self._chaos("update", kind):
+                    return
                 body = self._read_body()
                 typ = _BY_KIND[kind]
                 obj = typ.from_dict(body)
@@ -360,6 +535,8 @@ class FakeKubeApiServer:
                     self._status(404, "NotFound", f"no route {self.path}")
                     return
                 kind, ns, name, _sub = route
+                if self._chaos("delete", kind):
+                    return
                 try:
                     server.store.delete(kind, ns, name)
                 except NotFoundError as e:

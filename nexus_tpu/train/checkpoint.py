@@ -9,10 +9,48 @@ shardings and restored onto the same or a different mesh.
 from __future__ import annotations
 
 import importlib
+import json
+import logging
 import os
-from typing import Any, Optional
+import shutil
+from typing import Any, List, Optional
 
 import jax
+
+logger = logging.getLogger("nexus_tpu.checkpoint")
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Latest **durable** checkpoint step under ``directory``, or None.
+
+    Pure filesystem scan — no Orbax import (its transitive deps cost ~30 s
+    cold on this image), so the controller-side failover planner can call
+    it on every confirmed failure. A step counts only when its directory
+    name is purely numeric: Orbax in-progress saves
+    (``<step>.orbax-checkpoint-tmp-<ts>``) and this module's npz staging
+    dirs (``.tmp-<step>-<pid>``) are both excluded, so a save interrupted
+    mid-write can never be offered as a resume point.
+    """
+    if not os.path.isdir(directory):
+        return None
+    steps = [
+        int(entry)
+        for entry in os.listdir(directory)
+        if entry.isdigit() and os.path.isdir(os.path.join(directory, entry))
+    ]
+    return max(steps) if steps else None
+
+
+def all_steps(directory: str) -> List[int]:
+    """Sorted durable steps under ``directory`` (same rules as
+    :func:`latest_step`)."""
+    if not os.path.isdir(directory):
+        return []
+    return sorted(
+        int(entry)
+        for entry in os.listdir(directory)
+        if entry.isdigit() and os.path.isdir(os.path.join(directory, entry))
+    )
 
 
 def _ocp():
@@ -117,3 +155,148 @@ class Checkpointer:
     def close(self):
         self._mgr.wait_until_finished()
         self._mgr.close()
+
+
+class NpzCheckpointer:
+    """Orbax-free checkpointer (``checkpoint.format: npz``) with a
+    **params-only fast path**.
+
+    Layout per step (``<directory>/<step>/``):
+      * ``state.npz``  — every leaf of the full TrainState, flatten order
+      * ``params.npz`` — the params subtree alone
+      * ``meta.json``  — step + leaf counts
+
+    ``restore_params`` reads ``params.npz`` only — unlike the Orbax
+    Standard-handler path (see :meth:`Checkpointer.restore_params`), the
+    optimizer moments are never read, never allocated, never discarded:
+    the params-only save format the 8B-class restore transient called for.
+
+    Durability: each save stages into ``.tmp-<step>-<pid>`` and
+    ``os.rename``s into place, so :func:`latest_step` (numeric-dirs-only)
+    can never observe a partial save. ``keep=N`` GC prunes the oldest
+    durable steps after every successful save.
+
+    Restore targets follow the Orbax convention: pass an abstract tree
+    (concrete state or ``jax.eval_shape`` structs carrying shardings); the
+    restored leaves are cast to its dtypes and re-pinned to its shardings.
+    """
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.keep = int(keep)
+        os.makedirs(self.directory, exist_ok=True)
+
+    # ------------------------------------------------------------------ save
+    def save(self, state, step: Optional[int] = None, wait: bool = False) -> int:
+        import numpy as np
+
+        step = int(state.step) if step is None else int(step)
+        final = os.path.join(self.directory, str(step))
+        if os.path.isdir(final):
+            return step  # already durable (preemption save + final save)
+        staging = os.path.join(
+            self.directory, f".tmp-{step}-{os.getpid()}"
+        )
+        os.makedirs(staging, exist_ok=True)
+        try:
+            leaves = jax.tree_util.tree_leaves(state)
+            np.savez(
+                os.path.join(staging, "state.npz"),
+                **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)},
+            )
+            params = state.params if hasattr(state, "params") else state["params"]
+            p_leaves = jax.tree_util.tree_leaves(params)
+            np.savez(
+                os.path.join(staging, "params.npz"),
+                **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(p_leaves)},
+            )
+            with open(os.path.join(staging, "meta.json"), "w") as f:
+                json.dump(
+                    {"step": step, "leaves": len(leaves),
+                     "param_leaves": len(p_leaves)}, f,
+                )
+            os.rename(staging, final)  # atomic publish: durable or absent
+        except BaseException:
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
+        self._gc()
+        return step
+
+    def _gc(self) -> None:
+        steps = all_steps(self.directory)
+        for stale in steps[: max(len(steps) - self.keep, 0)]:
+            shutil.rmtree(
+                os.path.join(self.directory, str(stale)), ignore_errors=True
+            )
+
+    # --------------------------------------------------------------- restore
+    def latest_step(self) -> Optional[int]:
+        return latest_step(self.directory)
+
+    def all_steps(self) -> List[int]:
+        return all_steps(self.directory)
+
+    def _load(self, archive: str, abstract: Any, step: Optional[int]):
+        import numpy as np
+
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        path = os.path.join(self.directory, str(step), archive)
+        if not os.path.isfile(path):
+            raise FileNotFoundError(f"checkpoint step {step} missing {archive}")
+        ab_leaves, treedef = jax.tree_util.tree_flatten(abstract)
+        with np.load(path) as z:
+            if len(z.files) != len(ab_leaves):
+                raise ValueError(
+                    f"checkpoint {path} holds {len(z.files)} leaves but the "
+                    f"restore target has {len(ab_leaves)} — structure drift "
+                    "(different model/optimizer than the one saved)"
+                )
+            leaves = [
+                jax.numpy.asarray(
+                    z[f"leaf_{i}"], dtype=getattr(ab, "dtype", None)
+                )
+                for i, ab in enumerate(ab_leaves)
+            ]
+        restored = jax.tree_util.tree_unflatten(treedef, leaves)
+        from nexus_tpu.parallel.sharding import repin_tree
+
+        return repin_tree(restored, abstract)
+
+    def restore(self, abstract_state: Any, step: Optional[int] = None):
+        return self._load("state.npz", abstract_state, step)
+
+    def restore_params(self, abstract_params: Any, step: Optional[int] = None):
+        """Params-only restore: reads ``params.npz`` alone — zero optimizer
+        transients."""
+        return self._load("params.npz", abstract_params, step)
+
+    def close(self):
+        pass
+
+
+def detect_format(directory: str) -> str:
+    """Sniff which format wrote ``directory`` (restore paths shouldn't have
+    to be told): a durable step holding ``state.npz`` is npz, anything else
+    is orbax."""
+    step = latest_step(directory)
+    if step is not None and os.path.isfile(
+        os.path.join(directory, str(step), "state.npz")
+    ):
+        return "npz"
+    return "orbax"
+
+
+def make_checkpointer(directory: str, keep: int = 3, fmt: str = "orbax"):
+    """Format-dispatched constructor (``CheckpointSpec.format``): ``orbax``
+    (sharding-aware, async, multi-host — the default) or ``npz`` (dep-free,
+    params-only fast path; the CPU lane / small-model / failover-bench
+    format)."""
+    if fmt == "auto":
+        fmt = detect_format(directory)
+    if fmt == "npz":
+        return NpzCheckpointer(directory, keep=keep)
+    if fmt in ("", "orbax"):
+        return Checkpointer(directory, keep=keep)
+    raise ValueError(f"unknown checkpoint format {fmt!r} (orbax | npz)")

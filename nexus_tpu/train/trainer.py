@@ -210,6 +210,7 @@ class Trainer:
         profile_steps: int = 3,
         cancel=None,
         run_ahead: Optional[int] = None,
+        on_step: Optional[Callable[[int], None]] = None,
     ):
         self.step_fn = step_fn
         self.state = state
@@ -234,6 +235,12 @@ class Trainer:
         # preemption path. The loop stops at the next step boundary and
         # saves a final checkpoint so the requeued job resumes, not restarts.
         self.cancel = cancel
+        # Step-boundary hook (host-side step count, never a device fetch):
+        # the failover heartbeat renews through this — a worker that stops
+        # stepping stops renewing, which is exactly the liveness signal the
+        # controller-side detector judges. Exceptions are swallowed: a
+        # flaky shard API must never take the training loop down with it.
+        self.on_step = on_step
 
     def run(self, num_steps: int, warmup_steps: int = 1) -> TrainerResult:
         metrics: Dict[str, Any] = {}
@@ -275,6 +282,11 @@ class Trainer:
             if len(in_flight) >= self.run_ahead:
                 jax.block_until_ready(in_flight.popleft())
             completed += 1
+            if self.on_step is not None:
+                try:
+                    self.on_step(completed)
+                except Exception:  # noqa: BLE001 — liveness must not kill training
+                    pass
             if "loss" in metrics:
                 losses.append(metrics["loss"])
             if profiling and i + 1 >= self.profile_start + self.profile_steps:

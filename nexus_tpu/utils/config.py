@@ -63,6 +63,23 @@ class AppConfig:
     leader_election_identity: str = ""
     leader_election_lease_duration: float = 15.0
     leader_election_renew_period: float = 5.0
+    # Shard health & job failover (nexus_tpu/ha/, docs/failover.md): when
+    # enabled, the controller probes each shard's heartbeat leases, confirms
+    # worker/shard failures (flap-suppressed deadlines), and re-places
+    # failed workloads on healthy shards resuming from the latest durable
+    # checkpoint. TTL is the worker renew deadline; a failure is confirmed
+    # after `failover_suspect_misses` whole TTL windows of silence (so one
+    # missed renewal never migrates a job), or
+    # `failover_api_failure_threshold` consecutive probe errors for a shard
+    # API outage (probing backs off exponentially up to
+    # `failover_backoff_max_seconds` while it lasts).
+    failover_enabled: bool = False
+    heartbeat_ttl_seconds: float = 15.0
+    failover_probe_interval_seconds: float = 5.0
+    failover_suspect_misses: int = 2
+    failover_api_failure_threshold: int = 3
+    failover_backoff_max_seconds: float = 60.0
+    failover_recovery_probes: int = 2
 
 
 def _coerce(value: Any, target_type: Any) -> Any:

@@ -16,8 +16,17 @@ import threading
 class CancelToken:
     def __init__(self):
         self._event = threading.Event()
+        # hard=True models an ungraceful kill (chaos "kill worker", a node
+        # vanishing mid-step): the run stops at the next step boundary but
+        # the graceful-shutdown courtesies — final checkpoint, heartbeat
+        # completion marker — are SKIPPED, so failover recovery starts from
+        # the last interval checkpoint, exactly like a real preemption
+        # without a SIGTERM grace window.
+        self.hard = False
 
-    def cancel(self) -> None:
+    def cancel(self, hard: bool = False) -> None:
+        if hard:
+            self.hard = True
         self._event.set()
 
     def cancelled(self) -> bool:

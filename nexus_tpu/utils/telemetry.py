@@ -36,6 +36,15 @@ METRIC_SHARD_SYNC_LATENCY = "shard_sync_latency"
 # observed Running, per template + rolling p50 across templates.
 METRIC_TEMPLATE_TO_RUNNING = "template_to_running_seconds"
 METRIC_TEMPLATE_TO_RUNNING_P50 = "template_to_running_p50"
+# Failover subsystem gauges (nexus_tpu/ha/): per-shard health as seen by
+# the failure detector, cumulative confirmed failovers, seconds from first
+# missed deadline (or first API error) to confirmation, and training steps
+# between the failed worker's last heartbeat and the checkpoint the
+# re-placed job resumed from.
+METRIC_SHARD_HEALTHY = "shard_healthy"
+METRIC_FAILOVERS_TOTAL = "failovers_total"
+METRIC_FAILOVER_DETECTION_SECONDS = "failover_detection_seconds"
+METRIC_FAILOVER_STEPS_LOST = "failover_steps_lost"
 
 
 def configure_logger(

@@ -32,7 +32,11 @@ def test_examples_load_validate_and_materialize():
         kind = doc.get("kind")
         if kind == NexusAlgorithmWorkgroup.KIND:
             wg = NexusAlgorithmWorkgroup.from_dict(doc)
-            assert wg.spec.cluster, path
+            # a workgroup example must constrain placement somehow: a
+            # pinned cluster or a capability set (the failover example
+            # single-homes over a capability-matched pool)
+            assert wg.spec.cluster or wg.spec.capabilities, path
+            assert wg.spec.scheduling in ("all", "any"), path
             continue
         assert kind == NexusAlgorithmTemplate.KIND, (path, kind)
         tmpl = NexusAlgorithmTemplate.from_dict(doc)
@@ -48,4 +52,4 @@ def test_examples_load_validate_and_materialize():
             assert res["limits"]["google.com/tpu"] == str(rt.tpu.chips_per_host)
         svcs = materialize_headless_service(tmpl)
         assert len(svcs) == rt.tpu.slice_count, path
-    assert templates == 8
+    assert templates == 9

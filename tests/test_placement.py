@@ -21,7 +21,7 @@ from nexus_tpu.api.workgroup import (
 )
 from nexus_tpu.cluster.store import ClusterStore
 from nexus_tpu.controller.controller import Controller, SyncError
-from nexus_tpu.controller.events import REASON_ERR_RESOURCE_SYNC, FakeRecorder
+from nexus_tpu.controller.events import REASON_ERR_PLACEMENT, FakeRecorder
 from nexus_tpu.controller.placement import PlacementError, select_shards
 from nexus_tpu.shards.shard import Shard
 from nexus_tpu.utils.telemetry import StatsdClient
@@ -255,6 +255,16 @@ def test_unsatisfiable_placement_errors_and_requeues():
     with pytest.raises(SyncError):
         f.controller.template_sync_handler(NS, "algo-g")
     assert f.placed_on("algo-g") == []
+    # a distinct ErrPlacement event (not the generic sync error) ...
     assert any(
-        e.reason == REASON_ERR_RESOURCE_SYNC for e in f.recorder.events
+        e.reason == REASON_ERR_PLACEMENT for e in f.recorder.events
     ), f.recorder.events
+    # ... AND a Ready=False status condition carrying the reason, so the
+    # template itself answers "why is this not running"
+    from nexus_tpu.api.template import NexusAlgorithmTemplate
+
+    stored = f.controller_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-g")
+    cond = stored.status.conditions[0]
+    assert cond.status == "False"
+    assert "Placement failed" in cond.message
+    assert "gone-pool" in cond.message

@@ -1,0 +1,301 @@
+"""Hermetic failover bench: time-to-recover p50 (CPU-only, no TPU).
+
+Measures the whole failover pipeline through the REAL control plane —
+heartbeat leases → flap-suppressed failure detector → planner (restore-step
+annotation, dead-Job reap, sticky-home eviction) → placement re-run →
+re-materialized Job on a healthy shard — with simulated workers standing in
+for TPU pods (they renew leases, write real npz checkpoints, and honor the
+``NEXUS_RESTORE_STEP`` env the materializer stamps, so the annotation →
+env → resume plumbing is exercised end to end; the *training* side of
+resume is proven by tests/test_failover.py with a real mlp run).
+
+Per trial: kill the worker on its home shard (hard — no final checkpoint,
+no done-marker), then clock until a worker is running *on a different
+shard* with the correct restore step.
+
+  time_to_recover = detection (missed deadlines → confirmation)
+                  + re-place   (planner + reconcile + Job create)
+                  + resume     (worker start at the restored step)
+
+Prints ONE JSON line: {"metric": "failover_time_to_recover_p50_s", ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class SimWorker(threading.Thread):
+    """A TPU-pod stand-in bound to one materialized Job: marks it Running,
+    resumes from NEXUS_RESTORE_STEP (or the latest durable checkpoint),
+    then steps at a fixed rate — renewing its heartbeat lease through the
+    shard store and writing an npz checkpoint every ``ckpt_interval``
+    steps. ``kill()`` stops everything silently (no final checkpoint, no
+    done-marker): the failure the detector must confirm."""
+
+    def __init__(self, store, job, ckpt_dir: str, ttl: float,
+                 steps_per_sec: float, ckpt_interval: int):
+        super().__init__(daemon=True, name=f"sim-worker-{store.name}")
+        from nexus_tpu.ha.lease import LeaseRenewer
+        from nexus_tpu.runtime.materializer import LABEL_TEMPLATE
+        from nexus_tpu.train.checkpoint import NpzCheckpointer, latest_step
+
+        self.store = store
+        self.job = job
+        self.template = (job.metadata.labels or {}).get(LABEL_TEMPLATE, "")
+        self.namespace = job.metadata.namespace
+        self.ckpt = NpzCheckpointer(ckpt_dir, keep=3)
+        env = {
+            e.get("name"): e.get("value", "")
+            for e in job.spec["template"]["spec"]["containers"][0]["env"]
+        }
+        if env.get("NEXUS_RESTORE_STEP", ""):
+            self.resume_step = int(env["NEXUS_RESTORE_STEP"])
+        else:
+            self.resume_step = latest_step(ckpt_dir) or 0
+        self.step = self.resume_step
+        self.steps_per_sec = steps_per_sec
+        self.ckpt_interval = ckpt_interval
+        self.renewer = LeaseRenewer(
+            store, self.namespace, self.template,
+            holder=f"sim-{store.name}", ttl_seconds=ttl,
+        )
+        self._killed = threading.Event()
+        self.running = threading.Event()
+
+    def kill(self) -> None:
+        self._killed.set()
+
+    def run(self) -> None:
+        import numpy as np
+
+        self._mark_running()
+        self.running.set()
+        tick = 1.0 / self.steps_per_sec
+        state = {"params": {"w": np.zeros(8, dtype=np.float32)},
+                 "opt": np.zeros(8, dtype=np.float32)}
+        while not self._killed.wait(tick):
+            self.step += 1
+            self.renewer.renew(self.step)
+            if self.step % self.ckpt_interval == 0:
+                self.ckpt.save(state, step=self.step)
+
+    def _mark_running(self) -> None:
+        from datetime import datetime, timezone
+
+        from nexus_tpu.api.workload import Job
+
+        try:
+            job = self.store.get(Job.KIND, self.namespace,
+                                 self.job.metadata.name)
+            job.status.active = 1
+            job.status.ready = 1
+            job.status.start_time = datetime.now(timezone.utc).isoformat()
+            self.store.update_status(job)
+        except Exception:  # noqa: BLE001 — raced the reconciler; harmless
+            pass
+
+
+def _make_template(name: str, ns: str, ckpt_dir: str):
+    from nexus_tpu.api.runtime_spec import (
+        CheckpointSpec,
+        JaxXlaRuntime,
+        ModelRef,
+        ParallelismSpec,
+        TpuSliceSpec,
+        TrainSpec,
+    )
+    from nexus_tpu.api.template import (
+        Container,
+        NexusAlgorithmSpec,
+        NexusAlgorithmTemplate,
+        RuntimeEnvironment,
+        WorkgroupRef,
+    )
+    from nexus_tpu.api.types import ObjectMeta
+
+    tmpl = NexusAlgorithmTemplate(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=NexusAlgorithmSpec(
+            container=Container(
+                image="algo", registry="ghcr.io/bench", version_tag="v1",
+            ),
+            workgroup_ref=WorkgroupRef(name="wg-failover"),
+            runtime_environment=RuntimeEnvironment(),
+        ),
+    )
+    tmpl.spec.runtime = JaxXlaRuntime(
+        mode="train",
+        model=ModelRef(family="mlp", preset="tiny"),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1", slice_count=1),
+        parallelism=ParallelismSpec(),
+        train=TrainSpec(batch_size=8, steps=10_000),
+        checkpoint=CheckpointSpec(
+            enabled=True, directory=ckpt_dir, format="npz",
+        ),
+    )
+    return tmpl
+
+
+def run_bench(n_trials: int = 5, ttl: float = 0.5, probe: float = 0.1,
+              steps_per_sec: float = 200.0, ckpt_interval: int = 50,
+              timeout_s: float = 30.0) -> dict:
+    import tempfile
+
+    from nexus_tpu.api.workgroup import (
+        NexusAlgorithmWorkgroup,
+        NexusAlgorithmWorkgroupSpec,
+    )
+    from nexus_tpu.api.workload import Job
+    from nexus_tpu.api.types import ObjectMeta
+    from nexus_tpu.cluster.store import ClusterStore
+    from nexus_tpu.controller.controller import Controller
+    from nexus_tpu.ha.failover import FailoverConfig
+    from nexus_tpu.shards.shard import Shard
+    from nexus_tpu.utils.telemetry import (
+        METRIC_FAILOVER_DETECTION_SECONDS,
+        StatsdClient,
+    )
+
+    ns = "nexus-failover-bench"
+    ckpt_dir = tempfile.mkdtemp(prefix="nexus_failover_bench_")
+    ctrl_store = ClusterStore("controller")
+    shard_stores = [ClusterStore("shard0"), ClusterStore("shard1")]
+    shards = [Shard("bench", s.name, s) for s in shard_stores]
+    statsd = StatsdClient("bench")
+    controller = Controller(
+        ctrl_store, shards, statsd=statsd, resync_period=5.0,
+        failover=FailoverConfig(
+            heartbeat_ttl=ttl, probe_interval=probe,
+            suspect_misses=2, api_failure_threshold=3,
+        ),
+    )
+
+    workers: dict = {}  # shard name -> SimWorker
+    workers_lock = threading.Lock()
+
+    def watch_jobs(store):
+        def on_event(ev):
+            if ev.type != "ADDED":
+                return
+            w = SimWorker(store, ev.obj, ckpt_dir, ttl,
+                          steps_per_sec, ckpt_interval)
+            with workers_lock:
+                workers[store.name] = w
+            w.start()
+
+        store.subscribe(Job.KIND, on_event)
+
+    for s in shard_stores:
+        watch_jobs(s)
+
+    def wait_for_worker(exclude: str = "", timeout: float = 30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with workers_lock:
+                for name, w in workers.items():
+                    if name != exclude and w.running.is_set() and not w._killed.is_set():
+                        return w
+            time.sleep(0.01)
+        return None
+
+    result: dict = {"metric": "failover_time_to_recover_p50_s"}
+    recover_s, steps_lost, failed = [], [], 0
+    try:
+        controller.run(workers=2)
+        ctrl_store.create(NexusAlgorithmWorkgroup(
+            metadata=ObjectMeta(name="wg-failover", namespace=ns),
+            spec=NexusAlgorithmWorkgroupSpec(scheduling="any"),
+        ))
+        ctrl_store.create(_make_template("failover-bench", ns, ckpt_dir))
+
+        current = wait_for_worker(timeout=timeout_s)
+        if current is None:
+            return {**result, "error": "initial placement never ran a worker"}
+        for _ in range(n_trials):
+            # let the worker make progress past at least one durable save
+            target = current.step + ckpt_interval + ckpt_interval // 2
+            deadline = time.monotonic() + timeout_s
+            while current.step < target and time.monotonic() < deadline:
+                time.sleep(0.01)
+            kill_step = current.step
+            died_on = current.store.name
+            t_kill = time.monotonic()
+            current.kill()
+            nxt = wait_for_worker(exclude=died_on, timeout=timeout_s)
+            if nxt is None:
+                failed += 1
+                break
+            recover_s.append(time.monotonic() - t_kill)
+            steps_lost.append(max(kill_step - nxt.resume_step, 0))
+            current = nxt
+        if not recover_s:
+            return {**result, "error": "no trial recovered", "failed": failed}
+        import math
+
+        recover_s.sort()
+        p = lambda q: recover_s[max(0, math.ceil(q * len(recover_s)) - 1)]  # noqa: E731
+        with statsd._lock:
+            detections = sorted(
+                v for (name, v, _t) in statsd.history
+                if name == f"bench.{METRIC_FAILOVER_DETECTION_SECONDS}"
+            )
+        result.update({
+            "value": round(p(0.50), 4),
+            "unit": "seconds",
+            "p90_s": round(p(0.90), 4),
+            "max_s": round(recover_s[-1], 4),
+            "n_trials": len(recover_s),
+            "failed_trials": failed,
+            "detection_p50_s": round(
+                detections[len(detections) // 2], 4
+            ) if detections else None,
+            "replace_resume_p50_s": round(
+                p(0.50) - detections[len(detections) // 2], 4
+            ) if detections else None,
+            "failover_steps_lost_mean": round(
+                sum(steps_lost) / len(steps_lost), 2
+            ),
+            "heartbeat_ttl_s": ttl,
+            "probe_interval_s": probe,
+            "ckpt_interval_steps": ckpt_interval,
+            "steps_per_sec": steps_per_sec,
+            "failovers_total": controller.failover_manager.failovers_total,
+        })
+        return result
+    finally:
+        with workers_lock:
+            for w in workers.values():
+                w.kill()
+        try:
+            controller.stop()
+        except Exception:  # noqa: BLE001 — best-effort teardown
+            pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--trials", type=int, default=5)
+    ap.add_argument("--ttl", type=float, default=0.5,
+                    help="heartbeat TTL seconds (bench-scaled; prod 15)")
+    ap.add_argument("--probe", type=float, default=0.1,
+                    help="detector probe interval seconds (prod 5)")
+    ap.add_argument("--steps-per-sec", type=float, default=200.0)
+    ap.add_argument("--ckpt-interval", type=int, default=50)
+    ap.add_argument("--timeout", type=float, default=30.0)
+    args = ap.parse_args(argv)
+    result = run_bench(args.trials, args.ttl, args.probe,
+                       args.steps_per_sec, args.ckpt_interval, args.timeout)
+    print(json.dumps(result), flush=True)
+    return 0 if "value" in result else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,10 @@ EXCLUDE = [
 # files whose coverage IS load-bearing despite matching an exclusion
 FORCE_INCLUDE = [
     r"nexus_tpu/native/__init__\.py$",  # the ctypes binding layer
+    # the failover subsystem's package surface: every module under
+    # nexus_tpu/ha/ is gated per-file like any other, and the package
+    # __init__ re-export shim is gated too so a broken export can't hide
+    r"nexus_tpu/ha/__init__\.py$",
 ]
 
 
