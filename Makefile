@@ -3,7 +3,7 @@ SHELL := /bin/bash
 NATIVE_SRC := nexus_tpu/native/src/nexus_core.cpp nexus_tpu/native/src/nexus_data.cpp
 NATIVE_LIB := nexus_tpu/native/libnexus_core.so
 
-.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-failover chaos-smoke race-smoke clean lint
+.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-failover chaos-smoke serve-smoke race-smoke clean lint
 
 all: native
 
@@ -53,6 +53,13 @@ bench-failover:
 # the end-to-end kill → resume-on-second-shard path.
 chaos-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_failover.py -q
+
+# Serving smoke (fast lane): allocator/prefix-cache invariants and the
+# engine's sharing/CoW/eviction scheduling on tiny rows/blocks/prefix
+# configs — stub-model driven, seconds on CPU (the llama-backed parity
+# tiers stay in test_serving.py's compile-bound lane).
+serve-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_paged_kv.py tests/test_prefix_cache.py tests/test_property_prefix_cache.py -q
 
 # Thread-safety smoke for the store/informer/lister under parallel fan-out.
 race-smoke:

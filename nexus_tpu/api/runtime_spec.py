@@ -463,6 +463,19 @@ class ServeSpec:
     # per-request envelope (kv_pool_blocks below), which is what makes
     # admission HBM-aware instead of slot-count-based
     kv_num_blocks: int = 0
+    # cross-request KV reuse (runtime/prefix_cache.py): admission matches
+    # each prompt's longest cached full-block prefix, maps those blocks
+    # shared (ref-counted; copy-on-write on a full-prompt hit) and starts
+    # chunked prefill past them — the prefill compute AND the K/V writes
+    # for the shared region are skipped. Results are token-for-token
+    # identical either way (sharing is scheduling, never semantics).
+    # Inert on the dense layout (kvBlockSize = 0).
+    prefix_cache: bool = True
+    # synthetic queue: the first min(sharedPrefixLength, p-1) tokens of
+    # every prompt are ONE common preamble (system-prompt shape) drawn
+    # once from the seed — the shared-prefix bench leg's workload knob.
+    # 0 = fully independent random prompts (the PR 2 behavior).
+    shared_prefix_length: int = 0
 
     def kv_request_cap(self, max_seq_len: int) -> int:
         """Worst-case cache positions ONE synthetic-queue request can
@@ -483,10 +496,16 @@ class ServeSpec:
         the engine's scratch block): the explicit ``kvNumBlocks`` when
         set, else the queue envelope — ``rows`` requests at the WORST
         per-request need (kv_request_cap), never more than the
-        dense-equivalent capacity. The ONE sizing formula shared by the
-        HBM gate (hbm_budget_gb) and the serve entrypoint, so validation
-        and the engine's actual pool can never diverge. 0 when the spec
-        runs the dense layout."""
+        dense-equivalent capacity. With the prefix cache on and a
+        declared shared preamble, the envelope ACCOUNTS FOR SHARING: the
+        preamble's full blocks are resident once, not per row, so every
+        row past the first is priced at its private tail only — sized by
+        the GUARANTEED match (min(sharedPrefixLength, pmin-1) full
+        blocks: a shorter prompt shares less but also needs less), so
+        admission can always place the declared concurrency. The ONE
+        sizing formula shared by the HBM gate (hbm_budget_gb) and the
+        serve entrypoint, so validation and the engine's actual pool can
+        never diverge. 0 when the spec runs the dense layout."""
         bs = self.kv_block_size
         if bs <= 0:
             return 0
@@ -499,7 +518,13 @@ class ServeSpec:
             # engine's lazy growth keeps residency at actual lengths)
             return dense_blocks
         cap = self.kv_request_cap(max_seq_len)
-        return min(dense_blocks, rows * (-(-cap // bs)))
+        pool = rows * (-(-cap // bs))
+        if self.prefix_cache and self.shared_prefix_length > 0:
+            pmax = min(self.prompt_length_max, max_seq_len // 2)
+            pmin = max(1, min(self.prompt_length_min, pmax))
+            shared_blk = min(self.shared_prefix_length, pmin - 1) // bs
+            pool -= (rows - 1) * shared_blk
+        return min(dense_blocks, pool)
 
     def serve_slack(self) -> int:
         """Worst-case per-dispatch cache overrun the engine budgets for —
@@ -534,6 +559,10 @@ class ServeSpec:
             d["kvBlockSize"] = self.kv_block_size
         if self.kv_num_blocks:
             d["kvNumBlocks"] = self.kv_num_blocks
+        if not self.prefix_cache:
+            d["prefixCache"] = False
+        if self.shared_prefix_length:
+            d["sharedPrefixLength"] = self.shared_prefix_length
         return d
 
     @classmethod
@@ -545,6 +574,12 @@ class ServeSpec:
                 32 if d.get("kvBlockSize") is None else d["kvBlockSize"]
             ),
             kv_num_blocks=int(d.get("kvNumBlocks", 0) or 0),
+            # NOT `or True`: prefixCache=false (the A/B baseline) must
+            # survive the roundtrip
+            prefix_cache=bool(
+                True if d.get("prefixCache") is None else d["prefixCache"]
+            ),
+            shared_prefix_length=int(d.get("sharedPrefixLength", 0) or 0),
             num_requests=int(d.get("numRequests", 32) or 32),
             prompt_length_min=int(d.get("promptLengthMin", 16) or 16),
             prompt_length_max=int(d.get("promptLengthMax", 128) or 128),
@@ -990,6 +1025,17 @@ class JaxXlaRuntime:
                 errs.append(
                     "serve.kvNumBlocks requires kvBlockSize > 0 (a dense "
                     "cache has no block pool to size)"
+                )
+            if sv.shared_prefix_length < 0:
+                errs.append(
+                    "serve.sharedPrefixLength must be >= 0, got "
+                    f"{sv.shared_prefix_length}"
+                )
+            if sv.shared_prefix_length > 0 and sv.prompts:
+                errs.append(
+                    "serve.sharedPrefixLength shapes the SYNTHETIC "
+                    "queue; a literal prompts queue carries its own "
+                    "shared prefixes in the text"
                 )
             if sv.temperature < 0:
                 errs.append(

@@ -126,6 +126,28 @@ def init_paged_kv_cache(
     return cache
 
 
+def copy_kv_blocks(
+    cache: Dict[str, Any], src: jnp.ndarray, dst: jnp.ndarray
+) -> Dict[str, Any]:
+    """Copy pool blocks ``src[i] -> dst[i]`` across every K/V plane of a
+    PAGED cache (k, v, and the int8 scale planes when present) — the
+    device half of the serving engine's copy-on-write: when an admitted
+    row's prefix match ends inside a block (a full-prompt hit recomputes
+    only the last position), the frozen cached block is copied into the
+    row's private block and the row writes into the COPY, so no block
+    another row reads is ever mutated. Pairs with an out-of-range ``dst``
+    are dropped (fixed-width dispatch padding); everything else in the
+    cache (tables, lengths) passes through untouched."""
+    cache = dict(cache)
+    for key in ("k", "v", "k_scale", "v_scale"):
+        if key in cache:
+            buf = cache[key]
+            # gather the source blocks then scatter at dst; OOB dst
+            # drops (padding), OOB src clamps but its result is dropped
+            cache[key] = buf.at[:, dst].set(buf[:, src], mode="drop")
+    return cache
+
+
 def generic_forward_decode(
     params: Dict[str, Any],
     cfg: Any,

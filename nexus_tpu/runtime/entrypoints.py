@@ -816,7 +816,11 @@ def _run_serve(runtime, family, cfg, mesh):
         )
     import numpy as _np
 
-    from nexus_tpu.runtime.serving import ServeRequest, ServingEngine
+    from nexus_tpu.runtime.serving import (
+        ServeRequest,
+        ServingEngine,
+        percentile_nearest_rank,
+    )
 
     sv = runtime.serve
     tr = runtime.train
@@ -863,13 +867,28 @@ def _run_serve(runtime, family, cfg, mesh):
                     seed=i,
                 ))
         else:
+            # sharedPrefixLength: one common preamble (system-prompt
+            # shape), drawn once, heads every synthetic prompt — the
+            # shared-prefix serving workload the prefix cache dedupes.
+            # Drawn FIRST so the 0-length default consumes exactly the
+            # rng stream the PR 2 queue did (deterministic replays).
+            common = None
+            if sv.shared_prefix_length > 0:
+                common = rng.randint(
+                    0, cfg.vocab_size,
+                    size=min(sv.shared_prefix_length, max(0, pmax - 1)),
+                ).astype(_np.int32)
             for _ in range(sv.num_requests):
                 p = int(rng.randint(pmin, pmax + 1))
                 n = int(rng.randint(sv.max_new_min, sv.max_new_max + 1))
+                ids = rng.randint(
+                    0, cfg.vocab_size, size=p
+                ).astype(_np.int32)
+                if common is not None:
+                    s = min(len(common), p - 1)
+                    ids[:s] = common[:s]
                 requests.append(ServeRequest(
-                    prompt=rng.randint(
-                        0, cfg.vocab_size, size=p
-                    ).astype(_np.int32).tolist(),
+                    prompt=ids.tolist(),
                     max_new_tokens=n,
                     temperature=sv.temperature,
                     seed=len(requests),  # per-request stream, deterministic
@@ -916,11 +935,13 @@ def _run_serve(runtime, family, cfg, mesh):
             kv_num_blocks=sv.kv_pool_blocks(
                 tr.batch_size, cfg.max_seq_len
             ),
+            prefix_cache=sv.prefix_cache,
         )
         results, metrics = engine.serve(requests)
     finished = sum(1 for r in results if r is not None)
     latencies = sorted(r.latency_s for r in results if r is not None)
     p50 = latencies[len(latencies) // 2] if latencies else 0.0
+    p95 = percentile_nearest_rank(latencies, 0.95)
     text_extra = {}
     if tokenizer is not None:
         text_extra = {"completions": [
@@ -941,6 +962,7 @@ def _run_serve(runtime, family, cfg, mesh):
         "restored_step": restored_step,
         "finished_requests": finished,
         "request_latency_p50_s": round(p50, 4),
+        "request_latency_p95_s": round(p95, 4),
         "batch_rows": tr.batch_size,
         "n_devices": mesh.devices.size,
     }

@@ -1,0 +1,143 @@
+"""Content-addressed prefix index for the paged serving KV cache.
+
+Cross-request KV reuse (round 6): heavy serving queues are dominated by
+shared prompt prefixes — system prompts, few-shot preambles, multi-turn
+histories — and the paged block pool (runtime/serving.py) already stores
+K/V at block granularity, so a block whose positions hold the K/V of a
+known token prefix can back ANY row whose prompt starts with those
+tokens. This module is the host-side content index that makes blocks
+addressable by what they contain:
+
+  * ``chain_keys`` maps a prompt to one SHA-256 hash-chain digest per
+    FULL block (digest j commits to every token of blocks 0..j, so key
+    equality implies whole-prefix equality — the prefix property radix
+    trees encode structurally, here as a flat dict);
+  * ``PrefixCacheIndex`` maps digest → pool block id for blocks whose
+    K/V has been fully written, and keeps the refcount-0 subset in LRU
+    order so the allocator can reclaim cold cached content under pool
+    pressure — and ONLY then (eviction never touches a referenced
+    block; the ref-counted BlockAllocator in runtime/serving.py owns
+    the refcounts, this index owns content identity and LRU order).
+
+The K/V of prompt position i is a function of tokens 0..i alone, and the
+serving engine writes each prompt position exactly once (chunked prefill
+is append-only; done-row holding writes land past the prompt), so an
+indexed block is FROZEN — sharing it is pure bookkeeping and the
+engine's exactness contract carries over unchanged (tested:
+tests/test_prefix_cache.py, tests/test_serving.py)."""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def chain_keys(
+    tokens: Sequence[int], block_size: int, limit: Optional[int] = None
+) -> List[bytes]:
+    """Hash-chain digests of the FULL ``block_size``-token blocks of
+    ``tokens``: ``key[j] = sha256(key[j-1] || tokens[j*bs:(j+1)*bs])``.
+
+    Chaining makes each key commit to the whole prefix through its
+    block, so a flat dict lookup per block walks the same structure a
+    radix tree would — and two prompts share key j iff they agree on
+    every token of blocks 0..j. The trailing partial block (if any) is
+    never keyed: only fully-written blocks are shareable. SHA-256, not
+    ``hash()``: a collision would silently serve one request another
+    request's K/V, so the digest must be cryptographic."""
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    arr = np.asarray(tokens, dtype=np.int32)
+    n = arr.shape[0] // block_size
+    if limit is not None:
+        n = min(n, int(limit))
+    keys: List[bytes] = []
+    h = b""
+    for j in range(n):
+        blk = arr[j * block_size : (j + 1) * block_size]
+        h = hashlib.sha256(h + blk.tobytes()).digest()
+        keys.append(h)
+    return keys
+
+
+class PrefixCacheIndex:
+    """digest → pool block id, plus the LRU set of refcount-0 holders.
+
+    A block is in exactly one of three states from the allocator's view:
+    referenced (mapped by >= 1 row), PARKED (refcount 0 but content
+    retained here, LRU-evictable), or free (not indexed, on the free
+    list). This class tracks the digest mapping for every indexed block
+    and the parked subset in least-recently-used order; the allocator
+    drives the transitions (``park`` on last release, ``unpark`` on a
+    shared re-admission, ``evict_lru`` under pool pressure)."""
+
+    def __init__(self) -> None:
+        self._by_key: Dict[bytes, int] = {}
+        self._by_block: Dict[int, bytes] = {}
+        # refcount-0 indexed blocks, insertion order == LRU → MRU
+        self._parked: "OrderedDict[int, None]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._by_key)
+
+    @property
+    def parked_count(self) -> int:
+        return len(self._parked)
+
+    def put(self, key: bytes, block: int) -> bool:
+        """Publish ``block`` as the holder of ``key``'s content. No-op
+        (False) when the key is already indexed — first writer wins and
+        the duplicate block stays a plain private block — or when the
+        block already holds another key (one identity per block)."""
+        if key in self._by_key or block in self._by_block:
+            return False
+        self._by_key[key] = block
+        self._by_block[block] = key
+        return True
+
+    def match(self, keys: Sequence[bytes]) -> List[int]:
+        """Longest indexed prefix of ``keys`` → the blocks holding it.
+        Stops at the first miss: a chain broken by eviction can never
+        resume mid-prefix (the orphaned descendants simply age out)."""
+        blocks: List[int] = []
+        for key in keys:
+            blk = self._by_key.get(key)
+            if blk is None:
+                break
+            blocks.append(blk)
+        return blocks
+
+    def holds(self, block: int) -> bool:
+        return block in self._by_block
+
+    def park(self, block: int) -> None:
+        """Last reference dropped: retain the content, join the LRU tail
+        (most recently used end — it was just in service)."""
+        if block not in self._by_block:
+            raise ValueError(f"block {block} is not indexed")
+        self._parked[block] = None
+        self._parked.move_to_end(block)
+
+    def unpark(self, block: int) -> None:
+        """A parked block is being re-referenced (shared admission)."""
+        self._parked.pop(block, None)
+
+    def evict_lru(self) -> int:
+        """Reclaim the least-recently-used PARKED block: drop its digest
+        so it can never match again, return it for reallocation. Only
+        refcount-0 blocks are ever parked, so eviction can never touch a
+        block some row still reads — the allocator calls this only when
+        its free list is empty (pool pressure)."""
+        if not self._parked:
+            raise RuntimeError(
+                "no evictable cached blocks (every indexed block is "
+                "referenced) — the allocator's admission gate should "
+                "have refused before reaching here"
+            )
+        block, _ = self._parked.popitem(last=False)
+        key = self._by_block.pop(block)
+        del self._by_key[key]
+        return block

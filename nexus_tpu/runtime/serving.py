@@ -19,6 +19,13 @@ CHUNKED PREFILL:
     headroom; eviction-free by construction), so admitted residency
     tracks actual sequence lengths instead of batch × max_len worst
     cases. One compiled program still serves every table state;
+  * blocks are SHARED ACROSS REQUESTS (prefix cache, round 6): the
+    allocator is ref-counted and carries a content index of full-block
+    hash chains (runtime/prefix_cache.py), admission matches each
+    prompt's longest cached prefix and starts chunked prefill past it
+    (skipping the shared region's compute AND K/V writes), full-prompt
+    hits copy-on-write the tail block, and released blocks park
+    (refcount 0, LRU) for future hits until pool pressure evicts them;
   * prompts are NOT prefilled in a separate dispatch. Admission writes
     the prompt into a per-row token buffer (one tiny scatter), and the
     decode chunk program itself streams it through the model at
@@ -65,6 +72,7 @@ the bucketed-prefill design).
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence
 
@@ -75,29 +83,45 @@ from jax import lax
 
 from nexus_tpu.models.decoding import (
     constrain_kv_sharding,
+    copy_kv_blocks,
     init_kv_cache,
     init_paged_kv_cache,
 )
+from nexus_tpu.runtime.prefix_cache import PrefixCacheIndex, chain_keys
 
 
 class BlockAllocator:
-    """Host-side free-list allocator over the paged KV block pool.
+    """Host-side REF-COUNTED free-list allocator over the paged KV pool.
 
-    Reservation-based and EVICTION-FREE: ``admit`` succeeds only when the
-    pool can promise a row's whole worst-case block count up front (its
-    prompt plus its trimmed decode budget plus the dispatch slack — the
-    refundable headroom), so an admitted row can ALWAYS grow to its cap
-    without evicting anyone. Physical blocks are drawn lazily against
-    that reservation (``_BlockLease.grow_to``, once per dispatch), so
-    pool RESIDENCY tracks actual sequence lengths; the headroom a row
-    never materializes — and everything it did — returns to the pool at
-    ``release`` (stop-token finishes refund their unused budget).
+    Reservation-based and EVICTION-FREE for admitted rows: ``admit``
+    succeeds only when the pool can promise a row's whole worst-case
+    PRIVATE block count up front (its prompt past any shared prefix plus
+    its trimmed decode budget plus the dispatch slack — the refundable
+    headroom), so an admitted row can ALWAYS grow to its cap without
+    touching anyone else's blocks. Physical blocks are drawn lazily
+    against that reservation (``_BlockLease.grow_to``, once per
+    dispatch), so pool RESIDENCY tracks actual sequence lengths; the
+    headroom a row never materializes — and everything it did — returns
+    at ``release`` (stop-token finishes refund their unused budget).
 
-    Invariant: ``len(_free) >= _reserved`` at all times (admission gates
-    on ``available_blocks``), which is exactly why an in-reservation
-    ``grow_to`` can never fail mid-generation."""
+    Round 6 adds CROSS-REQUEST SHARING: every mapped block carries a
+    refcount (one per lease mapping it), and an optional content index
+    (``prefix_index``, runtime/prefix_cache.py) lets admission map
+    already-written prompt blocks into a new row instead of reserving
+    fresh ones (``match_prefix`` → ``admit(shared=...)``). A released
+    block whose content is indexed is PARKED (refcount 0, LRU) rather
+    than freed; parked blocks are reclaimed lazily — LRU-first, and only
+    under pool pressure (the free list running dry mid-``grow_to``) —
+    so cached prefixes survive exactly as long as the pool has room.
 
-    def __init__(self, num_blocks: int, block_size: int):
+    Invariant: ``len(_free) + parked >= _reserved`` at all times
+    (admission gates on ``available_blocks`` and counts the parked
+    blocks it revives), which is why an in-reservation ``grow_to`` can
+    never fail mid-generation and eviction can only ever see
+    refcount-0 blocks."""
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 prefix_index: Optional[PrefixCacheIndex] = None):
         if num_blocks < 1:
             raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
         if block_size < 1:
@@ -106,8 +130,11 @@ class BlockAllocator:
         self.block_size = int(block_size)
         # pop() from the tail → blocks hand out in ascending id order
         self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._ref = [0] * self.num_blocks  # leases mapping each block
         self._reserved = 0  # promised to admitted rows, not yet allocated
+        self.index = prefix_index
         self.peak_allocated = 0
+        self.evictions = 0
 
     def blocks_for(self, positions: int) -> int:
         """Blocks covering ``positions`` cache slots."""
@@ -118,63 +145,151 @@ class BlockAllocator:
         return len(self._free)
 
     @property
+    def cached_blocks(self) -> int:
+        """Parked blocks: refcount 0, content indexed, LRU-evictable."""
+        return self.index.parked_count if self.index is not None else 0
+
+    @property
     def allocated_blocks(self) -> int:
-        return self.num_blocks - len(self._free)
+        """Blocks some row actually maps (excludes parked cache — parked
+        content is reclaimable, so it isn't residency a request holds)."""
+        return self.num_blocks - len(self._free) - self.cached_blocks
 
     @property
     def available_blocks(self) -> int:
-        """Blocks admissible to NEW rows (free minus outstanding
-        reservations — the admission gate's currency)."""
-        return len(self._free) - self._reserved
+        """Blocks admissible to NEW rows (free plus evictable-cached,
+        minus outstanding reservations — the admission gate's
+        currency)."""
+        return len(self._free) + self.cached_blocks - self._reserved
 
-    def admit(self, need_blocks: int) -> Optional["_BlockLease"]:
-        """Reserve ``need_blocks`` for one row; None when the pool can't
-        promise them (the caller keeps the request queued — admission is
-        FIFO, so a refused head request waits for refunds rather than
-        being overtaken)."""
-        if need_blocks > self.available_blocks:
+    def match_prefix(self, keys, prompt_len: int):
+        """Longest cached prefix of a prompt whose full-block hash chain
+        is ``keys`` → ``(shared_blocks, matched_len, cow_src)``.
+
+        ``matched_len`` is capped at ``prompt_len - 1``: the row must
+        still run >= 1 prompt position through the model to produce its
+        first token's logits. On a FULL-prompt hit (block-aligned prompt
+        entirely cached) that cap lands inside the last matched block —
+        it is returned as ``cow_src`` for the engine to COPY into a
+        private block (copy-on-write) so recomputing position p-1 never
+        writes into a block other rows read."""
+        if self.index is None or not keys:
+            return [], 0, None
+        blocks = self.index.match(keys)
+        if not blocks:
+            return [], 0, None
+        matched = len(blocks) * self.block_size
+        cow_src = None
+        if matched > prompt_len - 1:
+            cow_src = blocks[-1]
+            blocks = blocks[:-1]
+            matched = prompt_len - 1
+        return blocks, matched, cow_src
+
+    def admit(self, need_blocks: int, shared=()) -> Optional["_BlockLease"]:
+        """Reserve ``need_blocks`` private blocks for one row and map the
+        ``shared`` (already-written, indexed) blocks into it with a
+        refcount bump each; None when the pool can't promise the privates
+        plus the parked blocks this admission would revive (the caller
+        keeps the request queued — admission is FIFO, so a refused head
+        request waits for refunds rather than being overtaken). Nothing
+        is mutated on refusal."""
+        revive = sum(1 for b in shared if self._ref[b] == 0)
+        if need_blocks + revive > self.available_blocks:
             return None
+        for b in shared:
+            if self._ref[b] == 0:
+                self.index.unpark(b)  # leaves the evictable LRU set
+            self._ref[b] += 1
         self._reserved += need_blocks
-        return _BlockLease(self, need_blocks)
+        return _BlockLease(self, need_blocks, shared)
+
+    def register_block(self, key: bytes, blk: int) -> None:
+        """Publish a fully-written prompt block into the content index
+        (no-op when the key is already held — first writer wins; the
+        duplicate block stays a plain private block)."""
+        if self.index is not None:
+            self.index.put(key, blk)
 
     def _alloc_one(self) -> int:
-        blk = self._free.pop()
+        if self._free:
+            blk = self._free.pop()
+        else:
+            # pool pressure: reclaim the least-recently-used refcount-0
+            # cached block — the ONLY evictable kind by construction
+            blk = self.index.evict_lru()
+            self.evictions += 1
+        self._ref[blk] += 1
         self._reserved -= 1  # reservation converts to allocation
         self.peak_allocated = max(self.peak_allocated, self.allocated_blocks)
         return blk
 
+    def _deref(self, blk: int) -> None:
+        """Drop one reference; the last one parks indexed content (kept
+        for future prefix hits, LRU-evictable) and frees the rest."""
+        self._ref[blk] -= 1
+        if self._ref[blk] == 0:
+            if self.index is not None and self.index.holds(blk):
+                self.index.park(blk)
+            else:
+                self._free.append(blk)
+
 
 class _BlockLease:
-    """One admitted row's slice of the pool: its reservation plus the
-    blocks physically mapped so far (in virtual-position order — entry i
-    backs positions [i*block_size, (i+1)*block_size))."""
+    """One admitted row's slice of the pool: the SHARED prefix blocks it
+    maps read-only (refcounts held at admit), its private reservation,
+    and the private blocks physically mapped so far — all in
+    virtual-position order (entry i of ``blocks`` backs positions
+    [i*block_size, (i+1)*block_size))."""
 
-    def __init__(self, allocator: BlockAllocator, reservation: int):
+    def __init__(self, allocator: BlockAllocator, reservation: int,
+                 shared=None):
         self._a = allocator
-        self.reservation = int(reservation)
-        self.blocks: List[int] = []
+        self.reservation = int(reservation)  # PRIVATE blocks promised
+        self.shared: List[int] = list(shared or [])
+        self._private: List[int] = []
         self._released = False
 
+    @property
+    def blocks(self) -> List[int]:
+        """Full mapping: shared prefix first, then private growth."""
+        return self.shared + self._private
+
     def grow_to(self, n_blocks: int) -> List[int]:
-        """Ensure at least ``n_blocks`` blocks are mapped (clamped to the
-        reservation — by construction callers never need more) and return
-        the full mapping."""
+        """Ensure at least ``n_blocks`` TOTAL blocks are mapped (clamped
+        to shared + reservation — by construction callers never need
+        more) and return the full mapping."""
         if self._released:
             raise RuntimeError("grow_to on a released lease")
-        n = min(int(n_blocks), self.reservation)
-        while len(self.blocks) < n:
-            self.blocks.append(self._a._alloc_one())
+        n = min(int(n_blocks) - len(self.shared), self.reservation)
+        while len(self._private) < n:
+            self._private.append(self._a._alloc_one())
         return self.blocks
 
     def release(self) -> None:
-        """Refund everything: mapped blocks back to the free list, the
-        never-materialized headroom back to the admission budget."""
+        """Refund everything: one refcount per mapped block (shared and
+        private — the allocator parks indexed content, frees the rest)
+        plus the never-materialized headroom back to the admission
+        budget."""
         if self._released:
             return
         self._released = True
-        self._a._free.extend(self.blocks)
-        self._a._reserved -= self.reservation - len(self.blocks)
-        self.blocks = []
+        for b in self.shared + self._private:
+            self._a._deref(b)
+        self._a._reserved -= self.reservation - len(self._private)
+        self.shared, self._private = [], []
+
+
+def percentile_nearest_rank(xs: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile of a sequence (0.0 when empty) — serve
+    latency/ttft/queue populations are a handful of values per run, so
+    the simple estimator is the honest one. Shared by the engine's
+    metrics and the entrypoint's request-latency rollups so the rank
+    formula can't diverge between them."""
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
 
 
 @dataclass
@@ -200,12 +315,19 @@ class ServeRequest:
 @dataclass
 class ServeResult:
     """Completed request: prompt + generated ids (stop token included when
-    one was hit), plus per-request latency from serve() start."""
+    one was hit), plus per-request timing from serve() start —
+    ``latency_s`` (enqueue → finished), ``queue_s`` (enqueue →
+    admission: the wait the HBM-aware gate and prefix-aware deferral
+    impose), and ``ttft_s`` (admission → first committed token: the
+    prefill cost the user actually feels, observed at chunk granularity
+    — the number prefix caching attacks directly)."""
 
     tokens: List[int]
     new_tokens: int
     finished_by_stop: bool
     latency_s: float
+    ttft_s: float = 0.0
+    queue_s: float = 0.0
 
 
 @dataclass
@@ -214,6 +336,8 @@ class _RowState:
     budget: int
     emitted: List[int] = field(default_factory=list)
     stopped: bool = False
+    admitted_t: float = 0.0  # monotonic stamp at admission
+    first_tok_t: float = 0.0  # monotonic stamp at first committed token
 
 
 class ServingEngine:
@@ -233,6 +357,7 @@ class ServingEngine:
         prefill_chunk: int = 8,
         kv_block_size: int = 32,
         kv_num_blocks: int = 0,
+        prefix_cache: bool = True,
     ):
         """``prefill_chunk`` (T): prompt tokens an admitting row consumes
         per decode step. A T-slot feed costs every row T slots of matmul
@@ -269,7 +394,26 @@ class ServingEngine:
         admission behavior, paged mechanics; pass a smaller pool to
         actually cap HBM (the serve entrypoint sizes it to the queue
         envelope). ``kv_block_size = 0`` keeps the legacy dense
-        ``batch × max_len`` rows (the A/B baseline)."""
+        ``batch × max_len`` rows (the A/B baseline).
+
+        ``prefix_cache`` (paged layout only) enables CROSS-REQUEST KV
+        reuse: admission hashes each prompt's full blocks
+        (runtime/prefix_cache.py), matches the longest cached prefix,
+        maps the matched blocks into the new row's table with refcount
+        bumps, and starts chunked prefill AT the matched length — both
+        the prefill compute and the K/V writes for the shared region are
+        skipped. A full-prompt hit copies the final cached block
+        (copy-on-write) so recomputing the last position never mutates a
+        block other rows read; released rows' indexed blocks are parked
+        (refcount 0, LRU) and evicted only under pool pressure.
+        Admission is prefix-AWARE: a request whose next needed block is
+        being prefilled by an active row right now is deferred (other
+        requests may overtake it) until the leader publishes, so a burst
+        of same-prefix requests prefills its preamble ONCE and the
+        followers then admit together in one wave. Sharing is pure
+        bookkeeping — outputs are token-for-token identical to
+        ``prefix_cache=False`` (tested across the fp, int8-KV, and
+        speculative tiers)."""
         self._fwd = forward_decode
         self._params = params
         self._cfg = cfg
@@ -317,6 +461,9 @@ class ServingEngine:
         else:
             self._blocks_per_row = 0
             self._num_blocks = 0
+        # cross-request KV reuse rides the paged layout only (the dense
+        # rows have no shareable unit)
+        self._prefix = bool(prefix_cache) and self._paged
         # rounds per dispatch: one round = one target forward committing
         # 1..k+1 tokens, so this keeps a spec chunk's committed-token
         # budget comparable to a plain chunk's C single-token steps
@@ -423,18 +570,23 @@ class ServingEngine:
         self._pick = _pick
 
         def _insert_wave(cache, buf, ptr, plen, temp_vec, seed_vec,
-                         rows, prompts, ps, temps, seeds):
+                         rows, prompts, ps, starts, temps, seeds):
             """Admit up to B requests in ONE tiny dispatch: write each
             prompt into its row of the token buffer and reset the row's
-            prefill pointer + cache depth. Unused wave slots carry an
+            prefill pointer + cache depth to ``starts`` (0 for a cold
+            prompt; the matched prefix length on a prefix-cache hit —
+            the shared blocks already hold K/V for positions below it,
+            so prefill resumes there). Unused wave slots carry an
             out-of-range row index and scatter-drop. The K/V buffers are
             untouched — stale data beyond a row's (reset) length is
             invisible to the length-masked attention and is overwritten
             as the prompt streams in."""
             cache = dict(cache)
-            cache["length"] = cache["length"].at[rows].set(0, mode="drop")
+            cache["length"] = cache["length"].at[rows].set(
+                starts, mode="drop"
+            )
             buf = buf.at[rows].set(prompts, mode="drop")
-            ptr = ptr.at[rows].set(0, mode="drop")
+            ptr = ptr.at[rows].set(starts, mode="drop")
             plen = plen.at[rows].set(ps, mode="drop")
             temp_vec = temp_vec.at[rows].set(temps, mode="drop")
             seed_vec = seed_vec.at[rows].set(seeds, mode="drop")
@@ -558,6 +710,12 @@ class ServingEngine:
             _insert_wave,
             donate_argnums=(0, 1, 2, 3, 4, 5) if donate else (),
         )
+        # copy-on-write program (paged only): copy pool blocks src→dst
+        # across every K/V plane in one tiny dispatch; padding pairs
+        # carry an out-of-range dst and drop (models/decoding.py)
+        self._copy_fn = jax.jit(
+            copy_kv_blocks, donate_argnums=(0,) if donate else ()
+        )
         self._spec_chunk = jax.jit(
             _spec_chunk, donate_argnums=(1, 5) if donate else ()
         )
@@ -613,33 +771,43 @@ class ServingEngine:
         wave's prompts into fixed (B, max_len) arrays (unused slots
         scatter-drop via an out-of-range row index) and write them into
         the device state. No model forward happens here — the chunk
-        program streams each prompt in-band. ``admissions``:
-        [(row, req, req_idx, prompt, p, budget), ...] (pre-validated by
-        the caller, which gates on the block pool first) →
-        [(row, _RowState), ...]."""
+        program streams each prompt in-band, starting at the row's
+        matched prefix length (0 without a prefix-cache hit).
+        ``admissions``: [(row, req, req_idx, prompt, p, budget,
+        matched), ...] (pre-validated by the caller, which gates on the
+        block pool first) → [(row, _RowState, steps), ...]."""
         b, max_len = self._b, self._max_len
         rows = np.full((b,), b, dtype=np.int32)  # b == dropped slot
         prompts = np.zeros((b, max_len), dtype=np.int32)
         ps = np.zeros((b,), dtype=np.int32)
+        starts = np.zeros((b,), dtype=np.int32)
         temps = np.zeros((b,), dtype=np.float32)
         seeds = np.zeros((b,), dtype=np.int32)
         out = []
-        for i, (row, req, req_idx, prompt, p, budget) in enumerate(
+        width = (self._k + 1) if self._lookup else self._t
+        now = time.monotonic()
+        for i, (row, req, req_idx, prompt, p, budget, matched) in enumerate(
             admissions
         ):
             rows[i] = row
             prompts[i, :p] = prompt
             ps[i] = p
+            starts[i] = matched
             temps[i] = req.temperature
             seeds[i] = req.seed
-            steps = -(-p // ((self._k + 1) if self._lookup else self._t))
-            out.append((row, _RowState(request_idx=req_idx, budget=budget),
+            steps = -(-(p - matched) // width)
+            out.append((row,
+                        _RowState(request_idx=req_idx, budget=budget,
+                                  admitted_t=now),
                         steps))
             self._prefill_steps += steps
+            # step-slots the matched prefix did NOT consume — the
+            # direct compute saving of the prefix cache
+            self._prefill_steps_saved += -(-p // width) - steps
         cache, buf, ptr, plen, temp_vec, seed_vec = self._insert_fn(
             cache, buf, ptr, plen, temp_vec, seed_vec,
             jnp.asarray(rows), jnp.asarray(prompts), jnp.asarray(ps),
-            jnp.asarray(temps), jnp.asarray(seeds),
+            jnp.asarray(starts), jnp.asarray(temps), jnp.asarray(seeds),
         )
         self._insert_dispatches += 1
         return cache, buf, ptr, plen, temp_vec, seed_vec, out
@@ -707,7 +875,7 @@ class ServingEngine:
          warm_seed) = self._insert_fn(
             warm_cache, warm_buf, zi(), zi(), zf(), zi(),
             jnp.full((b,), b, jnp.int32),
-            jnp.zeros((b, max_len), jnp.int32), zi(), zf(), zi(),
+            jnp.zeros((b, max_len), jnp.int32), zi(), zi(), zf(), zi(),
         )
         if self._lookup:
             out = self._spec_chunk(
@@ -749,7 +917,12 @@ class ServingEngine:
         # never depends on it (either program is exact for any state).
         prefill_left = [0] * b
         results: List[Optional[ServeResult]] = [None] * len(requests)
-        next_req = 0
+        # FIFO admission queue of request indices. Prefix-aware deferral
+        # may SKIP a request (its prefix is being prefilled by an active
+        # row — admitting now would duplicate exactly the compute the
+        # cache saves) and re-queue it at the front; a pool-full refusal
+        # still blocks the head (refund-wait, never overtaken).
+        pending = deque(range(len(requests)))
         committed = 0
         scheduled_slots = 0
         chunks = 0
@@ -758,6 +931,7 @@ class ServingEngine:
         accepted_total = 0
         self._insert_dispatches = 0
         self._prefill_steps = 0
+        self._prefill_steps_saved = 0
 
         # ---- paged-pool bookkeeping (all host-side) ----
         # per-position cache bytes across layers and k+v (+ the int8
@@ -772,7 +946,10 @@ class ServingEngine:
                 * int(np.dtype(cfg.dtype).itemsize) * 2
             )
         alloc = (
-            BlockAllocator(self._num_blocks, self._block_size)
+            BlockAllocator(
+                self._num_blocks, self._block_size,
+                prefix_index=PrefixCacheIndex() if self._prefix else None,
+            )
             if self._paged else None
         )
         leases: List[Optional[_BlockLease]] = [None] * b
@@ -782,9 +959,19 @@ class ServingEngine:
         table_np = np.full(
             (b, self._blocks_per_row or 1), scratch, dtype=np.int32
         )
-        reserved_blocks_total = 0  # Σ per-admission reservations
+        reserved_blocks_total = 0  # Σ per-admission PRIVATE reservations
         alloc_block_steps = 0  # Σ per-chunk allocated blocks (residency)
         table_dirty = [True]  # admission/finish/growth since last push
+        # ---- prefix-cache bookkeeping (host-side, per active row) ----
+        row_keys: List[List[bytes]] = [[] for _ in range(b)]  # chain keys
+        indexed_upto = [0] * b  # chain keys already published to the index
+        pf_ptr = [0] * b  # exact host mirror of the row's prefill pointer
+        keys_cache: dict = {}  # request idx → chain keys (deferral re-scan)
+        hit_tokens = 0
+        hit_requests = 0
+        cow_copies = 0
+        ttfts: List[float] = []
+        queues: List[float] = []
 
         def grow_and_push_tables():
             """Map every active row's next-dispatch coverage (its length
@@ -818,6 +1005,10 @@ class ServingEngine:
         def finish(state: _RowState) -> None:
             nonlocal committed
             committed += len(state.emitted)
+            ttft = max(0.0, state.first_tok_t - state.admitted_t)
+            queue_s = max(0.0, state.admitted_t - t0)
+            ttfts.append(ttft)
+            queues.append(queue_s)
             results[state.request_idx] = ServeResult(
                 tokens=list(np.asarray(
                     requests[state.request_idx].prompt, dtype=np.int32
@@ -825,6 +1016,8 @@ class ServingEngine:
                 new_tokens=len(state.emitted),
                 finished_by_stop=state.stopped,
                 latency_s=time.monotonic() - t0,
+                ttft_s=round(ttft, 6),
+                queue_s=round(queue_s, 6),
             )
 
         def row_done(state: _RowState) -> bool:
@@ -833,39 +1026,95 @@ class ServingEngine:
         def admit_into(free_rows):
             """Fill free rows from the queue — one insert dispatch per
             wave; the prompts stream through the next chunks in-band.
-            Paged: each admission must RESERVE its worst-case block count
-            first (HBM-aware gate). Admission stays FIFO — a refused head
-            request waits for refunds (rows finishing return blocks)
-            instead of being overtaken by a smaller one; progress is
-            guaranteed because an idle engine has its whole pool free and
-            _validate_request rejects requests that exceed it outright."""
+            Paged: each admission must RESERVE its worst-case PRIVATE
+            block count first (HBM-aware gate); with the prefix cache on,
+            the prompt's longest cached full-block prefix is matched
+            first and mapped SHARED (refcount bumps, no reservation), and
+            prefill starts past it. A pool-full refusal keeps FIFO order
+            (the head waits for refunds, never overtaken); a prefix-DEFER
+            skips the request — its next needed block is being prefilled
+            by an active row right now, so admitting it would duplicate
+            exactly the compute the cache saves; once the leader
+            publishes, the whole deferred group admits together in one
+            wave. Progress is guaranteed: deferral requires an ACTIVE
+            prefilling row, and _validate_request rejects requests that
+            exceed the whole pool outright."""
             nonlocal cache, buf, ptr_vec, plen_vec, temp_vec, seed_vec
-            nonlocal next_req, reserved_blocks_total
-            if not free_rows or next_req >= len(requests):
+            nonlocal reserved_blocks_total, hit_tokens, hit_requests
+            nonlocal cow_copies
+            if not free_rows or not pending:
                 return
+            # chain keys active rows will publish soon — the deferral set
+            inflight = set()
+            if self._prefix:
+                for r in range(b):
+                    if rows[r] is not None and row_keys[r]:
+                        inflight.update(row_keys[r][indexed_upto[r]:])
             wave = []
-            wave_meta = []  # (row, p, budget, lease) alongside the wave
-            while free_rows and next_req < len(requests):
-                req = requests[next_req]
-                prompt, p, budget = self._validate_request(req, next_req)
+            # (row, p, budget, lease, matched, cow_src, keys) per slot
+            wave_meta = []
+            deferred = []
+            while free_rows and pending:
+                req_idx = pending.popleft()
+                req = requests[req_idx]
+                prompt, p, budget = self._validate_request(req, req_idx)
+                shared, matched, cow_src = [], 0, None
+                keys: List[bytes] = []
+                if self._prefix:
+                    if req_idx not in keys_cache:
+                        keys_cache[req_idx] = chain_keys(
+                            prompt, self._block_size
+                        )
+                    keys = keys_cache[req_idx]
+                    shared, matched, cow_src = alloc.match_prefix(keys, p)
+                    published = len(shared) + (1 if cow_src is not None
+                                               else 0)
+                    if (published < len(keys)
+                            and keys[published] in inflight):
+                        deferred.append(req_idx)
+                        continue
                 lease = None
                 if self._paged:
-                    need = alloc.blocks_for(self._row_cap(p, budget))
-                    lease = alloc.admit(need)
+                    need = (
+                        alloc.blocks_for(self._row_cap(p, budget))
+                        - len(shared)
+                    )
+                    lease = alloc.admit(need, shared=shared)
                     if lease is None:
+                        pending.appendleft(req_idx)
                         break  # pool full: head of the queue waits
                     reserved_blocks_total += need
+                    if cow_src is not None:
+                        # copy-on-write: materialize the private copy of
+                        # the partially-reused block NOW (within the
+                        # reservation — can't fail) and queue the device
+                        # copy for right after the insert dispatch
+                        lease.grow_to(len(shared) + 1)
+                if matched:
+                    hit_tokens += matched
+                    hit_requests += 1
                 row = free_rows.pop(0)
-                wave.append((row, req, next_req, prompt, p, budget))
-                wave_meta.append((row, p, budget, lease))
-                next_req += 1
+                wave.append((row, req, req_idx, prompt, p, budget, matched))
+                wave_meta.append(
+                    (row, p, budget, lease, matched, cow_src, keys)
+                )
+                # the keys THIS row will publish defer same-prefix
+                # followers later in this very wave (intra-wave dedup)
+                if self._prefix:
+                    inflight.update(
+                        keys[len(shared) + (1 if cow_src is not None
+                                            else 0):]
+                    )
+            pending.extendleft(reversed(deferred))
             if not wave:
                 return
             (cache, buf, ptr_vec, plen_vec, temp_vec, seed_vec,
              admitted) = self._admit_wave(
                 cache, buf, ptr_vec, plen_vec, temp_vec, seed_vec, wave,
             )
-            for (row, state, steps), (_, p, budget, lease) in zip(
+            cow_pairs = []
+            for (row, state, steps), (_, p, budget, lease, matched,
+                                      cow_src, keys) in zip(
                 admitted, wave_meta
             ):
                 rows[row] = state
@@ -875,7 +1124,34 @@ class ServingEngine:
                     caps[row] = self._row_cap(p, budget)
                     plen_host[row] = p
                     table_np[row, :] = scratch
+                    # the shared prefix (and the CoW copy, if any) must
+                    # be in the table BEFORE the first chunk reads it —
+                    # grow_and_push_tables only writes on GROWTH
+                    mapped = lease.blocks
+                    if mapped:
+                        table_np[row, : len(mapped)] = mapped
                     table_dirty[0] = True
+                    row_keys[row] = keys
+                    indexed_upto[row] = len(lease.shared) + (
+                        1 if cow_src is not None else 0
+                    )
+                    pf_ptr[row] = matched
+                    if cow_src is not None:
+                        cow_pairs.append(
+                            (cow_src, lease.blocks[len(lease.shared)])
+                        )
+            if cow_pairs:
+                # one tiny dispatch copies every CoW block of the wave;
+                # ordering is the device stream's — the copy lands
+                # before the next chunk program reads the copies
+                src = np.full((b,), self._num_blocks + 1, dtype=np.int32)
+                dst = np.full((b,), self._num_blocks + 1, dtype=np.int32)
+                for i, (s_, d_) in enumerate(cow_pairs):
+                    src[i], dst[i] = s_, d_
+                cache = self._copy_fn(
+                    cache, jnp.asarray(src), jnp.asarray(dst)
+                )
+                cow_copies += len(cow_pairs)
 
         admit_into([r for r in range(b) if rows[r] is None])
 
@@ -902,6 +1178,7 @@ class ServingEngine:
                  host_actives) = jax.device_get(
                     (outs, accs, n_emits, actives)
                 )  # one batched fetch: (R,B,k+1), (R,B) x3
+                pf_advance = self._rounds * (self._k + 1)
             else:
                 chunk_fn = (
                     self._decode_chunk
@@ -920,8 +1197,34 @@ class ServingEngine:
                 # one batched device→host fetch (each np.asarray would
                 # pay its own tunnel round-trip)
                 host_toks, host_emits = jax.device_get((toks, emits))
+                pf_advance = self._chunk * (
+                    self._t if chunk_fn is self._decode_chunk else 1
+                )
                 for r in range(b):
                     prefill_left[r] = max(0, prefill_left[r] - self._chunk)
+            now = time.monotonic()
+            if self._prefix:
+                # mirror each row's prefill pointer exactly (per step a
+                # prefilling row advances by min(width, remaining), so a
+                # whole dispatch advances by min(dispatch width·steps,
+                # remaining)), then PUBLISH every prompt block the
+                # dispatch finished writing — from that instant the
+                # block is matchable by new admissions
+                for r in range(b):
+                    if rows[r] is None or leases[r] is None:
+                        continue
+                    if pf_ptr[r] < plen_host[r]:
+                        pf_ptr[r] = min(
+                            plen_host[r], pf_ptr[r] + pf_advance
+                        )
+                    pub = min(
+                        pf_ptr[r] // self._block_size, len(row_keys[r])
+                    )
+                    blks = leases[r].blocks
+                    while indexed_upto[r] < pub:
+                        j = indexed_upto[r]
+                        alloc.register_block(row_keys[r][j], blks[j])
+                        indexed_upto[r] += 1
             for r in range(b):
                 state = rows[r]
                 if state is None:
@@ -937,6 +1240,8 @@ class ServingEngine:
                         for t in host_outs[ri, r, :int(host_emits[ri, r])]:
                             if row_done(state):
                                 break
+                            if not state.emitted:
+                                state.first_tok_t = now
                             state.emitted.append(int(t))
                             if self._stop >= 0 and int(t) == self._stop:
                                 state.stopped = True
@@ -947,6 +1252,8 @@ class ServingEngine:
                         if not host_emits[c, r]:
                             continue  # the row was prefilling this step
                         t = int(host_toks[c, r])
+                        if not state.emitted:
+                            state.first_tok_t = now
                         state.emitted.append(t)
                         if self._stop >= 0 and t == self._stop:
                             state.stopped = True
@@ -963,10 +1270,14 @@ class ServingEngine:
                         leases[r] = None
                         table_np[r, :] = scratch
                         table_dirty[0] = True
+                        row_keys[r] = []
+                        indexed_upto[r] = 0
+                        pf_ptr[r] = 0
             # admit the next queued requests into every row this chunk
             # freed — ONE insert wave, no model forward
             admit_into([r for r in range(b) if rows[r] is None])
         wall = time.monotonic() - t0
+        _pctl = percentile_nearest_rank
         metrics = {
             "requests": len(requests),
             "committed_tokens": committed,
@@ -983,6 +1294,12 @@ class ServingEngine:
             "prefill_chunk": (
                 (self._k + 1) if self._lookup else self._t
             ),
+            # admission → first committed token (chunk-granular) and
+            # enqueue → admission waits, per request
+            "ttft_p50_s": round(_pctl(ttfts, 0.50), 4),
+            "ttft_p95_s": round(_pctl(ttfts, 0.95), 4),
+            "queue_p50_s": round(_pctl(queues, 0.50), 4),
+            "queue_p95_s": round(_pctl(queues, 0.95), 4),
         }
         # ---- KV-cache economics (the paged-vs-dense ledger) ----
         # bytes-per-request compares what one admitted request COSTS the
@@ -1010,6 +1327,22 @@ class ServingEngine:
                 round(alloc_block_steps * block_bytes / committed, 1)
                 if committed else 0.0
             )
+            metrics["prefix_cache"] = self._prefix
+            if self._prefix:
+                # the tentpole ledger: tokens whose prefill compute AND
+                # K/V writes were skipped, the step-slots that saving
+                # translates to at this feed width, and the CoW /
+                # eviction traffic behind it
+                metrics["prefix_hit_tokens"] = hit_tokens
+                metrics["prefix_hit_requests"] = hit_requests
+                metrics["prefix_prefill_steps_saved"] = (
+                    self._prefill_steps_saved
+                )
+                metrics["prefix_cow_copies"] = cow_copies
+                metrics["prefix_evictions"] = alloc.evictions
+                metrics["prefix_cached_blocks_final"] = (
+                    alloc.cached_blocks
+                )
         else:
             metrics["kv_pool_bytes"] = b * dense_row_bytes
             metrics["kv_bytes_per_request"] = dense_row_bytes

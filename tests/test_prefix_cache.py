@@ -1,0 +1,302 @@
+"""Prefix-cache block sharing (runtime/prefix_cache.py + the ref-counted
+BlockAllocator in runtime/serving.py).
+
+Fast tier: the index and allocator are pure host code, and the engine
+scheduling tests run the cyclic stub model, so the sharing invariants —
+no block freed or evicted while referenced, CoW instead of in-place
+mutation, deferral instead of duplicate prefill — are checked on every
+dev-lane run. The llama-backed exactness tiers (prefix-on == prefix-off
+== isolated decode, across fp / int8 / speculative) live in
+tests/test_serving.py with the rest of the compile-bound contract."""
+
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nexus_tpu.runtime.prefix_cache import PrefixCacheIndex, chain_keys
+from nexus_tpu.runtime.serving import (
+    BlockAllocator,
+    ServeRequest,
+    ServingEngine,
+)
+
+
+def _cyclic_model(v: int):
+    """next = (token + 1) % v — deterministic, no K/V reads (scheduling
+    and allocation are under test; the real paged-attention read path is
+    covered by test_serving.py's llama tiers)."""
+    cfg = SimpleNamespace(
+        n_layers=1, n_kv_heads=1, head_dim=8, dtype=jnp.float32,
+        max_seq_len=256, vocab_size=v,
+    )
+
+    def fwd(params, cfg_, tokens, cache):
+        logits = jax.nn.one_hot((tokens + 1) % v, v) * 10.0
+        new = {k: x for k, x in cache.items() if k != "n_valid"}
+        nv = cache.get("n_valid")
+        adv = tokens.shape[1] if nv is None else nv
+        new["length"] = cache["length"] + adv
+        return logits.astype(jnp.float32), new
+
+    return cfg, fwd
+
+
+def _expect(req, v):
+    out = []
+    cur = req.prompt[-1]
+    for _ in range(req.max_new_tokens):
+        cur = (cur + 1) % v
+        out.append(cur)
+    return list(req.prompt) + out
+
+
+# ---------------------------------------------------------------- keys
+
+
+def test_chain_keys_commit_to_the_whole_prefix():
+    toks = list(range(20))
+    keys = chain_keys(toks, 4)
+    assert len(keys) == 5  # only FULL blocks are keyed
+    assert chain_keys(toks[:19], 4) == keys[:4]  # partial tail dropped
+    # same prefix -> same leading keys; a divergence poisons every
+    # later key (each digest chains over all earlier blocks)
+    other = list(toks)
+    other[5] = 99
+    ok = chain_keys(other, 4)
+    assert ok[0] == keys[0]
+    assert all(a != b for a, b in zip(ok[1:], keys[1:]))
+    assert chain_keys(toks, 4, limit=2) == keys[:2]
+    with pytest.raises(ValueError):
+        chain_keys(toks, 0)
+
+
+def test_index_match_park_evict_roundtrip():
+    idx = PrefixCacheIndex()
+    keys = chain_keys(list(range(12)), 4)
+    assert idx.match(keys) == []
+    assert idx.put(keys[0], 7) and idx.put(keys[1], 3)
+    assert idx.put(keys[0], 9) is False  # first writer wins
+    assert idx.put(keys[2], 7) is False  # one identity per block
+    assert idx.match(keys) == [7, 3]
+    # a miss mid-chain stops the walk (orphans never match)
+    idx.put(chain_keys(list(range(12)), 4)[2], 5)
+    assert idx.match([keys[0], b"missing", keys[2]]) == [7]
+    idx.park(7)
+    idx.park(3)
+    idx.unpark(7)  # revived by a shared admission
+    assert idx.parked_count == 1
+    assert idx.evict_lru() == 3
+    assert idx.match(keys) == [7]  # 3's digest is gone
+    with pytest.raises(ValueError):
+        idx.park(99)  # never indexed
+    idx.park(7)
+    idx.evict_lru()
+    with pytest.raises(RuntimeError):
+        idx.evict_lru()  # nothing parked
+
+
+# ----------------------------------------------------- allocator refs
+
+
+def test_allocator_shared_admission_refcounts():
+    idx = PrefixCacheIndex()
+    a = BlockAllocator(num_blocks=8, block_size=4, prefix_index=idx)
+    leader = a.admit(4)
+    blks = leader.grow_to(4)
+    keys = chain_keys(list(range(16)), 4)
+    for k, blk in zip(keys, blks[:2]):
+        a.register_block(k, blk)
+    # follower maps the two indexed blocks shared + 2 private
+    shared, matched, cow = a.match_prefix(keys, prompt_len=16)
+    assert shared == blks[:2] and matched == 8 and cow is None
+    follower = a.admit(2, shared=shared)
+    assert follower is not None
+    assert follower.blocks[:2] == blks[:2]
+    # leader releases: the shared blocks stay ALIVE (follower's refs),
+    # the unindexed privates go back to the free list
+    leader.release()
+    assert a.cached_blocks == 0  # still referenced -> not parked
+    assert a.free_blocks == 6  # 2 of the leader's 4 were shared
+    follower.grow_to(4)
+    follower.release()
+    # last reference parks the indexed content instead of freeing it
+    assert a.cached_blocks == 2
+    assert a.free_blocks == 6
+    assert a.available_blocks == 8  # parked blocks stay admissible
+    # and the content is still matchable
+    assert a.match_prefix(keys, 16)[0] == blks[:2]
+
+
+def test_allocator_full_prompt_hit_returns_cow_source():
+    idx = PrefixCacheIndex()
+    a = BlockAllocator(num_blocks=8, block_size=4, prefix_index=idx)
+    lease = a.admit(3)
+    blks = lease.grow_to(3)
+    keys = chain_keys(list(range(12)), 4)
+    for k, blk in zip(keys, blks):
+        a.register_block(k, blk)
+    # block-aligned full-prompt hit: the cap at p-1 lands INSIDE the
+    # last matched block -> shared stops before it, cow_src returns it
+    shared, matched, cow = a.match_prefix(keys, prompt_len=12)
+    assert shared == blks[:2] and matched == 11 and cow == blks[2]
+
+
+def test_allocator_evicts_lru_refcount0_only_under_pressure():
+    idx = PrefixCacheIndex()
+    a = BlockAllocator(num_blocks=4, block_size=4, prefix_index=idx)
+    l1 = a.admit(4)
+    blks = l1.grow_to(4)
+    keys = chain_keys(list(range(16)), 4)
+    for k, blk in zip(keys, blks[:2]):
+        a.register_block(k, blk)
+    l1.release()  # 2 parked (cached), 2 free
+    assert a.cached_blocks == 2 and a.free_blocks == 2
+    assert a.evictions == 0
+    # a new 4-block admission drains the free list then reclaims the
+    # parked pair LRU-first — eviction only under pressure, and only of
+    # refcount-0 blocks (the free list is consumed first)
+    l2 = a.admit(4)
+    assert l2 is not None
+    got = l2.grow_to(4)
+    assert a.evictions == 2
+    assert sorted(got) == [0, 1, 2, 3]
+    assert a.match_prefix(keys, 16) == ([], 0, None)  # content gone
+    # while REFERENCED the same blocks are never evictable
+    assert a.admit(1) is None
+
+
+# -------------------------------------------------- engine scheduling
+
+
+def test_engine_shared_prefix_skips_prefill_and_stays_exact():
+    """6 requests sharing a 17-token preamble through 2 rows: every
+    output exact, and the cache saves most of the repeated prefill
+    (leader computes the preamble once; deferral keeps followers from
+    duplicating it, then they admit together with hits)."""
+    v = 11
+    cfg, fwd = _cyclic_model(v)
+    rng = np.random.RandomState(7)
+    common = rng.randint(0, v, size=17).tolist()
+    reqs = [
+        ServeRequest(
+            prompt=common + rng.randint(0, v, size=p).tolist(),
+            max_new_tokens=n,
+        )
+        for p, n in ((9, 6), (13, 5), (4, 8), (9, 4), (6, 7), (11, 3))
+    ]
+    metrics = {}
+    outs = {}
+    for pc in (False, True):
+        eng = ServingEngine(
+            fwd, {}, cfg, batch_size=2, max_len=96, chunk=4,
+            kv_block_size=8, prefix_cache=pc,
+        )
+        results, m = eng.serve(reqs)
+        for i, (req, res) in enumerate(zip(reqs, results)):
+            assert res.tokens == _expect(req, v), (pc, i)
+        metrics[pc], outs[pc] = m, [r.tokens for r in results]
+    assert outs[False] == outs[True]
+    on, off = metrics[True], metrics[False]
+    assert on["prefix_cache"] is True and off["prefix_cache"] is False
+    assert on["prefix_hit_tokens"] > 0
+    assert on["prefix_hit_requests"] >= 5  # every follower hits
+    assert on["prefill_steps"] < off["prefill_steps"]
+    assert on["prefix_prefill_steps_saved"] == (
+        off["prefill_steps"] - on["prefill_steps"]
+    )
+    # sharing shrinks what a request RESERVES, so the per-request KV
+    # ledger must undercut the cache-off engine's
+    assert on["kv_bytes_per_request"] < off["kv_bytes_per_request"]
+
+
+def test_engine_full_duplicate_prompt_takes_cow_path():
+    """A block-aligned exact-duplicate prompt matches its ENTIRE chain:
+    the engine recomputes only the last position into a copy-on-write
+    private block — one CoW copy, output still exact, and the frozen
+    original keeps serving later duplicates."""
+    v = 9
+    cfg, fwd = _cyclic_model(v)
+    base = [1, 2, 3, 4, 5, 6, 7, 8] * 2  # 16 tokens = 2 blocks of 8
+    reqs = [
+        ServeRequest(prompt=list(base), max_new_tokens=4)
+        for _ in range(3)
+    ]
+    eng = ServingEngine(
+        fwd, {}, cfg, batch_size=1, max_len=96, chunk=4,
+        kv_block_size=8, prefix_cache=True,
+    )
+    results, m = eng.serve(reqs)
+    for res in results:
+        assert res.tokens == _expect(reqs[0], v)
+    assert m["prefix_cow_copies"] == 2  # both duplicates CoW the tail
+    assert m["prefix_hit_tokens"] == 2 * (len(base) - 1)
+    # duplicates prefill exactly ONE position each (the capped last)
+    assert m["prefill_steps"] == -(-16 // 8) + 2
+
+
+def test_engine_eviction_under_tight_pool_stays_exact():
+    """Alternating prefix groups through a pool too small to cache both:
+    evictions happen (refcount-0 blocks only, by construction), the
+    queue drains completely and exactly."""
+    v = 13
+    cfg, fwd = _cyclic_model(v)
+    rng = np.random.RandomState(5)
+    g1 = rng.randint(0, v, size=16).tolist()
+    g2 = rng.randint(0, v, size=16).tolist()
+    reqs = []
+    for g in (g1, g2, g1, g2):
+        reqs.append(ServeRequest(
+            prompt=g + rng.randint(0, v, size=4).tolist(),
+            max_new_tokens=4,
+        ))
+    # per request: cap = 20 + 4 + slack(4) + 1 = 29 -> 4 blocks of 8;
+    # a 4-block pool can't keep a group cached past the next group
+    eng = ServingEngine(
+        fwd, {}, cfg, batch_size=1, max_len=96, chunk=4,
+        kv_block_size=8, kv_num_blocks=4, prefix_cache=True,
+    )
+    results, m = eng.serve(reqs)
+    for req, res in zip(reqs, results):
+        assert res.tokens == _expect(req, v)
+    assert m["prefix_evictions"] > 0
+    assert m["kv_peak_allocated_blocks"] <= 4
+
+
+def test_engine_reports_ttft_and_queue_percentiles():
+    v = 7
+    cfg, fwd = _cyclic_model(v)
+    reqs = [ServeRequest(prompt=[1, 2, 3], max_new_tokens=6)
+            for _ in range(6)]
+    eng = ServingEngine(fwd, {}, cfg, batch_size=2, max_len=64, chunk=4)
+    results, m = eng.serve(reqs)
+    for res in results:
+        # enqueue -> admission -> first token -> finish is monotone
+        assert 0.0 <= res.queue_s <= res.latency_s
+        assert 0.0 <= res.ttft_s <= res.latency_s
+    assert m["ttft_p50_s"] <= m["ttft_p95_s"]
+    assert m["queue_p50_s"] <= m["queue_p95_s"]
+    # later admissions waited for rows: the queue percentiles must see
+    # nonzero waits on a 6-requests / 2-rows run
+    assert max(r.queue_s for r in results) > 0.0
+
+
+def test_prefix_cache_off_by_dense_layout():
+    """prefix_cache=True on the dense layout is inert (no block unit to
+    share) — the knob must not leak into dense metrics."""
+    v = 7
+    cfg, fwd = _cyclic_model(v)
+    eng = ServingEngine(
+        fwd, {}, cfg, batch_size=1, max_len=64, chunk=4,
+        kv_block_size=0, prefix_cache=True,
+    )
+    results, m = eng.serve(
+        [ServeRequest(prompt=[1, 2, 3], max_new_tokens=4)]
+    )
+    assert results[0].tokens == _expect(
+        ServeRequest(prompt=[1, 2, 3], max_new_tokens=4), v
+    )
+    assert m["kv_layout"] == "dense"
+    assert "prefix_hit_tokens" not in m

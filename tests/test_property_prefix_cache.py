@@ -1,0 +1,136 @@
+"""Property-based invariants for the ref-counted BlockAllocator.
+
+Hypothesis drives random admit/match/grow/register/release sequences —
+with shared mappings, parked content, and forced pool pressure — and
+asserts the sharing invariants after EVERY operation:
+
+  * no block is freed or evicted while any lease references it;
+  * pool accounting is exact (free + parked + referenced partitions the
+    pool; refcounts equal the number of leases mapping each block;
+    free + parked always covers the outstanding reservations);
+  * eviction only ever touches refcount-0 (parked) blocks, and only
+    under pool pressure (the free list must drain first);
+  * every live lease can always grow to its full reservation (the
+    eviction-free admission guarantee), and no two leases ever share a
+    PRIVATE block.
+
+importorskip-guarded like test_property_convergence: a checkout without
+hypothesis skips the module instead of failing collection."""
+
+import pytest
+
+pytest.importorskip("hypothesis")
+
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+
+from nexus_tpu.runtime.prefix_cache import PrefixCacheIndex  # noqa: E402
+from nexus_tpu.runtime.serving import BlockAllocator  # noqa: E402
+
+NUM_BLOCKS = 12
+BLOCK_SIZE = 4
+
+# one operation = (kind, a, b); the driver interprets the integers
+# modulo whatever is currently valid, so every generated sequence is
+# executable and shrinks well
+_op = st.tuples(
+    st.integers(0, 3),  # 0 admit, 1 grow, 2 release, 3 register
+    st.integers(0, 31),
+    st.integers(0, 31),
+)
+
+
+def _check_invariants(a: BlockAllocator, leases):
+    refs = [0] * NUM_BLOCKS
+    privates = []
+    for lease in leases:
+        for blk in lease.blocks:
+            refs[blk] += 1
+        privates.extend(lease._private)
+    # refcounts match the leases exactly
+    assert refs == a._ref, (refs, a._ref)
+    # no two leases share a private block
+    assert len(privates) == len(set(privates))
+    free = set(a._free)
+    parked = set(a.index._parked)
+    referenced = {b for b in range(NUM_BLOCKS) if refs[b] > 0}
+    # free / parked / referenced partition the pool
+    assert not (free & parked)
+    assert not (free & referenced)
+    assert not (parked & referenced), "parked block still referenced"
+    assert free | parked | referenced == set(range(NUM_BLOCKS))
+    # accounting identities the metrics ledger reads off
+    assert a.free_blocks == len(free)
+    assert a.cached_blocks == len(parked)
+    assert a.allocated_blocks == len(referenced)
+    # reservations are always coverable without touching a referenced
+    # block — the eviction-free guarantee's arithmetic form
+    assert len(free) + len(parked) >= a._reserved >= 0
+    # every parked block is still indexed (evict drops both together)
+    for blk in parked:
+        assert a.index.holds(blk)
+
+
+@settings(
+    max_examples=120, deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+@given(ops=st.lists(_op, max_size=60))
+def test_refcounted_allocator_invariants(ops):
+    a = BlockAllocator(
+        NUM_BLOCKS, BLOCK_SIZE, prefix_index=PrefixCacheIndex()
+    )
+    leases = []
+    registered = []  # indexed blocks, in publish order
+    key_seq = [0]
+
+    for kind, x, y in ops:
+        if kind == 0:  # admit, optionally mapping indexed blocks shared
+            shared = [
+                b for b in registered[: x % (len(registered) + 1)]
+                if a.index.holds(b)
+            ]
+            need = y % (NUM_BLOCKS + 1)
+            evictions_before = a.evictions
+            free_before = a.free_blocks
+            lease = a.admit(need, shared=shared)
+            # admission itself never evicts or allocates
+            assert a.evictions == evictions_before
+            assert a.free_blocks == free_before
+            if lease is not None:
+                leases.append(lease)
+        elif kind == 1 and leases:  # grow within the reservation
+            lease = leases[x % len(leases)]
+            free_before = a.free_blocks
+            evictions_before = a.evictions
+            lease.grow_to(y % (NUM_BLOCKS + 2))
+            # pressure rule: evictions happen only once free drained
+            if a.evictions > evictions_before:
+                assert free_before < (
+                    a.evictions - evictions_before
+                ) + len(lease._private), "evicted while free blocks left"
+        elif kind == 2 and leases:  # release
+            lease = leases.pop(x % len(leases))
+            lease.release()
+        elif kind == 3 and leases:  # publish a private block
+            lease = leases[x % len(leases)]
+            if lease._private:
+                blk = lease._private[y % len(lease._private)]
+                if not a.index.holds(blk):
+                    key_seq[0] += 1
+                    a.register_block(
+                        key_seq[0].to_bytes(8, "big"), blk
+                    )
+                    registered.append(blk)
+        _check_invariants(a, leases)
+
+    # the eviction-free guarantee, end-state form: every live lease can
+    # still grow to its whole reservation, and the result is disjoint
+    seen = set()
+    for lease in leases:
+        lease.grow_to(NUM_BLOCKS + 1)
+        priv = set(lease._private)
+        assert len(lease._private) == len(priv)
+        assert not (priv & seen)
+        seen |= priv
+    _check_invariants(a, leases)

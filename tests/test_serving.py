@@ -424,6 +424,73 @@ def test_serving_sampled_requests_are_batch_invariant():
     assert outs[1][6] != outs[1][5]
 
 
+def test_prefix_cache_invisible_to_results_all_tiers():
+    """Round-6 acceptance: cross-request KV reuse is pure scheduling —
+    the same queue (shared system prompt, a block-aligned full
+    duplicate that exercises copy-on-write, an unshared control, and a
+    sampled request) through prefix-on and prefix-off engines commits
+    IDENTICAL tokens across the fp, int8-KV, and speculative tiers, and
+    the fp tier also equals the isolated greedy decode."""
+    rng = np.random.RandomState(23)
+    common = rng.randint(0, 256, size=16).tolist()
+    reqs = []
+    for p, n in ((8, 6), (5, 5), (12, 7)):
+        tail = rng.randint(0, 256, size=p).tolist()
+        reqs.append(ServeRequest(prompt=common + tail, max_new_tokens=n))
+    # 16 + 8 = 24 tokens = 3 full 8-blocks: the duplicate's whole chain
+    # matches and the engine must CoW the tail block, not mutate it
+    reqs.append(ServeRequest(prompt=list(reqs[0].prompt),
+                             max_new_tokens=4))
+    reqs.append(ServeRequest(
+        prompt=rng.randint(0, 256, size=7).tolist(), max_new_tokens=6,
+    ))
+    sampled = ServeRequest(
+        prompt=common + rng.randint(0, 256, size=6).tolist(),
+        max_new_tokens=6, temperature=0.8, seed=3,
+    )
+
+    tiers = [
+        ("fp", tiny_cfg(), reqs + [sampled], {"prefill_chunk": 3}),
+        ("int8", tiny_cfg(kv_cache_quantized=True), reqs + [sampled],
+         {"prefill_chunk": 3}),
+        # speculative serving is greedy-only: drop the sampled request
+        ("spec", tiny_cfg(), reqs,
+         {"lookup_ngram": 2, "num_speculative": 3, "chunk": 5}),
+    ]
+    for name, cfg, queue, kw in tiers:
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        outs = {}
+        metrics = {}
+        for pc in (False, True):
+            engine = ServingEngine(
+                llama.forward_decode, params, cfg, batch_size=2,
+                max_len=64, chunk=kw.get("chunk", 4), kv_block_size=8,
+                prefix_cache=pc,
+                **{k: v for k, v in kw.items() if k != "chunk"},
+            )
+            results, metrics[pc] = engine.serve(queue)
+            outs[pc] = [r.tokens for r in results]
+        assert outs[False] == outs[True], f"tier {name}"
+        on = metrics[True]
+        assert on["prefix_hit_tokens"] > 0, f"tier {name}"
+        assert on["prefix_cow_copies"] >= 1, f"tier {name}"
+        assert on["prefill_steps"] < metrics[False]["prefill_steps"], (
+            f"tier {name}"
+        )
+        if name == "fp":
+            for req, toks in zip(queue, outs[True]):
+                if req.temperature > 0:
+                    continue
+                ref = llama.generate(
+                    params, cfg,
+                    jnp.asarray(req.prompt, jnp.int32)[None, :],
+                    max_new_tokens=len(toks) - len(req.prompt),
+                )
+                np.testing.assert_array_equal(
+                    np.array(toks), np.array(ref[0])
+                )
+
+
 def test_serving_cross_family_gptneox():
     """The engine is family-generic: gptneox serves with the same
     exactness contract (its forward_decode has a different cache-filling

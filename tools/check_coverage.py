@@ -36,6 +36,10 @@ FORCE_INCLUDE = [
     # nexus_tpu/ha/ is gated per-file like any other, and the package
     # __init__ re-export shim is gated too so a broken export can't hide
     r"nexus_tpu/ha/__init__\.py$",
+    # the round-6 prefix-cache content index: a correctness-critical
+    # dedup layer (a bad match serves one request another's K/V) —
+    # always gated per-file, whatever future exclusions appear
+    r"nexus_tpu/runtime/prefix_cache\.py$",
 ]
 
 

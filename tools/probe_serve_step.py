@@ -116,6 +116,56 @@ def main() -> int:
                 f"(ceiling {rows / (best / chunk):.0f} tok/s)",
                 file=sys.stderr, flush=True,
             )
+    if os.environ.get("NEXUS_PROBE_PREFIX", "") not in ("", "0", "false"):
+        # end-to-end shared-prefix serve leg (round 6): 16 requests
+        # sharing a 192-token system prompt with distinct tails, prefix
+        # cache on vs off (off == the PR 2 paged engine) — reports the
+        # hit tokens, the prefill step-slots the cache saved, and the
+        # per-request KV reservation reduction, on whatever backend the
+        # probe is attached to
+        from nexus_tpu.runtime.serving import ServeRequest
+
+        rng = np.random.RandomState(0)
+        common = rng.randint(0, cfg.vocab_size, size=192).tolist()
+        reqs = [
+            ServeRequest(
+                prompt=common
+                + rng.randint(0, cfg.vocab_size,
+                              size=int(rng.randint(8, 33))).tolist(),
+                max_new_tokens=int(rng.randint(32, 65)),
+            )
+            for _ in range(16)
+        ]
+        legs = {}
+        for cache_on in (True, False):
+            eng = ServingEngine(
+                llama.forward_decode, params, cfg, batch_size=8,
+                max_len=max_len, chunk=chunk, prefill_chunk=16,
+                kv_block_size=int(
+                    os.environ.get("NEXUS_PROBE_KV_BLOCK") or 32
+                ) or 32,
+                prefix_cache=cache_on,
+            )
+            _, m = eng.serve(reqs)
+            legs[cache_on] = m
+            tag = "prefix_on" if cache_on else "prefix_off"
+            out[f"{tag}_prefill_steps"] = m["prefill_steps"]
+            out[f"{tag}_kv_bytes_per_request"] = m["kv_bytes_per_request"]
+            out[f"{tag}_tokens_per_sec"] = m["tokens_per_sec"]
+        out["prefix_hit_tokens"] = legs[True].get("prefix_hit_tokens")
+        out["prefix_prefill_steps_saved"] = legs[True].get(
+            "prefix_prefill_steps_saved"
+        )
+        out["prefix_prefill_steps_reduction"] = round(
+            legs[False]["prefill_steps"]
+            / max(1, legs[True]["prefill_steps"]), 3,
+        )
+        print(
+            f"[probe] shared-prefix: steps "
+            f"{legs[False]['prefill_steps']}→{legs[True]['prefill_steps']}"
+            f" hit_tokens={out['prefix_hit_tokens']}",
+            file=sys.stderr, flush=True,
+        )
     print(json.dumps(out), flush=True)
     return 0
 
