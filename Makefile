@@ -3,7 +3,7 @@ SHELL := /bin/bash
 NATIVE_SRC := nexus_tpu/native/src/nexus_core.cpp nexus_tpu/native/src/nexus_data.cpp
 NATIVE_LIB := nexus_tpu/native/libnexus_core.so
 
-.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-failover chaos-smoke serve-smoke race-smoke clean lint
+.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-failover bench-serve-outage chaos-smoke serve-smoke serve-chaos-smoke race-smoke clean lint
 
 all: native
 
@@ -48,11 +48,25 @@ bench-serve:
 bench-failover:
 	NEXUS_BENCH_FAILOVER=only NEXUS_BENCH_INIT_PROBE=0 JAX_PLATFORMS=cpu python bench.py
 
+# Serve-outage stage only: engine killed mid-decode → detector confirms →
+# drain-and-requeue with committed tokens preserved → token-identical
+# completion; time-to-recover + requests-lost (must be 0) + shed honesty —
+# CPU-only, stub-model, seconds (docs/failover.md "Serving failover").
+bench-serve-outage:
+	NEXUS_BENCH_SERVE_OUTAGE=only NEXUS_BENCH_INIT_PROBE=0 JAX_PLATFORMS=cpu python bench.py
+
 # Chaos smoke (fast lane): the failover test module alone — detector flap
 # suppression, API-outage vs lease-expiry disambiguation, chaos hooks, and
 # the end-to-end kill → resume-on-second-shard path.
 chaos-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_failover.py -q
+
+# Serve-plane chaos smoke (fast lane): request deadlines, bounded-queue
+# shedding, freeze_engine detector-confirm-without-crash, and the
+# kill-mid-decode → drain-and-requeue exactness drill (prefix cache on AND
+# off) — stub-model + tiny-llama driven, seconds on CPU.
+serve-chaos-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serve_failover.py -q
 
 # Serving smoke (fast lane): allocator/prefix-cache invariants and the
 # engine's sharing/CoW/eviction scheduling on tiny rows/blocks/prefix

@@ -27,7 +27,10 @@ NEXUS_BENCH_SWEEP_LOG the per-measurement session log ('0'/'off'/'false'
 disables;
 default docs/sweep_r5.jsonl on TPU); NEXUS_BENCH_CONTROL_PLANE=0 skips
 the hermetic template-to-running p50 stage; NEXUS_BENCH_CP_TEMPLATES its
-queue size.
+queue size. NEXUS_BENCH_SERVE_OUTAGE=only runs just the serve-outage
+chaos lane (kill-mid-decode → detector → drain-and-requeue; `0` skips
+it inside the serve-only stage), NEXUS_BENCH_SERVE_OUTAGE_TRIALS its
+trial count.
 """
 
 from __future__ import annotations
@@ -653,6 +656,59 @@ def _run_serve_bench(preset, progress, rows=8, kv_block_size=None,
     return m
 
 
+def _serve_outage_bench(progress):
+    """Hermetic serve-outage stage (`make bench-serve-outage`,
+    NEXUS_BENCH_SERVE_OUTAGE=only, and a leg of the serve-only stage):
+    an engine killed mid-decode → lease-expiry confirmation by the real
+    detector → drain-and-requeue with committed tokens preserved →
+    token-identical completion on the replacement engine — CPU-only,
+    stub-model, seconds. Headlines: time-to-recover p50, requests lost
+    (MUST be 0), zero leaked KV blocks, plus the overload leg's shed /
+    deadline-miss rates (bounded-queue honesty). Returns bench keys, {}
+    on failure."""
+    import subprocess
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    tool = os.path.join(root, "tools", "bench_serve_outage.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    trials = int(os.environ.get("NEXUS_BENCH_SERVE_OUTAGE_TRIALS") or 3)
+    try:
+        proc = subprocess.run(
+            [sys.executable, tool, "--trials", str(trials),
+             "--timeout", "60"],
+            capture_output=True, text=True, timeout=240, env=env,
+        )
+        rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 — hermetic leg must not kill bench
+        progress(f"serve-outage bench failed: "
+                 f"{type(e).__name__}: {str(e)[:160]}")
+        return {}
+    if "value" not in rec:
+        progress(f"serve-outage bench: {rec.get('error')}")
+        return {}
+    progress(
+        f"serve-outage bench: time-to-recover p50={rec['value']}s "
+        f"(detection p50={rec.get('detection_p50_s')}s, "
+        f"lost={rec.get('requests_lost')}, exact={rec.get('exact')}, "
+        f"shed_rate={rec.get('shed_rate')}, n={rec['n_trials']})"
+    )
+    _sweep_record("serve_outage", "kill-mid-decode", rec)
+    return {
+        "serve_outage_time_to_recover_p50_s": rec["value"],
+        "serve_outage_detection_p50_s": rec.get("detection_p50_s"),
+        "serve_outage_to_complete_p50_s": rec.get(
+            "outage_to_complete_p50_s"
+        ),
+        "serve_outage_requests_lost": rec.get("requests_lost"),
+        "serve_outage_exact": rec.get("exact"),
+        "serve_outage_kv_leaked_blocks": rec.get("kv_leaked_blocks"),
+        "serve_outage_restarts": rec.get("restarts_total"),
+        "serve_shed_rate": rec.get("shed_rate"),
+        "serve_deadline_miss_rate": rec.get("deadline_miss_rate"),
+        "serve_outage_trials": rec.get("n_trials"),
+    }
+
+
 def _serve_only_stage(progress):
     """Serve-only stage (`make bench-serve`, NEXUS_BENCH_SERVE=only):
     the paged-KV ledger and the row-scaling point, CPU-runnable — the
@@ -749,6 +805,13 @@ def _serve_only_stage(progress):
             off.get("ttft_p50_s", 0.0)
             / max(1e-9, on.get("ttft_p50_s", 1e-9)), 3,
         )
+    # ---- outage leg (round 7): kill-mid-decode → detector → requeue →
+    # token-identical recovery, plus bounded-queue shed honesty — its
+    # time-to-recover / requests-lost keys ride the per-round artifact
+    if os.environ.get("NEXUS_BENCH_SERVE_OUTAGE", "1") not in (
+        "0", "false"
+    ):
+        out.update(_serve_outage_bench(progress))
     return out if legs else {}
 
 
@@ -1270,6 +1333,18 @@ def main() -> int:
             timer.cancel()
         _emit({"metric": "failover_only", **fo})
         return 0 if fo else 1
+
+    # serve-outage-only mode (`make bench-serve-outage`): kill-mid-decode
+    # → detector-confirm → drain-and-requeue, time-to-recover and
+    # requests-lost (must be 0) — CPU-only, seconds
+    if os.environ.get("NEXUS_BENCH_SERVE_OUTAGE", "") == "only":
+        so = _serve_outage_bench(progress)
+        with _print_lock:
+            _done[0] = True
+        if timer is not None:
+            timer.cancel()
+        _emit({"metric": "serve_outage_only", **so})
+        return 0 if so else 1
 
     # serve-only mode (`make bench-serve`): the paged-KV ledger + the
     # rows=4 vs rows=16 scaling point on whatever backend JAX_PLATFORMS

@@ -476,6 +476,20 @@ class ServeSpec:
     # once from the seed — the shared-prefix bench leg's workload knob.
     # 0 = fully independent random prompts (the PR 2 behavior).
     shared_prefix_length: int = 0
+    # ---- serve-plane fault tolerance (round 7) ----
+    # bounded wait queue: past this depth the LOWEST-priority queued
+    # requests shed with an explicit `shed` status instead of queuing
+    # forever (0 = unbounded). Priced alongside kv_pool_blocks: the pool
+    # is sized for `rows` concurrent requests, so a bound BELOW the row
+    # count buys nothing and idles rows — validate() rejects it.
+    max_queue_depth: int = 0
+    # shed any request that has waited unadmitted longer than this
+    # (seconds; 0 = no bound) — the queue-delay half of load shedding
+    max_queue_delay_s: float = 0.0
+    # per-request deadline stamped on every synthetic/literal request
+    # (seconds from engine start; 0 = none): expired rows cancel at the
+    # next wave boundary with status `deadline_exceeded`
+    request_deadline_s: float = 0.0
 
     def kv_request_cap(self, max_seq_len: int) -> int:
         """Worst-case cache positions ONE synthetic-queue request can
@@ -563,6 +577,12 @@ class ServeSpec:
             d["prefixCache"] = False
         if self.shared_prefix_length:
             d["sharedPrefixLength"] = self.shared_prefix_length
+        if self.max_queue_depth:
+            d["maxQueueDepth"] = self.max_queue_depth
+        if self.max_queue_delay_s:
+            d["maxQueueDelaySeconds"] = self.max_queue_delay_s
+        if self.request_deadline_s:
+            d["requestDeadlineSeconds"] = self.request_deadline_s
         return d
 
     @classmethod
@@ -580,6 +600,11 @@ class ServeSpec:
                 True if d.get("prefixCache") is None else d["prefixCache"]
             ),
             shared_prefix_length=int(d.get("sharedPrefixLength", 0) or 0),
+            max_queue_depth=int(d.get("maxQueueDepth", 0) or 0),
+            max_queue_delay_s=float(d.get("maxQueueDelaySeconds", 0) or 0),
+            request_deadline_s=float(
+                d.get("requestDeadlineSeconds", 0) or 0
+            ),
             num_requests=int(d.get("numRequests", 32) or 32),
             prompt_length_min=int(d.get("promptLengthMin", 16) or 16),
             prompt_length_max=int(d.get("promptLengthMax", 128) or 128),
@@ -1036,6 +1061,40 @@ class JaxXlaRuntime:
                     "serve.sharedPrefixLength shapes the SYNTHETIC "
                     "queue; a literal prompts queue carries its own "
                     "shared prefixes in the text"
+                )
+            if sv.max_queue_depth < 0:
+                errs.append(
+                    "serve.maxQueueDepth must be >= 0 (0 = unbounded), "
+                    f"got {sv.max_queue_depth}"
+                )
+            if sv.max_queue_delay_s < 0:
+                errs.append(
+                    "serve.maxQueueDelaySeconds must be >= 0, got "
+                    f"{sv.max_queue_delay_s}"
+                )
+            if sv.request_deadline_s < 0:
+                errs.append(
+                    "serve.requestDeadlineSeconds must be >= 0, got "
+                    f"{sv.request_deadline_s}"
+                )
+            if 0 < sv.max_queue_depth < self.train.batch_size:
+                # priced alongside kv_pool_blocks: the pool reserves
+                # room for batchSize concurrent requests, so a queue
+                # bound below the row count sheds work the engine could
+                # serve while rows (and their reserved blocks) idle
+                errs.append(
+                    f"serve.maxQueueDepth ({sv.max_queue_depth}) below "
+                    f"train.batchSize ({self.train.batch_size}) idles "
+                    "decode rows the KV pool is already sized for; "
+                    "raise the bound to at least the row count"
+                )
+            if (sv.request_deadline_s > 0
+                    and sv.max_queue_delay_s > sv.request_deadline_s):
+                errs.append(
+                    f"serve.maxQueueDelaySeconds ({sv.max_queue_delay_s})"
+                    f" exceeds requestDeadlineSeconds "
+                    f"({sv.request_deadline_s}): every bounded-delay "
+                    "shed would already be a deadline miss"
                 )
             if sv.temperature < 0:
                 errs.append(

@@ -2,11 +2,15 @@
 checkpoint-resume migration across shard clusters (TPU slice pools).
 
 Layer map:
-  lease.py    — worker-side heartbeat protocol (ConfigMap-backed)
-  detector.py — per-shard deadline failure detector (flap-suppressed,
-                API-unreachable vs worker-lease-expired)
-  failover.py — planner: confirmed failure → re-place excluding unhealthy
-                shards → resume from the latest durable checkpoint
+  lease.py          — worker-side heartbeat protocol (ConfigMap-backed)
+  detector.py       — per-shard deadline failure detector (flap-suppressed,
+                      API-unreachable vs worker-lease-expired)
+  failover.py       — planner: confirmed failure → re-place excluding
+                      unhealthy shards → resume from the latest durable
+                      checkpoint
+  serve_failover.py — serve-plane planner: engine heartbeats
+                      (hb-serve-<template>), drain-and-requeue with
+                      committed tokens preserved, freeze_engine chaos hook
 
 See docs/failover.md for the protocol, tuning knobs, and runbook.
 """
@@ -25,6 +29,16 @@ from nexus_tpu.ha.detector import (
     FailureDetector,
 )
 from nexus_tpu.ha.failover import FailoverConfig, FailoverManager
+from nexus_tpu.ha.serve_failover import (
+    SERVE_HB_PREFIX,
+    RequeueEntry,
+    ServeEngineSupervisor,
+    ServeFailoverPlanner,
+    freeze_engine,
+    is_serve_lease,
+    serve_heartbeat_template,
+    strip_serve_prefix,
+)
 from nexus_tpu.ha.lease import (
     LABEL_HEARTBEAT,
     HeartbeatLease,
@@ -49,9 +63,17 @@ __all__ = [
     "FailoverConfig",
     "FailoverManager",
     "LABEL_HEARTBEAT",
+    "SERVE_HB_PREFIX",
     "HeartbeatLease",
     "LeaseRenewer",
+    "RequeueEntry",
+    "ServeEngineSupervisor",
+    "ServeFailoverPlanner",
+    "freeze_engine",
     "freeze_heartbeat",
     "heartbeat_name",
+    "is_serve_lease",
     "list_heartbeats",
+    "serve_heartbeat_template",
+    "strip_serve_prefix",
 ]

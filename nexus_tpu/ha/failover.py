@@ -157,13 +157,22 @@ class FailoverManager:
             self.controller.statsd.gauge(
                 METRIC_SHARD_HEALTHY, 0.0, tags=[f"shard:{shard.name}"]
             )
+            from nexus_tpu.ha.serve_failover import (
+                serve_heartbeat_template,
+            )
+
             for template in self._templates_on_shard(shard.name):
                 # the detector's last observation carries the real progress
                 # (step) — fabricating a fresh lease would report 0 steps
-                # lost for every API-outage failover
+                # lost for every API-outage failover. A serve template's
+                # engine renews under the serve-prefixed key, so check
+                # both before giving up.
                 lease = self.detector.last_heartbeat(
                     shard.name, template.metadata.namespace,
                     template.metadata.name,
+                ) or self.detector.last_heartbeat(
+                    shard.name, template.metadata.namespace,
+                    serve_heartbeat_template(template.metadata.name),
                 ) or HeartbeatLease(
                     template=template.metadata.name,
                     namespace=template.metadata.namespace,
@@ -206,15 +215,40 @@ class FailoverManager:
     def _fail_over_template(self, shard, lease: HeartbeatLease,
                             event: DetectorEvent, api_ok: bool) -> None:
         from nexus_tpu.controller.events import EVENT_TYPE_WARNING
+        from nexus_tpu.ha.serve_failover import (
+            is_serve_lease,
+            strip_serve_prefix,
+        )
 
+        # a serving engine's lease carries the ``serve-`` infix
+        # (hb-serve-<template>, ha/serve_failover.py) — the workload to
+        # re-place is the underlying template either way: a re-placed
+        # serve template re-runs its deterministic queue, and in-process
+        # drain/requeue (committed-token recovery) is the
+        # ServeFailoverPlanner's job, not this planner's. Resolution
+        # tries the lease's OWN name first so a workload that happens to
+        # be literally named ``serve-<x>`` is never misrouted onto
+        # ``<x>``; only an unresolved serve-prefixed lease falls back to
+        # the stripped name.
+        template = None
+        workload = lease.template
         try:
             template = self.controller.template_lister.get(
-                lease.namespace, lease.template
+                lease.namespace, workload
             )
         except NotFoundError:
+            if is_serve_lease(lease.template):
+                workload = strip_serve_prefix(lease.template)
+                try:
+                    template = self.controller.template_lister.get(
+                        lease.namespace, workload
+                    )
+                except NotFoundError:
+                    template = None
+        if template is None:
             # template gone (deleted mid-run): just clean the stale lease
             if api_ok:
-                self._cleanup_failed_shard(shard, lease)
+                self._cleanup_failed_shard(shard, lease, workload)
             return
         if template.spec.runtime is None:
             return
@@ -233,7 +267,7 @@ class FailoverManager:
             # Failing over a healthy/finished workload would re-run it —
             # just reap the leftovers.
             if api_ok:
-                self._cleanup_failed_shard(shard, lease)
+                self._cleanup_failed_shard(shard, lease, workload)
             return
 
         restore_step = self._restore_step(template)
@@ -263,7 +297,7 @@ class FailoverManager:
         if api_ok:
             # worker dead but shard API up: reap the dead Job so it stops
             # holding TPU, and the stale heartbeat so the detector forgets it
-            self._cleanup_failed_shard(shard, lease)
+            self._cleanup_failed_shard(shard, lease, workload)
         self.controller.recorder.event(
             template, EVENT_TYPE_WARNING, REASON_FAILOVER,
             f"Workload on shard {shard.name!r} "
@@ -314,9 +348,14 @@ class FailoverManager:
         )
         return None
 
-    def _cleanup_failed_shard(self, shard, lease: HeartbeatLease) -> None:
+    def _cleanup_failed_shard(self, shard, lease: HeartbeatLease,
+                              workload: Optional[str] = None) -> None:
         """Best-effort: delete the dead Jobs + stale heartbeat on the failed
-        shard (lease-expiry path only — the shard API is known reachable)."""
+        shard (lease-expiry path only — the shard API is known reachable).
+        ``workload`` is the RESOLVED template name the lease belongs to
+        (the caller's collision-safe resolution); Jobs are label-selected
+        by it, while the heartbeat ConfigMap keeps the lease's own
+        (possibly serve-prefixed) name."""
         from nexus_tpu.api.types import (
             CONTROLLER_APP_NAME,
             ConfigMap,
@@ -327,7 +366,7 @@ class FailoverManager:
 
         selector = {
             LABEL_CONTROLLER_APP: CONTROLLER_APP_NAME,
-            LABEL_TEMPLATE: lease.template,
+            LABEL_TEMPLATE: workload or lease.template,
         }
         try:
             for job in shard.store.list(

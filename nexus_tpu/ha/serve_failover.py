@@ -1,0 +1,496 @@
+"""Serve-plane failover: drain a dead engine's requests, requeue them
+with committed tokens preserved, complete them on a replacement engine.
+
+Training jobs already fail over step-exact (ha/failover.py); this module
+is the SERVING analogue. A serving engine renews an
+``hb-serve-<template>`` heartbeat lease at every wave boundary through
+the exact same ConfigMap protocol trainers use (ha/lease.py), so the
+existing :class:`~nexus_tpu.ha.detector.FailureDetector` confirms engine
+death — wedged (lease frozen, process alive) or crashed (silence) — with
+the same flap suppression and clock discipline. What differs is the
+recovery unit: a trainer resumes from a checkpoint step; a serving
+engine's durable state is each request's COMMITTED TOKEN PREFIX.
+
+The :class:`ServeFailoverPlanner` turns an engine's drain snapshot
+(``ServingEngine.last_drain`` — in-flight rows with their committed
+tokens plus the still-queued tail) into a requeue plan: each in-flight
+request re-enters the wait queue with its committed completion FOLDED
+INTO THE PROMPT, so the replacement engine never re-decodes recovered
+work — it chunk-prefills prompt + committed (cheap, parameter-bound) and
+decodes only the unmatched tail. Exactness carries over unchanged:
+
+  * greedy (temperature 0): token i+1 is a function of tokens 0..i
+    alone, so decoding the remaining budget from prompt + committed
+    reproduces the undisturbed stream token for token;
+  * sampled: the engine's sampling key is (request seed, absolute buffer
+    position) and the merged prompt preserves every absolute position,
+    so the recovered sample stream is identical too;
+  * with the prefix cache on, the merged prompts' full-block hash chains
+    (prompt PLUS already-committed completion) dedupe across requeued
+    requests on the replacement engine — a shared system preamble
+    prefills once for the whole recovered cohort, exactly as on the
+    engine that died.
+
+The :class:`ServeEngineSupervisor` is the in-process harness that wires
+the pieces end to end — renewer → detector → confirm → fence → drain →
+requeue → replacement — for the chaos tests, ``make serve-chaos-smoke``,
+and the ``bench-serve-outage`` lane. On real fleets the controller plays
+this role through the same planner (the fleet-serving ROADMAP item).
+
+Chaos surface: :func:`freeze_engine` wedges an engine's lease without
+killing the process (the serve twin of ``freeze_heartbeat``), and a
+launcher-style hard kill (CancelToken) stops renewals outright; the
+detector must confirm both.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from nexus_tpu.ha.detector import EVENT_LEASE_EXPIRED, FailureDetector
+from nexus_tpu.ha.lease import (
+    LeaseRenewer,
+    freeze_heartbeat,
+    heartbeat_name,
+    list_heartbeats,
+)
+
+logger = logging.getLogger("nexus_tpu.ha")
+
+# A serving engine's lease is ``hb-serve-<template>``: the ``serve-``
+# infix keeps engine liveness distinct from the template's own training
+# heartbeat namespace while riding the identical ConfigMap protocol,
+# detector, and chaos hooks.
+SERVE_HB_PREFIX = "serve-"
+
+
+def serve_heartbeat_template(template_name: str) -> str:
+    """Template field of a serving engine's lease (ConfigMap name then
+    becomes ``hb-serve-<template>`` via ha.lease.heartbeat_name)."""
+    return SERVE_HB_PREFIX + template_name
+
+
+def is_serve_lease(lease_template: str) -> bool:
+    return lease_template.startswith(SERVE_HB_PREFIX)
+
+
+def strip_serve_prefix(lease_template: str) -> str:
+    """The workload template a (possibly serve-) lease belongs to — the
+    name the failover planner must look up and label-select Jobs by."""
+    if is_serve_lease(lease_template):
+        return lease_template[len(SERVE_HB_PREFIX):]
+    return lease_template
+
+
+def freeze_engine(store, namespace: str, template_name: str) -> None:
+    """Chaos hook ("wedge engine"): freeze a serving engine's heartbeat
+    lease so its renewer stops touching it while the engine process
+    stays alive and serving — the detector must confirm the death
+    WITHOUT a crash ever happening (mirrors ``freeze_heartbeat`` for
+    trainers)."""
+    freeze_heartbeat(store, namespace, serve_heartbeat_template(template_name))
+
+
+@dataclass
+class RequeueEntry:
+    """One live entry of the (re)queue: the ORIGINAL queue index it
+    answers, the request as the next engine generation should see it
+    (committed tokens folded into the prompt, budget reduced, retries
+    bumped), every token recovered from prior generations, and the
+    serve time those dead generations already spent (``elapsed_s`` —
+    added back into the stitched latency so failover can never make a
+    request look FASTER than an undisturbed run)."""
+
+    request_idx: int
+    request: Any  # ServeRequest (imported lazily — keep jax out of ha/)
+    committed: List[int] = field(default_factory=list)
+    elapsed_s: float = 0.0
+
+
+class ServeFailoverPlanner:
+    """Pure planner: drain snapshot → requeue plan → stitched results.
+
+    Stateless between calls and free of clocks, threads, and stores —
+    every path unit-tests in microseconds (the detector's design). The
+    supervisor (below) and the controller own orchestration."""
+
+    def fresh(self, requests: Sequence[Any]) -> List[RequeueEntry]:
+        """The generation-0 queue: every request verbatim."""
+        return [
+            RequeueEntry(request_idx=i, request=req)
+            for i, req in enumerate(requests)
+        ]
+
+    def requeue(self, entries: Sequence[RequeueEntry],
+                drained: Sequence[Any]) -> List[RequeueEntry]:
+        """Drained requests (``DrainedRequest``, indices into THIS
+        generation's queue) → the next generation's queue. Committed
+        tokens fold into the prompt (never re-decoded; absolute buffer
+        positions — and therefore sampled streams — are preserved), the
+        decode budget shrinks by exactly what was recovered, and
+        ``retries`` increments. Queue order is preserved: in-flight rows
+        requeue ahead of the never-admitted tail, matching the FIFO
+        order the dead engine was serving."""
+        from nexus_tpu.runtime.serving import ServeRequest
+
+        out: List[RequeueEntry] = []
+        for d in drained:
+            base = entries[d.request_idx]
+            req = base.request
+            committed = [int(t) for t in d.committed]
+            # the deadline budget is cumulative SERVE time: charge the
+            # dead generation's elapsed clock so engine deaths can never
+            # extend a request's deadline indefinitely (an exhausted
+            # budget requeues with an epsilon deadline — the replacement
+            # terminates it `deadline_exceeded` at its first boundary
+            # instead of silently serving past the SLA). Detection /
+            # restart wall time is NOT charged — the engine clock pauses
+            # while nothing is being served (documented in
+            # docs/failover.md).
+            deadline = float(req.deadline_s or 0.0)
+            if deadline > 0:
+                deadline = max(1e-9, deadline - float(d.elapsed_s or 0.0))
+            remaining = int(req.max_new_tokens) - len(committed)
+            if remaining < 1:
+                # can't happen off a consistent drain (a budget-complete
+                # row finishes before any boundary snapshot), but a
+                # malformed snapshot must not crash recovery
+                logger.warning(
+                    "drained request %d arrived budget-complete; "
+                    "requeueing 1-token tail", base.request_idx,
+                )
+                remaining = 1
+            merged = ServeRequest(
+                prompt=[int(t) for t in req.prompt] + committed,
+                max_new_tokens=remaining,
+                temperature=req.temperature,
+                seed=req.seed,
+                deadline_s=deadline,
+                priority=req.priority,
+                retries=int(req.retries) + 1,
+            )
+            out.append(RequeueEntry(
+                request_idx=base.request_idx,
+                request=merged,
+                committed=list(base.committed) + committed,
+                elapsed_s=float(base.elapsed_s) + float(d.elapsed_s or 0.0),
+            ))
+        return out
+
+    def stitch(self, entry: RequeueEntry, result: Any) -> Any:
+        """A recovered entry's engine result → the final ServeResult the
+        ORIGINAL caller sees: ``new_tokens`` counts recovered + fresh
+        tokens against the original prompt, ``latency_s`` adds the serve
+        time the dead generations already spent (failover must never
+        make a request look FASTER than an undisturbed run; detection /
+        restart wall time between generations is still excluded — the
+        supervisor reports it separately as recover_s), ``status``
+        becomes ``failed_over`` for requests that survived an engine
+        death and completed (shed / deadline statuses propagate
+        unchanged — a failover must not launder a miss into a success),
+        and the retry count rides along. ttft_s/queue_s remain the
+        FINAL generation's observations (the true first token of a
+        requeued request landed on an engine that no longer exists)."""
+        from nexus_tpu.runtime.serving import (
+            STATUS_FAILED_OVER,
+            STATUS_OK,
+            ServeResult,
+        )
+
+        if result is None:
+            return None
+        status = result.status
+        if status == STATUS_OK and entry.request.retries > 0:
+            status = STATUS_FAILED_OVER
+        return ServeResult(
+            tokens=list(result.tokens),
+            new_tokens=len(entry.committed) + result.new_tokens,
+            finished_by_stop=result.finished_by_stop,
+            latency_s=round(float(entry.elapsed_s) + result.latency_s, 6),
+            ttft_s=result.ttft_s,
+            queue_s=result.queue_s,
+            status=status,
+            retries=int(result.retries),
+        )
+
+
+class ServeEngineSupervisor:
+    """Drive one serve queue to completion across engine deaths.
+
+    One generation = one engine (``make_engine()``) serving the current
+    queue in a worker thread while renewing its ``hb-serve-<template>``
+    lease at wave boundaries; the supervisor probes the store and feeds
+    the :class:`FailureDetector` exactly as the FailoverManager probes
+    trainer shards. A confirmed expiry FENCES the engine (cancel token —
+    a wedged engine must stop committing before its requests re-enter
+    the queue), drains it, requeues through the planner (stale/frozen
+    lease reaped so the replacement starts clean), and starts the next
+    generation. Requests that finished before a death keep their results
+    (with ``failed_over`` stamped on recovered completions).
+
+    ``kill_current(hard=True)`` is the launcher-style chaos kill for the
+    RUNNING generation (the engine stops renewing and exits — silence
+    the detector must confirm); ``freeze_engine`` wedges the lease with
+    the process alive. Both recovery paths are exercised by
+    ``make serve-chaos-smoke`` and the ``bench-serve-outage`` lane.
+    """
+
+    def __init__(
+        self,
+        make_engine: Callable[[], Any],
+        store,
+        namespace: str,
+        template: str,
+        ttl_seconds: float = 0.25,
+        shard: str = "serve-shard",
+        max_restarts: int = 3,
+        poll_s: Optional[float] = None,
+        pace_s: float = 0.0,
+        detector: Optional[FailureDetector] = None,
+        planner: Optional[ServeFailoverPlanner] = None,
+    ):
+        self.make_engine = make_engine
+        self.store = store
+        self.namespace = namespace
+        self.template = template
+        self.ttl = float(ttl_seconds)
+        self.shard = shard
+        self.max_restarts = int(max_restarts)
+        self.poll_s = float(poll_s) if poll_s else max(0.01, self.ttl / 5.0)
+        # pace_s > 0 sleeps per wave boundary — gives CPU-instant stub
+        # chunks a wall-clock duration so chaos can land mid-run (the
+        # LocalLauncher.step_pace_s pattern)
+        self.pace_s = float(pace_s)
+        self.detector = detector or FailureDetector(
+            ttl_seconds=self.ttl,
+            suspect_misses=2,
+            probe_interval=self.poll_s,
+        )
+        self.planner = planner or ServeFailoverPlanner()
+        self._current_cancel = None
+        self._last_heartbeats: List[Any] = []
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------ chaos
+    def kill_current(self, hard: bool = True) -> bool:
+        """Launcher-style kill of the RUNNING engine generation (the
+        renewer stops with it — the detector sees silence). Returns True
+        if a generation was running."""
+        with self._lock:
+            cancel = self._current_cancel
+        if cancel is None:
+            return False
+        cancel.cancel(hard=hard)
+        return True
+
+    # ------------------------------------------------------------- mechanics
+    def _serve_lease_template(self) -> str:
+        return serve_heartbeat_template(self.template)
+
+    def _probe(self) -> List:
+        """One detector probe of the store's heartbeats (API errors are
+        observations, exactly as in FailoverManager.probe_once)."""
+        try:
+            heartbeats = list_heartbeats(self.store)
+        except Exception as e:  # noqa: BLE001 — outage is an observation
+            return self.detector.observe_api_error(self.shard, e)
+        self._last_heartbeats = heartbeats
+        return self.detector.observe(self.shard, heartbeats)
+
+    def _confirmed(self, events) -> Optional[float]:
+        tpl = self._serve_lease_template()
+        for ev in events:
+            if (ev.kind == EVENT_LEASE_EXPIRED and ev.lease is not None
+                    and ev.lease.template == tpl):
+                return float(ev.detection_seconds)
+        return None
+
+    def _reap_lease(self) -> None:
+        """Delete the dead generation's (possibly frozen) lease so the
+        replacement's renewer starts from a clean ConfigMap — a frozen
+        lease left behind would instantly re-freeze the new renewer (the
+        serve mirror of FailoverManager._cleanup_failed_shard)."""
+        from nexus_tpu.api.types import ConfigMap
+        from nexus_tpu.cluster.store import NotFoundError
+
+        try:
+            self.store.delete(
+                ConfigMap.KIND, self.namespace,
+                heartbeat_name(self._serve_lease_template()),
+            )
+        except NotFoundError:
+            pass
+        except Exception:  # noqa: BLE001 — cleanup is advisory
+            logger.debug("serve lease reap incomplete", exc_info=True)
+
+    # ------------------------------------------------------------------- run
+    def run(self, requests: Sequence[Any], timeout_s: float = 180.0):
+        """Serve ``requests`` to terminal results, surviving up to
+        ``max_restarts`` engine deaths → ``(results, report)``.
+
+        ``results[i]`` answers ``requests[i]`` — None only for requests
+        genuinely lost (the acceptance gate requires zero). ``report``:
+        ``restarts``, per-death ``detection_seconds`` and
+        ``recover_s`` (confirmation → replacement engine's lease live
+        again), ``requeued`` request count, ``fenced_alive`` (a
+        confirmed-dead engine was still running — the freeze_engine
+        case), ``requests_lost``, and per-generation engine metrics
+        (``generations`` — the kill-side pool-partition audit reads the
+        dead generation's ledger here)."""
+        from nexus_tpu.utils.signals import CancelToken
+
+        results: List[Optional[Any]] = [None] * len(requests)
+        queue = self.planner.fresh(requests)
+        report: Dict[str, Any] = {
+            "restarts": 0,
+            "detections_s": [],
+            "recover_s": [],
+            "requeued": 0,
+            "fenced_alive": False,
+            "generations": [],
+        }
+        deadline = time.monotonic() + float(timeout_s)
+        pending_recover_t0: Optional[float] = None
+        attempt = 0
+        while queue:
+            engine = self.make_engine()
+            cancel = CancelToken()
+            with self._lock:
+                self._current_cancel = cancel
+            holder = f"engine-{attempt}"
+            renewer = LeaseRenewer(
+                self.store, self.namespace, self._serve_lease_template(),
+                holder=holder, ttl_seconds=self.ttl,
+            )
+
+            def hb(step, _renewer=renewer):
+                _renewer.renew(step)
+                if self.pace_s > 0:
+                    time.sleep(self.pace_s)
+
+            box: Dict[str, Any] = {}
+            gen_queue = queue
+
+            def work(_engine=engine, _cancel=cancel, _hb=hb,
+                     _queue=gen_queue, _box=box):
+                try:
+                    _box["results"], _box["metrics"] = _engine.serve(
+                        [e.request for e in _queue],
+                        cancel=_cancel, heartbeat=_hb,
+                    )
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    _box["error"] = e
+
+            thread = threading.Thread(
+                target=work, daemon=True,
+                name=f"serve-engine-{self.template}-{attempt}",
+            )
+            thread.start()
+
+            confirmed_detection: Optional[float] = None
+            while thread.is_alive():
+                if time.monotonic() > deadline:
+                    cancel.cancel(hard=True)
+                    thread.join(timeout=10.0)
+                    raise TimeoutError(
+                        f"supervised serve of {self.template!r} exceeded "
+                        f"{timeout_s}s"
+                    )
+                events = self._probe()
+                if pending_recover_t0 is not None and any(
+                    hb_.template == self._serve_lease_template()
+                    and hb_.holder == holder
+                    for hb_ in self._last_heartbeats
+                ):
+                    # the replacement engine's lease is live again —
+                    # confirmation → back-in-service, the serving half
+                    # of time-to-recover
+                    report["recover_s"].append(
+                        time.monotonic() - pending_recover_t0
+                    )
+                    pending_recover_t0 = None
+                confirmed_detection = self._confirmed(events)
+                if confirmed_detection is not None:
+                    # confirmed death with the process still running: a
+                    # WEDGED engine (frozen lease) — fence it before its
+                    # requests can be requeued anywhere else
+                    report["fenced_alive"] = True
+                    cancel.cancel(hard=True)
+                    break
+                time.sleep(self.poll_s)
+            thread.join(timeout=30.0)
+            with self._lock:
+                self._current_cancel = None
+            if thread.is_alive():
+                # a fenced engine that won't reach its next wave
+                # boundary within the join window is a zombie — its
+                # drain snapshot never materialized, so treating this
+                # as clean completion would silently abandon every
+                # recoverable request. Fail loudly instead.
+                raise RuntimeError(
+                    f"serve engine {self.template!r} (generation "
+                    f"{attempt}) did not stop within 30s of fencing; "
+                    "its requests cannot be drained in-process"
+                )
+            if "error" in box:
+                raise box["error"]
+            gen_results = box.get("results") or [None] * len(gen_queue)
+            gen_metrics = box.get("metrics") or {}
+            report["generations"].append(gen_metrics)
+            # harvest everything this generation finished (including
+            # terminal shed / deadline statuses — those are answers)
+            for entry, res in zip(gen_queue, gen_results):
+                if res is not None:
+                    results[entry.request_idx] = self.planner.stitch(
+                        entry, res
+                    )
+            drained = getattr(engine, "last_drain", None) or []
+            if not drained:
+                if pending_recover_t0 is not None:
+                    # the generation completed before the monitor ever
+                    # saw its lease — bound recover time by completion
+                    report["recover_s"].append(
+                        time.monotonic() - pending_recover_t0
+                    )
+                    pending_recover_t0 = None
+                if confirmed_detection is None:
+                    renewer.complete(
+                        int(gen_metrics.get("committed_tokens", -1) or -1)
+                    )
+                break  # clean completion — nothing to fail over
+            # death path: the detector must CONFIRM before requeue (a
+            # crash stops renewals; confirmation arrives by silence)
+            if confirmed_detection is None:
+                confirmed_detection = self._await_confirmation(deadline)
+            report["detections_s"].append(confirmed_detection)
+            report["restarts"] += 1
+            if report["restarts"] > self.max_restarts:
+                raise RuntimeError(
+                    f"serve failover gave up after {self.max_restarts} "
+                    f"restarts with {len(drained)} requests outstanding"
+                )
+            queue = self.planner.requeue(gen_queue, drained)
+            report["requeued"] += len(queue)
+            self._reap_lease()
+            pending_recover_t0 = time.monotonic()
+            attempt += 1
+        report["requests_lost"] = sum(1 for r in results if r is None)
+        return results, report
+
+    def _await_confirmation(self, deadline: float) -> float:
+        """Probe until the detector confirms the serve lease expired (a
+        crashed engine is confirmed by silence, after the flap
+        suppression's full window count)."""
+        while time.monotonic() < deadline:
+            detection = self._confirmed(self._probe())
+            if detection is not None:
+                return detection
+            time.sleep(self.poll_s)
+        raise TimeoutError(
+            f"failure detector never confirmed the death of serve "
+            f"engine {self.template!r}"
+        )

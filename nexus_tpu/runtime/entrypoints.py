@@ -124,7 +124,12 @@ def run_template_runtime(
     if runtime.mode == "infer":
         return _run_infer(runtime, family, cfg, mesh)
     if runtime.mode == "serve":
-        return _run_serve(runtime, family, cfg, mesh)
+        # the serve engine honors the same liveness/cancel contract as
+        # training: heartbeat at wave boundaries (→ hb-serve-<template>
+        # lease), cancel → drain at the next boundary (failover requeue)
+        return _run_serve(
+            runtime, family, cfg, mesh, cancel=cancel, heartbeat=heartbeat,
+        )
     return _run_train(
         runtime, family, cfg, mesh, n_devices, max_steps, cancel,
         heartbeat=heartbeat, restore_step=restore_step,
@@ -800,14 +805,19 @@ def _decode_completion(tokenizer, new_ids, stop_token_id: int) -> str:
     return tokenizer.decode(new_ids)
 
 
-def _run_serve(runtime, family, cfg, mesh):
+def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None):
     """Continuous-batching serving (mode='serve'): a synthetic request
     queue — deterministic from train.seed — decodes through
     runtime/serving.py's fixed-row engine; finished rows are refilled
     between chunks. Weights load exactly like mode='infer' (checkpoint or
     safetensors). The headline metrics are aggregate tokens/sec and
     slot utilization under uneven request lengths — the two numbers
-    static batching sacrifices."""
+    static batching sacrifices.
+
+    ``heartbeat`` renews the engine's liveness lease at wave boundaries
+    (the launcher names it ``hb-serve-<template>``); ``cancel`` drains
+    the engine at the next boundary with committed tokens preserved —
+    the serve-failover requeue path (ha/serve_failover.py)."""
     if getattr(family, "forward_decode", None) is None:
         raise ValueError(
             f"model family {runtime.model.family!r} does not support "
@@ -817,6 +827,7 @@ def _run_serve(runtime, family, cfg, mesh):
     import numpy as _np
 
     from nexus_tpu.runtime.serving import (
+        STATUS_OK,
         ServeRequest,
         ServingEngine,
         percentile_nearest_rank,
@@ -865,6 +876,7 @@ def _run_serve(runtime, family, cfg, mesh):
                     max_new_tokens=sv.max_new_max,
                     temperature=sv.temperature,
                     seed=i,
+                    deadline_s=sv.request_deadline_s,
                 ))
         else:
             # sharedPrefixLength: one common preamble (system-prompt
@@ -892,6 +904,7 @@ def _run_serve(runtime, family, cfg, mesh):
                     max_new_tokens=n,
                     temperature=sv.temperature,
                     seed=len(requests),  # per-request stream, deterministic
+                    deadline_s=sv.request_deadline_s,
                 ))
         # serving cache layout mirrors the infer path: kv heads over the
         # tensor axis, rows over the data axes (replicated when they don't
@@ -936,10 +949,21 @@ def _run_serve(runtime, family, cfg, mesh):
                 tr.batch_size, cfg.max_seq_len
             ),
             prefix_cache=sv.prefix_cache,
+            max_queue_depth=sv.max_queue_depth,
+            max_queue_delay_s=sv.max_queue_delay_s,
         )
-        results, metrics = engine.serve(requests)
+        results, metrics = engine.serve(
+            requests, cancel=cancel, heartbeat=heartbeat,
+        )
     finished = sum(1 for r in results if r is not None)
-    latencies = sorted(r.latency_s for r in results if r is not None)
+    # the latency rollups describe SERVED requests only — shed and
+    # deadline-missed terminals would flatter the p50 with their
+    # near-zero "latencies" (and an all-shed round reports NO rollup at
+    # all rather than a perfect one)
+    latencies = sorted(
+        r.latency_s for r in results
+        if r is not None and r.status == STATUS_OK
+    )
     p50 = latencies[len(latencies) // 2] if latencies else 0.0
     p95 = percentile_nearest_rank(latencies, 0.95)
     text_extra = {}
@@ -952,7 +976,7 @@ def _run_serve(runtime, family, cfg, mesh):
             )
             for req_ids, res in zip(literal_ids, results)
         ]}
-    return {
+    out = {
         **metrics,
         **text_extra,
         "mode": "serve",
@@ -961,8 +985,10 @@ def _run_serve(runtime, family, cfg, mesh):
         "weights_loaded": weights_loaded,
         "restored_step": restored_step,
         "finished_requests": finished,
-        "request_latency_p50_s": round(p50, 4),
-        "request_latency_p95_s": round(p95, 4),
         "batch_rows": tr.batch_size,
         "n_devices": mesh.devices.size,
     }
+    if latencies:  # omitted when nothing was served (all shed/expired)
+        out["request_latency_p50_s"] = round(p50, 4)
+        out["request_latency_p95_s"] = round(p95, 4)
+    return out

@@ -224,10 +224,21 @@ class LocalLauncher:
         if self.heartbeat_ttl > 0:
             from nexus_tpu.ha.lease import LeaseRenewer
 
+            hb_template = name
+            if tmpl.spec.runtime.mode == "serve":
+                # serving engines renew ``hb-serve-<template>`` (the
+                # detector confirms their death exactly as for trainers;
+                # the failover planners strip the infix back to the
+                # workload template — ha/serve_failover.py)
+                from nexus_tpu.ha.serve_failover import (
+                    serve_heartbeat_template,
+                )
+
+                hb_template = serve_heartbeat_template(name)
             renewer = LeaseRenewer(
                 self.store,
                 namespace=tmpl.metadata.namespace,
-                template_name=name,
+                template_name=hb_template,
                 holder=f"local-{self.store.name}",
                 ttl_seconds=self.heartbeat_ttl,
             )

@@ -125,6 +125,20 @@ class PrefixCacheIndex:
         """A parked block is being re-referenced (shared admission)."""
         self._parked.pop(block, None)
 
+    def parked_blocks(self) -> List[int]:
+        """The refcount-0 indexed block ids in LRU → MRU order — the
+        leak audit's view of the parked partition (every parked block
+        must also be indexed; tests/test_serve_failover.py cross-checks
+        this against the allocator's pool partition after drain and
+        deadline-cancellation chaos)."""
+        bad = [blk for blk in self._parked if blk not in self._by_block]
+        if bad:
+            raise RuntimeError(
+                f"parked blocks {bad} have no content index entry — "
+                "park/evict bookkeeping diverged"
+            )
+        return list(self._parked)
+
     def evict_lru(self) -> int:
         """Reclaim the least-recently-used PARKED block: drop its digest
         so it can never match again, return it for reallocation. Only

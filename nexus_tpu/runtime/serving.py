@@ -162,6 +162,28 @@ class BlockAllocator:
         currency)."""
         return len(self._free) + self.cached_blocks - self._reserved
 
+    @property
+    def reserved_blocks(self) -> int:
+        """Blocks promised to admitted rows but not yet materialized."""
+        return self._reserved
+
+    def pool_partition(self) -> dict:
+        """The leak audit: every pool block is in exactly ONE of three
+        states — free (on the free list), parked (refcount 0, content
+        indexed), or allocated (mapped by >= 1 row). The three must
+        partition ``num_blocks`` at all times; after every lease has
+        released (drain, cancellation, completion) ``reserved`` must be
+        0 and ``allocated`` must be 0 too — anything else is a leaked
+        block. tests/test_serve_failover.py asserts this after
+        kill-mid-decode chaos."""
+        return {
+            "free": self.free_blocks,
+            "parked": self.cached_blocks,
+            "allocated": self.allocated_blocks,
+            "reserved": self._reserved,
+            "total": self.num_blocks,
+        }
+
     def match_prefix(self, keys, prompt_len: int):
         """Longest cached prefix of a prompt whose full-block hash chain
         is ``keys`` → ``(shared_blocks, matched_len, cow_src)``.
@@ -281,15 +303,25 @@ class _BlockLease:
 
 
 def percentile_nearest_rank(xs: Sequence[float], q: float) -> float:
-    """Nearest-rank percentile of a sequence (0.0 when empty) — serve
-    latency/ttft/queue populations are a handful of values per run, so
-    the simple estimator is the honest one. Shared by the engine's
-    metrics and the entrypoint's request-latency rollups so the rank
-    formula can't diverge between them."""
+    """Nearest-rank percentile of a sequence — serve latency/ttft/queue
+    populations are a handful of values per run, so the simple estimator
+    is the honest one. Shared by the engine's metrics and the
+    entrypoint's request-latency rollups so the rank formula can't
+    diverge between them.
+
+    An EMPTY population returns NaN, never 0.0: an all-shed round must
+    not report a perfect p95 (callers omit the metric instead)."""
     if not xs:
-        return 0.0
+        return float("nan")
     s = sorted(xs)
     return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
+
+
+# ---- terminal request statuses (ServeResult.status) ----
+STATUS_OK = "ok"
+STATUS_DEADLINE_EXCEEDED = "deadline_exceeded"
+STATUS_SHED = "shed"
+STATUS_FAILED_OVER = "failed_over"
 
 
 @dataclass
@@ -304,12 +336,25 @@ class ServeRequest:
     are, and whatever the engine's batch size is (the same
     batch-invariance contract as greedy, tested in test_serving.py).
     Plain temperature only (top-k/top-p truncation stays on the static
-    path)."""
+    path).
+
+    Fault-tolerance fields (round 7): ``deadline_s`` > 0 bounds the
+    request's total time from enqueue (engine start) — the engine checks
+    it at every wave boundary, cancelling the row (or dropping the
+    queued request) with a terminal ``deadline_exceeded`` status instead
+    of serving a result nobody is waiting for. ``priority`` orders LOAD
+    SHEDDING only (admission stays FIFO): when the bounded queue
+    overflows, the LOWEST priority queued request is shed first.
+    ``retries`` counts engine-death requeues (stamped by the
+    ServeFailoverPlanner, echoed into the result)."""
 
     prompt: Sequence[int]
     max_new_tokens: int = 128
     temperature: float = 0.0
     seed: int = 0
+    deadline_s: float = 0.0
+    priority: int = 0
+    retries: int = 0
 
 
 @dataclass
@@ -320,7 +365,15 @@ class ServeResult:
     admission: the wait the HBM-aware gate and prefix-aware deferral
     impose), and ``ttft_s`` (admission → first committed token: the
     prefill cost the user actually feels, observed at chunk granularity
-    — the number prefix caching attacks directly)."""
+    — the number prefix caching attacks directly).
+
+    ``status`` is the request's TERMINAL disposition — ``ok`` (served to
+    completion), ``deadline_exceeded`` (cancelled at a wave boundary;
+    ``tokens`` carries whatever was committed), ``shed`` (refused by the
+    bounded queue — never admitted, zero compute spent), or
+    ``failed_over`` (completed on a replacement engine after its first
+    engine died; stamped by the ServeFailoverPlanner). ``retries`` is
+    the number of engine-death requeues the request survived."""
 
     tokens: List[int]
     new_tokens: int
@@ -328,6 +381,26 @@ class ServeResult:
     latency_s: float
     ttft_s: float = 0.0
     queue_s: float = 0.0
+    status: str = STATUS_OK
+    retries: int = 0
+
+
+@dataclass
+class DrainedRequest:
+    """One request drained off a cancelled/dead engine: its index into
+    the serve() queue, the tokens it had committed before death (exact
+    greedy/sampled prefix of its full completion — the engine commits at
+    chunk granularity, so the snapshot is always token-consistent),
+    whether it ever held a row, and how long the dead engine had already
+    been serving (``elapsed_s`` — charged against the request's deadline
+    on requeue, so engine deaths can't extend a deadline indefinitely).
+    The ServeFailoverPlanner folds ``committed`` into the requeued
+    prompt so a replacement engine never re-decodes recovered work."""
+
+    request_idx: int
+    committed: List[int] = field(default_factory=list)
+    admitted: bool = False
+    elapsed_s: float = 0.0
 
 
 @dataclass
@@ -358,6 +431,9 @@ class ServingEngine:
         kv_block_size: int = 32,
         kv_num_blocks: int = 0,
         prefix_cache: bool = True,
+        max_queue_depth: int = 0,
+        max_queue_delay_s: float = 0.0,
+        clock: Callable[[], float] = time.monotonic,
     ):
         """``prefill_chunk`` (T): prompt tokens an admitting row consumes
         per decode step. A T-slot feed costs every row T slots of matmul
@@ -413,7 +489,16 @@ class ServingEngine:
         followers then admit together in one wave. Sharing is pure
         bookkeeping — outputs are token-for-token identical to
         ``prefix_cache=False`` (tested across the fp, int8-KV, and
-        speculative tiers)."""
+        speculative tiers).
+
+        ``max_queue_depth`` (round 7) bounds the wait queue: past it the
+        LOWEST-priority queued requests are shed with an honest ``shed``
+        status instead of queuing forever (0 = unbounded — the pre-7
+        behavior). ``max_queue_delay_s`` sheds any request that has
+        waited unadmitted longer than this (0 = no bound). Both are
+        policed at every wave boundary, never mid-dispatch. ``clock`` is
+        injectable (the detector's pattern) so deadline/shed paths
+        unit-test without sleeps."""
         self._fwd = forward_decode
         self._params = params
         self._cfg = cfg
@@ -439,6 +524,20 @@ class ServingEngine:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {prefill_chunk}"
             )
+        self._max_queue_depth = int(max_queue_depth)
+        if self._max_queue_depth < 0:
+            raise ValueError(
+                f"max_queue_depth must be >= 0, got {max_queue_depth}"
+            )
+        self._max_queue_delay = float(max_queue_delay_s)
+        if self._max_queue_delay < 0:
+            raise ValueError(
+                f"max_queue_delay_s must be >= 0, got {max_queue_delay_s}"
+            )
+        self._clock = clock
+        # drain snapshot of the last cancelled serve() run (engine death):
+        # the ServeFailoverPlanner's input
+        self.last_drain: Optional[List[DrainedRequest]] = None
         self._block_size = int(kv_block_size)
         if self._block_size < 0:
             raise ValueError(
@@ -785,7 +884,7 @@ class ServingEngine:
         seeds = np.zeros((b,), dtype=np.int32)
         out = []
         width = (self._k + 1) if self._lookup else self._t
-        now = time.monotonic()
+        now = self._clock()
         for i, (row, req, req_idx, prompt, p, budget, matched) in enumerate(
             admissions
         ):
@@ -812,7 +911,8 @@ class ServingEngine:
         self._insert_dispatches += 1
         return cache, buf, ptr, plen, temp_vec, seed_vec, out
 
-    def serve(self, requests: Sequence[ServeRequest]):
+    def serve(self, requests: Sequence[ServeRequest], cancel=None,
+              heartbeat=None):
         """Run the queue to completion → (results, metrics).
 
         results[i] corresponds to requests[i]. Metrics: committed vs
@@ -821,6 +921,26 @@ class ServingEngine:
         steps are scheduled slots, so admission cost shows up here
         honestly), chunk count, wall time, decode tokens/sec over
         committed tokens.
+
+        Every request terminates with an explicit ``status`` — deadline
+        misses and bounded-queue sheds produce honest terminal results,
+        never silent drops or unbounded queuing (queue policing runs at
+        every wave boundary).
+
+        ``heartbeat``: wave-boundary liveness callback — called with the
+        committed-token count after every decode chunk; the serve
+        entrypoint wires it to a ``hb-serve-<template>`` lease renewer
+        (ha/lease.py) so the failover detector confirms engine death
+        exactly as for trainers.
+
+        ``cancel``: a utils.signals.CancelToken. When it fires, serve()
+        stops at the next wave boundary, releases every KV lease (the
+        pool partition stays leak-free — free + parked == the whole
+        pool), records a drain snapshot of the unfinished in-flight and
+        queued requests in ``self.last_drain`` (committed tokens
+        preserved — the ServeFailoverPlanner's requeue input), and
+        returns with ``metrics['interrupted'] = True``; unfinished
+        entries of ``results`` stay None.
 
         The two programs (decode chunk + insert) are compiled BEFORE the
         clock starts — tokens/sec and the per-request latencies measure
@@ -902,7 +1022,9 @@ class ServingEngine:
                 np.asarray(out[3])
         del warm_cache, warm_buf, out
 
-        t0 = time.monotonic()
+        t0 = self._clock()
+        self.last_drain = None
+        interrupted = False
         cache = fresh_cache()  # vector length from step 0
         buf = jnp.zeros((b, max_len), jnp.int32)
         tok_vec = jnp.zeros((b,), jnp.int32)
@@ -926,6 +1048,14 @@ class ServingEngine:
         committed = 0
         scheduled_slots = 0
         chunks = 0
+        shed_count = 0
+        deadline_miss_count = 0
+        deadline_cancelled_rows = 0
+        # peak WAIT-queue depth, sampled post-admission / pre-shed at
+        # every wave boundary (police_depth) — comparable against
+        # max_queue_depth, which bounds the same population; the raw
+        # arrival burst is just len(requests)
+        queue_depth_peak = 0
         target_forwards = 0
         drafted = 0
         accepted_total = 0
@@ -1002,23 +1132,107 @@ class ServingEngine:
                 cache["block_table"] = jnp.asarray(table_np)
                 table_dirty[0] = False
 
-        def finish(state: _RowState) -> None:
+        def finish(state: _RowState, status: str = STATUS_OK) -> None:
             nonlocal committed
             committed += len(state.emitted)
             ttft = max(0.0, state.first_tok_t - state.admitted_t)
             queue_s = max(0.0, state.admitted_t - t0)
-            ttfts.append(ttft)
-            queues.append(queue_s)
+            if status == STATUS_OK:
+                # the latency rollups describe SERVED requests only — a
+                # cancelled row's ttft must not flatter (or poison) the
+                # p95 of the work that actually completed
+                ttfts.append(ttft)
+                queues.append(queue_s)
             results[state.request_idx] = ServeResult(
                 tokens=list(np.asarray(
                     requests[state.request_idx].prompt, dtype=np.int32
                 )) + state.emitted,
                 new_tokens=len(state.emitted),
                 finished_by_stop=state.stopped,
-                latency_s=time.monotonic() - t0,
+                latency_s=self._clock() - t0,
                 ttft_s=round(ttft, 6),
                 queue_s=round(queue_s, 6),
+                status=status,
+                retries=int(getattr(
+                    requests[state.request_idx], "retries", 0
+                )),
             )
+
+        def finish_queued(req_idx: int, status: str) -> None:
+            """Terminal result for a request REFUSED before admission
+            (shed / queued-deadline-miss): prompt only, zero compute."""
+            req = requests[req_idx]
+            results[req_idx] = ServeResult(
+                tokens=[int(t) for t in np.asarray(
+                    req.prompt, dtype=np.int32
+                )],
+                new_tokens=0,
+                finished_by_stop=False,
+                latency_s=self._clock() - t0,
+                status=status,
+                retries=int(getattr(req, "retries", 0)),
+            )
+
+        def police_deadlines() -> None:
+            """Pre-admission policing: queued requests past their
+            deadline terminate ``deadline_exceeded`` (nobody is waiting
+            for the answer), and requests queued longer than
+            ``max_queue_delay_s`` shed — neither should consume a row.
+            FIFO order of the survivors is untouched."""
+            nonlocal shed_count, deadline_miss_count
+            now = self._clock()
+            for req_idx in list(pending):
+                req = requests[req_idx]
+                dl = float(getattr(req, "deadline_s", 0.0) or 0.0)
+                if dl > 0 and now - t0 >= dl:
+                    pending.remove(req_idx)
+                    finish_queued(req_idx, STATUS_DEADLINE_EXCEEDED)
+                    deadline_miss_count += 1
+                elif (self._max_queue_delay > 0
+                        and now - t0 > self._max_queue_delay):
+                    pending.remove(req_idx)
+                    finish_queued(req_idx, STATUS_SHED)
+                    shed_count += 1
+
+        def police_depth() -> None:
+            """POST-admission policing: ``max_queue_depth`` bounds the
+            requests left WAITING after the engine has taken everything
+            its free rows can serve this wave (shedding before admission
+            would refuse work while rows sit idle). Past the bound the
+            LOWEST-priority queued request sheds first (ties: the most
+            recently enqueued) — an overload burst produces honest
+            ``shed`` statuses instead of unbounded queue growth."""
+            nonlocal shed_count, queue_depth_peak
+            queue_depth_peak = max(queue_depth_peak, len(pending))
+            while (self._max_queue_depth > 0
+                    and len(pending) > self._max_queue_depth):
+                victim_pos, victim_pri = 0, None
+                for pos, req_idx in enumerate(pending):
+                    pri = int(getattr(requests[req_idx], "priority", 0))
+                    if victim_pri is None or pri <= victim_pri:
+                        victim_pri, victim_pos = pri, pos
+                victim = pending[victim_pos]
+                del pending[victim_pos]
+                finish_queued(victim, STATUS_SHED)
+                shed_count += 1
+
+        def release_row(r: int) -> None:
+            """Free a row whose request terminated (completion, deadline
+            cancellation, or drain): refund its lease — the allocator
+            parks shareable prefix blocks (indexed content survives for
+            future hits) and frees the rest — and point the table at
+            scratch so the frozen slot's rolled-back writes can't touch
+            a re-allocated block."""
+            rows[r] = None
+            prefill_left[r] = 0
+            if self._paged and leases[r] is not None:
+                leases[r].release()
+                leases[r] = None
+                table_np[r, :] = scratch
+                table_dirty[0] = True
+                row_keys[r] = []
+                indexed_upto[r] = 0
+                pf_ptr[r] = 0
 
         def row_done(state: _RowState) -> bool:
             return state.stopped or len(state.emitted) >= state.budget
@@ -1153,9 +1367,39 @@ class ServingEngine:
                 )
                 cow_copies += len(cow_pairs)
 
+        police_deadlines()
         admit_into([r for r in range(b) if rows[r] is None])
+        police_depth()
 
         while any(r is not None for r in rows):
+            if cancel is not None and cancel.cancelled():
+                # engine death / fencing: stop at the wave boundary,
+                # snapshot every unfinished request (committed tokens
+                # preserved — they are an exact prefix of the full
+                # completion, so the failover planner can fold them into
+                # the requeued prompt), and refund every KV lease so the
+                # pool partitions cleanly into free + parked
+                elapsed = max(0.0, self._clock() - t0)
+                drained: List[DrainedRequest] = []
+                for r in range(b):
+                    state = rows[r]
+                    if state is None:
+                        continue
+                    drained.append(DrainedRequest(
+                        request_idx=state.request_idx,
+                        committed=list(state.emitted),
+                        admitted=True,
+                        elapsed_s=elapsed,
+                    ))
+                    release_row(r)
+                for req_idx in pending:
+                    drained.append(DrainedRequest(
+                        request_idx=req_idx, elapsed_s=elapsed,
+                    ))
+                pending.clear()
+                self.last_drain = drained
+                interrupted = True
+                break
             if self._paged:
                 # map the blocks this dispatch can touch, then sample the
                 # pool's residency for the bytes-per-token metric
@@ -1202,7 +1446,12 @@ class ServingEngine:
                 )
                 for r in range(b):
                     prefill_left[r] = max(0, prefill_left[r] - self._chunk)
-            now = time.monotonic()
+            now = self._clock()
+            if heartbeat is not None:
+                # wave-boundary liveness: the serve-side analogue of the
+                # Trainer's on_step renew — committed tokens play the
+                # step counter (the lease's progress record)
+                heartbeat(committed)
             if self._prefix:
                 # mirror each row's prefill pointer exactly (per step a
                 # prefilling row advances by min(width, remaining), so a
@@ -1259,24 +1508,29 @@ class ServingEngine:
                             state.stopped = True
                 if row_done(state):
                     finish(state)
-                    rows[r] = None
-                    if self._paged and leases[r] is not None:
-                        # refund the row's blocks AND its never-used
-                        # headroom; point the table row at scratch so
-                        # the (frozen, rolled-back) slot writes a done
-                        # row still issues can't touch a block that is
-                        # re-allocated to someone else
-                        leases[r].release()
-                        leases[r] = None
-                        table_np[r, :] = scratch
-                        table_dirty[0] = True
-                        row_keys[r] = []
-                        indexed_upto[r] = 0
-                        pf_ptr[r] = 0
-            # admit the next queued requests into every row this chunk
-            # freed — ONE insert wave, no model forward
+                    release_row(r)
+                    continue
+                dl = float(getattr(
+                    requests[state.request_idx], "deadline_s", 0.0
+                ) or 0.0)
+                if dl > 0 and now - t0 >= dl:
+                    # deadline cancellation at the wave boundary: report
+                    # the partial completion honestly, free the lease
+                    # (shareable prefix blocks PARK for future hits —
+                    # the cancelled work's K/V is not wasted), and hand
+                    # the row to the next queued request
+                    finish(state, status=STATUS_DEADLINE_EXCEEDED)
+                    deadline_cancelled_rows += 1
+                    deadline_miss_count += 1
+                    release_row(r)
+            # reap expired waiters, admit into every row this chunk
+            # freed (ONE insert wave, no forward), then bound what is
+            # STILL waiting — depth shedding never refuses work a free
+            # row could have taken this wave
+            police_deadlines()
             admit_into([r for r in range(b) if rows[r] is None])
-        wall = time.monotonic() - t0
+            police_depth()
+        wall = self._clock() - t0
         _pctl = percentile_nearest_rank
         metrics = {
             "requests": len(requests),
@@ -1294,13 +1548,34 @@ class ServingEngine:
             "prefill_chunk": (
                 (self._k + 1) if self._lookup else self._t
             ),
-            # admission → first committed token (chunk-granular) and
-            # enqueue → admission waits, per request
-            "ttft_p50_s": round(_pctl(ttfts, 0.50), 4),
-            "ttft_p95_s": round(_pctl(ttfts, 0.95), 4),
-            "queue_p50_s": round(_pctl(queues, 0.50), 4),
-            "queue_p95_s": round(_pctl(queues, 0.95), 4),
+            # ---- robustness ledger (round 7) ----
+            "interrupted": interrupted,
+            "queue_depth_peak": queue_depth_peak,
+            "shed_requests": shed_count,
+            "shed_rate": (
+                round(shed_count / len(requests), 4) if requests else 0.0
+            ),
+            "deadline_miss_requests": deadline_miss_count,
+            "deadline_miss_rate": (
+                round(deadline_miss_count / len(requests), 4)
+                if requests else 0.0
+            ),
+            "deadline_cancelled_rows": deadline_cancelled_rows,
+            "ok_requests": sum(
+                1 for res in results
+                if res is not None and res.status == STATUS_OK
+            ),
         }
+        # admission → first committed token (chunk-granular) and
+        # enqueue → admission waits, per request — OMITTED when no
+        # request was served at all (an all-shed round must not report a
+        # perfect p95; percentile_nearest_rank returns NaN on empties)
+        if ttfts:
+            metrics["ttft_p50_s"] = round(_pctl(ttfts, 0.50), 4)
+            metrics["ttft_p95_s"] = round(_pctl(ttfts, 0.95), 4)
+        if queues:
+            metrics["queue_p50_s"] = round(_pctl(queues, 0.50), 4)
+            metrics["queue_p95_s"] = round(_pctl(queues, 0.95), 4)
         # ---- KV-cache economics (the paged-vs-dense ledger) ----
         # bytes-per-request compares what one admitted request COSTS the
         # cache: its block reservation (paged) vs a whole max_len row
@@ -1327,6 +1602,15 @@ class ServingEngine:
                 round(alloc_block_steps * block_bytes / committed, 1)
                 if committed else 0.0
             )
+            # end-of-run pool partition (the leak audit's ground truth):
+            # free + parked + allocated must equal the pool, and with
+            # every lease terminal — completion, cancellation, or drain
+            # — allocated and reserved must both be 0
+            part = alloc.pool_partition()
+            metrics["kv_free_blocks_final"] = part["free"]
+            metrics["kv_parked_blocks_final"] = part["parked"]
+            metrics["kv_allocated_blocks_final"] = part["allocated"]
+            metrics["kv_reserved_blocks_final"] = part["reserved"]
             metrics["prefix_cache"] = self._prefix
             if self._prefix:
                 # the tentpole ledger: tokens whose prefill compute AND

@@ -154,10 +154,21 @@ def run_from_env(environ: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
         from nexus_tpu.cluster.kube import KubeClusterStore
         from nexus_tpu.ha.lease import LeaseRenewer
 
+        hb_template = env.get("NEXUS_HB_TEMPLATE", "unknown")
+        if runtime.mode == "serve":
+            # serving engines renew ``hb-serve-<template>`` on the pod
+            # path too — the same name LocalLauncher uses, so the
+            # freeze_engine chaos hook and the failover planners' serve
+            # lease detection hold for real pods (ha/serve_failover.py)
+            from nexus_tpu.ha.serve_failover import (
+                serve_heartbeat_template,
+            )
+
+            hb_template = serve_heartbeat_template(hb_template)
         renewer = LeaseRenewer(
             KubeClusterStore("hb", env["NEXUS_HB_KUBECONFIG"]),
             namespace=env.get("NEXUS_HB_NAMESPACE", "default"),
-            template_name=env.get("NEXUS_HB_TEMPLATE", "unknown"),
+            template_name=hb_template,
             holder=f"{env.get('NEXUS_SHARD_NAME', '')}"
                    f"-p{identity.process_id}-{os.getpid()}",
             ttl_seconds=float(env.get("NEXUS_HB_TTL_SECONDS", "15") or 15),

@@ -23,9 +23,12 @@ real outage needed):
     outage the failure detector must confirm and back off from.
   * :class:`ChaosClusterStore` — the same rules over an in-process
     ClusterStore, for tests/benches that skip the HTTP layer.
-  * ``kill worker`` lives on the LocalLauncher (``launcher.kill``) and
-    ``expire lease`` on ha.lease.freeze_heartbeat — re-exported here so
-    testing code has one chaos namespace.
+  * ``kill worker`` lives on the LocalLauncher (``launcher.kill``),
+    ``expire lease`` on ha.lease.freeze_heartbeat, and ``wedge engine``
+    (a serving engine stops renewing its ``hb-serve-<template>`` lease
+    while the process keeps serving — detector-confirm-without-crash) on
+    ha.serve_failover.freeze_engine — re-exported here so testing code
+    has one chaos namespace.
 """
 
 from __future__ import annotations
@@ -46,8 +49,10 @@ from nexus_tpu.cluster.store import (
     ConflictError,
     NotFoundError,
 )
-# chaos-namespace re-export: "expire lease" lives with the lease protocol
+# chaos-namespace re-exports: "expire lease" lives with the lease
+# protocol, "wedge engine" with the serve-failover planner
 from nexus_tpu.ha.lease import freeze_heartbeat  # noqa: F401
+from nexus_tpu.ha.serve_failover import freeze_engine  # noqa: F401
 
 _TYPES = {
     "secrets": Secret,

@@ -1,0 +1,532 @@
+"""Serve-plane fault tolerance (round 7): request deadlines, bounded-queue
+load shedding, engine heartbeats, and drain-and-requeue failover.
+
+The load-bearing properties:
+
+  * every request TERMINATES with an explicit status — ok,
+    deadline_exceeded, shed, or failed_over — never a silent drop or an
+    unbounded queue;
+  * kill-mid-decode recovery is EXACT: an engine death drains its
+    in-flight requests with their committed tokens preserved, and the
+    replacement engine's outputs are token-identical to an undisturbed
+    run (prefix cache on AND off), with zero requests lost and zero KV
+    blocks leaked (free + parked + allocated still partition the pool);
+  * the detector confirms engine death through the SAME lease protocol
+    trainers use — including the wedged-not-crashed case
+    (freeze_engine).
+"""
+
+import math
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nexus_tpu.api.types import ConfigMap
+from nexus_tpu.cluster.store import ClusterStore, NotFoundError
+from nexus_tpu.ha.lease import heartbeat_name
+from nexus_tpu.ha.serve_failover import (
+    ServeEngineSupervisor,
+    ServeFailoverPlanner,
+    freeze_engine,
+    is_serve_lease,
+    serve_heartbeat_template,
+    strip_serve_prefix,
+)
+from nexus_tpu.runtime.serving import (
+    STATUS_DEADLINE_EXCEEDED,
+    STATUS_FAILED_OVER,
+    STATUS_OK,
+    STATUS_SHED,
+    DrainedRequest,
+    ServeRequest,
+    ServingEngine,
+    percentile_nearest_rank,
+)
+from tests.test_serving import _cyclic_model, tiny_cfg
+
+NS = "nexus-serve"
+
+
+# ------------------------------------------------------------ helpers
+
+def _cyclic_expected(req, v):
+    """Isolated greedy reference on the cyclic stub (no stop token)."""
+    out = [int(t) for t in req.prompt]
+    cur = out[-1]
+    for _ in range(req.max_new_tokens):
+        cur = (cur + 1) % v
+        out.append(cur)
+    return out
+
+
+def _assert_pool_clean(metrics):
+    """The leak audit: free + parked + allocated partition the pool, and
+    with every lease terminal nothing stays allocated or reserved."""
+    assert metrics["kv_allocated_blocks_final"] == 0, metrics
+    assert metrics["kv_reserved_blocks_final"] == 0, metrics
+    assert (
+        metrics["kv_free_blocks_final"]
+        + metrics["kv_parked_blocks_final"]
+        + metrics["kv_allocated_blocks_final"]
+    ) == metrics["kv_num_blocks"], metrics
+
+
+# --------------------------------------------------- satellite: percentiles
+
+def test_percentile_empty_population_is_nan_not_zero():
+    """An all-shed round must not report a perfect p95: the empty
+    population returns NaN (and the metric builders OMIT the key)."""
+    assert math.isnan(percentile_nearest_rank([], 0.5))
+    assert math.isnan(percentile_nearest_rank([], 0.95))
+    assert percentile_nearest_rank([3.0, 1.0, 2.0], 0.5) == 2.0
+
+
+# -------------------------------------------------- deadlines & cancellation
+
+def test_deadline_cancels_rows_and_expires_queued_requests():
+    """Deadlines are checked at every wave boundary: an admitted row past
+    its deadline cancels (partial tokens reported honestly, lease freed),
+    a queued request past its deadline terminates without ever being
+    admitted — and unrelated requests are untouched. Deterministic via
+    the injected clock (advanced by the heartbeat callback, one tick per
+    wave — no sleeps)."""
+    v = 10
+    cfg, fwd = _cyclic_model(v, -1)
+    t = [0.0]
+
+    def hb(_committed):
+        t[0] += 1.0
+
+    engine = ServingEngine(
+        fwd, {}, cfg, batch_size=1, max_len=96, chunk=4,
+        clock=lambda: t[0],
+    )
+    reqs = [
+        ServeRequest(prompt=[0, 1], max_new_tokens=50, deadline_s=2.5),
+        ServeRequest(prompt=[0, 2], max_new_tokens=5, deadline_s=1.5),
+        ServeRequest(prompt=[0, 3], max_new_tokens=5),
+    ]
+    results, metrics = engine.serve(reqs, heartbeat=hb)
+    r0, r1, r2 = results
+    assert r0.status == STATUS_DEADLINE_EXCEEDED
+    assert 0 < r0.new_tokens < 50  # cancelled mid-decode, partials kept
+    assert r0.tokens == _cyclic_expected(
+        ServeRequest(prompt=[0, 1], max_new_tokens=r0.new_tokens), v
+    )  # partial stream is an exact greedy prefix
+    assert r1.status == STATUS_DEADLINE_EXCEEDED and r1.new_tokens == 0
+    assert r1.tokens == [0, 2]  # never admitted: prompt only
+    assert r2.status == STATUS_OK
+    assert r2.tokens == _cyclic_expected(reqs[2], v)
+    assert metrics["deadline_miss_requests"] == 2
+    assert metrics["deadline_cancelled_rows"] == 1
+    assert metrics["ok_requests"] == 1
+    _assert_pool_clean(metrics)
+
+
+def test_all_deadline_missed_round_omits_latency_rollups():
+    """When nothing was served, the ttft/queue rollups are OMITTED (not
+    reported as a flattering 0.0) and the miss rate is honest."""
+    cfg, fwd = _cyclic_model(7, -1)
+    engine = ServingEngine(fwd, {}, cfg, batch_size=1, max_len=64, chunk=4)
+    reqs = [ServeRequest(prompt=[0, 1], max_new_tokens=4, deadline_s=1e-9)
+            for _ in range(3)]
+    results, metrics = engine.serve(reqs)
+    assert all(r.status == STATUS_DEADLINE_EXCEEDED for r in results)
+    assert metrics["deadline_miss_rate"] == 1.0
+    assert metrics["committed_tokens"] == 0
+    assert "ttft_p50_s" not in metrics and "queue_p95_s" not in metrics
+    _assert_pool_clean(metrics)
+
+
+# ------------------------------------------------------------ load shedding
+
+def test_bounded_queue_sheds_lowest_priority_first():
+    """max_queue_depth bounds what is left WAITING after admission has
+    taken everything the free rows can serve (shedding never refuses
+    work a free row could take): the head admits, then the two
+    LOWEST-priority waiters shed with an explicit `shed` status;
+    survivors keep FIFO order and exact outputs. The queue can never
+    grow past the bound."""
+    v = 10
+    cfg, fwd = _cyclic_model(v, -1)
+    engine = ServingEngine(
+        fwd, {}, cfg, batch_size=1, max_len=64, chunk=4,
+        max_queue_depth=2,
+    )
+    reqs = [ServeRequest(prompt=[0, 1], max_new_tokens=4, priority=p)
+            for p in (5, 1, 3, 2, 4)]
+    results, metrics = engine.serve(reqs)
+    # head (p5) admits into the one row; of the 4 waiters, p1 and p2
+    # shed (lowest priority first); p3 and p4 fit the depth-2 bound
+    assert [r.status for r in results] == [
+        STATUS_OK, STATUS_SHED, STATUS_OK, STATUS_SHED, STATUS_OK,
+    ]
+    for r in results:
+        if r.status == STATUS_SHED:
+            assert r.new_tokens == 0 and r.tokens == [0, 1]
+        else:
+            assert r.tokens == _cyclic_expected(
+                ServeRequest(prompt=[0, 1], max_new_tokens=4), v
+            )
+    assert metrics["shed_requests"] == 2
+    assert metrics["shed_rate"] == 0.4
+    # post-admission wait queue at t0: 5 arrivals minus the 1 admitted
+    # (comparable against max_queue_depth, which bounds this population)
+    assert metrics["queue_depth_peak"] == 4
+    _assert_pool_clean(metrics)
+
+
+def test_depth_bound_never_sheds_what_free_rows_can_serve():
+    """rows + bound together cover the whole burst → nothing sheds: a
+    2-row engine with depth bound 2 serves all 4 requests (pre-admission
+    shedding would have refused work while rows sat idle)."""
+    v = 10
+    cfg, fwd = _cyclic_model(v, -1)
+    engine = ServingEngine(
+        fwd, {}, cfg, batch_size=2, max_len=64, chunk=4,
+        max_queue_depth=2,
+    )
+    reqs = [ServeRequest(prompt=[0, 1], max_new_tokens=4)
+            for _ in range(4)]
+    results, metrics = engine.serve(reqs)
+    assert all(r.status == STATUS_OK for r in results)
+    assert metrics["shed_requests"] == 0
+
+
+def test_max_queue_delay_sheds_stale_waiters():
+    """A request that has waited unadmitted past max_queue_delay_s sheds
+    at the next wave boundary (fake clock — the single busy row never
+    frees in time)."""
+    v = 10
+    cfg, fwd = _cyclic_model(v, -1)
+    t = [0.0]
+
+    def hb(_committed):
+        t[0] += 1.0
+
+    engine = ServingEngine(
+        fwd, {}, cfg, batch_size=1, max_len=96, chunk=4,
+        max_queue_delay_s=2.0, clock=lambda: t[0],
+    )
+    reqs = [
+        ServeRequest(prompt=[0, 1], max_new_tokens=40),  # hogs the row
+        ServeRequest(prompt=[0, 2], max_new_tokens=4),   # waits > 2.0
+    ]
+    results, metrics = engine.serve(reqs, heartbeat=hb)
+    assert results[0].status == STATUS_OK
+    assert results[1].status == STATUS_SHED and results[1].new_tokens == 0
+    assert metrics["shed_requests"] == 1
+
+
+# ----------------------------------------------------------- planner units
+
+def test_planner_requeue_folds_committed_tokens_and_stitch():
+    """The requeue math: committed tokens fold into the prompt (absolute
+    positions preserved — greedy AND sampled streams recover exactly),
+    budget shrinks by what was recovered, retries bump; stitch counts
+    recovered + fresh tokens against the ORIGINAL prompt and stamps
+    failed_over only on completed recoveries."""
+    from nexus_tpu.runtime.serving import ServeResult
+
+    planner = ServeFailoverPlanner()
+    req = ServeRequest(prompt=[1, 2, 3], max_new_tokens=10,
+                       temperature=0.7, seed=9, deadline_s=5.0,
+                       priority=2)
+    entries = planner.fresh([req])
+    requeued = planner.requeue(
+        entries, [DrainedRequest(request_idx=0, committed=[4, 5],
+                                 admitted=True)],
+    )
+    assert len(requeued) == 1
+    merged = requeued[0].request
+    assert merged.prompt == [1, 2, 3, 4, 5]
+    assert merged.max_new_tokens == 8
+    assert merged.retries == 1
+    assert merged.temperature == 0.7 and merged.seed == 9
+    assert merged.deadline_s == 5.0 and merged.priority == 2
+    assert requeued[0].committed == [4, 5]
+    # the deadline budget is cumulative serve time: the dead engine's
+    # elapsed clock is charged, and an exhausted budget requeues with an
+    # epsilon deadline (terminates `deadline_exceeded` immediately on
+    # the replacement) instead of a fresh full budget
+    charged = planner.requeue(
+        entries, [DrainedRequest(request_idx=0, committed=[4],
+                                 admitted=True, elapsed_s=3.5)],
+    )
+    assert charged[0].request.deadline_s == pytest.approx(1.5)
+    exhausted = planner.requeue(
+        entries, [DrainedRequest(request_idx=0, committed=[4],
+                                 admitted=True, elapsed_s=9.0)],
+    )
+    assert 0 < exhausted[0].request.deadline_s <= 1e-9
+    # a second death accumulates committed tokens across generations
+    again = planner.requeue(
+        requeued, [DrainedRequest(request_idx=0, committed=[6],
+                                  admitted=True)],
+    )
+    assert again[0].request.prompt == [1, 2, 3, 4, 5, 6]
+    assert again[0].request.max_new_tokens == 7
+    assert again[0].request.retries == 2
+    assert again[0].committed == [4, 5, 6]
+    # stitch: recovered completion → failed_over, counts all new tokens
+    rec = ServeResult(tokens=[1, 2, 3, 4, 5, 6, 7], new_tokens=1,
+                      finished_by_stop=False, latency_s=0.5, retries=2)
+    final = planner.stitch(again[0], rec)
+    assert final.status == STATUS_FAILED_OVER
+    assert final.new_tokens == 4  # 3 recovered + 1 fresh
+    assert final.retries == 2
+    # a shed terminal must NOT be laundered into failed_over
+    shed = ServeResult(tokens=[1, 2, 3, 4, 5, 6], new_tokens=0,
+                       finished_by_stop=False, latency_s=0.1,
+                       status=STATUS_SHED, retries=2)
+    assert planner.stitch(again[0], shed).status == STATUS_SHED
+
+
+def test_serve_lease_naming_helpers():
+    assert serve_heartbeat_template("x") == "serve-x"
+    assert is_serve_lease("serve-x") and not is_serve_lease("x")
+    assert strip_serve_prefix("serve-x") == "x"
+    assert strip_serve_prefix("x") == "x"
+    assert heartbeat_name(serve_heartbeat_template("x")) == "hb-serve-x"
+
+
+# ------------------------------------------- detector-confirmed engine death
+
+def _stub_engine_factory(v=13):
+    cfg, fwd = _cyclic_model(v, -1)
+
+    def make_engine():
+        return ServingEngine(
+            fwd, {}, cfg, batch_size=2, max_len=128, chunk=4,
+            kv_block_size=8,
+        )
+
+    return make_engine
+
+
+def _chaos_when_step(store, template, threshold, action, timeout=30.0):
+    """Fire ``action`` once the serve lease's committed-token step
+    reaches ``threshold`` — the deterministic mid-decode kill trigger."""
+    name = heartbeat_name(serve_heartbeat_template(template))
+
+    def run():
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                cm = store.get(ConfigMap.KIND, NS, name)
+            except NotFoundError:
+                time.sleep(0.005)
+                continue
+            if int((cm.data or {}).get("step", "0") or 0) >= threshold:
+                action()
+                return
+            time.sleep(0.005)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_freeze_engine_detector_confirms_without_crash():
+    """The wedged-engine drill: freeze_engine stops lease renewals while
+    the engine keeps serving. The detector confirms the death WITHOUT any
+    crash, the supervisor fences the still-running engine, and every
+    request completes token-identically on the replacement."""
+    v = 13
+    store = ClusterStore("serve-shard-frz")
+    sup = ServeEngineSupervisor(
+        _stub_engine_factory(v), store, NS, "frz",
+        ttl_seconds=0.15, pace_s=0.012,
+    )
+    reqs = [ServeRequest(prompt=[0, (i % 5) + 1], max_new_tokens=60)
+            for i in range(8)]
+    _chaos_when_step(store, "frz", 20,
+                     lambda: freeze_engine(store, NS, "frz"))
+    results, report = sup.run(reqs, timeout_s=90)
+    assert report["requests_lost"] == 0
+    assert report["restarts"] == 1
+    assert report["fenced_alive"] is True  # confirmed while still running
+    assert report["detections_s"] and report["detections_s"][0] >= 0.0
+    recovered = [r for r in results if r.status == STATUS_FAILED_OVER]
+    assert recovered and all(r.retries == 1 for r in recovered)
+    for req, res in zip(reqs, results):
+        assert res.tokens == _cyclic_expected(req, v)
+        assert res.new_tokens == req.max_new_tokens
+    for gen in report["generations"]:
+        _assert_pool_clean(gen)
+
+
+def test_hard_kill_confirmed_by_silence_and_requeued():
+    """The crashed-engine drill: a launcher-style hard kill stops the
+    engine (and its renewer) outright; the detector confirms by silence
+    and the drained queue completes exactly on the replacement."""
+    v = 13
+    store = ClusterStore("serve-shard-kill")
+    sup = ServeEngineSupervisor(
+        _stub_engine_factory(v), store, NS, "kil",
+        ttl_seconds=0.15, pace_s=0.012,
+    )
+    reqs = [ServeRequest(prompt=[0, (i % 5) + 1], max_new_tokens=60)
+            for i in range(8)]
+    _chaos_when_step(store, "kil", 20,
+                     lambda: sup.kill_current(hard=True))
+    results, report = sup.run(reqs, timeout_s=90)
+    assert report["requests_lost"] == 0
+    assert report["restarts"] == 1
+    assert report["fenced_alive"] is False  # it was already dead
+    for req, res in zip(reqs, results):
+        assert res.tokens == _cyclic_expected(req, v)
+    for gen in report["generations"]:
+        _assert_pool_clean(gen)
+
+
+# -------------------------------------- satellite: requeue exactness (llama)
+
+@pytest.mark.parametrize("prefix_cache", [True, False])
+def test_requeue_exactness_kill_mid_decode_llama(prefix_cache):
+    """The acceptance drill on the REAL model: kill an engine mid-decode
+    (prefix cache on AND off), recover through detector confirmation and
+    drain-and-requeue, and assert the recovered outputs are
+    token-identical to the undisturbed isolated greedy decode — zero
+    requests lost, zero KV blocks leaked (free + parked + allocated
+    still partition the pool in BOTH the dead and replacement engines'
+    ledgers)."""
+    from nexus_tpu.models import llama
+
+    cfg = tiny_cfg()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(29)
+    common = rng.randint(0, cfg.vocab_size, size=16).tolist()
+    reqs = []
+    for i in range(6):
+        tail = rng.randint(0, cfg.vocab_size, size=4 + (i % 3)).tolist()
+        reqs.append(ServeRequest(prompt=common + tail, max_new_tokens=20))
+    refs = [
+        llama.generate(
+            params, cfg, jnp.asarray(r.prompt, jnp.int32)[None, :],
+            max_new_tokens=r.max_new_tokens,
+        )
+        for r in reqs
+    ]
+
+    def make_engine():
+        return ServingEngine(
+            llama.forward_decode, params, cfg, batch_size=2, max_len=64,
+            chunk=2, kv_block_size=8, prefix_cache=prefix_cache,
+        )
+
+    store = ClusterStore(f"serve-shard-llama-{int(prefix_cache)}")
+    template = f"llm-{int(prefix_cache)}"
+    sup = ServeEngineSupervisor(
+        make_engine, store, NS, template,
+        ttl_seconds=0.12, pace_s=0.02,
+    )
+    _chaos_when_step(store, template, 8,
+                     lambda: sup.kill_current(hard=True))
+    results, report = sup.run(reqs, timeout_s=120)
+    assert report["requests_lost"] == 0
+    assert report["restarts"] >= 1, "chaos never landed mid-decode"
+    recovered = [r for r in results if r.status == STATUS_FAILED_OVER]
+    assert recovered and all(r.retries >= 1 for r in recovered)
+    for req, ref, res in zip(reqs, refs, results):
+        np.testing.assert_array_equal(
+            np.array(res.tokens), np.array(ref[0]),
+            err_msg=f"prefix_cache={prefix_cache} prompt {req.prompt[:4]}",
+        )
+        assert res.new_tokens == req.max_new_tokens
+    for gen in report["generations"]:
+        _assert_pool_clean(gen)
+        if not prefix_cache:
+            assert gen["kv_parked_blocks_final"] == 0
+    if prefix_cache:
+        # the recovered cohort's merged prompts re-match the shared
+        # preamble chain on the replacement engine
+        assert report["generations"][-1]["prefix_hit_tokens"] > 0
+
+
+# ----------------------------------------------------- spec & entrypoints
+
+def test_serve_spec_fault_tolerance_knobs_roundtrip_and_validate():
+    from nexus_tpu.api.runtime_spec import (
+        JaxXlaRuntime, ModelRef, ParallelismSpec, ServeSpec, TpuSliceSpec,
+        TrainSpec,
+    )
+
+    spec = ServeSpec(max_queue_depth=8, max_queue_delay_s=1.5,
+                     request_deadline_s=30.0)
+    rt = ServeSpec.from_dict(spec.to_dict())
+    assert rt.max_queue_depth == 8
+    assert rt.max_queue_delay_s == 1.5
+    assert rt.request_deadline_s == 30.0
+    # defaults survive the roundtrip (unbounded / no deadline)
+    assert ServeSpec.from_dict(ServeSpec().to_dict()).max_queue_depth == 0
+
+    def mk(serve):
+        return JaxXlaRuntime(
+            mode="serve",
+            model=ModelRef(family="llama", preset="tiny",
+                           overrides={"dtype": "float32"}),
+            tpu=TpuSliceSpec(accelerator="v5e", topology="1x1",
+                             slice_count=1),
+            parallelism=ParallelismSpec(),
+            train=TrainSpec(batch_size=4, seq_len=64),
+            serve=serve,
+        )
+
+    assert mk(ServeSpec(max_queue_depth=8)).validate() == []
+    # a bound below the row count idles rows the pool already paid for
+    errs = mk(ServeSpec(max_queue_depth=2)).validate()
+    assert any("maxQueueDepth" in e for e in errs), errs
+    errs = mk(ServeSpec(max_queue_depth=-1)).validate()
+    assert any("maxQueueDepth" in e for e in errs), errs
+    errs = mk(ServeSpec(max_queue_delay_s=-0.5)).validate()
+    assert any("maxQueueDelaySeconds" in e for e in errs), errs
+    errs = mk(ServeSpec(request_deadline_s=-1.0)).validate()
+    assert any("requestDeadlineSeconds" in e for e in errs), errs
+    # a delay bound past the deadline can only ever mislabel misses
+    errs = mk(ServeSpec(request_deadline_s=1.0,
+                        max_queue_delay_s=2.0)).validate()
+    assert any("exceeds requestDeadlineSeconds" in e for e in errs), errs
+
+
+def test_run_template_runtime_serve_heartbeat_and_cancel_drain():
+    """mode='serve' honors the training runtime's liveness/cancel
+    contract: the heartbeat callback fires at wave boundaries, and a
+    fired cancel token drains the engine (interrupted metrics, no
+    latency rollups fabricated for unserved work)."""
+    from nexus_tpu.api.runtime_spec import (
+        JaxXlaRuntime, ModelRef, ParallelismSpec, ServeSpec, TpuSliceSpec,
+        TrainSpec,
+    )
+    from nexus_tpu.runtime.entrypoints import run_template_runtime
+    from nexus_tpu.utils.signals import CancelToken
+
+    rt = JaxXlaRuntime(
+        mode="serve",
+        model=ModelRef(family="llama", preset="tiny",
+                       overrides={"dtype": "float32"}),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1", slice_count=1),
+        parallelism=ParallelismSpec(),
+        train=TrainSpec(batch_size=2, seq_len=64),
+        serve=ServeSpec(
+            num_requests=4, prompt_length_min=4, prompt_length_max=8,
+            max_new_min=3, max_new_max=6, chunk=4,
+        ),
+    )
+    assert rt.validate() == []
+    beats = []
+    m = run_template_runtime(rt, heartbeat=beats.append)
+    assert m["interrupted"] is False
+    assert m["finished_requests"] == 4
+    assert beats, "serve engine never heartbeat at a wave boundary"
+
+    token = CancelToken()
+    token.cancel(hard=True)
+    m2 = run_template_runtime(rt, cancel=token)
+    assert m2["interrupted"] is True
+    assert m2["finished_requests"] == 0
+    assert "request_latency_p50_s" not in m2

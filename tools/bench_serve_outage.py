@@ -1,0 +1,273 @@
+"""Hermetic serve-outage bench: engine killed mid-decode → detector
+confirms → drain-and-requeue → token-identical completion (CPU-only).
+
+The serving twin of tools/bench_failover.py. A stub-model engine (next
+token = (t + 1) mod v — deterministic, compile-light, seconds on CPU)
+serves a shared-prefix queue under the ServeEngineSupervisor
+(ha/serve_failover.py): the engine renews its ``hb-serve-<template>``
+lease at wave boundaries, a chaos thread kills it mid-decode once enough
+tokens have committed (odd trials wedge the lease via ``freeze_engine``
+— detector-confirm-without-crash; even trials hard-kill the engine —
+confirmation by silence), the real FailureDetector confirms, and the
+planner requeues every unfinished request with its committed tokens
+folded into the prompt.
+
+Measured per trial:
+
+  time_to_recover = confirmation → the replacement engine's lease live
+                    (the serving plane is back in business)
+  detection       = first missed renewal → confirmation
+  requests_lost   = results still None after recovery (MUST be 0)
+  exact           = every recovered stream token-identical to an
+                    undisturbed run of the same queue
+
+plus one overload leg (no chaos): a burst past ``max_queue_depth`` on a
+bounded-queue engine with per-request deadlines — shed rate and
+deadline-miss rate prove load shedding stays honest under pressure.
+
+Prints ONE JSON line: {"metric": "serve_outage_time_to_recover_s", ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _cyclic_model(v: int):
+    """Deterministic stub: next = (token + 1) % v. The engine's
+    scheduling/failover machinery is model-agnostic, so the stub proves
+    requeue exactness without a single weight or compile-heavy program
+    (the llama-backed exactness tiers live in tests/)."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = SimpleNamespace(
+        n_layers=1, n_kv_heads=1, head_dim=8, dtype=jnp.float32,
+        max_seq_len=512, vocab_size=v,
+    )
+
+    def fwd(params, cfg_, tokens, cache):
+        logits = jax.nn.one_hot((tokens + 1) % v, v) * 10.0
+        new = {k: x for k, x in cache.items() if k != "n_valid"}
+        nv = cache.get("n_valid")
+        adv = tokens.shape[1] if nv is None else nv
+        new["length"] = cache["length"] + adv
+        return logits.astype(jnp.float32), new
+
+    return cfg, fwd
+
+
+def _queue(v: int, n: int, shared: int, max_new: int):
+    """Shared-prefix queue (the prefix cache dedupes the preamble on the
+    replacement engine exactly as on the one that died)."""
+    from nexus_tpu.runtime.serving import ServeRequest
+
+    common = [(7 * i + 3) % v for i in range(shared)]
+    reqs = []
+    for i in range(n):
+        tail = [(3 * i + j) % v for j in range(4)]
+        reqs.append(ServeRequest(
+            prompt=common + tail, max_new_tokens=max_new,
+        ))
+    return reqs
+
+
+def _expected(req, v: int):
+    out = [int(t) for t in req.prompt]
+    cur = out[-1]
+    for _ in range(req.max_new_tokens):
+        cur = (cur + 1) % v
+        out.append(cur)
+    return out
+
+
+def _one_trial(trial: int, v: int, reqs, ttl: float, pace: float,
+               kill_after: int, timeout: float):
+    from nexus_tpu.api.types import ConfigMap
+    from nexus_tpu.cluster.store import ClusterStore, NotFoundError
+    from nexus_tpu.ha.lease import heartbeat_name
+    from nexus_tpu.ha.serve_failover import (
+        ServeEngineSupervisor,
+        freeze_engine,
+        serve_heartbeat_template,
+    )
+    from nexus_tpu.runtime.serving import ServingEngine
+
+    cfg, fwd = _cyclic_model(v)
+
+    def make_engine():
+        return ServingEngine(
+            fwd, {}, cfg, batch_size=2, max_len=256, chunk=4,
+            kv_block_size=8,
+        )
+
+    template = f"outage-{trial}"
+    store = ClusterStore(f"serve-shard-{trial}")
+    sup = ServeEngineSupervisor(
+        make_engine, store, "nexus", template,
+        ttl_seconds=ttl, pace_s=pace,
+    )
+    kill_t = [0.0]
+    mode = "freeze" if trial % 2 else "kill"
+
+    def chaos():
+        name = heartbeat_name(serve_heartbeat_template(template))
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                cm = store.get(ConfigMap.KIND, "nexus", name)
+            except NotFoundError:
+                time.sleep(0.005)
+                continue
+            step = int((cm.data or {}).get("step", "0") or 0)
+            if step >= kill_after:
+                kill_t[0] = time.monotonic()
+                if mode == "freeze":
+                    freeze_engine(store, "nexus", template)
+                else:
+                    sup.kill_current(hard=True)
+                return
+            time.sleep(0.005)
+
+    chaos_thread = threading.Thread(target=chaos, daemon=True)
+    chaos_thread.start()
+    results, report = sup.run(reqs, timeout_s=timeout)
+    done_t = time.monotonic()
+    exact = all(
+        r is not None and r.tokens == _expected(req, v)
+        for req, r in zip(reqs, results)
+    )
+    return {
+        "mode": mode,
+        "restarts": report["restarts"],
+        "requests_lost": report["requests_lost"],
+        "exact": exact,
+        "detection_s": (
+            report["detections_s"][0] if report["detections_s"] else None
+        ),
+        "time_to_recover_s": (
+            report["recover_s"][0] if report["recover_s"] else None
+        ),
+        "outage_to_complete_s": (
+            done_t - kill_t[0] if kill_t[0] else None
+        ),
+        "failed_over": sum(
+            1 for r in results
+            if r is not None and r.status == "failed_over"
+        ),
+        "kv_leaked_blocks": sum(
+            g.get("kv_allocated_blocks_final", 0)
+            + g.get("kv_reserved_blocks_final", 0)
+            for g in report["generations"]
+        ),
+    }
+
+
+def _overload_leg(v: int):
+    """Bounded-queue shedding under a burst — no chaos, pure policing:
+    12 requests into a 2-row engine bounded at depth 4, three of them
+    carrying a sub-millisecond deadline. Sheds and misses must be
+    explicit statuses, never queue growth."""
+    from nexus_tpu.runtime.serving import ServeRequest, ServingEngine
+
+    cfg, fwd = _cyclic_model(v)
+    engine = ServingEngine(
+        fwd, {}, cfg, batch_size=2, max_len=256, chunk=4,
+        kv_block_size=8, max_queue_depth=4,
+    )
+    reqs = []
+    for i in range(12):
+        reqs.append(ServeRequest(
+            prompt=[(i + j) % v for j in range(6)], max_new_tokens=24,
+            priority=i % 3,
+            deadline_s=1e-6 if i in (9, 10, 11) else 0.0,
+        ))
+    results, m = engine.serve(reqs)
+    assert all(r is not None for r in results)
+    return {
+        "shed_rate": m["shed_rate"],
+        "deadline_miss_rate": m["deadline_miss_rate"],
+        "queue_depth_peak": m["queue_depth_peak"],
+        "ok_requests": m["ok_requests"],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--timeout", type=float, default=60.0)
+    ap.add_argument("--ttl", type=float, default=0.15)
+    ap.add_argument("--pace", type=float, default=0.008)
+    args = ap.parse_args()
+
+    from nexus_tpu.runtime.serving import percentile_nearest_rank
+
+    def _p50(xs):
+        """Nearest-rank p50 rounded for the artifact, None for an empty
+        population — NaN must never reach the JSON line (json.dumps
+        would emit the non-standard `NaN` token and break every strict
+        consumer of the per-round artifact)."""
+        return round(percentile_nearest_rank(xs, 0.50), 4) if xs else None
+
+    v = 13
+    # enough decode runway (with the per-wave pace) that a freeze trial's
+    # engine is still serving when the detector confirms — a queue that
+    # drains inside the detection window would recover trivially
+    reqs = _queue(v, n=8, shared=16, max_new=90)
+    trials = []
+    for i in range(args.trials):
+        try:
+            trials.append(_one_trial(
+                i, v, reqs, ttl=args.ttl, pace=args.pace,
+                kill_after=20, timeout=args.timeout,
+            ))
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            print(json.dumps({
+                "error": f"trial {i}: {type(e).__name__}: {e}"
+            }))
+            return 1
+    recover = [t["time_to_recover_s"] for t in trials
+               if t["time_to_recover_s"] is not None]
+    detect = [t["detection_s"] for t in trials
+              if t["detection_s"] is not None]
+    lost = sum(t["requests_lost"] for t in trials)
+    leaked = sum(t["kv_leaked_blocks"] for t in trials)
+    overload = _overload_leg(v)
+    rec = {
+        "metric": "serve_outage_time_to_recover_s",
+        "value": _p50(recover),
+        "unit": "seconds",
+        "n_trials": len(trials),
+        "requests_lost": lost,
+        "kv_leaked_blocks": leaked,
+        "exact": all(t["exact"] for t in trials),
+        "detection_p50_s": _p50(detect),
+        "outage_to_complete_p50_s": _p50(
+            [t["outage_to_complete_s"] for t in trials
+             if t["outage_to_complete_s"] is not None],
+        ),
+        "restarts_total": sum(t["restarts"] for t in trials),
+        "failed_over_total": sum(t["failed_over"] for t in trials),
+        "shed_rate": overload["shed_rate"],
+        "deadline_miss_rate": overload["deadline_miss_rate"],
+        "overload_queue_depth_peak": overload["queue_depth_peak"],
+    }
+    print(json.dumps(rec))
+    # honest exit: a lost request, a leaked block, an inexact recovery,
+    # or a round where the chaos never landed (nothing was proven) is a
+    # FAILED bench even when the timing numbers look fine
+    ok = (lost == 0 and leaked == 0 and rec["exact"]
+          and rec["restarts_total"] >= 1)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
