@@ -500,7 +500,7 @@ def _spec_suite(progress, attn, sink=None):
         train(target_preset, tsteps, target_dir, 8 if on_tpu else 2,
               "dots_attn" if on_tpu else None, f"target {target_preset}")
     except Exception as e:  # noqa: BLE001 — training failure: skip suite
-        progress(f"speculation suite training failed: "
+        progress("speculation suite training failed: "
                  f"{type(e).__name__}: {str(e)[:200]}")
         return out
     draft_ok = False
@@ -510,7 +510,7 @@ def _spec_suite(progress, attn, sink=None):
                   None, f"draft {draft_preset}")
             draft_ok = True
         except Exception as e:  # noqa: BLE001 — draft leg just drops
-            progress(f"speculation suite draft training failed: "
+            progress("speculation suite draft training failed: "
                      f"{type(e).__name__}: {str(e)[:200]}")
     prompt_ids = _corpus_prompt(corpus, n_tok // 3, 64)
 
@@ -680,7 +680,7 @@ def _serve_outage_bench(progress):
         )
         rec = json.loads(proc.stdout.strip().splitlines()[-1])
     except Exception as e:  # noqa: BLE001 — hermetic leg must not kill bench
-        progress(f"serve-outage bench failed: "
+        progress("serve-outage bench failed: "
                  f"{type(e).__name__}: {str(e)[:160]}")
         return {}
     if "value" not in rec:

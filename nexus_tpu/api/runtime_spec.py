@@ -794,7 +794,6 @@ class JaxXlaRuntime:
         # p.expert would underestimate per-chip state (ADVICE r4 #1)
         dense_shards = max(1, p.fsdp * p.tensor * p.pipeline)
         dense_params, expert_params = _expert_param_split(cfg)
-        n_params = dense_params + expert_params
         # per-chip parameter count after sharding (fractional is fine —
         # this is a bytes estimate, not a tensor shape)
         params_chip = (
@@ -972,7 +971,7 @@ class JaxXlaRuntime:
 
             if self.model.family not in CONVERTERS:
                 errs.append(
-                    f"model.weights: no safetensors converter for family "
+                    "model.weights: no safetensors converter for family "
                     f"{self.model.family!r} (have: {sorted(CONVERTERS)})"
                 )
         if self.profile.enabled:
@@ -1033,17 +1032,17 @@ class JaxXlaRuntime:
                 errs.append(f"serve.chunk must be >= 1, got {sv.chunk}")
             if sv.prefill_chunk < 1:
                 errs.append(
-                    f"serve.prefillChunk must be >= 1, got "
+                    "serve.prefillChunk must be >= 1, got "
                     f"{sv.prefill_chunk}"
                 )
             if sv.kv_block_size < 0:
                 errs.append(
-                    f"serve.kvBlockSize must be >= 0 (0 = dense layout), "
+                    "serve.kvBlockSize must be >= 0 (0 = dense layout), "
                     f"got {sv.kv_block_size}"
                 )
             if sv.kv_num_blocks < 0:
                 errs.append(
-                    f"serve.kvNumBlocks must be >= 0 (0 = auto), got "
+                    "serve.kvNumBlocks must be >= 0 (0 = auto), got "
                     f"{sv.kv_num_blocks}"
                 )
             if sv.kv_num_blocks > 0 and sv.kv_block_size <= 0:
@@ -1092,7 +1091,7 @@ class JaxXlaRuntime:
                     and sv.max_queue_delay_s > sv.request_deadline_s):
                 errs.append(
                     f"serve.maxQueueDelaySeconds ({sv.max_queue_delay_s})"
-                    f" exceeds requestDeadlineSeconds "
+                    " exceeds requestDeadlineSeconds "
                     f"({sv.request_deadline_s}): every bounded-delay "
                     "shed would already be a deadline miss"
                 )
@@ -1139,10 +1138,10 @@ class JaxXlaRuntime:
                             and pmax + sv.serve_slack() + 1
                             >= s_cfg.max_seq_len):
                         errs.append(
-                            f"serve shapes don't fit: promptLengthMax "
+                            "serve shapes don't fit: promptLengthMax "
                             f"({pmax} after the max_seq_len/2 clamp) + "
                             f"dispatch slack ({sv.serve_slack()}) + 1 "
-                            f"leaves no decode budget within max_seq_len "
+                            "leaves no decode budget within max_seq_len "
                             f"{s_cfg.max_seq_len}"
                         )
                     if sv.kv_num_blocks > 0 and sv.kv_block_size > 0:
@@ -1155,7 +1154,7 @@ class JaxXlaRuntime:
                         if not sv.prompts and need > sv.kv_num_blocks:
                             errs.append(
                                 f"serve.kvNumBlocks ({sv.kv_num_blocks}) "
-                                f"cannot hold the queue's largest request "
+                                "cannot hold the queue's largest request "
                                 f"({need} blocks of {sv.kv_block_size} "
                                 f"for its {cap}-position envelope)"
                             )
@@ -1166,7 +1165,7 @@ class JaxXlaRuntime:
             if draft_family == "mlp" or draft_family not in list_families():
                 errs.append(
                     f"infer.draft.family {draft_family!r} must be an LM "
-                    f"family with a decode path (one of "
+                    "family with a decode path (one of "
                     f"{[f for f in list_families() if f != 'mlp']})"
                 )
             else:

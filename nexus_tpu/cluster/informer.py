@@ -34,7 +34,7 @@ class Lister:
 
     def __init__(self):
         self._lock = threading.RLock()
-        self._items: Dict[str, APIObject] = {}
+        self._items: Dict[str, APIObject] = {}  # guarded-by: _lock
 
     def get(self, namespace: str, name: str) -> APIObject:
         with self._lock:
@@ -94,9 +94,9 @@ class Informer:
         self.kind = kind
         self.resync_period = resync_period
         self.lister = Lister()
-        self._handlers: List[Dict[str, Callable]] = []
+        self._handlers: List[Dict[str, Callable]] = []  # guarded-by: _lock
         self._synced = threading.Event()
-        self._started = False
+        self._started = False  # guarded-by: _lock
         self._stop = threading.Event()
         self._resync_thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
@@ -210,7 +210,7 @@ class InformerFactory:
     def __init__(self, store: ClusterStore, resync_period: float = 30.0):
         self._store = store
         self._resync = resync_period
-        self._informers: Dict[str, Informer] = {}
+        self._informers: Dict[str, Informer] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def informer(self, kind: str) -> Informer:

@@ -22,10 +22,10 @@ from __future__ import annotations
 
 import logging
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from nexus_tpu.api.types import APIObject, ObjectMeta, new_uid, utcnow
+from nexus_tpu.api.types import APIObject, new_uid, utcnow
 
 logger = logging.getLogger("nexus_tpu.cluster")
 
@@ -77,31 +77,31 @@ class ClusterStore:
         self.name = name
         self._lock = threading.RLock()
         # (kind, namespace) -> {name: obj}
-        self._objects: Dict[Tuple[str, str], Dict[str, APIObject]] = {}
-        self._rv_counter = 0
-        self.actions: List[Action] = []
-        self._watchers: Dict[str, List[Callable[[WatchEvent], None]]] = {}
+        self._objects: Dict[Tuple[str, str], Dict[str, APIObject]] = {}  # guarded-by: _lock
+        self._rv_counter = 0  # guarded-by: _lock
+        self.actions: List[Action] = []  # guarded-by: _lock
+        self._watchers: Dict[str, List[Callable[[WatchEvent], None]]] = {}  # guarded-by: _lock
         self.record_reads = False
         # watch events are enqueued under _lock (global commit order) and
         # drained under _dispatch_lock, so concurrent writers can never
         # deliver events out of order (e.g. a DELETED overtaking the ADDED of
         # a re-created object would permanently desync informer caches)
-        self._pending_events: List[Tuple[str, WatchEvent]] = []
+        self._pending_events: List[Tuple[str, WatchEvent]] = []  # guarded-by: _lock
         self._dispatch_lock = threading.RLock()
         self._draining = threading.local()
 
     # ------------------------------------------------------------------ utils
-    def _next_rv(self) -> str:
+    def _next_rv(self) -> str:  # guarded-by: _lock
         self._rv_counter += 1
         return str(self._rv_counter)
 
-    def _bucket(self, kind: str, namespace: str) -> Dict[str, APIObject]:
+    def _bucket(self, kind: str, namespace: str) -> Dict[str, APIObject]:  # guarded-by: _lock
         return self._objects.setdefault((kind, namespace), {})
 
-    def _record(self, action: Action) -> None:
+    def _record(self, action: Action) -> None:  # guarded-by: _lock
         self.actions.append(action)
 
-    def _enqueue_event(self, kind: str, event: WatchEvent) -> None:
+    def _enqueue_event(self, kind: str, event: WatchEvent) -> None:  # guarded-by: _lock
         """Queue a watch event. MUST be called while still holding ``_lock``
         in the same critical section as the mutation it describes — that is
         what makes queue order equal commit order. (Enqueueing after

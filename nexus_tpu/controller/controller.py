@@ -43,7 +43,7 @@ import logging
 import threading
 import time
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 from nexus_tpu.api.template import NexusAlgorithmTemplate
 from nexus_tpu.api.types import (

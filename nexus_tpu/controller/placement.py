@@ -54,7 +54,7 @@ def select_shards(
         if not selected:
             raise PlacementError(
                 f"workgroup {workgroup.name!r} pins cluster {cluster!r} "
-                f"but no connected shard has that name"
+                "but no connected shard has that name"
             )
 
     required = required_capabilities(workgroup)

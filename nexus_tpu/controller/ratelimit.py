@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, Sequence
+from typing import Any, Callable, Dict, Sequence
 
 
 class RateLimiter:
@@ -58,18 +58,23 @@ class BucketRateLimiter(RateLimiter):
     golang.org/x/time/rate ``Reserve().Delay()``).
     """
 
-    def __init__(self, rate: float = 50.0, burst: int = 300):
+    def __init__(self, rate: float = 50.0, burst: int = 300,
+                 clock: Callable[[], float] = time.monotonic):
         if rate <= 0:
             raise ValueError("rate must be positive")
         self.rate = rate
         self.burst = burst
+        # injectable clock (the detector's pattern): token accrual is pure
+        # arithmetic over clock readings, so backoff behavior unit-tests
+        # deterministically without sleeps
+        self._clock = clock
         self._tokens = float(burst)
-        self._last = time.monotonic()
+        self._last = self._clock()
         self._lock = threading.Lock()
 
     def when(self, item: Any) -> float:
         with self._lock:
-            now = time.monotonic()
+            now = self._clock()
             self._tokens = min(
                 float(self.burst), self._tokens + (now - self._last) * self.rate
             )

@@ -26,17 +26,17 @@ class WorkQueue:
 
     def __init__(self):
         self._cond = threading.Condition()
-        self._queue: List[Any] = []
-        self._dirty: Set[Any] = set()
-        self._processing: Set[Any] = set()
-        self._shutting_down = False
+        self._queue: List[Any] = []  # guarded-by: _cond
+        self._dirty: Set[Any] = set()  # guarded-by: _cond
+        self._processing: Set[Any] = set()  # guarded-by: _cond
+        self._shutting_down = False  # guarded-by: _cond
         # burst coalescing bookkeeping: every add absorbed by the dirty-set
         # dedup is a duplicate key coalesced into the one already waiting
-        self._coalesced_total = 0
+        self._coalesced_total = 0  # guarded-by: _cond
         # delayed adds
-        self._delay_heap: List[Tuple[float, int, Any]] = []
-        self._delay_seq = 0
-        self._delay_thread: Optional[threading.Thread] = None
+        self._delay_heap: List[Tuple[float, int, Any]] = []  # guarded-by: _cond
+        self._delay_seq = 0  # guarded-by: _cond
+        self._delay_thread: Optional[threading.Thread] = None  # guarded-by: _cond
 
     # -------------------------------------------------------------- core API
     def add(self, item: Any) -> None:

@@ -302,7 +302,7 @@ class FailoverManager:
             template, EVENT_TYPE_WARNING, REASON_FAILOVER,
             f"Workload on shard {shard.name!r} "
             f"{'lost its worker (lease expired)' if api_ok else 'abandoned (shard API unreachable)'}"
-            f"; re-placing with restore step "
+            "; re-placing with restore step "
             f"{restore_step if restore_step is not None else 'none (fresh start)'}"
             f" ({steps_lost} steps lost)",
         )

@@ -252,6 +252,8 @@ class ServeEngineSupervisor:
         pace_s: float = 0.0,
         detector: Optional[FailureDetector] = None,
         planner: Optional[ServeFailoverPlanner] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
     ):
         self.make_engine = make_engine
         self.store = store
@@ -271,6 +273,11 @@ class ServeEngineSupervisor:
             probe_interval=self.poll_s,
         )
         self.planner = planner or ServeFailoverPlanner()
+        # injectable clock + sleeper (the detector's pattern): every
+        # deadline, poll wait, and recover_s measurement below reads
+        # _clock/_sleep, so supervision logic unit-tests without real time
+        self._clock = clock
+        self._sleep = sleep
         self._current_cancel = None
         self._last_heartbeats: List[Any] = []
         self._lock = threading.Lock()
@@ -353,7 +360,7 @@ class ServeEngineSupervisor:
             "fenced_alive": False,
             "generations": [],
         }
-        deadline = time.monotonic() + float(timeout_s)
+        deadline = self._clock() + float(timeout_s)
         pending_recover_t0: Optional[float] = None
         attempt = 0
         while queue:
@@ -370,7 +377,7 @@ class ServeEngineSupervisor:
             def hb(step, _renewer=renewer):
                 _renewer.renew(step)
                 if self.pace_s > 0:
-                    time.sleep(self.pace_s)
+                    self._sleep(self.pace_s)
 
             box: Dict[str, Any] = {}
             gen_queue = queue
@@ -393,7 +400,7 @@ class ServeEngineSupervisor:
 
             confirmed_detection: Optional[float] = None
             while thread.is_alive():
-                if time.monotonic() > deadline:
+                if self._clock() > deadline:
                     cancel.cancel(hard=True)
                     thread.join(timeout=10.0)
                     raise TimeoutError(
@@ -410,7 +417,7 @@ class ServeEngineSupervisor:
                     # confirmation → back-in-service, the serving half
                     # of time-to-recover
                     report["recover_s"].append(
-                        time.monotonic() - pending_recover_t0
+                        self._clock() - pending_recover_t0
                     )
                     pending_recover_t0 = None
                 confirmed_detection = self._confirmed(events)
@@ -421,7 +428,7 @@ class ServeEngineSupervisor:
                     report["fenced_alive"] = True
                     cancel.cancel(hard=True)
                     break
-                time.sleep(self.poll_s)
+                self._sleep(self.poll_s)
             thread.join(timeout=30.0)
             with self._lock:
                 self._current_cancel = None
@@ -454,7 +461,7 @@ class ServeEngineSupervisor:
                     # the generation completed before the monitor ever
                     # saw its lease — bound recover time by completion
                     report["recover_s"].append(
-                        time.monotonic() - pending_recover_t0
+                        self._clock() - pending_recover_t0
                     )
                     pending_recover_t0 = None
                 if confirmed_detection is None:
@@ -476,7 +483,7 @@ class ServeEngineSupervisor:
             queue = self.planner.requeue(gen_queue, drained)
             report["requeued"] += len(queue)
             self._reap_lease()
-            pending_recover_t0 = time.monotonic()
+            pending_recover_t0 = self._clock()
             attempt += 1
         report["requests_lost"] = sum(1 for r in results if r is None)
         return results, report
@@ -485,12 +492,12 @@ class ServeEngineSupervisor:
         """Probe until the detector confirms the serve lease expired (a
         crashed engine is confirmed by silence, after the flap
         suppression's full window count)."""
-        while time.monotonic() < deadline:
+        while self._clock() < deadline:
             detection = self._confirmed(self._probe())
             if detection is not None:
                 return detection
-            time.sleep(self.poll_s)
+            self._sleep(self.poll_s)
         raise TimeoutError(
-            f"failure detector never confirmed the death of serve "
+            "failure detector never confirmed the death of serve "
             f"engine {self.template!r}"
         )

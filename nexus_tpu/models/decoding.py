@@ -507,7 +507,6 @@ def _commit_speculation(buf, rows, last_pos, active, accepted, out, k,
     only: the correction token's K/V is NOT in any cache — it is appended
     when the next round feeds it as its first input. Shared by both
     speculative loops (the subtle invariants live exactly once)."""
-    b = accepted.shape[0]
     n_new = jnp.where(active, accepted + 1, 0)  # (B,)
     write_pos = jnp.where(
         active[:, None],

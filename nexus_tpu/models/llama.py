@@ -12,7 +12,6 @@ FSDP pretraining). TPU-first layout decisions:
 
 from __future__ import annotations
 
-import dataclasses
 import math
 from dataclasses import dataclass
 from functools import partial

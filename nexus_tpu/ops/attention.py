@@ -454,7 +454,7 @@ def _flash_impl(q, k, v, opts):
         or (block_k % 8 and block_k != sk)
     ):
         raise ValueError(
-            f"flash_attention requires tileable sequences (pad the sequence "
+            "flash_attention requires tileable sequences (pad the sequence "
             f"or pass explicit blocks): sq={sq} (block_q={block_q}), "
             f"sk={sk} (block_k={block_k})"
         )

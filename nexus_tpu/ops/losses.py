@@ -15,8 +15,6 @@ is a TPU-first HBM-bandwidth optimization in the workload plane.
 
 from __future__ import annotations
 
-from typing import Tuple
-
 import jax
 import jax.numpy as jnp
 from jax import lax

@@ -9,7 +9,7 @@ resharding between token-sharded and expert-sharded operands).
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

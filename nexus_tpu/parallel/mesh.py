@@ -78,7 +78,7 @@ def split_dcn_axes(
             f"cannot place {n_slices} slices onto mesh shape "
             f"{tuple(plan_shape)}: outer axes only absorb "
             f"{n_slices // remaining}; give the data/fsdp/pipeline axes a "
-            f"multiple of the slice count"
+            "multiple of the slice count"
         )
     ici = tuple(s // d for s, d in zip(plan_shape, dcn))
     return ici, tuple(dcn)

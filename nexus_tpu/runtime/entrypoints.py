@@ -16,7 +16,6 @@ from typing import Any, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from nexus_tpu.api.runtime_spec import JaxXlaRuntime
@@ -35,7 +34,6 @@ from nexus_tpu.train.data import (
     synthetic_mlp_batches,
 )
 from nexus_tpu.train.metrics import (
-    detect_peak_flops_per_chip,
     mfu,
     model_flops_per_token,
 )

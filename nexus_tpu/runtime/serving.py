@@ -512,6 +512,32 @@ class ServingEngine:
         self._stop = int(stop_token_id)
         self._chunk = int(chunk)
         self._cache_sharding = cache_sharding
+        # On a sharded mesh, host-built arrays (admission waves, block
+        # tables, per-chunk done masks) must enter every dispatch with
+        # the SAME committed sharding as the steady-state values the jit
+        # programs return, or each commitment flavor compiles its own
+        # program — the silent-recompile leak the NEXUS_SANITIZE audit
+        # caught on the 8-device mesh (3 decode programs instead of 1).
+        # ``_mint`` commits them replicated on the cache's mesh.
+        mesh = getattr(cache_sharding, "mesh", None)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            self._host_sharding = NamedSharding(mesh, P())
+            # normalize the caller's spec to jax's canonical form (trailing
+            # None axes trimmed; all-None == P()) — the eager constraint on
+            # a fresh cache and the sharding the jit programs RETURN must
+            # compare EQUAL, or the first dispatch after every fresh cache
+            # compiles its own program
+            spec = getattr(cache_sharding, "spec", None)
+            if spec is not None:
+                entries = list(spec)
+                while entries and entries[-1] is None:
+                    entries.pop()
+                self._cache_sharding = NamedSharding(mesh, P(*entries))
+        else:
+            self._host_sharding = None
         self._base_key = jax.random.PRNGKey(int(sample_seed))
         self._lookup = int(lookup_ngram)
         self._k = int(num_speculative)
@@ -819,6 +845,14 @@ class ServingEngine:
             _spec_chunk, donate_argnums=(1, 5) if donate else ()
         )
 
+    def _mint(self, x, dtype=None):
+        """Host value → device array with a dispatch-stable commitment
+        (replicated on the cache mesh when one is set — see __init__)."""
+        arr = jnp.asarray(x, dtype)
+        if self._host_sharding is not None:
+            arr = jax.device_put(arr, self._host_sharding)
+        return arr
+
     def _validate_request(self, req: ServeRequest, req_idx: int):
         """Per-request admission checks → (prompt, p, budget)."""
         prompt = np.asarray(req.prompt, dtype=np.int32)
@@ -905,8 +939,8 @@ class ServingEngine:
             self._prefill_steps_saved += -(-p // width) - steps
         cache, buf, ptr, plen, temp_vec, seed_vec = self._insert_fn(
             cache, buf, ptr, plen, temp_vec, seed_vec,
-            jnp.asarray(rows), jnp.asarray(prompts), jnp.asarray(ps),
-            jnp.asarray(starts), jnp.asarray(temps), jnp.asarray(seeds),
+            self._mint(rows), self._mint(prompts), self._mint(ps),
+            self._mint(starts), self._mint(temps), self._mint(seeds),
         )
         self._insert_dispatches += 1
         return cache, buf, ptr, plen, temp_vec, seed_vec, out
@@ -971,42 +1005,53 @@ class ServingEngine:
                     b, max_len, quantized=quantized,
                 )
                 c["length"] = jnp.zeros((b,), jnp.int32)
-            return constrain_kv_sharding(c, self._cache_sharding)
+            c = constrain_kv_sharding(c, self._cache_sharding)
+            if self._host_sharding is not None:
+                # k/v (+ scales) already carry the cache sharding; commit
+                # the host-side leaves (tables, lengths) replicated so the
+                # first dispatch's cache signature equals the steady
+                # state's
+                c = {
+                    k: (v if k in ("k", "v", "k_scale", "v_scale")
+                        else jax.device_put(v, self._host_sharding))
+                    for k, v in c.items()
+                }
+            return c
 
         # ---- warm-up (outside the timed window) ----
         # warm with the REAL layout or jit compiles a second program for
         # the constrained cache on the first timed chunk (scale planes
         # included — unconstrained they replicate on a sharded mesh)
         warm_cache = fresh_cache()
-        warm_buf = jnp.zeros((b, max_len), jnp.int32)
+        warm_buf = self._mint(np.zeros((b, max_len), np.int32))
 
         def zi():
             # donation demands DISTINCT buffers per donated argnum (a
             # shared array would be both donated twice in one call and
             # dead for the next one) — mint a fresh array per use
-            return jnp.zeros((b,), jnp.int32)
+            return self._mint(np.zeros((b,), np.int32))
 
         def zf():
-            return jnp.zeros((b,), jnp.float32)
+            return self._mint(np.zeros((b,), np.float32))
 
         # the insert consumes its donated inputs; thread its RETURNS
         # into the chunk warm-up instead of reusing dead arrays
         (warm_cache, warm_buf, warm_ptr, warm_plen, warm_temp,
          warm_seed) = self._insert_fn(
             warm_cache, warm_buf, zi(), zi(), zf(), zi(),
-            jnp.full((b,), b, jnp.int32),
-            jnp.zeros((b, max_len), jnp.int32), zi(), zi(), zf(), zi(),
+            self._mint(np.full((b,), b, np.int32)),
+            self._mint(np.zeros((b, max_len), np.int32)), zi(), zi(), zf(), zi(),
         )
         if self._lookup:
             out = self._spec_chunk(
                 self._params, warm_cache, zi(), warm_ptr,
-                jnp.ones((b,), jnp.bool_), warm_buf, warm_plen,
+                self._mint(np.ones((b,), np.bool_)), warm_buf, warm_plen,
             )
             np.asarray(out[4])  # host fetch: the warm-up really completed
         else:
             out = self._decode_chunk(
                 self._params, warm_cache, zi(), warm_ptr,
-                jnp.ones((b,), jnp.bool_), warm_buf, warm_plen,
+                self._mint(np.ones((b,), np.bool_)), warm_buf, warm_plen,
                 warm_temp, warm_seed,
             )
             np.asarray(out[3])  # host fetch: the warm-up really completed
@@ -1016,8 +1061,8 @@ class ServingEngine:
                 warm2 = fresh_cache()
                 out = self._decode_chunk_narrow(
                     self._params, warm2, zi(), zi(),
-                    jnp.ones((b,), jnp.bool_),
-                    jnp.zeros((b, max_len), jnp.int32), zi(), zf(), zi(),
+                    self._mint(np.ones((b,), np.bool_)),
+                    self._mint(np.zeros((b, max_len), np.int32)), zi(), zf(), zi(),
                 )
                 np.asarray(out[3])
         del warm_cache, warm_buf, out
@@ -1026,12 +1071,12 @@ class ServingEngine:
         self.last_drain = None
         interrupted = False
         cache = fresh_cache()  # vector length from step 0
-        buf = jnp.zeros((b, max_len), jnp.int32)
-        tok_vec = jnp.zeros((b,), jnp.int32)
-        ptr_vec = jnp.zeros((b,), jnp.int32)
-        plen_vec = jnp.zeros((b,), jnp.int32)
-        temp_vec = jnp.zeros((b,), jnp.float32)
-        seed_vec = jnp.zeros((b,), jnp.int32)
+        buf = self._mint(np.zeros((b, max_len), np.int32))
+        tok_vec = zi()
+        ptr_vec = zi()
+        plen_vec = zi()
+        temp_vec = zf()
+        seed_vec = zi()
         rows: List[Optional[_RowState]] = [None] * b
         # host-side mirror of each row's remaining prefill steps (at the
         # chunk program's feed width) — selects the wide program only
@@ -1129,7 +1174,7 @@ class ServingEngine:
                     table_dirty[0] = True
             if table_dirty[0]:
                 cache = dict(cache)
-                cache["block_table"] = jnp.asarray(table_np)
+                cache["block_table"] = self._mint(table_np)
                 table_dirty[0] = False
 
         def finish(state: _RowState, status: str = STATUS_OK) -> None:
@@ -1363,7 +1408,7 @@ class ServingEngine:
                 for i, (s_, d_) in enumerate(cow_pairs):
                     src[i], dst[i] = s_, d_
                 cache = self._copy_fn(
-                    cache, jnp.asarray(src), jnp.asarray(dst)
+                    cache, self._mint(src), self._mint(dst)
                 )
                 cow_copies += len(cow_pairs)
 
@@ -1405,8 +1450,9 @@ class ServingEngine:
                 # pool's residency for the bytes-per-token metric
                 grow_and_push_tables()
                 alloc_block_steps += alloc.allocated_blocks
-            done_vec = jnp.asarray(
-                [r is None or row_done(r) for r in rows], jnp.bool_
+            done_vec = self._mint(
+                np.asarray([r is None or row_done(r) for r in rows]),
+                jnp.bool_,
             )
             if self._lookup:
                 (cache, tok_vec, ptr_vec, buf, outs, accs, n_emits,

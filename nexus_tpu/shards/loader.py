@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from nexus_tpu.cluster.store import ClusterStore
 from nexus_tpu.shards.shard import Shard

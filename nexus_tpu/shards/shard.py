@@ -15,7 +15,7 @@ Write contract (reference test oracle controller_test.go:183-228):
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Optional
 
 from nexus_tpu.api.template import NexusAlgorithmSpec, NexusAlgorithmTemplate
 from nexus_tpu.api.types import (

@@ -40,7 +40,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from nexus_tpu.api.template import NexusAlgorithmTemplate
-from nexus_tpu.api.types import GROUP, VERSION, ConfigMap, Lease, Secret
+from nexus_tpu.api.types import ConfigMap, Lease, Secret
 from nexus_tpu.api.workgroup import NexusAlgorithmWorkgroup
 from nexus_tpu.api.workload import Job, Service
 from nexus_tpu.cluster.store import (

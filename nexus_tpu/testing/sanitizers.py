@@ -1,0 +1,209 @@
+"""Runtime sanitizers for the serving engine (``NEXUS_SANITIZE=1``).
+
+Static analysis (tools/nexuslint) catches what the AST can prove; the
+two failure modes it cannot prove are exactly the ones that cost real
+money on TPUs:
+
+  * **silent recompiles** — the engine's contract is ONE compiled decode
+    program per jitted callable for the whole serve loop (static shapes;
+    runtime/serving.py module docstring). A shape or dtype leak turns
+    that into a compile per wave: the run still produces correct tokens,
+    just 100× slower — no test asserts on wall time, so nothing fails.
+  * **leaked KV blocks** — ``BlockAllocator.pool_partition`` documents
+    the invariant (every block free, parked, or referenced; nothing
+    allocated or reserved once every lease released). PR 6's failover
+    tests assert it after kill-mid-decode, but ordinary serve paths had
+    no audit: a leak introduced on the happy path permanently shrinks
+    the pool one request at a time.
+
+With ``NEXUS_SANITIZE=1`` (tier-1 conftest wires this), every
+``ServingEngine.serve()`` call is followed by both audits; a violation
+raises :class:`SanitizerError` inside whatever test drove the engine —
+cheap enough to leave on for the whole suite (two dict reads and five
+``_cache_size()`` probes per serve run).
+
+Knobs:
+
+  NEXUS_SANITIZE               truthy → conftest installs the audits
+  NEXUS_SANITIZE_MAX_PROGRAMS  per-callable compiled-program bound
+                               (default 2: the program itself, plus one
+                               slot of slack for dtype-promotion drift
+                               between jax versions)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+ENV_FLAG = "NEXUS_SANITIZE"
+ENV_MAX_PROGRAMS = "NEXUS_SANITIZE_MAX_PROGRAMS"
+DEFAULT_MAX_PROGRAMS = 2
+
+#: the serving engine's compiled surface — every jax.jit callable it
+#: constructs (runtime/serving.py __init__). An attr absent on the
+#: engine (or a jax without ``_cache_size``) is skipped, not an error.
+ENGINE_JIT_ATTRS = (
+    "_decode_chunk",
+    "_decode_chunk_narrow",
+    "_insert_fn",
+    "_copy_fn",
+    "_spec_chunk",
+)
+
+
+class SanitizerError(AssertionError):
+    """A runtime invariant the sanitizers watch for was violated."""
+
+
+def sanitizers_enabled(env: Optional[Dict[str, str]] = None) -> bool:
+    raw = (env if env is not None else os.environ).get(ENV_FLAG, "")
+    return raw.strip().lower() not in ("", "0", "off", "false", "no")
+
+
+def max_programs(env: Optional[Dict[str, str]] = None) -> int:
+    raw = (env if env is not None else os.environ).get(ENV_MAX_PROGRAMS, "")
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return DEFAULT_MAX_PROGRAMS
+
+
+# ---------------------------------------------------------------------------
+# audit 1: pool-partition leak check
+
+
+def audit_pool_partition(metrics: Dict[str, Any], context: str = "serve") -> None:
+    """Assert the end-of-serve block-pool partition is leak-free.
+
+    Reads the ledger ``serve()`` already publishes (kv_*_blocks_final):
+    free + parked must cover the whole pool, and with every lease
+    released nothing may remain allocated or reserved — a non-zero
+    residue is a leaked lease (or a reservation refund that never
+    happened). Dense-layout runs carry no pool and are skipped.
+    """
+    if metrics.get("kv_layout") != "paged":
+        return
+    free = metrics.get("kv_free_blocks_final")
+    parked = metrics.get("kv_parked_blocks_final")
+    allocated = metrics.get("kv_allocated_blocks_final")
+    reserved = metrics.get("kv_reserved_blocks_final")
+    total = metrics.get("kv_num_blocks")
+    if None in (free, parked, allocated, reserved, total):
+        raise SanitizerError(
+            f"{context}: paged serve metrics are missing the pool-partition "
+            "ledger (kv_*_blocks_final) — the leak audit has nothing to check"
+        )
+    partition = f"free={free} parked={parked} allocated={allocated} " \
+                f"reserved={reserved} total={total}"
+    if allocated != 0:
+        raise SanitizerError(
+            f"{context}: {allocated} KV block(s) still allocated after every "
+            f"lease should have released — leaked lease ({partition})"
+        )
+    if reserved != 0:
+        raise SanitizerError(
+            f"{context}: {reserved} reserved KV block(s) never refunded "
+            f"({partition})"
+        )
+    if free + parked != total:
+        raise SanitizerError(
+            f"{context}: free+parked != pool — block(s) fell out of the "
+            f"partition entirely ({partition})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# audit 2: bounded jit recompiles
+
+
+def jit_program_counts(engine: Any) -> Dict[str, int]:
+    """Compiled-program count per engine jit callable (best-effort:
+    attrs or ``_cache_size`` absent on this jax version are skipped)."""
+    counts: Dict[str, int] = {}
+    seen = set()
+    for attr in ENGINE_JIT_ATTRS:
+        fn = getattr(engine, attr, None)
+        if fn is None or id(fn) in seen:
+            continue  # narrow may alias the wide program at T == 1
+        seen.add(id(fn))
+        probe = getattr(fn, "_cache_size", None)
+        if probe is None:
+            continue
+        try:
+            counts[attr] = int(probe())
+        except Exception:  # noqa: BLE001 — introspection must never crash serving
+            continue
+    return counts
+
+
+def audit_recompiles(
+    engine: Any, bound: Optional[int] = None, context: str = "serve"
+) -> Dict[str, int]:
+    """Assert every engine jit callable stayed within its program bound.
+
+    The steady-state contract is ONE program per callable (each is built
+    for exactly one static shape signature); the default bound of 2
+    leaves a slack slot so a jax-version dtype-promotion quirk doesn't
+    hard-fail the suite, while a genuine per-wave recompile storm (tens
+    of programs) is caught immediately. Returns the observed counts so
+    callers can log them.
+    """
+    bound = max_programs() if bound is None else bound
+    counts = jit_program_counts(engine)
+    for attr, n in sorted(counts.items()):
+        if n > bound:
+            raise SanitizerError(
+                f"{context}: {attr} compiled {n} programs (bound {bound}) — "
+                "a shape or dtype is leaking into the decode wave; the "
+                "one-compiled-program serving contract is broken "
+                f"(all counts: {counts})"
+            )
+    return counts
+
+
+# ---------------------------------------------------------------------------
+# installation
+
+
+_INSTALLED_FLAG = "_nexus_sanitize_wrapped"
+
+
+def install(engine_cls: Optional[type] = None) -> bool:
+    """Wrap ``ServingEngine.serve`` with both audits (idempotent).
+
+    Returns True when the wrap is active (already-installed counts).
+    Audits run only on serve() calls that RETURN — a serve that raises
+    keeps its original traceback untouched.
+    """
+    if engine_cls is None:
+        from nexus_tpu.runtime.serving import ServingEngine as engine_cls  # noqa: N813
+    if getattr(engine_cls, _INSTALLED_FLAG, False):
+        return True
+    original: Callable = engine_cls.serve
+
+    def serve_with_audits(self, requests, cancel=None, heartbeat=None):
+        results, metrics = original(
+            self, requests, cancel=cancel, heartbeat=heartbeat
+        )
+        audit_pool_partition(metrics, context="sanitizer[pool]")
+        audit_recompiles(self, context="sanitizer[recompile]")
+        return results, metrics
+
+    serve_with_audits._nexus_sanitize_original = original  # type: ignore[attr-defined]
+    engine_cls.serve = serve_with_audits
+    setattr(engine_cls, _INSTALLED_FLAG, True)
+    return True
+
+
+def uninstall(engine_cls: Optional[type] = None) -> bool:
+    """Undo :func:`install` (tests that exercise the sanitizer itself)."""
+    if engine_cls is None:
+        from nexus_tpu.runtime.serving import ServingEngine as engine_cls  # noqa: N813
+    wrapped = engine_cls.serve
+    original = getattr(wrapped, "_nexus_sanitize_original", None)
+    if original is None:
+        return False
+    engine_cls.serve = original
+    setattr(engine_cls, _INSTALLED_FLAG, False)
+    return True

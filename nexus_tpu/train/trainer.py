@@ -12,7 +12,6 @@ from __future__ import annotations
 import time
 from collections import deque
 from dataclasses import dataclass
-from functools import partial
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -20,7 +19,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from nexus_tpu.parallel.sharding import logical_to_spec, sharding_tree
+from nexus_tpu.parallel.sharding import sharding_tree
 
 
 def _on_tpu() -> bool:

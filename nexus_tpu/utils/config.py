@@ -11,7 +11,7 @@ reference: build.yaml:79).
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field, fields
+from dataclasses import dataclass, fields
 from typing import Any, Dict, Optional, Type, TypeVar
 
 import yaml

@@ -35,6 +35,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
 
+# Runtime sanitizers (docs/static-analysis.md): NEXUS_SANITIZE=1 wraps
+# every ServingEngine.serve() with the pool-partition leak audit and the
+# bounded-recompile audit, so ANY serving test that leaks a KV block or
+# triggers a per-wave recompile storm fails loudly — not just the
+# failover tests that assert the partition explicitly.
+from nexus_tpu.testing import sanitizers as _sanitizers  # noqa: E402
+
+if _sanitizers.sanitizers_enabled():
+    _sanitizers.install()
+
 # Workload-plane modules are compile-bound (minutes each on CPU) — they
 # carry the `slow` marker so the default dev lane (`pytest -m "not slow"`)
 # finishes in single-digit minutes while CI's full lane still runs and

@@ -8,7 +8,7 @@ import time
 import pytest
 
 from nexus_tpu.api.template import NexusAlgorithmTemplate
-from nexus_tpu.api.types import ConfigMap, ObjectMeta, Secret
+from nexus_tpu.api.types import Secret
 from nexus_tpu.cluster.store import ClusterStore, NotFoundError
 from nexus_tpu.controller.controller import Controller
 from nexus_tpu.shards.shard import Shard

@@ -426,7 +426,7 @@ def _write_stub_plugin(tmp_path, token="exec-minted-token", expiry="",
         body += "print('not json')\n"
     else:
         body += f"print(json.dumps({{'apiVersion': "
-        body += f"'client.authentication.k8s.io/v1', 'kind': "
+        body += "'client.authentication.k8s.io/v1', 'kind': "
         body += f"'ExecCredential', 'status': {status!r}}}))\n"
     script.write_text(body)
     return script, count

@@ -1,0 +1,741 @@
+"""nexuslint + runtime-sanitizer coverage (fast CPU lane).
+
+Three layers, mirroring how the gate is trusted:
+
+  1. per-rule fixtures — a violating snippet and its clean twin, so every
+     rule family demonstrably fires AND demonstrably stays quiet;
+  2. machinery — suppression comments, file-level disables, config
+     scoping, CLI exit codes;
+  3. the repo gate itself — ``make analyze`` must pass on the tree
+     (asserted here through the same API the CLI uses), and the runtime
+     sanitizers must catch seeded pool leaks / recompile storms while
+     passing a real stub-engine serve.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from tools.nexuslint import __main__ as nexuslint_cli
+from tools.nexuslint.core import LintConfig, lint_paths, lint_source, load_config
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ids(findings):
+    return [f.rule_id for f in findings]
+
+
+def _lint(src, path="mod.py", config=None, select=None):
+    return lint_source(path, textwrap.dedent(src), config, select=select)
+
+
+# ---------------------------------------------------------------------------
+# NX-CLOCK
+
+
+CLOCK_VIOLATION = """
+    import time
+
+    class Detector:
+        def __init__(self, clock=time.monotonic):
+            self.clock = clock
+
+        def probe(self):
+            return time.monotonic()  # the drift the rule exists for
+"""
+
+
+def test_clock_rule_fires_on_direct_read_in_disciplined_module():
+    findings = _lint(CLOCK_VIOLATION, select=["NX-CLOCK"])
+    assert _ids(findings) == ["NX-CLOCK001"]
+    assert "time.monotonic" in findings[0].message
+
+
+def test_clock_rule_ignores_undisciplined_modules():
+    src = """
+        import time
+
+        def stamp():
+            return time.monotonic()
+    """
+    assert _lint(src, select=["NX-CLOCK"]) == []
+
+
+def test_clock_rule_allows_default_value_references():
+    """``clock=time.monotonic`` as a default is the injection idiom, not
+    a violation — only CALLS are flagged."""
+    src = """
+        import time
+
+        class Ok:
+            def __init__(self, clock=time.monotonic):
+                self._clock = clock
+
+            def now(self):
+                return self._clock()
+    """
+    assert _lint(src, select=["NX-CLOCK"]) == []
+
+
+def test_clock_rule_catches_sleep_and_aliases():
+    src = """
+        import time as t
+        from time import sleep as zzz
+
+        class Paced:
+            def __init__(self, clock=None):
+                self.clock = clock
+
+            def wait(self):
+                zzz(0.1)
+                t.sleep(0.2)
+                return t.time()
+    """
+    ids = _ids(_lint(src, select=["NX-CLOCK"]))
+    assert ids == ["NX-CLOCK002", "NX-CLOCK002", "NX-CLOCK001"]
+
+
+def test_clock_rule_catches_datetime_now():
+    src = """
+        import datetime
+
+        class Lease:
+            def __init__(self, clock=None):
+                self.clock = clock
+
+            def stamp(self):
+                return datetime.datetime.now(datetime.timezone.utc)
+    """
+    assert _ids(_lint(src, select=["NX-CLOCK"])) == ["NX-CLOCK001"]
+
+
+def test_clock_rule_config_include_scopes_undetectable_modules():
+    """A module with no ``clock`` parameter is still disciplined when the
+    config pins it (the repo pins ha/, serving, ratelimit)."""
+    cfg = LintConfig(rule_include={"NX-CLOCK": ["pinned/*.py"]})
+    src = """
+        import time
+
+        def helper():
+            return time.monotonic()
+    """
+    assert _lint(src, path="pinned/mod.py", config=cfg, select=["NX-CLOCK"])
+    assert not _lint(src, path="other/mod.py", config=cfg, select=["NX-CLOCK"])
+
+
+# ---------------------------------------------------------------------------
+# NX-LOCK
+
+
+LOCK_VIOLATION = """
+    import threading
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self._items = {}  # guarded-by: _lock
+
+        def get(self, k):
+            return self._items[k]
+"""
+
+
+def test_lock_rule_fires_on_unlocked_access():
+    findings = _lint(LOCK_VIOLATION, select=["NX-LOCK"])
+    assert _ids(findings) == ["NX-LOCK001"]
+    assert "_items" in findings[0].message and "get()" in findings[0].message
+
+
+def test_lock_rule_accepts_locked_access_and_init():
+    src = """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._items = {}  # guarded-by: _lock
+                self._items["seed"] = 1  # __init__ is exempt
+
+            def get(self, k):
+                with self._lock:
+                    return self._items[k]
+    """
+    assert _lint(src, select=["NX-LOCK"]) == []
+
+
+def test_lock_rule_honors_holder_method_annotation():
+    src = """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._items = {}  # guarded-by: _lock
+
+            def _bucket(self, k):  # guarded-by: _lock
+                return self._items.setdefault(k, {})
+
+            def put(self, k, v):
+                with self._lock:
+                    self._bucket(k)[v] = True
+    """
+    assert _lint(src, select=["NX-LOCK"]) == []
+
+
+def test_lock_rule_flags_access_under_the_wrong_lock():
+    src = """
+        import threading
+
+        class TwoLocks:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._other = threading.Lock()
+                self._q = []  # guarded-by: _lock
+
+            def pop(self):
+                with self._other:
+                    return self._q.pop()
+    """
+    assert _ids(_lint(src, select=["NX-LOCK"])) == ["NX-LOCK001"]
+
+
+def test_lock_rule_trailing_annotation_cannot_disable_a_method():
+    """A guarded-by comment on a method's LAST line (e.g. an
+    attribute-style annotation misplaced outside __init__) must not mark
+    the method as a lock holder — that would silently turn NX-LOCK001
+    OFF for exactly the method it was meant to tighten."""
+    src = """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._items = {}  # guarded-by: _lock
+
+            def wipe(self):
+                self._items.clear()  # guarded-by: _lock
+    """
+    assert _ids(_lint(src, select=["NX-LOCK"])) == ["NX-LOCK001"]
+
+
+def test_lock_rule_typo_guard():
+    src = """
+        import threading
+
+        class Typo:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = []  # guarded-by: _lokc
+
+            def pop(self):
+                with self._lokc:
+                    return self._q.pop()
+    """
+    assert "NX-LOCK002" in _ids(_lint(src, select=["NX-LOCK"]))
+
+
+def test_lock_annotations_on_real_modules_are_parsed():
+    """The store/informer/workqueue annotations must actually register
+    (an annotation grammar drift would silently disable the rule)."""
+    import ast
+
+    from tools.nexuslint.core import FileContext
+    from tools.nexuslint.rules_locks import _class_info
+
+    expectations = {
+        "nexus_tpu/cluster/store.py": ("ClusterStore", "_objects"),
+        "nexus_tpu/cluster/informer.py": ("Lister", "_items"),
+        "nexus_tpu/controller/workqueue.py": ("WorkQueue", "_dirty"),
+    }
+    for rel, (cls_name, attr) in expectations.items():
+        path = os.path.join(REPO_ROOT, rel)
+        ctx = FileContext(rel, open(path).read(), LintConfig())
+        guarded = {}
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef) and cls.name == cls_name:
+                guarded, _, _ = _class_info(ctx, cls)
+        assert attr in guarded, f"{rel}: {cls_name}.{attr} lost its annotation"
+
+
+# ---------------------------------------------------------------------------
+# NX-JIT
+
+
+JIT_VIOLATION = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        return float(x) + x.item()
+"""
+
+
+def test_jit_rule_fires_on_cast_and_item():
+    ids = _ids(_lint(JIT_VIOLATION, select=["NX-JIT"]))
+    assert ids == ["NX-JIT002", "NX-JIT001"] or ids == ["NX-JIT001", "NX-JIT002"]
+
+
+def test_jit_rule_allows_static_shape_casts():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            n = int(x.shape[0])
+            m = int(len(x.shape))
+            return x * n * m
+    """
+    assert _lint(src, select=["NX-JIT"]) == []
+
+
+def test_jit_rule_partial_decorator_and_np_random():
+    src = """
+        from functools import partial
+        import jax
+        import numpy as np
+
+        @partial(jax.jit, static_argnums=(1,))
+        def noisy(x, k):
+            return x + np.random.randn(*x.shape)
+    """
+    assert _ids(_lint(src, select=["NX-JIT"])) == ["NX-JIT003"]
+
+
+def test_jit_rule_wrapped_function_form():
+    src = """
+        import jax
+
+        def step(x):
+            return x.item()
+
+        fast_step = jax.jit(step)
+    """
+    assert _ids(_lint(src, select=["NX-JIT"])) == ["NX-JIT001"]
+
+
+def test_jit_rule_factory_form_marks_returned_workers():
+    """The serving-engine idiom: ``jax.jit(make_fn(T))`` traces the
+    factory's nested def, not the factory itself."""
+    src = """
+        import jax
+
+        def make_chunk(width):
+            scale = int(width)  # factory body is host code: legal
+
+            def chunk(x):
+                return x * x.item()  # traced body: flagged
+
+            return chunk
+
+        fn = jax.jit(make_chunk(8))
+    """
+    findings = _lint(src, select=["NX-JIT"])
+    assert _ids(findings) == ["NX-JIT001"]
+
+
+def test_jit_rule_mutable_default():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x, acc=[]):
+            return x
+    """
+    assert _ids(_lint(src, select=["NX-JIT"])) == ["NX-JIT004"]
+
+
+def test_jit_rule_ignores_plain_functions():
+    src = """
+        def host(x):
+            return float(x) + x.item()
+    """
+    assert _lint(src, select=["NX-JIT"]) == []
+
+
+def test_jit_rule_traces_real_serving_factories():
+    """Regression probe: the engine's jitted surface must stay visible
+    to the rule (a detection regression would turn NX-JIT into a no-op
+    on the exact module it exists for)."""
+    import ast
+
+    from tools.nexuslint.rules_jit import _jitted_functions
+
+    path = os.path.join(REPO_ROOT, "nexus_tpu/runtime/serving.py")
+    tree = ast.parse(open(path).read())
+    traced = _jitted_functions(tree)
+    names = {
+        n.name
+        for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef) and id(n) in traced
+    }
+    assert {"_decode_chunk", "_insert_wave", "_spec_chunk"} <= names
+
+
+# ---------------------------------------------------------------------------
+# NX-PAIR
+
+
+PAIR_VIOLATION = """
+    def use(alloc):
+        lease = alloc.admit(4)
+        lease.grow_to(2)
+        lease.release()
+"""
+
+
+def test_pair_rule_fires_without_finally():
+    findings = _lint(PAIR_VIOLATION, select=["NX-PAIR"])
+    assert _ids(findings) == ["NX-PAIR001", "NX-PAIR001"]  # admit + grow_to
+
+
+def test_pair_rule_accepts_finally():
+    src = """
+        def use(alloc):
+            lease = alloc.admit(4)
+            try:
+                lease.grow_to(2)
+            finally:
+                lease.release()
+    """
+    assert _lint(src, select=["NX-PAIR"]) == []
+
+
+def test_pair_rule_accepts_context_manager_acquire():
+    src = """
+        def use(pool):
+            with pool.acquire() as lease:
+                lease.work()
+            pool.release()
+    """
+    assert _lint(src, select=["NX-PAIR"]) == []
+
+
+def test_pair_rule_skips_pure_acquire_ownership_transfer():
+    src = """
+        def admit_row(alloc):
+            return alloc.admit(4)
+    """
+    assert _lint(src, select=["NX-PAIR"]) == []
+
+
+def test_pair_rule_receiver_hint():
+    """chaos.add:chaos.clear only matches receivers ending in `chaos` —
+    a set's .add() near an unrelated .clear() must not pair up."""
+    src = """
+        def chaosy(server):
+            server.chaos.add("error")
+            run(server)
+            server.chaos.clear()
+
+        def setty(s):
+            s.add(1)
+            s.clear()
+    """
+    findings = _lint(src, select=["NX-PAIR"])
+    assert _ids(findings) == ["NX-PAIR001"]
+    assert findings[0].line == 3  # the chaos.add, never the set.add
+
+
+def test_pair_rule_nested_functions_are_separate_scopes():
+    src = """
+        def engine(alloc):
+            def admit_into(free):
+                return alloc.admit(free)
+
+            def release_row(lease):
+                lease.release()
+
+            return admit_into, release_row
+    """
+    assert _lint(src, select=["NX-PAIR"]) == []
+
+
+# ---------------------------------------------------------------------------
+# NX-IMP
+
+
+IMP_VIOLATION = """
+    import os
+    import sys
+
+    print(os.getcwd())
+"""
+
+
+def test_imp_rule_fires_on_unused():
+    findings = _lint(IMP_VIOLATION, select=["NX-IMP"])
+    assert _ids(findings) == ["NX-IMP001"]
+    assert "sys" in findings[0].message
+
+
+def test_imp_rule_carveouts():
+    src = """
+        import json  # noqa
+        from typing import List as List
+        try:
+            import hypothesis
+        except ImportError:
+            hypothesis = None
+        __all__ = ["exported"]
+        from .mod import exported
+    """
+    assert _lint(src, select=["NX-IMP"]) == []
+
+
+def test_imp_rule_skips_init_files():
+    src = "import re\n"
+    assert _lint(src, path="pkg/__init__.py", select=["NX-IMP"]) == []
+    assert _lint(src, path="pkg/mod.py", select=["NX-IMP"])
+
+
+# ---------------------------------------------------------------------------
+# machinery: suppressions, config, syntax errors, CLI
+
+
+def test_line_suppression():
+    src = """
+        import time
+
+        class D:
+            def __init__(self, clock=None):
+                self.clock = clock
+
+            def probe(self):
+                return time.monotonic()  # nexuslint: disable=NX-CLOCK001
+    """
+    assert _lint(src, select=["NX-CLOCK"]) == []
+
+
+def test_line_suppression_family_prefix_and_all():
+    base = """
+        import time
+
+        class D:
+            def __init__(self, clock=None):
+                self.clock = clock
+
+            def probe(self):
+                return time.monotonic()  # nexuslint: disable={}
+    """
+    for tag in ("NX-CLOCK", "all", "NX-IMP001,NX-CLOCK001"):
+        assert _lint(base.format(tag), select=["NX-CLOCK"]) == []
+    # an unrelated id does NOT suppress
+    assert _lint(base.format("NX-JIT001"), select=["NX-CLOCK"])
+
+
+def test_file_level_suppression():
+    src = """
+        # nexuslint: disable-file=NX-CLOCK
+        import time
+
+        class D:
+            def __init__(self, clock=None):
+                self.clock = clock
+
+            def probe(self):
+                return time.monotonic()
+    """
+    assert _lint(src, select=["NX-CLOCK"]) == []
+
+
+def test_config_rule_exclude_scoping():
+    cfg = LintConfig(rule_exclude={"NX-IMP": ["tests/*"]})
+    src = "import sys\n"
+    assert _lint(src, path="tests/helper.py", config=cfg, select=["NX-IMP"]) == []
+    assert _lint(src, path="pkg/mod.py", config=cfg, select=["NX-IMP"])
+
+
+def test_syntax_error_is_a_finding():
+    findings = lint_source("bad.py", "def broken(:\n")
+    assert _ids(findings) == ["NX-SYNTAX"]
+
+
+def test_repo_config_parses_and_scopes():
+    cfg = load_config(os.path.join(REPO_ROOT, "nexuslint.ini"))
+    assert "nexus_tpu/ha/lease.py" in " ".join(cfg.rule_include["NX-CLOCK"])
+    assert cfg.file_excluded("__graft_entry__.py")
+    assert not cfg.family_allows("NX-CLOCK", "tests/test_failover.py")
+    assert cfg.family_allows("NX-CLOCK", "nexus_tpu/ha/lease.py")
+    assert "admit:release" in cfg.option("NX-PAIR", "pairs")
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import sys\nx = 1\n")
+    assert nexuslint_cli.main([str(clean)]) == 0
+    assert nexuslint_cli.main([str(dirty), "--select", "NX-IMP"]) == 1
+    out = capsys.readouterr().out
+    assert "NX-IMP001" in out
+    assert nexuslint_cli.main([str(tmp_path / "missing.py")]) == 2
+    assert nexuslint_cli.main(["--list-rules"]) == 0
+    assert "NX-LOCK001" in capsys.readouterr().out
+
+
+def test_cli_respects_quiet_and_config(tmp_path, capsys):
+    dirty = tmp_path / "d.py"
+    dirty.write_text("import sys\n")
+    ini = tmp_path / "lint.ini"
+    ini.write_text("[rule:NX-IMP]\nexclude = d.py\n")
+    assert nexuslint_cli.main(
+        [str(dirty), "--config", str(ini), "-q"]
+    ) == 0
+    assert nexuslint_cli.main(["--config", str(tmp_path / "nope.ini")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# the gate itself: `make analyze` semantics on the repo tree
+
+
+def test_repo_tree_is_clean_under_full_rule_set():
+    """The exact check `make analyze` runs (nexuslint half): the tree
+    must be violation-free — a rule regression OR a new violation in the
+    tree fails here before it fails in CI."""
+    cfg = load_config(os.path.join(REPO_ROOT, "nexuslint.ini"))
+    findings = lint_paths(
+        [os.path.join(REPO_ROOT, "nexus_tpu"), os.path.join(REPO_ROOT, "tools")],
+        cfg,
+        root=REPO_ROOT,
+    )
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_seeded_violations_fail_each_family_end_to_end(tmp_path):
+    """Acceptance drill: one seeded violation per rule family exits
+    non-zero through the same path `make analyze` uses."""
+    seeds = {
+        "clock.py": CLOCK_VIOLATION,
+        "lock.py": LOCK_VIOLATION,
+        "jit.py": JIT_VIOLATION,
+        "pair.py": PAIR_VIOLATION,
+        "imp.py": IMP_VIOLATION,
+    }
+    for name, src in seeds.items():
+        p = tmp_path / name
+        p.write_text(textwrap.dedent(src))
+        assert nexuslint_cli.main(["-q", str(p)]) == 1, name
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizers
+
+
+def _paged_metrics(free=6, parked=0, allocated=0, reserved=0, total=6):
+    return {
+        "kv_layout": "paged",
+        "kv_free_blocks_final": free,
+        "kv_parked_blocks_final": parked,
+        "kv_allocated_blocks_final": allocated,
+        "kv_reserved_blocks_final": reserved,
+        "kv_num_blocks": total,
+    }
+
+
+def test_sanitizer_pool_audit():
+    from nexus_tpu.testing import sanitizers
+
+    sanitizers.audit_pool_partition(_paged_metrics())  # clean
+    sanitizers.audit_pool_partition({"kv_layout": "dense"})  # no pool: skip
+    with pytest.raises(sanitizers.SanitizerError, match="leaked lease"):
+        sanitizers.audit_pool_partition(_paged_metrics(free=4, allocated=2))
+    with pytest.raises(sanitizers.SanitizerError, match="never refunded"):
+        sanitizers.audit_pool_partition(_paged_metrics(reserved=1))
+    with pytest.raises(sanitizers.SanitizerError, match="fell out"):
+        sanitizers.audit_pool_partition(_paged_metrics(free=5))
+    with pytest.raises(sanitizers.SanitizerError, match="missing"):
+        sanitizers.audit_pool_partition({"kv_layout": "paged"})
+
+
+def test_sanitizer_recompile_audit():
+    from nexus_tpu.testing import sanitizers
+
+    class Fn:
+        def __init__(self, n):
+            self._n = n
+
+        def _cache_size(self):
+            return self._n
+
+    class Engine:
+        pass
+
+    eng = Engine()
+    eng._decode_chunk = Fn(1)
+    eng._insert_fn = Fn(2)
+    counts = sanitizers.audit_recompiles(eng, bound=2)
+    assert counts == {"_decode_chunk": 1, "_insert_fn": 2}
+    eng._decode_chunk = Fn(37)
+    with pytest.raises(sanitizers.SanitizerError, match="37 programs"):
+        sanitizers.audit_recompiles(eng, bound=2)
+    # narrow aliasing wide (T == 1) is counted once
+    eng._decode_chunk = eng._decode_chunk_narrow = Fn(1)
+    eng._insert_fn = Fn(1)
+    assert "_decode_chunk_narrow" not in sanitizers.audit_recompiles(eng, bound=2)
+
+
+def test_sanitizer_env_parsing(monkeypatch):
+    from nexus_tpu.testing import sanitizers
+
+    assert not sanitizers.sanitizers_enabled({})
+    for off in ("0", "off", "false", "no", ""):
+        assert not sanitizers.sanitizers_enabled({sanitizers.ENV_FLAG: off})
+    assert sanitizers.sanitizers_enabled({sanitizers.ENV_FLAG: "1"})
+    assert sanitizers.max_programs({}) == sanitizers.DEFAULT_MAX_PROGRAMS
+    monkeypatch.setenv(sanitizers.ENV_MAX_PROGRAMS, "5")
+    assert sanitizers.max_programs() == 5
+    monkeypatch.setenv(sanitizers.ENV_MAX_PROGRAMS, "0")
+    assert sanitizers.max_programs() == 1  # floor
+
+
+def test_sanitizer_install_wraps_and_audits_stub_engine():
+    """End to end: install → a real (cyclic-stub) paged serve passes the
+    audits; a forged leaky ledger fails through the wrapper; uninstall
+    restores the original serve."""
+    from types import SimpleNamespace
+
+    import jax
+    import jax.numpy as jnp
+
+    from nexus_tpu.runtime.serving import ServeRequest, ServingEngine
+    from nexus_tpu.testing import sanitizers
+
+    pre_installed = getattr(
+        ServingEngine, sanitizers._INSTALLED_FLAG, False
+    )
+    installed = sanitizers.install()
+    try:
+        assert installed and sanitizers.install()  # idempotent
+        v = 7
+        cfg = SimpleNamespace(
+            n_layers=1, n_kv_heads=1, head_dim=8, dtype=jnp.float32,
+            max_seq_len=128, vocab_size=v,
+        )
+
+        def fwd(params, cfg_, tokens, cache):
+            logits = jax.nn.one_hot((tokens + 1) % v, v) * 10.0
+            new = {k: x for k, x in cache.items() if k != "n_valid"}
+            nv = cache.get("n_valid")
+            adv = tokens.shape[1] if nv is None else nv
+            new["length"] = cache["length"] + adv
+            return logits.astype(jnp.float32), new
+
+        eng = ServingEngine(fwd, {}, cfg, batch_size=2, max_len=64, chunk=4)
+        results, metrics = eng.serve(
+            [ServeRequest(prompt=[1, 2], max_new_tokens=4)]
+        )
+        assert results[0].tokens[-4:] == [3, 4, 5, 6]
+        assert metrics["kv_allocated_blocks_final"] == 0
+        # the wrapper's own jit-program observation on a REAL engine:
+        # exactly one compiled program per exercised callable
+        counts = sanitizers.jit_program_counts(eng)
+        assert counts["_decode_chunk"] == 1
+        assert counts["_insert_fn"] == 1
+    finally:
+        if not pre_installed:
+            # leave a conftest-installed (NEXUS_SANITIZE=1) wrap in place
+            # for the rest of the session
+            assert sanitizers.uninstall()
+            assert not sanitizers.uninstall()  # already restored

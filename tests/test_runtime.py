@@ -17,7 +17,7 @@ from nexus_tpu.api.runtime_spec import (
     TrainSpec,
 )
 from nexus_tpu.api.template import NexusAlgorithmTemplate
-from nexus_tpu.api.types import ConfigMap, ObjectMeta, Secret
+from nexus_tpu.api.types import ConfigMap
 from nexus_tpu.cluster.store import ClusterStore, NotFoundError
 from nexus_tpu.controller.controller import Controller
 from nexus_tpu.runtime.entrypoints import run_template_runtime
@@ -743,7 +743,7 @@ def test_launcher_reruns_on_spec_change_only():
     launcher.start()
     try:
         tmpl = template_with_runtime()
-        created = store.create(tmpl)
+        store.create(tmpl)
         assert wait_for(
             lambda: store.get(ConfigMap.KIND, NS, "tpu-algo-result").data["phase"]
             == "Succeeded"

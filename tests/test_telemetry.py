@@ -3,7 +3,6 @@ log sink (the slog-datadog equivalent, reference main.go:43-44)."""
 
 import json
 import logging
-import os
 import socket
 import threading
 import time

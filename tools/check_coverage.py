@@ -44,6 +44,12 @@ FORCE_INCLUDE = [
     # where a bug silently loses or duplicates user requests — always
     # gated per-file, whatever future exclusions appear
     r"nexus_tpu/ha/serve_failover\.py$",
+    # the round-8 enforcement layer itself: a rule or audit whose own
+    # coverage rots is a gate that silently stops gating — nexuslint's
+    # package __init__ (rule registration) and every rule module, plus
+    # the runtime sanitizers, are gated per-file like product code
+    r"tools/nexuslint/.*\.py$",
+    r"nexus_tpu/testing/sanitizers\.py$",
 ]
 
 

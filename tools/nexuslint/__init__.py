@@ -1,0 +1,57 @@
+"""nexuslint — project-invariant static analysis for nexus-tpu.
+
+Generic linters protect generic invariants. This repo's load-bearing
+conventions — injectable clocks in the failure-detection and serving
+planes, ``guarded-by`` lock discipline in the store/informer/workqueue,
+JAX trace purity inside jitted programs, and exception-safe pairing of
+resource acquire/release sites — are enforced by nothing a stock tool
+knows about. nexuslint is the AST-based rule suite that closes that gap
+(the Python answer to the race detector + vet lineage the reference Go
+controller inherits for free).
+
+Usage (repo root)::
+
+    python -m tools.nexuslint [paths...]          # full rule set
+    python -m tools.nexuslint --select NX-IMP .   # one family
+    python -m tools.nexuslint --list-rules
+
+Rule families (docs/static-analysis.md has the full catalogue):
+
+  NX-CLOCK  clock discipline   — no direct wall-clock reads / sleeps in
+                                 modules that take an injectable clock
+  NX-LOCK   lock discipline    — ``# guarded-by: <lock>`` attributes
+                                 accessed only under ``with self.<lock>``
+  NX-JIT    JAX trace purity   — no host materialization, numpy RNG, or
+                                 mutable defaults inside jitted programs
+  NX-PAIR   resource pairing   — acquire sites whose paired release is
+                                 not exception-safe (``finally``/ctx mgr)
+  NX-IMP    import hygiene     — unused imports (the ruff-F401 fallback
+                                 for environments without ruff)
+
+Per-line suppression: trailing ``# nexuslint: disable=NX-JIT001`` (or a
+comma list, or ``disable=all``); file-level: a leading-comment line
+``# nexuslint: disable-file=NX-CLOCK001``. Scoping lives in
+``nexuslint.ini`` at the repo root.
+"""
+
+from tools.nexuslint.core import (  # noqa: F401
+    Finding,
+    LintConfig,
+    Rule,
+    iter_rules,
+    lint_paths,
+    lint_source,
+    load_config,
+    rule,
+)
+
+# import for side effect: each module registers its rules
+from tools.nexuslint import (  # noqa: E402,F401
+    rules_clock,
+    rules_imports,
+    rules_jit,
+    rules_locks,
+    rules_pairing,
+)
+
+__version__ = "1.0.0"

@@ -1,0 +1,72 @@
+"""CLI: ``python -m tools.nexuslint [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/config error — so ``make
+analyze`` and CI gate on it directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import tools.nexuslint as nexuslint
+from tools.nexuslint.core import _selected, iter_rules, lint_paths, load_config
+
+DEFAULT_CONFIG = "nexuslint.ini"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="nexuslint",
+        description="project-invariant static analysis for nexus-tpu",
+    )
+    ap.add_argument("paths", nargs="*", default=[], help="files or trees (default: nexus_tpu)")
+    ap.add_argument(
+        "--select", action="append", default=None, metavar="RULE",
+        help="only run rules whose id starts with RULE (repeatable), "
+        "e.g. --select NX-IMP or --select NX-JIT002",
+    )
+    ap.add_argument(
+        "--config", default=None,
+        help=f"config file (default: ./{DEFAULT_CONFIG} when present)",
+    )
+    ap.add_argument("--list-rules", action="store_true", help="print the rule catalogue")
+    ap.add_argument("-q", "--quiet", action="store_true", help="findings only, no summary")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in iter_rules():
+            print(f"{r.id:12s} {r.summary}")
+        return 0
+
+    config_path = args.config
+    if config_path is None and os.path.exists(DEFAULT_CONFIG):
+        config_path = DEFAULT_CONFIG
+    if config_path is not None and not os.path.exists(config_path):
+        print(f"nexuslint: config not found: {config_path}", file=sys.stderr)
+        return 2
+    config = load_config(config_path)
+
+    paths = args.paths or ["nexus_tpu"]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"nexuslint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    findings = lint_paths(paths, config, select=args.select)
+    for f in findings:
+        print(f.format())
+    if not args.quiet:
+        n_rules = len([r for r in iter_rules() if _selected(r, args.select)])
+        tag = f"nexuslint {nexuslint.__version__}"
+        if findings:
+            print(f"{tag}: {len(findings)} finding(s) [{n_rules} rules]",
+                  file=sys.stderr)
+        else:
+            print(f"{tag}: clean [{n_rules} rules]", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,338 @@
+"""nexuslint core: rule registry, config, suppressions, file runner.
+
+Design goals, in order:
+
+  1. **Zero dependencies** — stdlib ``ast`` + ``tokenize`` + ``configparser``
+     only, so the gate runs in every environment the repo runs in
+     (including containers without ruff).
+  2. **Project-scoped precision** — rules key off THIS repo's annotations
+     and conventions (``guarded-by`` comments, injectable ``clock``
+     parameters, ``jax.jit`` factories), so a finding is an invariant
+     violation, not a style nit.
+  3. **Escape hatches that leave a paper trail** — per-line
+     ``# nexuslint: disable=<rule>`` and per-file/per-rule ``nexuslint.ini``
+     scoping, so a deliberate exception is visible at the site it excuses.
+"""
+
+from __future__ import annotations
+
+import ast
+import configparser
+import fnmatch
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# findings
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule_id: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule_id} {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# config
+
+
+@dataclass
+class LintConfig:
+    """Parsed ``nexuslint.ini``.
+
+    ``exclude``: repo-relative glob patterns never linted at all.
+    ``rule_include`` / ``rule_exclude``: per-FAMILY path scoping — when a
+    family has an ``include`` list, only matching files are checked by that
+    family's auto-detection-independent rules; ``exclude`` always wins.
+    ``options``: per-family free-form key/value options (e.g. the pairing
+    rule's acquire:release table).
+    """
+
+    exclude: List[str] = field(default_factory=list)
+    rule_include: Dict[str, List[str]] = field(default_factory=dict)
+    rule_exclude: Dict[str, List[str]] = field(default_factory=dict)
+    options: Dict[str, Dict[str, str]] = field(default_factory=dict)
+
+    def file_excluded(self, rel_path: str) -> bool:
+        return _match_any(rel_path, self.exclude)
+
+    def family_allows(self, family: str, rel_path: str) -> bool:
+        """May rules of ``family`` examine this file at all?"""
+        if _match_any(rel_path, self.rule_exclude.get(family, [])):
+            return False
+        return True
+
+    def family_includes(self, family: str, rel_path: str) -> bool:
+        """Is this file in the family's explicit ``include`` scope?
+        (False also when no include list is configured — rules combine
+        this with their own auto-detection.)"""
+        return _match_any(rel_path, self.rule_include.get(family, []))
+
+    def option(self, family: str, key: str, default: str = "") -> str:
+        return self.options.get(family, {}).get(key, default)
+
+
+def _match_any(rel_path: str, patterns: Sequence[str]) -> bool:
+    p = rel_path.replace(os.sep, "/")
+    for pat in patterns:
+        if fnmatch.fnmatch(p, pat) or fnmatch.fnmatch(os.path.basename(p), pat):
+            return True
+    return False
+
+
+def _split_list(raw: str) -> List[str]:
+    return [x.strip() for x in re.split(r"[,\n]", raw) if x.strip()]
+
+
+def load_config(path: Optional[str] = None) -> LintConfig:
+    """Load ``nexuslint.ini`` (missing file → permissive defaults)."""
+    cfg = LintConfig()
+    if path is None or not os.path.exists(path):
+        return cfg
+    parser = configparser.ConfigParser()
+    parser.read(path)
+    if parser.has_section("nexuslint"):
+        cfg.exclude = _split_list(parser.get("nexuslint", "exclude", fallback=""))
+    for section in parser.sections():
+        if not section.startswith("rule:"):
+            continue
+        family = section[len("rule:"):]
+        opts = dict(parser.items(section))
+        if "include" in opts:
+            cfg.rule_include[family] = _split_list(opts.pop("include"))
+        if "exclude" in opts:
+            cfg.rule_exclude[family] = _split_list(opts.pop("exclude"))
+        cfg.options[family] = opts
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# per-file context shared by every rule
+
+
+class FileContext:
+    """Parsed view of one source file: AST, per-line comments, config."""
+
+    def __init__(self, rel_path: str, source: str, config: LintConfig):
+        self.path = rel_path.replace(os.sep, "/")
+        self.source = source
+        self.config = config
+        self.tree: Optional[ast.Module] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        #: physical line number -> comment text (without leading '#')
+        self.comments: Dict[int, str] = {}
+        try:
+            self.tree = ast.parse(source)
+        except SyntaxError as e:  # surfaced as its own finding
+            self.syntax_error = e
+            return
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string.lstrip("#").strip()
+        except tokenize.TokenError:
+            pass  # AST parsed; comments best-effort
+
+    def comment_on(self, line: int) -> str:
+        return self.comments.get(line, "")
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+
+_DISABLE_RE = re.compile(r"nexuslint:\s*disable(?P<file>-file)?\s*=\s*(?P<ids>[\w\-, ]+)")
+
+
+def _parse_disables(comment: str) -> Tuple[Optional[List[str]], Optional[List[str]]]:
+    """-> (line_ids, file_ids); an id list of ['all'] disables everything."""
+    m = _DISABLE_RE.search(comment)
+    if not m:
+        return None, None
+    ids = [x.strip() for x in m.group("ids").split(",") if x.strip()]
+    if m.group("file"):
+        return None, ids
+    return ids, None
+
+
+def _suppressed(finding: Finding, ctx: FileContext, file_ids: List[str]) -> bool:
+    def covers(ids: Iterable[str]) -> bool:
+        for i in ids:
+            if i == "all" or finding.rule_id == i or finding.rule_id.startswith(i):
+                return True
+        return False
+
+    if covers(file_ids):
+        return True
+    line_ids, _ = _parse_disables(ctx.comment_on(finding.line))
+    return bool(line_ids and covers(line_ids))
+
+
+def _file_disables(ctx: FileContext) -> List[str]:
+    out: List[str] = []
+    for comment in ctx.comments.values():
+        _, file_ids = _parse_disables(comment)
+        if file_ids:
+            out.extend(file_ids)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    summary: str
+    check: Callable[[FileContext], List[Finding]]
+
+    @property
+    def family(self) -> str:
+        return self.id.rstrip("0123456789")
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, summary: str):
+    """Register a rule. The check receives a :class:`FileContext` and
+    returns findings; scoping and suppression are handled by the runner."""
+
+    def wrap(fn: Callable[[FileContext], List[Finding]]) -> Rule:
+        if rule_id in _REGISTRY:
+            raise ValueError(f"duplicate rule id {rule_id}")
+        r = Rule(rule_id, summary, fn)
+        _REGISTRY[rule_id] = r
+        return r
+
+    return wrap
+
+
+def iter_rules() -> List[Rule]:
+    return [r for _, r in sorted(_REGISTRY.items())]
+
+
+def _selected(r: Rule, select: Optional[Sequence[str]]) -> bool:
+    if not select:
+        return True
+    return any(r.id == s or r.id.startswith(s) or r.family == s for s in select)
+
+
+# ---------------------------------------------------------------------------
+# runners
+
+
+def lint_source(
+    rel_path: str,
+    source: str,
+    config: Optional[LintConfig] = None,
+    select: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Lint one in-memory source file → surviving findings."""
+    config = config or LintConfig()
+    ctx = FileContext(rel_path, source, config)
+    if ctx.syntax_error is not None:
+        e = ctx.syntax_error
+        return [
+            Finding(
+                "NX-SYNTAX", ctx.path, e.lineno or 1, (e.offset or 1) - 1,
+                f"file does not parse: {e.msg}",
+            )
+        ]
+    file_ids = _file_disables(ctx)
+    findings: List[Finding] = []
+    for r in iter_rules():
+        if not _selected(r, select):
+            continue
+        if not config.family_allows(r.family, ctx.path):
+            continue
+        findings.extend(r.check(ctx))
+    findings = [f for f in findings if not _suppressed(f, ctx, file_ids)]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    return findings
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [
+                    d for d in sorted(dirnames)
+                    if d not in {"__pycache__", ".git", ".venv", "node_modules"}
+                ]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def lint_paths(
+    paths: Sequence[str],
+    config: Optional[LintConfig] = None,
+    select: Optional[Sequence[str]] = None,
+    root: Optional[str] = None,
+) -> List[Finding]:
+    """Lint files/trees → findings (repo-relative paths when under ``root``)."""
+    config = config or LintConfig()
+    root = os.path.abspath(root or os.getcwd())
+    out: List[Finding] = []
+    for path in _iter_py_files(paths):
+        abs_path = os.path.abspath(path)
+        rel = os.path.relpath(abs_path, root)
+        if rel.startswith(".."):
+            rel = path
+        rel = rel.replace(os.sep, "/")
+        if config.file_excluded(rel):
+            continue
+        try:
+            with open(abs_path, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            out.append(Finding("NX-IO", rel, 1, 0, f"unreadable: {e}"))
+            continue
+        out.extend(lint_source(rel, source, config, select))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers shared by rule modules
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_functions(tree: ast.AST):
+    """Yield every FunctionDef/AsyncFunctionDef in the tree."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def all_args(fn) -> List[ast.arg]:
+    a = fn.args
+    return [*a.posonlyargs, *a.args, *a.kwonlyargs] + (
+        [a.vararg] if a.vararg else []
+    ) + ([a.kwarg] if a.kwarg else [])

@@ -1,0 +1,137 @@
+"""NX-CLOCK — clock discipline.
+
+The failure-detection and serving planes are built around **injectable
+clocks** (``FailureDetector(clock=...)``, ``ServingEngine(clock=...)``):
+every deadline, TTL, and latency path unit-tests in milliseconds with a
+fake clock, and wall-clock skew can never leak into protocol decisions.
+One stray ``time.monotonic()`` in such a module silently splits time into
+two sources — the injected clock the tests control and the real one they
+don't — which is exactly how flaky timing tests and untestable deadline
+paths are born.
+
+A module is **clock-disciplined** when either:
+
+  * it matches the ``[rule:NX-CLOCK] include`` list in ``nexuslint.ini``
+    (the repo pins its known disciplined modules there), or
+  * any function in it takes a parameter named ``clock`` or ``sleep``
+    (auto-detection — a module that OFFERS injection must also USE it).
+
+Inside a disciplined module, rules:
+
+  NX-CLOCK001  direct wall-clock read: ``time.time()`` /
+               ``time.monotonic()`` / ``time.perf_counter()`` (and _ns
+               variants) / ``datetime.now()`` / ``datetime.utcnow()``
+  NX-CLOCK002  direct ``time.sleep()`` (inject a sleeper / pace hook)
+
+References (not calls) stay legal — ``clock: Callable = time.monotonic``
+as a default value IS the injection idiom. Deliberately-informational
+wall stamps (e.g. a lease's ``renewTime``, never compared by anyone) are
+suppressed at the site with a justification comment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from tools.nexuslint.core import (
+    FileContext,
+    Finding,
+    all_args,
+    dotted_name,
+    rule,
+    walk_functions,
+)
+
+_TIME_FUNCS = {
+    "time", "monotonic", "monotonic_ns", "perf_counter", "perf_counter_ns",
+}
+_DT_FUNCS = {"now", "utcnow"}
+_INJECT_PARAMS = {"clock", "sleep"}
+
+
+def _alias_maps(tree: ast.Module):
+    """(module aliases {local: canonical}, from-imports {local: 'mod.fn'})."""
+    mods: Dict[str, str] = {}
+    funcs: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in ("time", "datetime"):
+                    mods[a.asname or a.name] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module in ("time", "datetime"):
+            for a in node.names:
+                funcs[a.asname or a.name] = f"{node.module}.{a.name}"
+    return mods, funcs
+
+
+def _is_disciplined(ctx: FileContext) -> bool:
+    if ctx.config.family_includes("NX-CLOCK", ctx.path):
+        return True
+    for fn in walk_functions(ctx.tree):
+        for a in all_args(fn):
+            if a.arg in _INJECT_PARAMS:
+                return True
+    return False
+
+
+def _classify_call(call: ast.Call, mods, funcs):
+    """-> ('read'|'sleep', canonical name) for banned calls, else None."""
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    # from-import alias: monotonic() / sleep() / now-bound datetime class
+    if parts[0] in funcs:
+        parts = funcs[parts[0]].split(".") + parts[1:]
+    # module alias: t.monotonic() -> time.monotonic()
+    if parts[0] in mods:
+        parts = [mods[parts[0]]] + parts[1:]
+    canonical = ".".join(parts)
+    if parts[0] == "time" and len(parts) == 2:
+        if parts[1] == "sleep":
+            return "sleep", canonical
+        if parts[1] in _TIME_FUNCS:
+            return "read", canonical
+    # datetime.datetime.now() / datetime.now() (class imported directly)
+    if parts[0] == "datetime" and parts[-1] in _DT_FUNCS and len(parts) <= 3:
+        return "read", canonical
+    return None
+
+
+@rule("NX-CLOCK001", "direct wall-clock read in a clock-disciplined module")
+def check_clock_reads(ctx: FileContext) -> List[Finding]:
+    if not _is_disciplined(ctx):
+        return []
+    mods, funcs = _alias_maps(ctx.tree)
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        hit = _classify_call(node, mods, funcs)
+        if hit and hit[0] == "read":
+            out.append(Finding(
+                "NX-CLOCK001", ctx.path, node.lineno, node.col_offset,
+                f"direct {hit[1]}() in a clock-disciplined module; "
+                "route it through the injectable clock",
+            ))
+    return out
+
+
+@rule("NX-CLOCK002", "direct time.sleep in a clock-disciplined module")
+def check_clock_sleeps(ctx: FileContext) -> List[Finding]:
+    if not _is_disciplined(ctx):
+        return []
+    mods, funcs = _alias_maps(ctx.tree)
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        hit = _classify_call(node, mods, funcs)
+        if hit and hit[0] == "sleep":
+            out.append(Finding(
+                "NX-CLOCK002", ctx.path, node.lineno, node.col_offset,
+                f"direct {hit[1]}() in a clock-disciplined module; "
+                "inject a sleeper (the supervisor/launcher pace pattern)",
+            ))
+    return out

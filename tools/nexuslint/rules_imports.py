@@ -1,0 +1,90 @@
+"""NX-IMP — import hygiene (the ruff fallback).
+
+CI lints with ruff, but the repo must also gate in environments where
+ruff isn't installable (the TPU containers bake a fixed toolchain). This
+family reimplements the highest-value subset — unused imports, ruff's
+F401 — with stdlib ``ast`` so ``make lint`` can NEVER silently degrade
+to a no-op again (the ``ruff check || true`` failure mode this PR
+removes).
+
+  NX-IMP001  imported name never used in the module
+
+Deliberately conservative, mirroring ruff's own carve-outs:
+
+  * ``__init__.py`` files are skipped (re-export surface);
+  * ``from x import y as y`` (self-alias) marks an intentional re-export;
+  * imports under ``try:`` are skipped (availability probes);
+  * a ``# noqa`` on the import line is honored (ruff compatibility), as
+    is the native ``# nexuslint: disable=NX-IMP001``;
+  * names in ``__all__`` count as used.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set
+
+from tools.nexuslint.core import FileContext, Finding, rule
+
+_NOQA_RE = re.compile(r"noqa(?::\s*[\w, ]+)?\b", re.IGNORECASE)
+
+
+def _used_names(tree: ast.Module) -> Set[str]:
+    used: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and not isinstance(node.ctx, ast.Store):
+            used.add(node.id)
+        elif isinstance(node, ast.Assign):
+            # __all__ = ["x", ...] marks its entries as used
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Constant) and isinstance(
+                            sub.value, str
+                        ):
+                            used.add(sub.value)
+    return used
+
+
+def _in_try(tree: ast.Module) -> Set[int]:
+    """ids of import statements nested under any try block."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Try):
+            for stmt in ast.walk(node):
+                if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                    out.add(id(stmt))
+    return out
+
+
+@rule("NX-IMP001", "imported name is never used")
+def check_unused_imports(ctx: FileContext) -> List[Finding]:
+    if ctx.path.endswith("__init__.py"):
+        return []
+    used = _used_names(ctx.tree)
+    guarded = _in_try(ctx.tree)
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        if id(node) in guarded:
+            continue
+        if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+            continue
+        if _NOQA_RE.search(ctx.comment_on(node.lineno)) or _NOQA_RE.search(
+            ctx.comment_on(getattr(node, "end_lineno", node.lineno))
+        ):
+            continue
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            if alias.asname is not None and alias.asname == alias.name:
+                continue  # explicit re-export (from x import y as y)
+            bound = alias.asname or alias.name.split(".")[0]
+            if bound not in used:
+                out.append(Finding(
+                    "NX-IMP001", ctx.path, node.lineno, node.col_offset,
+                    f"{alias.asname or alias.name!s} imported but unused",
+                ))
+    return out

@@ -1,0 +1,252 @@
+"""NX-JIT — JAX trace purity inside jitted programs.
+
+The serving engine's whole performance premise is "ONE compiled decode
+program for any table state" (runtime/serving.py): a host materialization
+(``.item()``, ``int(traced)``) inside a jitted function forces a device
+sync per call, and a value-dependent Python branch silently turns one
+program into one-per-shape — the recompile storm the paged design
+exists to avoid. ``np.random`` inside a trace is worse than slow: it
+bakes ONE sample into the compiled program, so every subsequent call
+replays the same "random" numbers. These are the classic jit footguns
+(JAX's own docs call them out), caught here at review time instead of as
+a silent 100× serving regression.
+
+What counts as jitted (lexically, including nested defs):
+
+  * decorated: ``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)``,
+    ``@functools.partial(jax.jit, ...)``
+  * wrapped: ``jax.jit(fn)`` where ``fn`` is a function defined in the
+    same module
+  * factory-wrapped: ``jax.jit(make_fn(...))`` where ``make_fn`` is a
+    local def — its directly nested defs (the returned workers) are
+    treated as traced (the ``_make_decode_chunk`` idiom)
+
+Rules:
+
+  NX-JIT001  ``.item()`` on a traced value (host sync per call)
+  NX-JIT002  ``int()``/``float()``/``bool()`` cast of a non-static value
+             (casts of ``.shape``/``.ndim``/``len()``/constants are
+             static and stay legal)
+  NX-JIT003  ``np.random.*`` / stdlib ``random.*`` inside a trace
+             (baked into the compiled program; use ``jax.random`` keys)
+  NX-JIT004  mutable default argument on a jitted function (shared
+             across traces — aliasing bugs that only appear on retrace)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.nexuslint.core import FileContext, Finding, dotted_name, rule
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Does this decorator/callee expression denote jax.jit?"""
+    name = dotted_name(node)
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        callee = dotted_name(node.func)
+        if callee in _PARTIAL_NAMES and node.args:
+            return dotted_name(node.args[0]) in _JIT_NAMES
+    return False
+
+
+class _Scope:
+    """Lexical scope node: maps names to FunctionDefs defined there."""
+
+    def __init__(self, node: ast.AST, parent: Optional["_Scope"]):
+        self.node = node
+        self.parent = parent
+        self.defs = {}
+
+    def resolve(self, name: str):
+        s = self
+        while s is not None:
+            if name in s.defs:
+                return s.defs[name]
+            s = s.parent
+        return None
+
+
+def _build_scopes(tree: ast.Module):
+    """-> (scope of every function/module node, jit-wrap call sites)."""
+    root = _Scope(tree, None)
+    scopes = {id(tree): root}
+    jit_calls = []  # (Call node, enclosing scope)
+
+    def visit(node: ast.AST, scope: _Scope):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope.defs[child.name] = child
+                inner = _Scope(child, scope)
+                scopes[id(child)] = inner
+                visit(child, inner)
+            elif isinstance(child, (ast.ClassDef,)):
+                # class body is its own namespace but NOT a closure scope;
+                # methods resolve names from the enclosing scope
+                visit(child, scope)
+            else:
+                if isinstance(child, ast.Call) and _is_jit_expr(child.func):
+                    jit_calls.append((child, scope))
+                visit(child, scope)
+
+    visit(tree, root)
+    return scopes, jit_calls
+
+
+def _jitted_functions(tree: ast.Module) -> Set[int]:
+    """ids of FunctionDef nodes whose bodies run under jax tracing."""
+    traced: Set[int] = set()
+    scopes, jit_calls = _build_scopes(tree)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_expr(d) for d in node.decorator_list):
+                traced.add(id(node))
+
+    for call, scope in jit_calls:
+        if not call.args:
+            continue
+        target = call.args[0]
+        if isinstance(target, ast.Name):
+            fn = scope.resolve(target.id)
+            if fn is not None:
+                traced.add(id(fn))
+        elif isinstance(target, ast.Call) and isinstance(target.func, ast.Name):
+            factory = scope.resolve(target.func.id)
+            if factory is not None:
+                # jit(make_fn(...)): the factory's directly nested defs are
+                # the returned traced workers
+                for child in ast.walk(factory):
+                    if (
+                        isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and child is not factory
+                    ):
+                        traced.add(id(child))
+        elif isinstance(target, ast.Lambda):
+            traced.add(id(target))
+
+    # everything lexically inside a traced function is traced too
+    grow = True
+    while grow:
+        grow = False
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if id(node) not in traced:
+                continue
+            for child in ast.walk(node):
+                if (
+                    isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and id(child) not in traced
+                ):
+                    traced.add(id(child))
+                    grow = True
+    return traced
+
+
+def _static_cast_arg(arg: ast.AST) -> bool:
+    """Casts of shapes/dims/lengths/constants are trace-static."""
+    if isinstance(arg, ast.Constant):
+        return True
+    for node in ast.walk(arg):
+        if isinstance(node, ast.Attribute) and node.attr in (
+            "shape", "ndim", "size", "itemsize", "dtype",
+        ):
+            return True
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func)
+            if callee in ("len", "np.dtype"):
+                return True
+    return False
+
+
+def _each_traced_body(ctx: FileContext):
+    traced = _jitted_functions(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            if id(node) in traced:
+                yield node
+
+
+@rule("NX-JIT001", ".item() host materialization inside a jitted function")
+def check_item_calls(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _each_traced_body(ctx):
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"
+                and not node.args
+            ):
+                out.append(Finding(
+                    "NX-JIT001", ctx.path, node.lineno, node.col_offset,
+                    ".item() inside a jitted function forces a host sync "
+                    "per call; keep the value on-device",
+                ))
+    return out
+
+
+@rule("NX-JIT002", "python scalar cast of a traced value inside a jitted function")
+def check_scalar_casts(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _each_traced_body(ctx):
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+                continue
+            if node.func.id not in ("int", "float", "bool") or len(node.args) != 1:
+                continue
+            if _static_cast_arg(node.args[0]):
+                continue
+            out.append(Finding(
+                "NX-JIT002", ctx.path, node.lineno, node.col_offset,
+                f"{node.func.id}() cast inside a jitted function "
+                "materializes the traced value (ConcretizationError at "
+                "best, a silent per-value recompile at worst)",
+            ))
+    return out
+
+
+@rule("NX-JIT003", "non-JAX randomness inside a jitted function")
+def check_np_random(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _each_traced_body(ctx):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if name.startswith(("np.random.", "numpy.random.", "random.")):
+                out.append(Finding(
+                    "NX-JIT003", ctx.path, node.lineno, node.col_offset,
+                    f"{name}() inside a jitted function bakes ONE sample "
+                    "into the compiled program; use jax.random with an "
+                    "explicit key",
+                ))
+    return out
+
+
+@rule("NX-JIT004", "mutable default argument on a jitted function")
+def check_mutable_defaults(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _each_traced_body(ctx):
+        defaults = list(fn.args.defaults) + [
+            d for d in fn.args.kw_defaults if d is not None
+        ]
+        for d in defaults:
+            mutable = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call)
+                and dotted_name(d.func) in ("list", "dict", "set", "bytearray")
+            )
+            if mutable:
+                out.append(Finding(
+                    "NX-JIT004", ctx.path, d.lineno, d.col_offset,
+                    "mutable default argument on a jitted function is "
+                    "shared across traces; use None and allocate inside",
+                ))
+    return out

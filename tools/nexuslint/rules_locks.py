@@ -1,0 +1,177 @@
+"""NX-LOCK — ``guarded-by`` lock discipline.
+
+The store/informer/workqueue trio is the concurrency backbone of the
+control plane: every cache, queue, and watch-event buffer in them is
+documented as "accessed under ``_lock``" (or ``_cond``), and the
+``race-smoke`` harness hammers exactly that contract. Comments don't
+compile, though — a new method reading ``self._items`` without the lock
+passes every deterministic test and corrupts state only under the
+parallel shard fan-out. This family makes the comment checkable, the
+poor-Python's cousin of Go's ``go vet``-adjacent guarded-by analyses
+and Clang's ``GUARDED_BY`` thread-safety annotations.
+
+Annotation grammar (see docs/static-analysis.md):
+
+  * attribute: a trailing comment on its ``__init__`` assignment::
+
+        self._items: Dict[str, APIObject] = {}  # guarded-by: _lock
+
+  * method precondition (caller must hold the lock; the body is then
+    checked as if inside it)::
+
+        def _bucket(self, kind, namespace):  # guarded-by: _lock
+
+Rules:
+
+  NX-LOCK001  guarded attribute read/written outside ``with self.<lock>``
+              (``__init__`` is exempt: construction happens-before
+              publication)
+  NX-LOCK002  annotation names a lock attribute the class never assigns
+              (typo guard — a misspelled lock silently guards nothing)
+
+Condition objects count as their own lock (``with self._cond:``), which
+is how the workqueue's dirty/processing sets are annotated.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from tools.nexuslint.core import FileContext, Finding, rule
+
+_GUARDED_RE = re.compile(r"guarded-by:\s*(?:self\.)?(\w+)")
+
+
+def _self_attr(node: ast.AST):
+    """-> attribute name for ``self.<name>`` nodes, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _annotation_on(ctx: FileContext, node: ast.AST, def_line_only: bool = False):
+    """``def_line_only`` is set for method preconditions: a FunctionDef's
+    end_lineno is its LAST body line, and honoring a guarded-by comment
+    there would silently mark the whole method as a lock holder (turning
+    the rule OFF for it) whenever its final statement carries an
+    attribute-style annotation."""
+    lines = {node.lineno}
+    if not def_line_only:
+        lines.add(getattr(node, "end_lineno", node.lineno))
+    for line in lines:
+        m = _GUARDED_RE.search(ctx.comment_on(line))
+        if m:
+            return m.group(1)
+    return None
+
+
+def _class_info(ctx: FileContext, cls: ast.ClassDef):
+    """-> (guarded {attr: lock}, holder methods {name: lock},
+    lock-ish attrs assigned in __init__)."""
+    guarded: Dict[str, str] = {}
+    holders: Dict[str, str] = {}
+    init_attrs: Set[str] = set()
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        lock = _annotation_on(ctx, item, def_line_only=True)
+        if lock and item.name != "__init__":
+            holders[item.name] = lock
+        if item.name != "__init__":
+            continue
+        for node in ast.walk(item):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            else:
+                continue
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                init_attrs.add(attr)
+                lock = _annotation_on(ctx, node)
+                if lock:
+                    guarded[attr] = lock
+    return guarded, holders, init_attrs
+
+
+def _with_locks(node: ast.With) -> Set[str]:
+    out: Set[str] = set()
+    for item in node.items:
+        attr = _self_attr(item.context_expr)
+        if attr:
+            out.add(attr)
+    return out
+
+
+def _check_method(
+    ctx: FileContext,
+    method: ast.FunctionDef,
+    guarded: Dict[str, str],
+    held0: Set[str],
+) -> List[Finding]:
+    findings: List[Finding] = []
+    # (node, held-locks) worklist preserving lexical lock scope
+    stack: List[Tuple[ast.AST, Set[str]]] = [(method, held0)]
+    while stack:
+        node, held = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            attr = _self_attr(child)
+            if attr is not None and attr in guarded and guarded[attr] not in held:
+                findings.append(Finding(
+                    "NX-LOCK001", ctx.path, child.lineno, child.col_offset,
+                    f"self.{attr} is guarded-by {guarded[attr]} but accessed "
+                    f"outside `with self.{guarded[attr]}` in {method.name}()",
+                ))
+                continue  # don't re-flag the nested Name('self')
+            if isinstance(child, ast.With):
+                stack.append((child, held | _with_locks(child)))
+            else:
+                stack.append((child, held))
+    return findings
+
+
+@rule("NX-LOCK001", "guarded-by attribute accessed outside its lock")
+def check_guarded_access(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guarded, holders, _ = _class_info(ctx, cls)
+        if not guarded:
+            continue
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__":
+                continue
+            held0 = {holders[item.name]} if item.name in holders else set()
+            out.extend(_check_method(ctx, item, guarded, held0))
+    return out
+
+
+@rule("NX-LOCK002", "guarded-by annotation names a lock the class never assigns")
+def check_guard_lock_exists(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guarded, holders, init_attrs = _class_info(ctx, cls)
+        named = set(guarded.values()) | set(holders.values())
+        for lock in sorted(named):
+            if lock not in init_attrs:
+                out.append(Finding(
+                    "NX-LOCK002", ctx.path, cls.lineno, cls.col_offset,
+                    f"guarded-by annotation in class {cls.name} names "
+                    f"{lock!r}, which __init__ never assigns",
+                ))
+    return out

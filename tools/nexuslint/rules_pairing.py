@@ -1,0 +1,146 @@
+"""NX-PAIR — exception-safe resource acquire/release pairing.
+
+The expensive leaks in this stack are not file handles — they are KV
+blocks (``BlockAllocator.admit`` → ``lease.release``: a leaked lease
+permanently shrinks the serve pool), heartbeat/election leases, chaos
+hooks left installed across tests, and watch subscriptions. All of them
+follow the same shape: an acquire call whose paired release must run on
+EVERY exit path, which in Python means a ``finally`` block or a context
+manager — a bare ``acquire(); ...; release()`` sequence leaks the moment
+anything between them raises.
+
+The pair table lives in ``nexuslint.ini`` (``[rule:NX-PAIR] pairs``),
+one ``acquire:release`` entry per resource kind; either side may be
+qualified with a receiver hint (``chaos.add:chaos.clear`` only matches
+calls whose receiver chain ends in ``chaos``).
+
+  NX-PAIR001  a function contains both an acquire site and its paired
+              release site, but no release is inside a ``finally`` block
+              and the acquire is not used as a context manager
+
+Functions that only acquire (handing the lease to a caller or storing it
+on ``self``) are intentionally NOT flagged — ownership transfer is the
+allocator's normal protocol; the rule targets the local
+acquire-use-release shape where exception safety is the author's job.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from tools.nexuslint.core import FileContext, Finding, dotted_name, rule
+
+DEFAULT_PAIRS = (
+    "admit:release, acquire:release, try_acquire:release, grow_to:release, "
+    "chaos.add:chaos.clear, subscribe:unsubscribe"
+)
+
+
+@dataclass(frozen=True)
+class _Side:
+    method: str
+    receiver: Optional[str]  # last receiver component, or None = any
+
+    @classmethod
+    def parse(cls, spec: str) -> "_Side":
+        parts = spec.strip().split(".")
+        if len(parts) == 1:
+            return cls(parts[0], None)
+        return cls(parts[-1], parts[-2])
+
+    def matches(self, call: ast.Call) -> bool:
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr != self.method:
+                return False
+            if self.receiver is None:
+                return True
+            recv = dotted_name(fn.value)
+            return recv is not None and recv.split(".")[-1] == self.receiver
+        if isinstance(fn, ast.Name):
+            return self.receiver is None and fn.id == self.method
+        return False
+
+
+def _pairs(ctx: FileContext) -> List[Tuple[_Side, _Side]]:
+    raw = ctx.config.option("NX-PAIR", "pairs", DEFAULT_PAIRS)
+    out: List[Tuple[_Side, _Side]] = []
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry or ":" not in entry:
+            continue
+        acq, rel = entry.split(":", 1)
+        out.append((_Side.parse(acq), _Side.parse(rel)))
+    return out
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk a function's body WITHOUT descending into nested defs (each
+    nested function is its own pairing scope)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _finally_calls(fn: ast.AST):
+    """Call nodes located inside any finally block of this function."""
+    out = set()
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Try):
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call):
+                        out.add(id(sub))
+    return out
+
+
+def _with_context_calls(fn: ast.AST):
+    """Call nodes used as `with` context expressions (ctx-manager acquire)."""
+    out = set()
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    if isinstance(sub, ast.Call):
+                        out.add(id(sub))
+    return out
+
+
+@rule("NX-PAIR001", "acquire whose paired release is not exception-safe")
+def check_pairing(ctx: FileContext) -> List[Finding]:
+    pairs = _pairs(ctx)
+    if not pairs:
+        return []
+    out: List[Finding] = []
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        calls = [n for n in _own_nodes(fn) if isinstance(n, ast.Call)]
+        if not calls:
+            continue
+        in_finally = _finally_calls(fn)
+        in_with = _with_context_calls(fn)
+        for acq_side, rel_side in pairs:
+            acquires = [c for c in calls if acq_side.matches(c)]
+            releases = [c for c in calls if rel_side.matches(c)]
+            if not acquires or not releases:
+                continue  # pure acquire (ownership transfer) or pure release
+            if any(id(c) in in_finally for c in releases):
+                continue
+            for acq in acquires:
+                if id(acq) in in_with:
+                    continue
+                out.append(Finding(
+                    "NX-PAIR001", ctx.path, acq.lineno, acq.col_offset,
+                    f"{acq_side.method}() is released by "
+                    f"{rel_side.method}() in {fn.name}() but no release is "
+                    "in a finally block — an exception between them leaks "
+                    "the resource",
+                ))
+    return out

@@ -161,7 +161,7 @@ def main() -> int:
             / max(1, legs[True]["prefill_steps"]), 3,
         )
         print(
-            f"[probe] shared-prefix: steps "
+            "[probe] shared-prefix: steps "
             f"{legs[False]['prefill_steps']}→{legs[True]['prefill_steps']}"
             f" hit_tokens={out['prefix_hit_tokens']}",
             file=sys.stderr, flush=True,

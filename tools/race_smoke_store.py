@@ -145,7 +145,7 @@ def main(argv=None) -> int:
     cache_names = {o.metadata.name for o in lister.list(NS)}
     if store_names != cache_names:
         violations.append(
-            f"lister diverged from store: cache-only="
+            "lister diverged from store: cache-only="
             f"{sorted(cache_names - store_names)} "
             f"store-only={sorted(store_names - cache_names)}"
         )
