@@ -75,6 +75,24 @@ serve-chaos-smoke:
 serve-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_paged_kv.py tests/test_prefix_cache.py tests/test_property_prefix_cache.py -q
 
+# Fused block-table attention smoke (fast lane, deterministic — every
+# test seeds its own RandomState): the round-8 kernel's parity tests
+# against the gather oracle (permuted/shared/stale-tail tables, ragged
+# depths, GQA, sliding window, int8 scales, the Hydragen prefix/suffix
+# LSE merge), then the same lane with the runtime sanitizers armed plus
+# the 8-device-mesh recompile probe — one decode + one insert program
+# with the fused/prefix dispatch live. Seconds on CPU; wired into the
+# CI fast job so the kernel can't regress silently between bench rounds.
+fused-smoke: fused-smoke-sanitize
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fused_attention.py -q
+
+# Just the sanitizer-armed lane — what CI's fast job runs, since its
+# plain pytest step already covers test_fused_attention.py unarmed.
+fused-smoke-sanitize:
+	NEXUS_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
+	  tests/test_fused_attention.py \
+	  "tests/test_nexuslint.py::test_recompile_audit_fused_hydragen_one_program_on_mesh" -q
+
 # Thread-safety smoke for the store/informer/lister under parallel fan-out.
 race-smoke:
 	python tools/race_smoke_store.py --threads 8 --seconds 3

@@ -476,6 +476,14 @@ class ServeSpec:
     # once from the seed — the shared-prefix bench leg's workload knob.
     # 0 = fully independent random prompts (the PR 2 behavior).
     shared_prefix_length: int = 0
+    # paged table-read implementation (round 8): "fused" (default)
+    # attends THROUGH the block table — online-softmax over table slots,
+    # traffic bounded by actual row depths, with the Hydragen
+    # shared-prefix decomposition on waves whose live rows alias the
+    # same leading blocks; "gather" keeps the round-6 gather-then-attend
+    # reference (materializes the full virtual view each step — the A/B
+    # baseline `bench-serve` measures). Token-for-token identical.
+    attention_path: str = "fused"
     # ---- serve-plane fault tolerance (round 7) ----
     # bounded wait queue: past this depth the LOWEST-priority queued
     # requests shed with an explicit `shed` status instead of queuing
@@ -577,6 +585,8 @@ class ServeSpec:
             d["prefixCache"] = False
         if self.shared_prefix_length:
             d["sharedPrefixLength"] = self.shared_prefix_length
+        if self.attention_path != "fused":
+            d["attentionPath"] = self.attention_path
         if self.max_queue_depth:
             d["maxQueueDepth"] = self.max_queue_depth
         if self.max_queue_delay_s:
@@ -600,6 +610,7 @@ class ServeSpec:
                 True if d.get("prefixCache") is None else d["prefixCache"]
             ),
             shared_prefix_length=int(d.get("sharedPrefixLength", 0) or 0),
+            attention_path=str(d.get("attentionPath") or "fused"),
             max_queue_depth=int(d.get("maxQueueDepth", 0) or 0),
             max_queue_delay_s=float(d.get("maxQueueDelaySeconds", 0) or 0),
             request_deadline_s=float(
@@ -1049,6 +1060,13 @@ class JaxXlaRuntime:
                 errs.append(
                     "serve.kvNumBlocks requires kvBlockSize > 0 (a dense "
                     "cache has no block pool to size)"
+                )
+            if sv.attention_path not in ("fused", "gather"):
+                errs.append(
+                    "serve.attentionPath must be 'fused' (block-table "
+                    "kernel + Hydragen shared-prefix decomposition) or "
+                    "'gather' (the reference oracle), got "
+                    f"{sv.attention_path!r}"
                 )
             if sv.shared_prefix_length < 0:
                 errs.append(

@@ -18,6 +18,7 @@ from jax import lax
 
 from nexus_tpu.ops.attention import (
     decode_attention as _decode_attention,
+    fused_paged_decode_attention,
     paged_decode_attention,
 )
 from nexus_tpu.ops.norms import rms_norm
@@ -186,26 +187,53 @@ def generic_forward_decode(
     PAGED layout (init_paged_kv_cache): K/V buffers are block POOLS
     ((L, num_blocks, block_size, Hkv, D)) and each row's virtual
     position p lives at pool block ``block_table[b, p // block_size]``,
-    offset ``p % block_size``. Reads gather the row's blocks into the
-    dense virtual view (ops/attention.py::paged_decode_attention), writes
-    scatter through the table; everything else — masks, rope, n_valid,
-    per-row lengths — is IDENTICAL to the dense vector-length path, so
-    the exactness contract carries over unchanged. The table is part of
+    offset ``p % block_size``. Reads attend through the table, writes
+    scatter through it; everything else — masks, rope, n_valid, per-row
+    lengths — is IDENTICAL to the dense vector-length path, so the
+    exactness contract carries over unchanged. The table is part of
     the cache dict and is passed through to the returned cache (the host
-    owns its contents; requires vector ``length``)."""
+    owns its contents; requires vector ``length``).
+
+    Paged reads have two implementations: the gather-then-attend oracle
+    (ops/attention.py::paged_decode_attention — materializes the whole
+    (B, M·Bs, ...) virtual view every step) and the FUSED block-table
+    kernel (fused_paged_decode_attention — streams over table slots with
+    an online softmax; traffic tracks actual row depths). The consumed
+    cache key ``shared_blocks`` ((,) int32) selects the fused path and
+    carries the wave's Hydragen shared-prefix run length (0 = no shared
+    run — same compiled program), with ``shared_table`` ((M,) int32) the
+    aliased leading physical blocks; both are consumed here, like
+    ``n_valid``, never returned. The scaffold derives each row's
+    per-row VALID-BLOCK COUNT (ceil((length + real tokens)/Bs)) and
+    passes it down so the kernel's slot loop is depth-bounded and stale
+    table tails are unreadable."""
     b, t = tokens.shape
     start = cache["length"]
     n_valid = cache.get("n_valid")  # (B,) real-token counts, or None
     block_table = cache.get("block_table")  # (B, M) pool ids, or None
+    shared_blocks = cache.get("shared_blocks")  # fused-path signal (r8)
+    shared_table = cache.get("shared_table")  # (M,) aliased prefix ids
     paged = block_table is not None
+    fused_attn = paged and shared_blocks is not None
+    valid_blocks = None
     if paged:
         num_blocks, block_size = cache["k"].shape[1], cache["k"].shape[2]
         # virtual per-row capacity — every dense-path position bound
         # below works against it unchanged
         max_len = block_table.shape[1] * block_size
+        # per-row valid-block counts for the fused kernel: the highest
+        # position this feed touches is length + real tokens - 1 (padding
+        # slots past n_valid never enter the cache)
+        fed = n_valid if n_valid is not None else t
+        valid_blocks = jnp.clip(
+            -(-(start + fed) // block_size), 1, block_table.shape[1]
+        )
     else:
         max_len = cache["k"].shape[2]
-    cache = {k_: v_ for k_, v_ in cache.items() if k_ != "n_valid"}
+    cache = {
+        k_: v_ for k_, v_ in cache.items()
+        if k_ not in ("n_valid", "shared_blocks", "shared_table")
+    }
     vector_len = jnp.ndim(start) == 1  # per-row cache depths (batched spec)
     if n_valid is not None and not vector_len:
         raise ValueError("n_valid requires a vector (per-row) cache length")
@@ -232,13 +260,24 @@ def generic_forward_decode(
         cos = lax.dynamic_slice_in_dim(cos_full, start, t, axis=0)
         sin = lax.dynamic_slice_in_dim(sin_full, start, t, axis=0)
 
-    def write_cache(buf, new):
-        """Append ``new`` (B, t, ...) at each row's depth: contiguous
-        dynamic-slice in the scalar case, a per-row scatter (dropped when
-        out of range) in the vector case, a through-the-table scatter
-        into the block pool in the paged case. Padding slots
-        (j >= n_valid[b]) are pushed out of range so the drop mode
-        discards them."""
+    def write_cache(buf, new, li):
+        """Append ``new`` (B, t, ...) at each row's depth inside layer
+        ``li``'s plane of the FULL stacked buffer: contiguous
+        dynamic-update-slice in the scalar case, a per-row scatter
+        (dropped when out of range) in the vector case, a
+        through-the-table scatter into the block pool in the paged case.
+        Padding slots (j >= n_valid[b]) are pushed out of range so the
+        drop mode discards them.
+
+        The K/V buffers ride the layer scan's CARRY (scatter at ``li``,
+        then read the updated plane) rather than its xs/ys: stacking
+        per-layer ys re-materializes the ENTIRE stacked buffer every
+        step — a hidden full-pool copy per decode step whose cost scales
+        with POOL size, exactly the ∝width traffic the fused kernel
+        exists to remove (measured 16.7ms vs 2.9ms per 8-step chunk at a
+        1100-block pool on the CPU lane; docs/PERF.md round 8). As carry
+        state the scatters update in place and per-step traffic is the
+        attention's own reads plus one (B, t) write."""
         pos = start[:, None] + jnp.arange(t)[None, :] if vector_len else None
         if paged:
             # virtual position -> (pool block, offset); positions past
@@ -253,38 +292,52 @@ def generic_forward_decode(
                 axis=1,
             )
             phys = jnp.where(keep, blk, num_blocks)
-            return buf.at[phys, pos % block_size].set(new, mode="drop")
+            return buf.at[li, phys, pos % block_size].set(new, mode="drop")
         if not vector_len:
-            return lax.dynamic_update_slice_in_dim(buf, new, start, axis=1)
+            return lax.dynamic_update_slice(
+                buf, new[None].astype(buf.dtype),
+                (li, 0, start) + (0,) * (buf.ndim - 3),
+            )
         rows = jnp.arange(b)[:, None]
         if n_valid is not None:
             pos = jnp.where(
                 jnp.arange(t)[None, :] < n_valid[:, None], pos, max_len
             )
-        return buf.at[rows, pos].set(new, mode="drop")
+        return buf.at[li, rows, pos].set(new, mode="drop")
 
     quantized = "k_scale" in cache
-    scan_xs = (params["layers"], cache["k"], cache["v"]) + (
+    n_layers = cache["k"].shape[0]
+    bufs0 = (cache["k"], cache["v"]) + (
         (cache["k_scale"], cache["v_scale"]) if quantized else ()
     )
+    scan_xs = (params["layers"], jnp.arange(n_layers, dtype=jnp.int32))
 
-    def layer_step(x, scanned):
-        if quantized:
-            layer, k_cache, v_cache, ks_cache, vs_cache = scanned
-        else:
-            layer, k_cache, v_cache = scanned
+    def layer_step(carry, scanned):
+        x, bufs = carry
+        layer, li = scanned
         calls = []
 
         def attend(q, k, v):
             window = getattr(cfg, "sliding_window", 0)
             if quantized:
+                k_pool, v_pool, ks_pool, vs_pool = bufs
                 kq, ks = _quantize_kv(k)
                 vq, vs = _quantize_kv(v)
-                k_buf = write_cache(k_cache, kq)
-                v_buf = write_cache(v_cache, vq)
-                ks_buf = write_cache(ks_cache, ks)
-                vs_buf = write_cache(vs_cache, vs)
-                calls.append((k_buf, v_buf, ks_buf, vs_buf))
+                k_pool = write_cache(k_pool, kq, li)
+                v_pool = write_cache(v_pool, vq, li)
+                ks_pool = write_cache(ks_pool, ks, li)
+                vs_pool = write_cache(vs_pool, vs, li)
+                calls.append((k_pool, v_pool, ks_pool, vs_pool))
+                k_buf, v_buf = k_pool[li], v_pool[li]
+                ks_buf, vs_buf = ks_pool[li], vs_pool[li]
+                if fused_attn:
+                    return fused_paged_decode_attention(
+                        q, k_buf, v_buf, block_table, start, window=window,
+                        k_scale=ks_buf, v_scale=vs_buf,
+                        n_blocks=valid_blocks,
+                        shared_blocks=shared_blocks,
+                        shared_table=shared_table,
+                    )
                 if paged:
                     return paged_decode_attention(
                         q, k_buf, v_buf, block_table, start, window=window,
@@ -294,9 +347,17 @@ def generic_forward_decode(
                     q, k_buf, v_buf, start, window=window,
                     k_scale=ks_buf, v_scale=vs_buf,
                 )
-            k_buf = write_cache(k_cache, k)
-            v_buf = write_cache(v_cache, v)
-            calls.append((k_buf, v_buf))
+            k_pool, v_pool = bufs
+            k_pool = write_cache(k_pool, k, li)
+            v_pool = write_cache(v_pool, v, li)
+            calls.append((k_pool, v_pool))
+            k_buf, v_buf = k_pool[li], v_pool[li]
+            if fused_attn:
+                return fused_paged_decode_attention(
+                    q, k_buf, v_buf, block_table, start, window=window,
+                    n_blocks=valid_blocks, shared_blocks=shared_blocks,
+                    shared_table=shared_table,
+                )
             if paged:
                 return paged_decode_attention(
                     q, k_buf, v_buf, block_table, start, window=window
@@ -311,9 +372,9 @@ def generic_forward_decode(
             raise ValueError(
                 f"layer_fn must call attend() exactly once, got {len(calls)}"
             )
-        return x, calls[0]
+        return (x, calls[0]), None
 
-    x, new_bufs = lax.scan(layer_step, x, scan_xs)
+    (x, new_bufs), _ = lax.scan(layer_step, (x, bufs0), scan_xs)
     if finalize is None:
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     else:

@@ -992,9 +992,18 @@ def gather_kv_blocks(pool: jnp.ndarray, block_table: jnp.ndarray) -> jnp.ndarray
     virtual view, rows' blocks concatenated in table order. The gather is
     the whole paged↔dense bridge: the result has exactly the dense
     layout's per-row axis, so mask/rope/write semantics need no second
-    implementation. Table entries for unmapped tails may point anywhere
-    in range (conventionally the scratch block) — those virtual positions
-    sit at or beyond the row's length and the mask hides them."""
+    implementation. Table entries for unmapped tails MUST point at the
+    scratch block (pool id ``N - 1`` — the allocator guarantees it and
+    asserts it under NEXUS_SANITIZE; runtime/serving.py::BlockAllocator):
+    those virtual positions sit at or beyond the row's length, the mask
+    hides them, and the scratch convention means a stale table entry can
+    never alias a block another row owns.
+
+    This gather-then-attend read is the REFERENCE path: it materializes
+    the whole (B, M·Bs, ...) view in HBM every decode step — traffic
+    proportional to the table WIDTH, not actual row depths — which is
+    exactly what ``fused_paged_decode_attention`` avoids. It stays as the
+    parity oracle and as the `attention_path="gather"` A/B baseline."""
     b, m = block_table.shape
     gathered = pool[block_table]  # (B, M, Bs, ...)
     return gathered.reshape((b, m * pool.shape[1]) + pool.shape[2:])
@@ -1019,6 +1028,318 @@ def paged_decode_attention(
     return decode_attention(
         q, k_buf, v_buf, start, window=window, k_scale=ks, v_scale=vs
     )
+
+
+# ------------------------------------------- fused block-table decode (r8)
+#
+# The gather path above pays B·M·Bs·Hkv·D of HBM traffic per decode step
+# per layer — the MAX table width, not actual row depths — plus a full
+# (B, ..., M·Bs) logits materialization. The fused path streams over the
+# table slots instead (vLLM PagedAttention's core trick): each iteration
+# reads ONE (block_size, Hkv, D) block per row straight from the pool,
+# folds it into a flash-style running (max, sum, accumulator), and moves
+# on — the virtual view is never materialized, the loop's trip count is
+# the max VALID block count across rows (lax.fori_loop with traced
+# bounds), and per-slot masks derived from `start` hide unmapped tails
+# and unwritten block interiors. GQA (grouped einsums against the raw
+# Hkv blocks), sliding-window, and int8 k_scale/v_scale dequant all ride
+# the same per-block inner loop.
+#
+# On top of it sits the Hydragen shared-prefix decomposition: when every
+# live row's leading table entries alias the SAME physical blocks (the
+# prefix cache makes this the common case for same-preamble waves),
+# `shared_prefix_attention_partials` computes prefix attention once per
+# wave with the rows' queries batched — each shared block is read ONCE,
+# not once per row, and the score matmul is a dense (B·T·Hq) × Bs GEMM
+# instead of B gathered GEMVs — while the per-row loop covers only the
+# private tails; `merge_attention_partials` combines the two partial
+# softmaxes exactly via log-sum-exp. The split lengths are TRACED
+# operands, so one compiled program serves every wave.
+#
+# Numerics: per-position logits are bitwise identical to the gather
+# oracle (same dots, same scale, same finite mask value); only the
+# softmax reduction ORDER differs (blockwise rescaling vs one flat
+# reduce), so outputs agree to f32 roundoff — tests/test_fused_attention
+# pins the tolerance and test_serving.py proves token-for-token parity
+# through the engine.
+
+
+def _online_softmax_init(b, hkv, n_rep, t, hd):
+    """Fresh partial-softmax state (m, l, acc). `m` starts at the FINITE
+    mask value, not -inf: with finite masking, an all-masked block folds
+    as exp(MASK-MASK)=1 against an explicit zero probability (see
+    `_fold_block`), so no -inf minus -inf NaN can ever appear — the same
+    finite-mask convention `decode_attention` uses."""
+    return (
+        jnp.full((b, hkv, n_rep, t), DEFAULT_MASK_VALUE, jnp.float32),
+        jnp.zeros((b, hkv, n_rep, t), jnp.float32),
+        jnp.zeros((b, hkv, n_rep, t, hd), jnp.float32),
+    )
+
+
+def _fold_block(carry, s, v_blk, visible):
+    """Fold one block's masked logits + values into the running softmax.
+
+    s: (B, Hkv, rep, T, Bs) f32 logits already set to DEFAULT_MASK_VALUE
+    at invisible positions; visible: (B, T, Bs) bool; v_blk: the block's
+    values, (B, Bs, Hkv, D) (per-row gather) or (Bs, Hkv, D) (shared
+    block, read once for the whole wave).
+
+    The probability of an invisible position is forced to literal 0.0
+    (not exp(MASK - m), which is only zero once a real max has been
+    seen): a block that is entirely masked — the sliding window not yet
+    reaching it, or a slot past the row's valid count — contributes
+    exactly nothing, whatever the running max currently is."""
+    m_prev, l_prev, acc = carry
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.where(
+        visible[:, None, None], jnp.exp(s - m_new[..., None]), 0.0
+    )  # (B, Hkv, rep, T, Bs)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1)
+    if v_blk.ndim == 4:  # per-row blocks
+        pv = jnp.einsum(
+            "bgrts,bsgd->bgrtd", p, v_blk.astype(jnp.float32),
+        )
+    else:  # one shared block for every row (Hydragen prefix)
+        pv = jnp.einsum("bgrts,sgd->bgrtd", p, v_blk.astype(jnp.float32))
+    return m_new, l_new, acc * alpha[..., None] + pv
+
+
+def merge_attention_partials(a, b):
+    """Exact log-sum-exp combination of two partial-softmax states over
+    DISJOINT key sets — the Hydragen prefix/suffix merge. For states
+    (m_i, l_i, acc_i) with l_i = Σ_j exp(s_ij - m_i) and
+    acc_i = Σ_j exp(s_ij - m_i)·v_j, rescaling both onto the joint max
+    reproduces the single-pass softmax state over the union exactly
+    (tests/test_fused_attention.py proves it against the unsplit loop
+    and the dense oracle)."""
+    m1, l1, a1 = a
+    m2, l2, a2 = b
+    m = jnp.maximum(m1, m2)
+    e1 = jnp.exp(m1 - m)
+    e2 = jnp.exp(m2 - m)
+    return m, l1 * e1 + l2 * e2, a1 * e1[..., None] + a2 * e2[..., None]
+
+
+def finalize_attention_partials(parts, out_dtype):
+    """(m, l, acc) → normalized attention output (B, T, Hq, D). Rows
+    whose every position was masked carry l == 0 and emit exact zeros
+    (only ever padding/garbage slots the caller ignores)."""
+    _, l, acc = parts
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = acc / safe_l[..., None]  # (B, Hkv, rep, T, D)
+    b, hkv, n_rep, t, hd = out.shape
+    return out.transpose(0, 3, 1, 2, 4).reshape(
+        b, t, hkv * n_rep, hd
+    ).astype(out_dtype)
+
+
+def _dequant_block(blk, blk_scale, dtype):
+    """int8 block → compute dtype, bitwise-matching the gather oracle's
+    dequant (`decode_attention`): f32 multiply, cast to the model's
+    compute width."""
+    return (blk.astype(jnp.float32) * blk_scale[..., None]).astype(dtype)
+
+
+# positions one loop iteration covers: each fori_loop step processes a
+# GROUP of ceil(SLOT_GROUP_SPAN / block_size) table slots at once (the
+# paged-attention "pages per compute block"), so the per-iteration
+# gather+matmul is big enough to amortize dispatch overhead — a
+# slot-per-iteration loop measured ~1.5x SLOWER than the gather path at
+# 16 rows on the CPU lane purely on loop overhead. Boundary
+# over-compute is bounded by one group span, fully masked, and ∝B —
+# every row pays the span-rounding past its true depth — so the span
+# trades per-row over-read (wants small) against loop fixed overhead
+# (wants big): the interleaved pf=1 sweep at rows 4/16 (each engine
+# compiled under its own span, matched queues) measured 128 and 256
+# equivalent within noise (rows16/rows4 1.54x / 1.55x) and 512 worse
+# (1.48x) — docs/PERF.md round 8.
+SLOT_GROUP_SPAN = 128
+
+
+def _slots_per_group(block_size: int) -> int:
+    return max(1, SLOT_GROUP_SPAN // int(block_size))
+
+
+def _group_visibility(slots, bs, q_pos, slot_ok, window):
+    """Visibility of a slot-group's positions for every (row, query):
+    the causal length mask (position <= q_pos), the sliding window, and
+    the per-slot validity (stale/out-of-range slots) — the mask that
+    makes a stale table entry unreadable regardless of what it points
+    at. ``slots``: (G,) global slot ids; ``slot_ok``: (B, G)."""
+    g = slots.shape[0]
+    pos = (slots[:, None] * bs + jnp.arange(bs)[None, :]).reshape(
+        g * bs
+    )  # (G·Bs,) global virtual positions
+    vis = pos[None, None, :] <= q_pos[..., None]  # (B, T, G·Bs)
+    if window > 0:
+        vis = vis & (pos[None, None, :] > q_pos[..., None] - window)
+    ok = jnp.repeat(slot_ok, bs, axis=-1)  # (B, G·Bs)
+    return vis & ok[:, None, :]
+
+
+def paged_attention_partials(
+    q: jnp.ndarray, k_pool: jnp.ndarray, v_pool: jnp.ndarray,
+    block_table: jnp.ndarray, start: jnp.ndarray, lo, hi,
+    n_blocks: jnp.ndarray, window: int = 0, k_scale=None, v_scale=None,
+):
+    """Per-row fused block-table attention partials over table slots
+    ``lo <= mi < hi`` (traced bounds — the loop runs exactly the needed
+    trip count, so per-step traffic tracks actual row depths, not the
+    table width). Returns the (m, l, acc) online-softmax state.
+
+    ``n_blocks`` (B,) is each row's VALID block count: slots at or past
+    it are fully masked AND their table entry is replaced by the scratch
+    block (pool id N-1) before the gather, so a stale entry can never be
+    read — not even into masked lanes."""
+    b, t, hq, hd = q.shape
+    bs, hkv = k_pool.shape[1], k_pool.shape[2]
+    n_rep = hq // hkv
+    m_slots = block_table.shape[1]
+    scale = hd ** -0.5
+    starts = jnp.broadcast_to(jnp.asarray(start), (b,))
+    q_pos = starts[:, None] + jnp.arange(t)[None, :]  # (B, T)
+    qg = q.reshape(b, t, hkv, n_rep, hd)
+    scratch = k_pool.shape[0] - 1
+    G = _slots_per_group(bs)
+
+    def body(i, carry):
+        slots = lo + i * G + jnp.arange(G)  # (G,) global slot ids
+        slot_ok = (slots[None, :] < n_blocks[:, None]) & (
+            slots < hi
+        )[None, :]  # (B, G)
+        idx = jnp.clip(slots, 0, m_slots - 1)
+        blk = jnp.take(block_table, idx, axis=1)  # (B, G)
+        blk = jnp.where(slot_ok, blk, scratch)
+        k_blk = k_pool[blk].reshape(
+            b, G * bs, hkv, k_pool.shape[-1]
+        )  # (B, G·Bs, Hkv, D)
+        v_blk = v_pool[blk].reshape(b, G * bs, hkv, v_pool.shape[-1])
+        if k_scale is not None:
+            ks = k_scale[blk].reshape(b, G * bs, hkv)
+            vs = v_scale[blk].reshape(b, G * bs, hkv)
+            k_blk = _dequant_block(k_blk, ks, q.dtype)
+            v_blk = _dequant_block(v_blk, vs, q.dtype)
+        vis = _group_visibility(slots, bs, q_pos, slot_ok, window)
+        s = jnp.einsum(
+            "btgrd,bsgd->bgrts", qg, k_blk,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = jnp.where(vis[:, None, None], s, DEFAULT_MASK_VALUE)
+        return _fold_block(carry, s, v_blk, vis)
+
+    n_groups = -(-(hi - lo) // G)  # traced ceil — exact trip count
+    return lax.fori_loop(
+        0, n_groups, body, _online_softmax_init(b, hkv, n_rep, t, hd)
+    )
+
+
+def shared_prefix_attention_partials(
+    q: jnp.ndarray, k_pool: jnp.ndarray, v_pool: jnp.ndarray,
+    shared_table: jnp.ndarray, n_shared, start: jnp.ndarray,
+    n_blocks: jnp.ndarray, window: int = 0, k_scale=None, v_scale=None,
+):
+    """Hydragen prefix partials: attention of EVERY row's queries over
+    the ``n_shared`` leading blocks all live rows alias (``shared_table``
+    (M,) physical ids, ``n_shared`` a traced scalar). Each shared block
+    is read from the pool ONCE for the whole wave — per-slot traffic is
+    Bs·Hkv·D instead of the per-row loop's B·Bs·Hkv·D — and the score
+    matmul runs dense over the batched queries. Masks are identical to
+    the per-row loop, so rows whose depth or window doesn't reach a
+    shared position simply see it masked."""
+    b, t, hq, hd = q.shape
+    bs, hkv = k_pool.shape[1], k_pool.shape[2]
+    n_rep = hq // hkv
+    m_slots = shared_table.shape[0]
+    scale = hd ** -0.5
+    starts = jnp.broadcast_to(jnp.asarray(start), (b,))
+    q_pos = starts[:, None] + jnp.arange(t)[None, :]
+    qg = q.reshape(b, t, hkv, n_rep, hd)
+    scratch = k_pool.shape[0] - 1
+    G = _slots_per_group(bs)
+
+    def body(i, carry):
+        slots = i * G + jnp.arange(G)  # (G,) leading slot ids
+        in_run = slots < n_shared  # (G,) — past-run slots masked
+        idx = jnp.clip(slots, 0, m_slots - 1)
+        blk = jnp.where(in_run, shared_table[idx], scratch)  # (G,)
+        k_blk = k_pool[blk].reshape(
+            G * bs, hkv, k_pool.shape[-1]
+        )  # (G·Bs, Hkv, D) — each shared block read ONCE for the wave
+        v_blk = v_pool[blk].reshape(G * bs, hkv, v_pool.shape[-1])
+        if k_scale is not None:
+            ks = k_scale[blk].reshape(G * bs, hkv)
+            vs = v_scale[blk].reshape(G * bs, hkv)
+            k_blk = _dequant_block(k_blk, ks, q.dtype)
+            v_blk = _dequant_block(v_blk, vs, q.dtype)
+        slot_ok = in_run & (slots[None, :] < n_blocks[:, None])  # (B, G)
+        vis = _group_visibility(slots, bs, q_pos, slot_ok, window)
+        s = jnp.einsum(
+            "btgrd,sgd->bgrts", qg, k_blk,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = jnp.where(vis[:, None, None], s, DEFAULT_MASK_VALUE)
+        return _fold_block(carry, s, v_blk, vis)
+
+    n_groups = -(-n_shared // G)
+    return lax.fori_loop(
+        0, n_groups, body, _online_softmax_init(b, hkv, n_rep, t, hd)
+    )
+
+
+def fused_paged_decode_attention(
+    q: jnp.ndarray, k_pool: jnp.ndarray, v_pool: jnp.ndarray,
+    block_table: jnp.ndarray, start: jnp.ndarray, window: int = 0,
+    k_scale=None, v_scale=None, n_blocks: Optional[jnp.ndarray] = None,
+    shared_blocks=None, shared_table: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """``paged_decode_attention`` without the gather: attend THROUGH the
+    block table with a blockwise online softmax. Same signature and
+    semantics as the gather oracle, plus:
+
+    ``n_blocks`` (B,) int32: per-row valid-block counts (defaults to
+    ceil((start + T) / Bs)); slots past a row's count are masked and
+    their gather is redirected to the scratch block, and the slot loop's
+    trip count is the max count across rows — traffic proportional to
+    actual depths.
+
+    ``shared_blocks`` (traced scalar) + ``shared_table`` ((M,) physical
+    ids): the Hydragen shared-prefix decomposition. Slots below
+    ``shared_blocks`` — leading table entries every live row aliases —
+    are computed once per wave from ``shared_table`` with the queries
+    batched; the per-row loop covers only ``[shared_blocks, hi)``; the
+    two partial softmaxes combine exactly via log-sum-exp
+    (``merge_attention_partials``). ``shared_blocks == 0`` at runtime
+    degrades to the plain fused loop in the SAME compiled program — the
+    split length is an operand, never a compile key."""
+    b, t = q.shape[0], q.shape[1]
+    bs = k_pool.shape[1]
+    m_slots = block_table.shape[1]
+    starts = jnp.broadcast_to(jnp.asarray(start), (b,))
+    if n_blocks is None:
+        n_blocks = -(-(starts + t) // bs)
+    n_blocks = jnp.clip(n_blocks, 1, m_slots)
+    hi = jnp.max(n_blocks)  # traced scalar loop bound
+    common = dict(window=window, k_scale=k_scale, v_scale=v_scale)
+    if shared_table is not None and shared_blocks is not None:
+        s_eff = jnp.clip(jnp.asarray(shared_blocks, jnp.int32), 0, hi)
+        prefix = shared_prefix_attention_partials(
+            q, k_pool, v_pool, shared_table, s_eff, starts, n_blocks,
+            **common,
+        )
+        suffix = paged_attention_partials(
+            q, k_pool, v_pool, block_table, starts, s_eff, hi, n_blocks,
+            **common,
+        )
+        parts = merge_attention_partials(prefix, suffix)
+    else:
+        parts = paged_attention_partials(
+            q, k_pool, v_pool, block_table, starts, 0, hi, n_blocks,
+            **common,
+        )
+    return finalize_attention_partials(parts, q.dtype)
 
 
 def attention(

@@ -949,6 +949,7 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None):
             prefix_cache=sv.prefix_cache,
             max_queue_depth=sv.max_queue_depth,
             max_queue_delay_s=sv.max_queue_delay_s,
+            attention_path=sv.attention_path,
         )
         results, metrics = engine.serve(
             requests, cancel=cancel, heartbeat=heartbeat,

@@ -136,6 +136,35 @@ class BlockAllocator:
         self.peak_allocated = 0
         self.evictions = 0
 
+    @property
+    def scratch_block(self) -> int:
+        """The one pool block the allocator NEVER hands out: the cache
+        carries ``num_blocks + 1`` physical blocks and by convention the
+        last one (id == ``num_blocks``) is scratch — unmapped table
+        tails and released rows point there, so frozen-slot writes land
+        harmlessly and the fused kernel's stale-entry redirect has a
+        fixed target (ops/attention.py reads it as pool id N-1)."""
+        return self.num_blocks
+
+    def audit_scratch_tails(self, table, mapped_counts) -> None:
+        """The unmapped-tail contract, asserted (NEXUS_SANITIZE path):
+        every table entry past a row's mapped block count MUST be the
+        scratch block — "may point anywhere in range" is no longer
+        tolerated, because a stale entry aliasing a block another row
+        owns would be one mask bug away from cross-request K/V reads.
+        ``table``: the (B, M) host table; ``mapped_counts``: per-row
+        mapped block counts (0 for free rows)."""
+        scratch = self.scratch_block
+        for r, n in enumerate(mapped_counts):
+            tail = table[r, n:]
+            if tail.size and not (tail == scratch).all():
+                bad = int(tail[(tail != scratch).argmax()])
+                raise AssertionError(
+                    f"block-table row {r}: unmapped tail entry points at "
+                    f"block {bad}, not the scratch block {scratch} — the "
+                    "allocator's scratch-tail contract is broken"
+                )
+
     def blocks_for(self, positions: int) -> int:
         """Blocks covering ``positions`` cache slots."""
         return max(0, -(-int(positions) // self.block_size))
@@ -434,6 +463,7 @@ class ServingEngine:
         max_queue_depth: int = 0,
         max_queue_delay_s: float = 0.0,
         clock: Callable[[], float] = time.monotonic,
+        attention_path: str = "fused",
     ):
         """``prefill_chunk`` (T): prompt tokens an admitting row consumes
         per decode step. A T-slot feed costs every row T slots of matmul
@@ -498,7 +528,35 @@ class ServingEngine:
         waited unadmitted longer than this (0 = no bound). Both are
         policed at every wave boundary, never mid-dispatch. ``clock`` is
         injectable (the detector's pattern) so deadline/shed paths
-        unit-test without sleeps."""
+        unit-test without sleeps.
+
+        ``attention_path`` (round 8, paged layout only) selects how the
+        decode programs read K/V through the block table:
+
+          * ``"fused"`` (default) — the fused block-table kernel
+            (ops/attention.py::fused_paged_decode_attention): stream
+            over table slots with an online softmax, trip count bounded
+            by the max valid-block count across rows — per-step traffic
+            tracks actual depths, never the table width. The engine
+            also runs the HYDRAGEN shared-prefix decomposition on top:
+            at every wave boundary the host detects the longest run of
+            leading table entries shared by ALL live rows (prefix-cache
+            hits alias the same physical blocks, so same-preamble waves
+            share trivially), and the kernel computes that prefix's
+            attention once per wave with the rows' queries batched,
+            per-row attention over only the private tails, and combines
+            the two via log-sum-exp. The run length and shared ids are
+            TRACED operands — waves with no shared run fall through to
+            the plain fused loop inside the SAME compiled program (the
+            recompile sanitizer gates this).
+          * ``"gather"`` — the round-6 gather-then-attend reference
+            (materializes the (B, M·Bs, ...) virtual view every step):
+            kept as the parity oracle and the A/B baseline
+            (`bench-serve` measures both).
+
+        Outputs are token-for-token identical across both paths and the
+        dense layout (tested across the fp / int8-KV / speculative
+        tiers with the prefix cache on and off)."""
         self._fwd = forward_decode
         self._params = params
         self._cfg = cfg
@@ -561,6 +619,12 @@ class ServingEngine:
                 f"max_queue_delay_s must be >= 0, got {max_queue_delay_s}"
             )
         self._clock = clock
+        # NEXUS_SANITIZE arms the allocator's scratch-tail audit (the
+        # unmapped-tail contract) alongside the conftest-installed
+        # serve() wrappers — stdlib-only check, read once at build time
+        from nexus_tpu.testing.sanitizers import sanitizers_enabled
+
+        self._sanitize = sanitizers_enabled()
         # drain snapshot of the last cancelled serve() run (engine death):
         # the ServeFailoverPlanner's input
         self.last_drain: Optional[List[DrainedRequest]] = None
@@ -589,6 +653,15 @@ class ServingEngine:
         # cross-request KV reuse rides the paged layout only (the dense
         # rows have no shareable unit)
         self._prefix = bool(prefix_cache) and self._paged
+        if attention_path not in ("fused", "gather"):
+            raise ValueError(
+                f"attention_path must be 'fused' or 'gather', got "
+                f"{attention_path!r}"
+            )
+        self._attn_path = attention_path
+        # the fused kernel + Hydragen dispatch ride the paged layout
+        # only (dense rows read a contiguous stripe — nothing to fuse)
+        self._fused = self._paged and attention_path == "fused"
         # rounds per dispatch: one round = one target forward committing
         # 1..k+1 tokens, so this keeps a spec chunk's committed-token
         # budget comparable to a plain chunk's C single-token steps
@@ -609,6 +682,28 @@ class ServingEngine:
         B = self._b
         max_len_ = self._max_len
         base_key = self._base_key
+        use_fused = self._fused
+
+        def _with_attn_operands(cache_in, shared_blocks, shared_table):
+            """Thread the fused path's per-wave operands into the feed
+            cache (consumed by the decode scaffold like ``n_valid``):
+            the Hydragen shared-run length — a TRACED scalar, so a new
+            run length is a new operand VALUE, never a new compile key —
+            and the (M,) aliased leading block ids. The gather path
+            passes neither and dispatches the round-6 gather read."""
+            if use_fused:
+                cache_in["shared_blocks"] = shared_blocks
+                cache_in["shared_table"] = shared_table
+            return cache_in
+
+        def _strip_attn_keys(cache_out):
+            """Normalize a family's returned cache: the scaffold consumes
+            the fused operands, but stub families that pass unknown keys
+            through must not change the scan carry structure."""
+            return {
+                k: v for k, v in cache_out.items()
+                if k not in ("shared_blocks", "shared_table", "n_valid")
+            }
 
         def _pick(logits_row, temp, seed, pos):
             """Per-row token choice: argmax at temp 0, else a categorical
@@ -621,6 +716,22 @@ class ServingEngine:
             return jnp.where(
                 temp > 0.0, sampled, jnp.argmax(logits_row, axis=-1)
             ).astype(jnp.int32)
+
+        def _pick_wave(logits, temps, seeds, poss):
+            """Batch token choice with an all-greedy fast path: the
+            per-row threefry fold-ins + vocab-wide categorical draws are
+            pure waste when NO request in the wave samples (the common
+            serving case), so a scalar `lax.cond` skips them wholesale —
+            measured ~10% of the narrow decode chunk at 16 rows on the
+            CPU lane. Exact either way: greedy rows take the identical
+            argmax inside the sampled branch's per-row `where`, so a
+            sampled co-resident never changes a greedy row's tokens
+            (batch-invariance, tested)."""
+            return lax.cond(
+                jnp.any(temps > 0.0),
+                lambda: jax.vmap(_pick)(logits, temps, seeds, poss),
+                lambda: jnp.argmax(logits, axis=-1).astype(jnp.int32),
+            )
 
         def _make_decode_chunk(T):
             """Chunk program at feed width T: C steps in ONE dispatch;
@@ -643,7 +754,7 @@ class ServingEngine:
             under the width-1 program just streams 1 token/step)."""
 
             def _decode_chunk(params, cache, tok, ptr, done, buf, plen,
-                              temp, seed):
+                              temp, seed, shared_blocks, shared_table):
                 def step(carry, _):
                     cache, tok, ptr = carry
                     prefilling = (ptr < plen) & ~done
@@ -661,8 +772,11 @@ class ServingEngine:
                     )
                     cache_in = dict(cache)
                     cache_in["n_valid"] = n_valid
+                    cache_in = _with_attn_operands(
+                        cache_in, shared_blocks, shared_table
+                    )
                     logits, cache2 = fwd(params, cfg_, feed, cache_in)
-                    cache2 = dict(cache2)
+                    cache2 = _strip_attn_keys(dict(cache2))
                     cache2["length"] = jnp.where(
                         done, cache["length"], cache2["length"]
                     )
@@ -676,7 +790,7 @@ class ServingEngine:
                         (n_valid - 1)[:, None, None].astype(jnp.int32),
                         axis=1,
                     )[:, 0]
-                    nxt = jax.vmap(_pick)(
+                    nxt = _pick_wave(
                         pick_logits, temp, seed, cache2["length"]
                     ).astype(tok.dtype)
                     finish = prefilling & (plen - ptr <= T)
@@ -722,7 +836,8 @@ class ServingEngine:
         W = k_spec + 1
         rows_idx = jnp.arange(B)
 
-        def _spec_chunk(params, cache, tok, ptr, done, buf, plen):
+        def _spec_chunk(params, cache, tok, ptr, done, buf, plen,
+                        shared_blocks, shared_table):
             """R speculative rounds in ONE dispatch: decode rows propose
             k by n-gram lookup in their committed text and verify in one
             k+1-wide forward; PREFILLING rows ride the same forward with
@@ -757,8 +872,11 @@ class ServingEngine:
                 ).astype(jnp.int32)
                 cache_in = dict(cache)
                 cache_in["n_valid"] = n_valid
+                cache_in = _with_attn_operands(
+                    cache_in, shared_blocks, shared_table
+                )
                 logits, cache2 = fwd(params, cfg_, block, cache_in)
-                cache2 = dict(cache2)
+                cache2 = _strip_attn_keys(dict(cache2))
                 target_choice = jnp.argmax(logits, axis=-1).astype(tok.dtype)
                 accepted, out = _greedy_accept(proposals, target_choice)
                 accepted = jnp.where(active, accepted, 0)
@@ -812,12 +930,16 @@ class ServingEngine:
             return cache, tok, ptr, buf, outs, accs, n_emits, actives
 
         # donate the cache (and the spec path's token buffer): XLA updates
-        # the K/V buffers in place instead of copying the multi-GB cache
+        # the K/V buffers in place instead of copying the whole cache
         # every chunk (same pattern as train/trainer.py's donated state).
-        # CPU can't donate and would warn on every dispatch — TPU only.
-        from nexus_tpu.utils.hw import is_tpu
+        # Gated on a capability probe, not the platform name: current
+        # jax donates fine on CPU, and without it every dispatch pays a
+        # full pool copy — a cost proportional to POOL size, which is
+        # exactly the ∝rows overhead the fused kernel exists to remove
+        # (rows16's pool is 4x rows4's; docs/PERF.md round 8).
+        from nexus_tpu.utils.hw import supports_donation
 
-        donate = is_tpu()
+        donate = supports_donation()
         self._decode_chunk = jax.jit(
             _make_decode_chunk(self._t),
             donate_argnums=(1,) if donate else (),
@@ -1034,6 +1156,17 @@ class ServingEngine:
         def zf():
             return self._mint(np.zeros((b,), np.float32))
 
+        # fused-path operands (traced VALUES — one program whatever the
+        # wave's shared run is): the Hydragen shared-run length and the
+        # aliased leading block ids; an all-scratch table + length 0 is
+        # the no-shared-run neutral element, reused whenever detection
+        # finds nothing (gather/dense engines pass it uninspected)
+        m_slots = self._blocks_per_row or 1
+        zero_shared = (
+            self._mint(np.int32(0)),
+            self._mint(np.full((m_slots,), self._num_blocks, np.int32)),
+        )
+
         # the insert consumes its donated inputs; thread its RETURNS
         # into the chunk warm-up instead of reusing dead arrays
         (warm_cache, warm_buf, warm_ptr, warm_plen, warm_temp,
@@ -1046,13 +1179,14 @@ class ServingEngine:
             out = self._spec_chunk(
                 self._params, warm_cache, zi(), warm_ptr,
                 self._mint(np.ones((b,), np.bool_)), warm_buf, warm_plen,
+                *zero_shared,
             )
             np.asarray(out[4])  # host fetch: the warm-up really completed
         else:
             out = self._decode_chunk(
                 self._params, warm_cache, zi(), warm_ptr,
                 self._mint(np.ones((b,), np.bool_)), warm_buf, warm_plen,
-                warm_temp, warm_seed,
+                warm_temp, warm_seed, *zero_shared,
             )
             np.asarray(out[3])  # host fetch: the warm-up really completed
             if self._decode_chunk_narrow is not self._decode_chunk:
@@ -1063,6 +1197,7 @@ class ServingEngine:
                     self._params, warm2, zi(), zi(),
                     self._mint(np.ones((b,), np.bool_)),
                     self._mint(np.zeros((b, max_len), np.int32)), zi(), zf(), zi(),
+                    *zero_shared,
                 )
                 np.asarray(out[3])
         del warm_cache, warm_buf, out
@@ -1145,6 +1280,8 @@ class ServingEngine:
         hit_tokens = 0
         hit_requests = 0
         cow_copies = 0
+        hydragen_waves = 0  # dispatches that ran with a shared run > 0
+        hydragen_shared_slots = 0  # Σ shared-run blocks over those waves
         ttfts: List[float] = []
         queues: List[float] = []
 
@@ -1172,10 +1309,47 @@ class ServingEngine:
                 if len(blks) != before:
                     table_np[r, : len(blks)] = blks
                     table_dirty[0] = True
+            if self._sanitize:
+                # the unmapped-tail contract: everything past a row's
+                # mapped blocks points at the scratch block, always
+                alloc.audit_scratch_tails(table_np, [
+                    len(leases[r].blocks) if leases[r] is not None else 0
+                    for r in range(b)
+                ])
             if table_dirty[0]:
                 cache = dict(cache)
                 cache["block_table"] = self._mint(table_np)
                 table_dirty[0] = False
+
+        def detect_shared_run():
+            """Hydragen wave-level detection (host-side, O(B·M) numpy):
+            the longest run of leading table entries shared by ALL live
+            rows — prefix-cache hits alias the same physical block ids,
+            so same-preamble waves share trivially and unrelated waves
+            mismatch at slot 0. Needs >= 2 live rows (a single row's
+            "shared" prefix amortizes nothing) and returns the run
+            length plus the minted traced operands; 0/neutral otherwise
+            — the SAME compiled program either way."""
+            if not self._fused:
+                return 0, zero_shared
+            live = [
+                r for r in range(b)
+                if rows[r] is not None and leases[r] is not None
+            ]
+            if len(live) < 2:
+                return 0, zero_shared
+            # entries past a lease's mapped blocks are scratch — cap the
+            # run at the shallowest mapping so it only ever covers real,
+            # fully-owned blocks
+            s = min(len(leases[r].blocks) for r in live)
+            head = table_np[live[0]]
+            for r in live[1:]:
+                neq = np.nonzero(table_np[r, :s] != head[:s])[0]
+                if neq.size:
+                    s = int(neq[0])
+                if s == 0:
+                    return 0, zero_shared
+            return s, (self._mint(np.int32(s)), self._mint(head.copy()))
 
         def finish(state: _RowState, status: str = STATUS_OK) -> None:
             nonlocal committed
@@ -1450,6 +1624,10 @@ class ServingEngine:
                 # pool's residency for the bytes-per-token metric
                 grow_and_push_tables()
                 alloc_block_steps += alloc.allocated_blocks
+            shared_s, shared_ops = detect_shared_run()
+            if shared_s:
+                hydragen_waves += 1
+                hydragen_shared_slots += shared_s
             done_vec = self._mint(
                 np.asarray([r is None or row_done(r) for r in rows]),
                 jnp.bool_,
@@ -1458,7 +1636,7 @@ class ServingEngine:
                 (cache, tok_vec, ptr_vec, buf, outs, accs, n_emits,
                  actives) = self._spec_chunk(
                     self._params, cache, tok_vec, ptr_vec, done_vec, buf,
-                    plen_vec,
+                    plen_vec, *shared_ops,
                 )
                 chunks += 1
                 # one verify scores k+1 positions; utilization over them
@@ -1480,7 +1658,7 @@ class ServingEngine:
                 )
                 cache, tok_vec, ptr_vec, toks, emits = chunk_fn(
                     self._params, cache, tok_vec, ptr_vec, done_vec,
-                    buf, plen_vec, temp_vec, seed_vec,
+                    buf, plen_vec, temp_vec, seed_vec, *shared_ops,
                 )
                 chunks += 1
                 scheduled_slots += self._chunk * b
@@ -1633,6 +1811,15 @@ class ServingEngine:
         metrics["kv_layout"] = "paged" if self._paged else "dense"
         metrics["kv_dense_bytes_per_request"] = dense_row_bytes
         if self._paged:
+            # which table-read implementation served (the r8 A/B knob)
+            # and the Hydragen ledger: how many dispatches ran with a
+            # shared-prefix run and how many block-slots of per-row
+            # gather+score work the decomposition replaced with the
+            # once-per-wave batched prefix computation
+            metrics["attention_path"] = self._attn_path
+            if self._fused:
+                metrics["hydragen_waves"] = hydragen_waves
+                metrics["hydragen_shared_slots"] = hydragen_shared_slots
             metrics["kv_block_size"] = self._block_size
             metrics["kv_num_blocks"] = self._num_blocks
             metrics["kv_pool_bytes"] = (self._num_blocks + 1) * block_bytes

@@ -100,6 +100,37 @@ def is_tpu() -> bool:
         return False
 
 
+_DONATION_SUPPORTED: Optional[bool] = None
+
+
+def supports_donation() -> bool:
+    """True when the resolved backend actually honors jit buffer donation.
+
+    Probed ONCE by compiling a trivial donated program and checking the
+    donated input was really consumed (``is_deleted``): a backend that
+    ignores donation leaves the buffer alive (and warns), so keying off
+    the platform name would either miss real support (CPU donates fine
+    on current jax — the serving engine's per-dispatch cache copy was
+    pure waste there) or silently lose it on an exotic plugin backend.
+    """
+    global _DONATION_SUPPORTED
+    if _DONATION_SUPPORTED is None:
+        try:
+            import warnings
+
+            import jax.numpy as jnp
+
+            probe = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+            x = jnp.zeros((8,), jnp.float32)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                probe(x).block_until_ready()
+            _DONATION_SUPPORTED = bool(x.is_deleted())
+        except Exception:  # noqa: BLE001 — absent probe APIs = no donation
+            _DONATION_SUPPORTED = False
+    return _DONATION_SUPPORTED
+
+
 def device_kind() -> str:
     try:
         return jax.devices()[0].device_kind

@@ -1,0 +1,250 @@
+"""Fused block-table decode attention (ops/attention.py, round 8).
+
+The fused kernel must be a drop-in for the gather-then-attend oracle:
+same masks, same dequant, same GQA grouping — only the reduction order
+differs (blockwise online softmax vs one flat softmax), so outputs agree
+to f32 roundoff. These tests drive randomized block tables (permuted,
+shared between rows, scratch-padded tails, STALE tails), ragged per-row
+depths, GQA head ratios, sliding windows, and int8 scales against the
+oracle, plus the Hydragen prefix/suffix split's exact log-sum-exp
+combination. Fast lane: pure ops, no engine compiles.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nexus_tpu.ops.attention import (
+    finalize_attention_partials,
+    fused_paged_decode_attention,
+    merge_attention_partials,
+    paged_attention_partials,
+    paged_decode_attention,
+    shared_prefix_attention_partials,
+)
+
+TOL = dict(rtol=2e-5, atol=2e-6)
+
+
+def _pool(rng, nb, bs, hkv, hd, quantized=False):
+    if quantized:
+        k = jnp.asarray(
+            rng.randint(-127, 128, size=(nb, bs, hkv, hd)), jnp.int8
+        )
+        v = jnp.asarray(
+            rng.randint(-127, 128, size=(nb, bs, hkv, hd)), jnp.int8
+        )
+        ks = jnp.asarray(
+            np.abs(rng.randn(nb, bs, hkv)) * 0.02 + 1e-3, jnp.float32
+        )
+        vs = jnp.asarray(
+            np.abs(rng.randn(nb, bs, hkv)) * 0.02 + 1e-3, jnp.float32
+        )
+        return k, v, ks, vs
+    k = jnp.asarray(rng.randn(nb, bs, hkv, hd), jnp.float32)
+    v = jnp.asarray(rng.randn(nb, bs, hkv, hd), jnp.float32)
+    return k, v, None, None
+
+
+def _random_table(rng, b, m, nb, share_rows=False, scratch_tails=False,
+                  starts=None, bs=None):
+    """Random block table over pool ids [0, nb-1); nb-1 is scratch by
+    convention. ``share_rows`` aliases a common leading run across all
+    rows (the prefix-cache shape); ``scratch_tails`` pads entries past
+    each row's valid count with the scratch block (the allocator
+    contract)."""
+    ids = rng.permutation(nb - 1)[: b * m].reshape(b, m).astype(np.int32)
+    if share_rows:
+        ids[:, : m // 2] = ids[0, : m // 2]
+    if scratch_tails:
+        assert starts is not None and bs is not None
+        for r in range(b):
+            nblk = -(-int(starts[r] + 1) // bs)
+            ids[r, nblk:] = nb - 1
+    return jnp.asarray(ids)
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2), (8, 2)])
+@pytest.mark.parametrize("window", [0, 11])
+def test_fused_matches_gather_oracle_gqa_window(hq, hkv, window):
+    """Randomized tables + ragged depths + GQA ratios + windows: the
+    fused kernel equals the gather oracle to f32 roundoff at every
+    REAL query slot."""
+    rng = np.random.RandomState(hq * 31 + hkv * 7 + window)
+    b, t, hd, bs, m, nb = 4, 3, 16, 8, 7, 32
+    q = jnp.asarray(rng.randn(b, t, hq, hd), jnp.float32)
+    k_pool, v_pool, _, _ = _pool(rng, nb, bs, hkv, hd)
+    start = jnp.asarray(rng.randint(0, m * bs - t, size=b), jnp.int32)
+    table = _random_table(rng, b, m, nb, share_rows=True)
+    ref = paged_decode_attention(
+        q, k_pool, v_pool, table, start, window=window
+    )
+    got = fused_paged_decode_attention(
+        q, k_pool, v_pool, table, start, window=window
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), **TOL)
+
+
+def test_fused_matches_gather_oracle_int8_scales():
+    """int8 K/V with per-(block, position, head) scales: the per-block
+    dequant is bitwise the oracle's gathered dequant."""
+    rng = np.random.RandomState(5)
+    b, t, hq, hkv, hd, bs, m, nb = 3, 2, 4, 2, 8, 4, 6, 24
+    q = jnp.asarray(rng.randn(b, t, hq, hd), jnp.float32)
+    k8, v8, ks, vs = _pool(rng, nb, bs, hkv, hd, quantized=True)
+    start = jnp.asarray([1, 9, 17], jnp.int32)
+    table = _random_table(rng, b, m, nb)
+    ref = paged_decode_attention(
+        q, k8, v8, table, start, k_scale=ks, v_scale=vs
+    )
+    got = fused_paged_decode_attention(
+        q, k8, v8, table, start, k_scale=ks, v_scale=vs
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), **TOL)
+
+
+def test_stale_table_tail_entries_are_never_read():
+    """The valid-block mask + scratch redirect make the output BITWISE
+    independent of what unmapped tail entries point at — in-range
+    aliases of other rows' blocks and out-of-range garbage alike (the
+    tightened gather_kv_blocks contract, enforced kernel-side)."""
+    rng = np.random.RandomState(9)
+    b, t, hq, hkv, hd, bs, m, nb = 3, 2, 4, 2, 8, 8, 8, 32
+    q = jnp.asarray(rng.randn(b, t, hq, hd), jnp.float32)
+    k_pool, v_pool, _, _ = _pool(rng, nb, bs, hkv, hd)
+    starts = np.asarray([3, 20, 33])
+    clean = np.asarray(_random_table(
+        rng, b, m, nb, scratch_tails=True, starts=starts, bs=bs
+    ))
+    stale = clean.copy()
+    for r in range(b):
+        nblk = -(-int(starts[r] + t) // bs)
+        # stale tails: other rows' live blocks AND out-of-range ids
+        stale[r, nblk:] = rng.randint(0, nb + 50, size=m - nblk)
+    start = jnp.asarray(starts, jnp.int32)
+    out_clean = np.asarray(fused_paged_decode_attention(
+        q, k_pool, v_pool, jnp.asarray(clean), start
+    ))
+    out_stale = np.asarray(fused_paged_decode_attention(
+        q, k_pool, v_pool, jnp.asarray(stale), start
+    ))
+    np.testing.assert_array_equal(out_clean, out_stale)
+
+
+def test_lse_merge_of_prefix_suffix_split_is_exact():
+    """The Hydragen combination rule: partials over slots [0, s) and
+    [s, hi) merged via log-sum-exp equal the unsplit loop bitwise-close
+    and the oracle to roundoff — at EVERY split point, including the
+    degenerate s=0 and s=hi ends."""
+    rng = np.random.RandomState(13)
+    b, t, hq, hkv, hd, bs, m, nb = 3, 2, 4, 2, 8, 4, 6, 20
+    q = jnp.asarray(rng.randn(b, t, hq, hd), jnp.float32)
+    k_pool, v_pool, _, _ = _pool(rng, nb, bs, hkv, hd)
+    start = jnp.asarray([7, 15, 22], jnp.int32)
+    table = _random_table(rng, b, m, nb, share_rows=True)
+    n_blocks = jnp.clip(-(-(start + t) // bs), 1, m)
+    hi = jnp.max(n_blocks)
+    ref = np.asarray(paged_decode_attention(q, k_pool, v_pool, table, start))
+    full = paged_attention_partials(
+        q, k_pool, v_pool, table, start, 0, hi, n_blocks
+    )
+    out_full = np.asarray(finalize_attention_partials(full, q.dtype))
+    for s in range(int(hi) + 1):
+        s_ = jnp.int32(s)
+        prefix = paged_attention_partials(
+            q, k_pool, v_pool, table, start, 0, s_, n_blocks
+        )
+        suffix = paged_attention_partials(
+            q, k_pool, v_pool, table, start, s_, hi, n_blocks
+        )
+        merged = merge_attention_partials(prefix, suffix)
+        out = np.asarray(finalize_attention_partials(merged, q.dtype))
+        np.testing.assert_allclose(out, out_full, **TOL)
+        np.testing.assert_allclose(out, ref, **TOL)
+
+
+def test_hydragen_shared_prefix_decomposition_matches_oracle():
+    """Rows aliasing the same leading blocks: the batched-queries prefix
+    partials (each shared block read ONCE) + per-row suffix + LSE merge
+    equal the oracle; shared_blocks=0 equals the plain fused loop in the
+    same code path (the no-shared-run fall-through)."""
+    rng = np.random.RandomState(21)
+    b, t, hq, hkv, hd, bs, m, nb = 4, 2, 4, 2, 8, 4, 6, 32
+    q = jnp.asarray(rng.randn(b, t, hq, hd), jnp.float32)
+    for quant in (False, True):
+        k_pool, v_pool, ks, vs = _pool(rng, nb, bs, hkv, hd, quant)
+        table = _random_table(rng, b, m, nb, share_rows=True)
+        # every row deep enough to cover the shared run (m//2 blocks)
+        start = jnp.asarray(rng.randint(m // 2 * bs, m * bs - t, size=b),
+                            jnp.int32)
+        kw = dict(k_scale=ks, v_scale=vs) if quant else {}
+        ref = paged_decode_attention(q, k_pool, v_pool, table, start, **kw)
+        got = fused_paged_decode_attention(
+            q, k_pool, v_pool, table, start,
+            shared_blocks=jnp.int32(m // 2), shared_table=table[0], **kw,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), err_msg=f"quant={quant}",
+            **TOL,
+        )
+        plain = fused_paged_decode_attention(
+            q, k_pool, v_pool, table, start, **kw
+        )
+        zero = fused_paged_decode_attention(
+            q, k_pool, v_pool, table, start,
+            shared_blocks=jnp.int32(0), shared_table=table[0], **kw,
+        )
+        np.testing.assert_array_equal(np.asarray(zero), np.asarray(plain))
+
+
+def test_shared_prefix_partials_mask_shallow_rows():
+    """A row whose depth does NOT reach into the shared run (its q_pos
+    sits below some shared positions) sees those positions masked in
+    the prefix partials exactly as the per-row loop would — the split
+    never leaks future keys into a shallow row."""
+    rng = np.random.RandomState(3)
+    b, t, hq, hkv, hd, bs, m, nb = 3, 1, 4, 2, 8, 4, 4, 16
+    q = jnp.asarray(rng.randn(b, t, hq, hd), jnp.float32)
+    k_pool, v_pool, _, _ = _pool(rng, nb, bs, hkv, hd)
+    table = _random_table(rng, b, m, nb, share_rows=True)
+    # row 1's depth ends INSIDE shared block 1; row 2 before block 1
+    start = jnp.asarray([2 * bs + 1, bs + 1, 2], jnp.int32)
+    n_blocks = jnp.clip(-(-(start + t) // bs), 1, m)
+    prefix = shared_prefix_attention_partials(
+        q, k_pool, v_pool, table[0], jnp.int32(2), start, n_blocks
+    )
+    per_row = paged_attention_partials(
+        q, k_pool, v_pool, table, start, 0, jnp.int32(2), n_blocks
+    )
+    for a, bb in zip(prefix, per_row):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bb), rtol=1e-6, atol=1e-7
+        )
+
+
+def test_fused_under_jit_with_traced_operands():
+    """shared_blocks / n_blocks / start are VALUES, not compile keys:
+    one jitted wrapper serves every run length and depth (the engine's
+    one-compiled-program contract rides exactly this)."""
+    rng = np.random.RandomState(17)
+    b, t, hq, hkv, hd, bs, m, nb = 3, 2, 4, 2, 8, 4, 6, 24
+    q = jnp.asarray(rng.randn(b, t, hq, hd), jnp.float32)
+    k_pool, v_pool, _, _ = _pool(rng, nb, bs, hkv, hd)
+    table = _random_table(rng, b, m, nb, share_rows=True)
+
+    @jax.jit
+    def run(start, sb):
+        return fused_paged_decode_attention(
+            q, k_pool, v_pool, table, start,
+            shared_blocks=sb, shared_table=table[0],
+        )
+
+    for depth, sb in ((13, 0), (17, 1), (22, 3)):
+        start = jnp.asarray([depth, depth + 1, depth + 2], jnp.int32)
+        ref = paged_decode_attention(q, k_pool, v_pool, table, start)
+        got = run(start, jnp.int32(sb))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), err_msg=f"sb={sb}", **TOL
+        )
+    assert run._cache_size() == 1

@@ -739,3 +739,71 @@ def test_sanitizer_install_wraps_and_audits_stub_engine():
             # for the rest of the session
             assert sanitizers.uninstall()
             assert not sanitizers.uninstall()  # already restored
+
+
+def test_recompile_audit_fused_hydragen_one_program_on_mesh():
+    """Round-8 regression probe for the fused/prefix dispatch: on the
+    8-device mesh, a paged engine running the FUSED attention path with
+    the Hydragen shared-prefix decomposition engaged still compiles
+    exactly ONE decode and ONE insert program. The wave's shared-run
+    length and aliased block ids enter the dispatch as traced OPERANDS
+    (minted on the cache mesh like every other host-built array), so a
+    new run length — including 0, the no-shared-run waves — is a new
+    operand value, never a new compile key. A per-wave compile key here
+    is precisely the regression the PR 7 sanitizer exists to catch."""
+    from types import SimpleNamespace
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from nexus_tpu.runtime.serving import ServeRequest, ServingEngine
+    from nexus_tpu.testing import sanitizers
+
+    devs = jax.devices()
+    assert len(devs) == 8, "conftest forces 8 host-platform devices"
+    mesh = Mesh(devs, ("d",))
+    v = 11
+    cfg = SimpleNamespace(
+        n_layers=1, n_kv_heads=1, head_dim=8, dtype=jnp.float32,
+        max_seq_len=256, vocab_size=v,
+    )
+
+    def fwd(params, cfg_, tokens, cache):
+        logits = jax.nn.one_hot((tokens + 1) % v, v) * 10.0
+        new = {
+            k: x for k, x in cache.items()
+            if k not in ("n_valid", "shared_blocks", "shared_table")
+        }
+        nv = cache.get("n_valid")
+        adv = tokens.shape[1] if nv is None else nv
+        new["length"] = cache["length"] + adv
+        return logits.astype(jnp.float32), new
+
+    eng = ServingEngine(
+        fwd, {}, cfg, batch_size=4, max_len=128, chunk=4,
+        kv_block_size=4, prefix_cache=True, attention_path="fused",
+        cache_sharding=NamedSharding(mesh, P()),
+    )
+    # same 12-token preamble, distinct tails: prefix-cache hits alias the
+    # leading physical blocks, so decode waves carry a shared run whose
+    # length varies as rows churn (plus shared-run-0 waves around them)
+    preamble = [1, 2, 3, 4, 5, 6, 7, 8, 1, 2, 3, 4]
+    reqs = [
+        ServeRequest(prompt=preamble + [9 + (i % 2), 10], max_new_tokens=6)
+        for i in range(8)
+    ]
+    results, metrics = eng.serve(reqs)
+    assert all(len(r.tokens) > len(reqs[i].prompt)
+               for i, r in enumerate(results))
+    assert metrics["attention_path"] == "fused"
+    assert metrics["hydragen_waves"] >= 1, (
+        "the shared-preamble queue must actually engage the Hydragen "
+        "decomposition for this probe to mean anything"
+    )
+    counts = sanitizers.jit_program_counts(eng)
+    assert counts["_decode_chunk"] == 1, counts
+    assert counts["_insert_fn"] == 1, counts
+    # the audit's bound=1 is the steady-state contract — it must hold
+    # with the fused/prefix dispatch live, shared-run lengths and all
+    sanitizers.audit_recompiles(eng, bound=1)

@@ -291,7 +291,8 @@ def test_paged_layout_greedy_parity_across_block_sizes():
     ]
     for kw in (
         {"kv_block_size": 0},                       # dense baseline
-        {"kv_block_size": 8},                       # many blocks/row
+        {"kv_block_size": 8},                       # many blocks/row (fused)
+        {"kv_block_size": 8, "attention_path": "gather"},  # r6 oracle path
         {"kv_block_size": 8, "kv_num_blocks": 5},   # admission-throttled
         {"kv_block_size": 64},                      # one block per row
     ):
@@ -425,12 +426,15 @@ def test_serving_sampled_requests_are_batch_invariant():
 
 
 def test_prefix_cache_invisible_to_results_all_tiers():
-    """Round-6 acceptance: cross-request KV reuse is pure scheduling —
-    the same queue (shared system prompt, a block-aligned full
-    duplicate that exercises copy-on-write, an unshared control, and a
-    sampled request) through prefix-on and prefix-off engines commits
-    IDENTICAL tokens across the fp, int8-KV, and speculative tiers, and
-    the fp tier also equals the isolated greedy decode."""
+    """Round-6 + round-8 acceptance: cross-request KV reuse AND the
+    attention data path are pure scheduling/implementation — the same
+    queue (shared system prompt, a block-aligned full duplicate that
+    exercises copy-on-write, an unshared control, and a sampled
+    request) commits IDENTICAL tokens across the fp, int8-KV, and
+    speculative tiers through every engine variant: fused block-table
+    kernel (+ Hydragen) and gather oracle, each with the prefix cache
+    on and off, plus the dense layout — and the fp tier also equals the
+    isolated greedy decode."""
     rng = np.random.RandomState(23)
     common = rng.randint(0, 256, size=16).tolist()
     reqs = []
@@ -457,28 +461,44 @@ def test_prefix_cache_invisible_to_results_all_tiers():
         ("spec", tiny_cfg(), reqs,
          {"lookup_ngram": 2, "num_speculative": 3, "chunk": 5}),
     ]
+    variants = [
+        ("fused", True), ("fused", False),
+        ("gather", True), ("gather", False),
+        ("dense", False),
+    ]
     for name, cfg, queue, kw in tiers:
         params = llama.init(jax.random.PRNGKey(0), cfg)
         outs = {}
         metrics = {}
-        for pc in (False, True):
+        for path, pc in variants:
+            eng_kw = (
+                dict(kv_block_size=0) if path == "dense"
+                else dict(kv_block_size=8, prefix_cache=pc,
+                          attention_path=path)
+            )
             engine = ServingEngine(
                 llama.forward_decode, params, cfg, batch_size=2,
-                max_len=64, chunk=kw.get("chunk", 4), kv_block_size=8,
-                prefix_cache=pc,
+                max_len=64, chunk=kw.get("chunk", 4), **eng_kw,
                 **{k: v for k, v in kw.items() if k != "chunk"},
             )
-            results, metrics[pc] = engine.serve(queue)
-            outs[pc] = [r.tokens for r in results]
-        assert outs[False] == outs[True], f"tier {name}"
-        on = metrics[True]
-        assert on["prefix_hit_tokens"] > 0, f"tier {name}"
-        assert on["prefix_cow_copies"] >= 1, f"tier {name}"
-        assert on["prefill_steps"] < metrics[False]["prefill_steps"], (
-            f"tier {name}"
+            results, metrics[(path, pc)] = engine.serve(queue)
+            outs[(path, pc)] = [r.tokens for r in results]
+        base = outs[("fused", True)]
+        for key, toks in outs.items():
+            assert toks == base, f"tier {name}: variant {key} diverges"
+        for path in ("fused", "gather"):
+            on = metrics[(path, True)]
+            assert on["prefix_hit_tokens"] > 0, f"tier {name} {path}"
+            assert on["prefix_cow_copies"] >= 1, f"tier {name} {path}"
+            assert on["prefill_steps"] < metrics[(path, False)][
+                "prefill_steps"
+            ], f"tier {name} {path}"
+        assert metrics[("fused", True)].get("hydragen_waves", 0) >= 1, (
+            f"tier {name}: the shared-preamble queue must engage the "
+            "Hydragen decomposition on the fused path"
         )
         if name == "fp":
-            for req, toks in zip(queue, outs[True]):
+            for req, toks in zip(queue, outs[("fused", True)]):
                 if req.temperature > 0:
                     continue
                 ref = llama.generate(
