@@ -3,7 +3,7 @@ SHELL := /bin/bash
 NATIVE_SRC := nexus_tpu/native/src/nexus_core.cpp nexus_tpu/native/src/nexus_data.cpp
 NATIVE_LIB := nexus_tpu/native/libnexus_core.so
 
-.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-failover bench-serve-outage chaos-smoke serve-smoke serve-chaos-smoke serve-sanitize-smoke race-smoke clean lint nexuslint analyze
+.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-failover bench-serve-outage chaos-smoke serve-smoke serve-chaos-smoke serve-sanitize-smoke radix-smoke race-smoke clean lint nexuslint analyze
 
 all: native
 
@@ -74,6 +74,20 @@ serve-chaos-smoke:
 # tiers stay in test_serving.py's compile-bound lane).
 serve-smoke:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_paged_kv.py tests/test_prefix_cache.py tests/test_property_prefix_cache.py -q
+
+# Radix prefix-tree smoke (fast lane, stub-model, seconds on CPU): the
+# round-9 tree + scheduling units — radix insert/split/match/leaf-first
+# eviction invariants, admission-policy ordering and aging, the
+# multi-turn completion-chain and cache-aware engine tiers, and the
+# property drivers (match == longest-common-prefix oracle, partition
+# exactness) — run with the runtime sanitizers ARMED, so the tree's
+# structural audit (runs/accelerator agreement, parked ⊆ indexed,
+# descendant closure) executes at every admission wave and engine
+# teardown in the lane. Wired into the CI fast job; the unarmed run of
+# the same modules already rides `pytest -m "not slow"`.
+radix-smoke:
+	NEXUS_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
+	  tests/test_prefix_cache.py tests/test_property_prefix_cache.py -q
 
 # Fused block-table attention smoke (fast lane, deterministic — every
 # test seeds its own RandomState): the round-8 kernel's parity tests

@@ -970,6 +970,168 @@ def _serve_row_scaling_ab(preset, progress, block, chunk, pf,
     return out
 
 
+def _serve_radix_scenarios(preset, progress, block, chunk):
+    """Radix-tree prefix-cache scenarios (round 9): the two traffic
+    shapes ROADMAP names that the round-6 single-chain matcher mostly
+    misses, A/B'd against it on IDENTICAL queues.
+
+    * MULTI-TURN (`multi_turn_*`): 8 two-turn conversations. Turn-1
+      prompts are 12 tokens — SUB-BLOCK at the default block 16, so the
+      round-6 matcher (prompt-only registration, emulated with
+      ``prefix_completions=False`` + fifo) can register nothing a
+      successor could ever match: its turn-2 hit count is exactly 0.
+      The radix tree registers each turn-1 row's DECODED blocks at
+      release, so turn 2 (prompt = turn-1's full prompt + completion +
+      a 12-token user message) matches the whole prior chain. Varied
+      turn-1 budgets (24..56) spread the matches across tree depths
+      2..4 — the `multi_turn_radix_hit_depth_hist` ledger.
+
+    * BRANCHING (`branching_*`): 4 independent conversations, each
+      fanned out by 3 follow-ups that share their root's FULL 72-token
+      history and diverge only in their 12-token user tails — the tree
+      splits at each branch point and the siblings share the 4-block
+      history run physically (depth-4 hits for every branch). The
+      single-chain matcher sees only each root's one full PROMPT block
+      (16 tokens) until some sibling has re-prefilled the history and
+      registered it as ITS prompt — so a whole concurrent sibling wave
+      misses (and duplicates the history prefill) per family, which is
+      exactly the fan-out cost ChunkAttention's prefix-tree dedup
+      removes. The prefill-step contrast rides along.
+
+    Turn-1 completions are PRECOMPUTED with the model's own greedy
+    decode so successor prompts are exactly what a chat client would
+    send back; every request is greedy and each scenario re-serves its
+    queue through a cache-OFF engine asserting token-identical results
+    (`radix_exact`) — hits are scheduling, never semantics.
+
+    Keys (artifact: docs/bench_serve_r<N>.json): per-scenario radix vs
+    single-chain hit tokens + the gain, hit rate (hit tokens / prompt
+    tokens), completion blocks registered, hit-count-by-tree-depth
+    histograms, and `radix_exact`."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from nexus_tpu.models import llama
+        from nexus_tpu.runtime.serving import ServeRequest, ServingEngine
+        from nexus_tpu.utils.hw import is_tpu
+
+        dtype = jnp.bfloat16 if is_tpu() else jnp.float32
+        cfg = llama.config(preset, dtype=dtype, max_seq_len=256)
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+    except Exception as e:  # noqa: BLE001 — harness must not kill bench
+        progress(f"radix scenarios unavailable: {type(e).__name__}: "
+                 f"{str(e)[:160]}")
+        return {}
+
+    def greedy(prompt, n):
+        out = llama.generate(
+            params, cfg, jnp.asarray(prompt, jnp.int32)[None, :],
+            max_new_tokens=n,
+        )
+        return np.array(out[0]).tolist()
+
+    rng = np.random.RandomState(90)
+
+    def multi_turn_queue():
+        budgets = [24, 32, 40, 48, 56, 24, 32, 40]
+        reqs, late = [], []
+        for b in budgets:
+            p1 = rng.randint(0, cfg.vocab_size, size=12).tolist()
+            full1 = greedy(p1, b)
+            p2 = full1 + rng.randint(0, cfg.vocab_size, size=12).tolist()
+            reqs.append(ServeRequest(prompt=p1, max_new_tokens=b))
+            late.append(ServeRequest(prompt=p2, max_new_tokens=24))
+        return reqs + late  # turn 2 arrives after turn 1, like a chat
+
+    def branching_queue():
+        # 4 roots serve first (one admission wave at batch 4, nothing
+        # shared between them), release, and register their chains;
+        # branch waves then arrive interleaved ACROSS families, so the
+        # first sibling wave of every family admits concurrently — the
+        # shape where the single-chain matcher has nothing deeper than
+        # each root's lone prompt block to offer
+        roots, fams = [], []
+        for _ in range(4):
+            root = rng.randint(0, cfg.vocab_size, size=24).tolist()
+            full = greedy(root, 48)
+            roots.append(ServeRequest(prompt=root, max_new_tokens=48))
+            branches = []
+            for _ in range(3):
+                tail = rng.randint(0, cfg.vocab_size, size=12).tolist()
+                branches.append(ServeRequest(prompt=full + tail,
+                                             max_new_tokens=24))
+            fams.append(branches)
+        return roots + [fams[f][i] for i in range(3) for f in range(4)]
+
+    out = {}
+    exact = True
+    for name, queue in (("multi_turn", multi_turn_queue()),
+                        ("branching", branching_queue())):
+        prompt_tokens = sum(len(r.prompt) for r in queue)
+        toks = {}
+        for mode in ("radix", "single", "off"):
+            kw = dict(kv_block_size=block)
+            if mode == "single":
+                kw.update(admission_policy="fifo",
+                          prefix_completions=False)
+            elif mode == "off":
+                kw.update(prefix_cache=False)
+            try:
+                eng = ServingEngine(
+                    llama.forward_decode, params, cfg, batch_size=4,
+                    max_len=256, chunk=chunk, prefill_chunk=1, **kw,
+                )
+                results, m = eng.serve(queue)
+            except Exception as e:  # noqa: BLE001
+                progress(f"radix scenario {name}/{mode} failed: "
+                         f"{type(e).__name__}: {str(e)[:160]}")
+                # never ship hit numbers without an exactness verdict:
+                # a missing key reads as a clean run, an explicit False
+                # does not
+                out["radix_exact"] = False
+                return out
+            toks[mode] = [r.tokens for r in results]
+            if mode == "off":
+                continue
+            tag = f"{name}_{mode}"
+            hits = int(m.get("prefix_hit_tokens") or 0)
+            out[f"{tag}_hit_tokens"] = hits
+            out[f"{tag}_hit_rate"] = round(hits / prompt_tokens, 3)
+            out[f"{tag}_hit_depth_hist"] = {
+                str(k): v
+                for k, v in (m.get("prefix_hit_depth_hist") or {}).items()
+            }
+            out[f"{tag}_completion_blocks"] = int(
+                m.get("prefix_completion_blocks") or 0
+            )
+            out[f"{tag}_prefill_steps"] = int(
+                m.get("prefill_steps") or 0
+            )
+            if mode == "radix":
+                out[f"{name}_admission_overtakes"] = int(
+                    m.get("admission_overtakes") or 0
+                )
+        if not (toks["radix"] == toks["single"] == toks["off"]):
+            exact = False
+            progress(f"radix scenario {name}: EXACTNESS VIOLATION — "
+                     "cache-on tokens diverge from cache-off")
+        out[f"{name}_hit_token_gain"] = (
+            out[f"{name}_radix_hit_tokens"]
+            - out[f"{name}_single_hit_tokens"]
+        )
+        progress(
+            f"radix scenario {name}: radix "
+            f"{out[f'{name}_radix_hit_tokens']} hit tokens "
+            f"(rate {out[f'{name}_radix_hit_rate']}, depths "
+            f"{out[f'{name}_radix_hit_depth_hist']}) vs single-chain "
+            f"{out[f'{name}_single_hit_tokens']}"
+        )
+    out["radix_exact"] = exact
+    return out
+
+
 def _serve_only_stage(progress):
     """Serve-only stage (`make bench-serve`, NEXUS_BENCH_SERVE=only):
     the paged-KV ledger and the row-scaling point, CPU-runnable — the
@@ -1111,6 +1273,13 @@ def _serve_only_stage(progress):
             off.get("ttft_p50_s", 0.0)
             / max(1e-9, on.get("ttft_p50_s", 1e-9)), 3,
         )
+    # ---- radix-tree scenarios (round 9): multi-turn + branching-prefix
+    # traffic, radix vs the round-6 single-chain matcher on identical
+    # queues, hit rate by tree depth — the tentpole's acceptance ledger
+    if os.environ.get("NEXUS_BENCH_SERVE_RADIX", "1") not in (
+        "0", "false"
+    ):
+        out.update(_serve_radix_scenarios(preset, progress, block, chunk))
     # ---- outage leg (round 7): kill-mid-decode → detector → requeue →
     # token-identical recovery, plus bounded-queue shed honesty — its
     # time-to-recover / requests-lost keys ride the per-round artifact
@@ -1156,7 +1325,9 @@ def _write_serve_artifact(sv):
         "vs_baseline": round(red / 2.0, 3),
     }
     for k, v in sv.items():
-        if isinstance(v, (int, float, str, bool)) or v is None:
+        # dicts carry the round-9 hit-rate-by-tree-depth histograms
+        # (int keys become JSON strings — fine for the artifact)
+        if isinstance(v, (int, float, str, bool, dict)) or v is None:
             rec.setdefault(k, v)
     try:
         with open(path, "w") as f:

@@ -484,6 +484,18 @@ class ServeSpec:
     # reference (materializes the full virtual view each step — the A/B
     # baseline `bench-serve` measures). Token-for-token identical.
     attention_path: str = "fused"
+    # wait-queue admission ordering (runtime/scheduling.py, round 9):
+    # "cache-aware" (default) admits the request with the longest
+    # prefix match RESIDENT in the radix prefix cache first — parked
+    # preambles convert to hits before eviction and same-subtree
+    # requests stay together — with FIFO aging so nothing starves;
+    # "fifo" is strict arrival order (the pre-round-9 behavior and the
+    # A/B baseline). Token-for-token identical either way (ordering is
+    # scheduling, never semantics).
+    admission_policy: str = "cache-aware"
+    # admission waves a request may be passed over before it outranks
+    # every fresher arrival (the cache-aware starvation bound)
+    admission_aging_waves: int = 8
     # ---- serve-plane fault tolerance (round 7) ----
     # bounded wait queue: past this depth the LOWEST-priority queued
     # requests shed with an explicit `shed` status instead of queuing
@@ -587,6 +599,10 @@ class ServeSpec:
             d["sharedPrefixLength"] = self.shared_prefix_length
         if self.attention_path != "fused":
             d["attentionPath"] = self.attention_path
+        if self.admission_policy != "cache-aware":
+            d["admissionPolicy"] = self.admission_policy
+        if self.admission_aging_waves != 8:
+            d["admissionAgingWaves"] = self.admission_aging_waves
         if self.max_queue_depth:
             d["maxQueueDepth"] = self.max_queue_depth
         if self.max_queue_delay_s:
@@ -611,6 +627,13 @@ class ServeSpec:
             ),
             shared_prefix_length=int(d.get("sharedPrefixLength", 0) or 0),
             attention_path=str(d.get("attentionPath") or "fused"),
+            admission_policy=str(
+                d.get("admissionPolicy") or "cache-aware"
+            ),
+            admission_aging_waves=int(
+                8 if d.get("admissionAgingWaves") is None
+                else d["admissionAgingWaves"]
+            ),
             max_queue_depth=int(d.get("maxQueueDepth", 0) or 0),
             max_queue_delay_s=float(d.get("maxQueueDelaySeconds", 0) or 0),
             request_deadline_s=float(
@@ -1067,6 +1090,19 @@ class JaxXlaRuntime:
                     "kernel + Hydragen shared-prefix decomposition) or "
                     "'gather' (the reference oracle), got "
                     f"{sv.attention_path!r}"
+                )
+            if sv.admission_policy not in ("fifo", "cache-aware"):
+                errs.append(
+                    "serve.admissionPolicy must be 'cache-aware' "
+                    "(longest-resident-prefix-match-first with FIFO "
+                    "aging) or 'fifo' (strict arrival order), got "
+                    f"{sv.admission_policy!r}"
+                )
+            if sv.admission_aging_waves < 1:
+                errs.append(
+                    "serve.admissionAgingWaves must be >= 1 (the "
+                    "cache-aware starvation bound), got "
+                    f"{sv.admission_aging_waves}"
                 )
             if sv.shared_prefix_length < 0:
                 errs.append(

@@ -950,6 +950,8 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None):
             max_queue_depth=sv.max_queue_depth,
             max_queue_delay_s=sv.max_queue_delay_s,
             attention_path=sv.attention_path,
+            admission_policy=sv.admission_policy,
+            admission_aging_waves=sv.admission_aging_waves,
         )
         results, metrics = engine.serve(
             requests, cancel=cancel, heartbeat=heartbeat,

@@ -1,36 +1,48 @@
-"""Content-addressed prefix index for the paged serving KV cache.
+"""Content-addressed RADIX-TREE prefix index for the paged serving KV
+cache.
 
-Cross-request KV reuse (round 6): heavy serving queues are dominated by
-shared prompt prefixes — system prompts, few-shot preambles, multi-turn
-histories — and the paged block pool (runtime/serving.py) already stores
-K/V at block granularity, so a block whose positions hold the K/V of a
-known token prefix can back ANY row whose prompt starts with those
-tokens. This module is the host-side content index that makes blocks
-addressable by what they contain:
+Cross-request KV reuse (round 6) made blocks addressable by content:
+``chain_keys`` maps a prompt to one SHA-256 hash-chain digest per FULL
+block, and the index maps digests to pool block ids so admission can map
+already-written blocks into a new row. Round 9 upgrades the index from a
+flat digest→block dict with a flat LRU to a **radix tree over block
+digests** (SGLang RadixAttention / ChunkAttention, PAPERS.md):
 
-  * ``chain_keys`` maps a prompt to one SHA-256 hash-chain digest per
-    FULL block (digest j commits to every token of blocks 0..j, so key
-    equality implies whole-prefix equality — the prefix property radix
-    trees encode structurally, here as a flat dict);
-  * ``PrefixCacheIndex`` maps digest → pool block id for blocks whose
-    K/V has been fully written, and keeps the refcount-0 subset in LRU
-    order so the allocator can reclaim cold cached content under pool
-    pressure — and ONLY then (eviction never touches a referenced
-    block; the ref-counted BlockAllocator in runtime/serving.py owns
-    the refcounts, this index owns content identity and LRU order).
+  * interior nodes hold block RUNS shared by multiple chains (N few-shot
+    variants of one system prompt share the preamble run physically;
+    the tree splits a run exactly where chains diverge);
+  * leaves carry the park/LRU state, and eviction is **leaf-first**: a
+    block is reclaimable only when no cached descendant depends on it,
+    so a hot interior run outlives its cold tails — the flat LRU could
+    evict a shared ancestor and strand every descendant unmatchable;
+  * ``match()`` walks the tree and returns the longest cached prefix
+    for ANY branching point — including chains extended past a prompt
+    by COMPLETION blocks (runtime/serving.py registers decoded blocks
+    at row release), which is what lets a multi-turn successor (prompt
+    = a prior request's full prompt + completion) hit the prior turn's
+    whole chain.
 
-The K/V of prompt position i is a function of tokens 0..i alone, and the
-serving engine writes each prompt position exactly once (chunked prefill
-is append-only; done-row holding writes land past the prompt), so an
-indexed block is FROZEN — sharing it is pure bookkeeping and the
-engine's exactness contract carries over unchanged (tested:
-tests/test_prefix_cache.py, tests/test_serving.py)."""
+Digest chaining already gives each key the prefix property (key j
+commits to every token of blocks 0..j), so tree EDGES need no token
+payload: equality of the next digest is equality of the whole prefix.
+What the tree adds over the flat dict is the ancestry structure —
+parent-linked insert (an orphan whose ancestor was evicted is refused,
+never silently unmatchable), leaf-first eviction, and per-depth hit
+accounting.
+
+The K/V of prompt position i is a function of tokens 0..i alone, and
+the serving engine writes each registered position exactly once before
+publishing it, so an indexed block is FROZEN — sharing it is pure
+bookkeeping and the engine's exactness contract carries over unchanged
+(tested: tests/test_prefix_cache.py, tests/test_property_prefix_cache.py,
+tests/test_serving.py)."""
 
 from __future__ import annotations
 
 import hashlib
+import heapq
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -42,12 +54,12 @@ def chain_keys(
     ``tokens``: ``key[j] = sha256(key[j-1] || tokens[j*bs:(j+1)*bs])``.
 
     Chaining makes each key commit to the whole prefix through its
-    block, so a flat dict lookup per block walks the same structure a
-    radix tree would — and two prompts share key j iff they agree on
-    every token of blocks 0..j. The trailing partial block (if any) is
-    never keyed: only fully-written blocks are shareable. SHA-256, not
-    ``hash()``: a collision would silently serve one request another
-    request's K/V, so the digest must be cryptographic."""
+    block, so two prompts share key j iff they agree on every token of
+    blocks 0..j — the prefix property the radix tree's edges rely on.
+    The trailing partial block (if any) is never keyed: only
+    fully-written blocks are shareable. SHA-256, not ``hash()``: a
+    collision would silently serve one request another request's K/V,
+    so the digest must be cryptographic."""
     if block_size < 1:
         raise ValueError(f"block_size must be >= 1, got {block_size}")
     arr = np.asarray(tokens, dtype=np.int32)
@@ -63,22 +75,64 @@ def chain_keys(
     return keys
 
 
+class _RadixNode:
+    """One tree node: a RUN of consecutive (digest, block) pairs shared
+    by every chain through it, plus children keyed by the FIRST digest
+    of each child's run. The root is a sentinel with an empty run (all
+    chain roots are its children)."""
+
+    __slots__ = ("keys", "blocks", "children", "parent")
+
+    def __init__(self, parent: Optional["_RadixNode"] = None) -> None:
+        self.keys: List[bytes] = []
+        self.blocks: List[int] = []
+        self.children: Dict[bytes, "_RadixNode"] = {}
+        self.parent = parent
+
+
 class PrefixCacheIndex:
-    """digest → pool block id, plus the LRU set of refcount-0 holders.
+    """Radix tree over block digests, plus the LRU set of refcount-0
+    holders.
 
     A block is in exactly one of three states from the allocator's view:
     referenced (mapped by >= 1 row), PARKED (refcount 0 but content
-    retained here, LRU-evictable), or free (not indexed, on the free
-    list). This class tracks the digest mapping for every indexed block
-    and the parked subset in least-recently-used order; the allocator
-    drives the transitions (``park`` on last release, ``unpark`` on a
-    shared re-admission, ``evict_lru`` under pool pressure)."""
+    retained here, evictable), or free (not indexed, on the free list).
+    This class owns content identity, tree ancestry, and LRU order; the
+    ref-counted BlockAllocator (runtime/serving.py) owns the refcounts
+    and drives the transitions (``park`` on last release, ``unpark`` on
+    a shared re-admission, ``evict_lru`` under pool pressure).
+
+    Eviction is LEAF-FIRST: ``evict_lru`` reclaims the least-recently
+    -used parked block *that has no indexed descendant* (the tail of a
+    childless run). The allocator's usage keeps references
+    prefix-closed (a row mapping block j maps every ancestor of j), so
+    the parked set is always descendant-closed and a parked evictable
+    leaf exists whenever anything is parked at all — ``audit`` asserts
+    exactly that closure under NEXUS_SANITIZE."""
 
     def __init__(self) -> None:
-        self._by_key: Dict[bytes, int] = {}
+        self._root = _RadixNode()
+        # digest → (node, offset into the node's run); the O(1) walk
+        # accelerator and the parent-lookup for insert
+        self._by_key: Dict[bytes, Tuple[_RadixNode, int]] = {}
         self._by_block: Dict[int, bytes] = {}
         # refcount-0 indexed blocks, insertion order == LRU → MRU
         self._parked: "OrderedDict[int, None]" = OrderedDict()
+        # eviction accelerator: a min-heap of (park sequence, block)
+        # candidate EVICTABLE LEAVES with lazy invalidation, so
+        # evict_lru never linearly re-scans parked interior runs (a
+        # long parked chain's ancestors sit at the LRU head — a plain
+        # scan makes reclaiming an L-block chain Θ(L²)). Entries go
+        # stale when a block is unparked/re-parked (sequence mismatch)
+        # or gains a child (evictable() re-check at pop); a block
+        # parked while a descendant still holds references gets its
+        # entry pushed later, by the remove() that exposes it. The
+        # sequence number mirrors the OrderedDict's park order exactly,
+        # so victim choice is unchanged — audit() cross-checks that
+        # every parked evictable block has a live heap entry.
+        self._park_clock = 0
+        self._park_seq: Dict[int, int] = {}
+        self._leaf_heap: List[Tuple[int, int]] = []
 
     def __len__(self) -> int:
         return len(self._by_key)
@@ -87,31 +141,127 @@ class PrefixCacheIndex:
     def parked_count(self) -> int:
         return len(self._parked)
 
-    def put(self, key: bytes, block: int) -> bool:
-        """Publish ``block`` as the holder of ``key``'s content. No-op
-        (False) when the key is already indexed — first writer wins and
-        the duplicate block stays a plain private block — or when the
-        block already holds another key (one identity per block)."""
+    # ------------------------------------------------------------ insert
+
+    def insert(
+        self, key: bytes, block: int, parent: Optional[bytes] = None
+    ) -> bool:
+        """Publish ``block`` as the holder of ``key``'s content, attached
+        under ``parent`` (the preceding digest of its chain; None = a
+        chain root). No-op (False) when:
+
+          * the key is already indexed — first writer wins, the
+            duplicate block stays a plain private block;
+          * the block already holds another key (one identity per
+            block);
+          * ``parent`` is given but not indexed — the ancestor was
+            evicted, and an orphan that could never be reached by a
+            root walk must not enter the tree (the flat index used to
+            keep such orphans around, unmatchable, until LRU aged them
+            out).
+        """
         if key in self._by_key or block in self._by_block:
             return False
-        self._by_key[key] = block
+        if parent is None:
+            node: _RadixNode = self._root
+            off = -1
+        else:
+            loc = self._by_key.get(parent)
+            if loc is None:
+                return False
+            node, off = loc
+        if node is self._root:
+            # the root carries no run; every chain root is a child
+            child = _RadixNode(parent=node)
+            child.keys.append(key)
+            child.blocks.append(block)
+            node.children[key] = child
+            target, toff = child, 0
+        elif off == len(node.keys) - 1 and not node.children:
+            # path compression: extend the run in place
+            node.keys.append(key)
+            node.blocks.append(block)
+            target, toff = node, len(node.keys) - 1
+        elif off == len(node.keys) - 1:
+            # run end already branches: one more branch
+            child = _RadixNode(parent=node)
+            child.keys.append(key)
+            child.blocks.append(block)
+            node.children[key] = child
+            target, toff = child, 0
+        else:
+            # the chain diverges MID-run: split the node so the shared
+            # ancestors [..off] become an interior run and the old
+            # suffix + the new key become siblings
+            suffix = _RadixNode(parent=node)
+            suffix.keys = node.keys[off + 1 :]
+            suffix.blocks = node.blocks[off + 1 :]
+            suffix.children = node.children
+            for ch in suffix.children.values():
+                ch.parent = suffix
+            node.keys = node.keys[: off + 1]
+            node.blocks = node.blocks[: off + 1]
+            node.children = {suffix.keys[0]: suffix}
+            for i, k in enumerate(suffix.keys):
+                self._by_key[k] = (suffix, i)
+            child = _RadixNode(parent=node)
+            child.keys.append(key)
+            child.blocks.append(block)
+            node.children[key] = child
+            target, toff = child, 0
+        self._by_key[key] = (target, toff)
         self._by_block[block] = key
         return True
 
+    def put(
+        self, key: bytes, block: int, parent: Optional[bytes] = None
+    ) -> bool:
+        """Alias of :meth:`insert` (the round-6 flat-index name)."""
+        return self.insert(key, block, parent=parent)
+
+    # ------------------------------------------------------------- match
+
     def match(self, keys: Sequence[bytes]) -> List[int]:
-        """Longest indexed prefix of ``keys`` → the blocks holding it.
-        Stops at the first miss: a chain broken by eviction can never
-        resume mid-prefix (the orphaned descendants simply age out)."""
+        """Walk the tree from the root along ``keys`` → the blocks of
+        the longest cached prefix. Because digests chain, the walk stops
+        at the first divergence — whether that is a miss at a branch
+        point, mid-run, or simply the end of what is cached. Chains
+        extended by completion blocks match exactly like prompt chains
+        (the tree does not know the difference)."""
         blocks: List[int] = []
-        for key in keys:
-            blk = self._by_key.get(key)
-            if blk is None:
+        node = self._root
+        i = 0
+        while i < len(keys):
+            nxt = node.children.get(keys[i])
+            if nxt is None:
                 break
-            blocks.append(blk)
+            node = nxt
+            for j in range(len(node.keys)):
+                if i < len(keys) and node.keys[j] == keys[i]:
+                    blocks.append(node.blocks[j])
+                    i += 1
+                else:
+                    return blocks  # diverged mid-run / keys exhausted
         return blocks
 
     def holds(self, block: int) -> bool:
         return block in self._by_block
+
+    def holder(self, key: bytes) -> Optional[int]:
+        """The block currently holding ``key``'s content, or None. The
+        serving engine's registration guard uses this: a row may extend
+        the tree only under a parent digest held by the row's OWN block
+        — attaching a referenced block beneath ANOTHER lease's block
+        (duplicate-content race, CoW source) could leave a parked run
+        with referenced descendants, which breaks the descendant
+        closure that leaf-first eviction's progress relies on."""
+        loc = self._by_key.get(key)
+        if loc is None:
+            return None
+        node, off = loc
+        return node.blocks[off]
+
+    # -------------------------------------------------------- park / LRU
 
     def park(self, block: int) -> None:
         """Last reference dropped: retain the content, join the LRU tail
@@ -120,10 +270,16 @@ class PrefixCacheIndex:
             raise ValueError(f"block {block} is not indexed")
         self._parked[block] = None
         self._parked.move_to_end(block)
+        self._park_clock += 1
+        self._park_seq[block] = self._park_clock
+        if self.evictable(block):
+            heapq.heappush(self._leaf_heap, (self._park_clock, block))
 
     def unpark(self, block: int) -> None:
         """A parked block is being re-referenced (shared admission)."""
         self._parked.pop(block, None)
+        # any heap entry goes stale by sequence mismatch
+        self._park_seq.pop(block, None)
 
     def parked_blocks(self) -> List[int]:
         """The refcount-0 indexed block ids in LRU → MRU order — the
@@ -139,19 +295,169 @@ class PrefixCacheIndex:
             )
         return list(self._parked)
 
+    # ----------------------------------------------------------- evict
+
+    def evictable(self, block: int) -> bool:
+        """True when ``block`` has no indexed descendant — it is the
+        tail of a childless run, so removing it cannot strand a cached
+        chain (leaf-first eviction's unit test)."""
+        key = self._by_block.get(block)
+        if key is None:
+            return False
+        node, off = self._by_key[key]
+        return off == len(node.keys) - 1 and not node.children
+
+    def remove(self, block: int) -> None:
+        """Remove an indexed LEAF block from the tree: drop its digest
+        so it can never match again. Refuses (RuntimeError) to remove a
+        block with indexed descendants — interior runs must outlive
+        their tails by construction, never by caller discipline."""
+        key = self._by_block.get(block)
+        if key is None:
+            raise ValueError(f"block {block} is not indexed")
+        node, off = self._by_key[key]
+        if off != len(node.keys) - 1 or node.children:
+            raise RuntimeError(
+                f"block {block} still has cached descendants — "
+                "leaf-first eviction must reclaim the tails first"
+            )
+        node.keys.pop()
+        node.blocks.pop()
+        del self._by_key[key]
+        del self._by_block[block]
+        self._parked.pop(block, None)
+        self._park_seq.pop(block, None)
+        exposed: Optional[_RadixNode] = None
+        if not node.keys and node.parent is not None:
+            # the run emptied: unlink the node (its first — only — key
+            # was `key`, which is how the parent indexed it)
+            del node.parent.children[key]
+            exposed = node.parent
+        elif node.keys:
+            exposed = node
+        # the removal may expose a NEW evictable leaf (the run's new
+        # tail, or the parent's tail once its last child unlinks) — if
+        # that block is parked, (re)arm its heap entry at its original
+        # park sequence so eviction order stays exactly park-LRU
+        if (exposed is not None and exposed.parent is not None
+                and exposed.keys and not exposed.children):
+            tail = exposed.blocks[-1]
+            seq = self._park_seq.get(tail)
+            if seq is not None:
+                heapq.heappush(self._leaf_heap, (seq, tail))
+
     def evict_lru(self) -> int:
-        """Reclaim the least-recently-used PARKED block: drop its digest
-        so it can never match again, return it for reallocation. Only
-        refcount-0 blocks are ever parked, so eviction can never touch a
-        block some row still reads — the allocator calls this only when
-        its free list is empty (pool pressure)."""
+        """Reclaim the least-recently-used parked block WITHOUT cached
+        descendants (leaf-first): drop its digest, return it for
+        reallocation. Only refcount-0 blocks are ever parked, so
+        eviction can never touch a block some row still reads — the
+        allocator calls this only when its free list is empty (pool
+        pressure). The allocator keeps references prefix-closed, which
+        makes the parked set descendant-closed — so whenever anything
+        is parked, a parked evictable leaf exists."""
         if not self._parked:
             raise RuntimeError(
                 "no evictable cached blocks (every indexed block is "
                 "referenced) — the allocator's admission gate should "
                 "have refused before reaching here"
             )
-        block, _ = self._parked.popitem(last=False)
-        key = self._by_block.pop(block)
-        del self._by_key[key]
-        return block
+        # lazy-invalidation pop: a stale entry is one whose block was
+        # unparked (sequence gone), re-parked (sequence moved), or grew
+        # a child since it was pushed — skip it; each stale entry is
+        # dropped exactly once, so eviction stays amortized O(log n)
+        # instead of re-scanning parked interior runs every call
+        while self._leaf_heap:
+            seq, block = heapq.heappop(self._leaf_heap)
+            if self._park_seq.get(block) != seq:
+                continue
+            if not self.evictable(block):
+                continue
+            self.remove(block)
+            return block
+        raise RuntimeError(
+            "every parked block has cached descendants that are "
+            "still referenced — the allocator's prefix-closed "
+            "reference invariant is broken (see audit())"
+        )
+
+    # ----------------------------------------------------------- audit
+
+    def audit(self) -> None:
+        """The radix-tree invariant, asserted (NEXUS_SANITIZE runs this
+        next to the pool-partition audit):
+
+          * structure: every non-root node holds a non-empty run, its
+            parent's child entry is keyed by its first digest, and the
+            digest/block accelerator maps agree exactly with the runs
+            (each block holds one identity, reachable from the root);
+          * parked ⊆ indexed (LRU entries always have content);
+          * descendant closure: a PARKED block's immediate descendants
+            are all parked too — the arithmetic reason leaf-first
+            eviction can always make progress and the allocator may
+            count every parked block as reclaimable capacity.
+        """
+        seen_keys: Dict[bytes, Tuple[_RadixNode, int]] = {}
+        seen_blocks: Dict[int, bytes] = {}
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            if node is not self._root and not node.keys:
+                raise AssertionError("empty non-root radix node")
+            if len(node.keys) != len(node.blocks):
+                raise AssertionError("run keys/blocks length mismatch")
+            for i, (k, b) in enumerate(zip(node.keys, node.blocks)):
+                if k in seen_keys or b in seen_blocks:
+                    raise AssertionError(
+                        f"digest or block {b} indexed twice"
+                    )
+                seen_keys[k] = (node, i)
+                seen_blocks[b] = k
+            for first, child in node.children.items():
+                if child.parent is not node:
+                    raise AssertionError("child parent link broken")
+                if not child.keys or child.keys[0] != first:
+                    raise AssertionError(
+                        "child entry not keyed by its first digest"
+                    )
+                stack.append(child)
+        if seen_keys != self._by_key:
+            raise AssertionError(
+                "digest accelerator map diverged from the tree"
+            )
+        if seen_blocks != self._by_block:
+            raise AssertionError(
+                "block accelerator map diverged from the tree"
+            )
+        for blk in self._parked:
+            if blk not in self._by_block:
+                raise AssertionError(
+                    f"parked block {blk} has no content index entry"
+                )
+        parked = set(self._parked)
+        for blk in parked:
+            node, off = self._by_key[self._by_block[blk]]
+            if off + 1 < len(node.keys):
+                descendants = [node.blocks[off + 1]]
+            else:
+                descendants = [ch.blocks[0] for ch in node.children.values()]
+            for d in descendants:
+                if d not in parked:
+                    raise AssertionError(
+                        f"parked block {blk} has referenced descendant "
+                        f"{d} — references are no longer prefix-closed"
+                    )
+        # eviction accelerator coherence: the sequence map tracks the
+        # parked set exactly, and every parked EVICTABLE block has a
+        # live heap entry (else evict_lru could raise with work left)
+        if set(self._park_seq) != parked:
+            raise AssertionError(
+                "park-sequence map diverged from the parked set"
+            )
+        live = set(self._leaf_heap)
+        for blk in parked:
+            if (self.evictable(blk)
+                    and (self._park_seq[blk], blk) not in live):
+                raise AssertionError(
+                    f"parked evictable block {blk} has no live "
+                    "eviction-heap entry"
+                )

@@ -19,13 +19,20 @@ CHUNKED PREFILL:
     headroom; eviction-free by construction), so admitted residency
     tracks actual sequence lengths instead of batch × max_len worst
     cases. One compiled program still serves every table state;
-  * blocks are SHARED ACROSS REQUESTS (prefix cache, round 6): the
-    allocator is ref-counted and carries a content index of full-block
+  * blocks are SHARED ACROSS REQUESTS (prefix cache, round 6; RADIX
+    TREE + cache-aware admission, round 9): the allocator is
+    ref-counted and carries a radix-tree content index of full-block
     hash chains (runtime/prefix_cache.py), admission matches each
-    prompt's longest cached prefix and starts chunked prefill past it
-    (skipping the shared region's compute AND K/V writes), full-prompt
-    hits copy-on-write the tail block, and released blocks park
-    (refcount 0, LRU) for future hits until pool pressure evicts them;
+    prompt's longest cached prefix — at ANY branching point, and
+    through chains extended by a finished request's DECODED blocks, so
+    multi-turn successors hit their prior turn's whole chain — and
+    starts chunked prefill past it (skipping the shared region's
+    compute AND K/V writes), full-prompt hits copy-on-write the tail
+    block, released blocks park (refcount 0, LRU) for future hits
+    until pool pressure evicts them LEAF-FIRST (a shared interior run
+    outlives its cold tails), and the wait queue is ordered by a
+    pluggable admission policy (runtime/scheduling.py; default:
+    longest-resident-match-first with FIFO aging);
   * prompts are NOT prefilled in a separate dispatch. Admission writes
     the prompt into a per-row token buffer (one tiny scatter), and the
     decode chunk program itself streams it through the model at
@@ -88,6 +95,7 @@ from nexus_tpu.models.decoding import (
     init_paged_kv_cache,
 )
 from nexus_tpu.runtime.prefix_cache import PrefixCacheIndex, chain_keys
+from nexus_tpu.runtime.scheduling import make_admission_policy
 
 
 class BlockAllocator:
@@ -242,9 +250,11 @@ class BlockAllocator:
         ``shared`` (already-written, indexed) blocks into it with a
         refcount bump each; None when the pool can't promise the privates
         plus the parked blocks this admission would revive (the caller
-        keeps the request queued — admission is FIFO, so a refused head
-        request waits for refunds rather than being overtaken). Nothing
-        is mutated on refusal."""
+        keeps the request queued — a refusal stops the admission wave,
+        so the refused request waits for refunds rather than being
+        overtaken within the policy's order, whatever ordering the
+        engine's admission policy chose). Nothing is mutated on
+        refusal."""
         revive = sum(1 for b in shared if self._ref[b] == 0)
         if need_blocks + revive > self.available_blocks:
             return None
@@ -255,12 +265,17 @@ class BlockAllocator:
         self._reserved += need_blocks
         return _BlockLease(self, need_blocks, shared)
 
-    def register_block(self, key: bytes, blk: int) -> None:
-        """Publish a fully-written prompt block into the content index
-        (no-op when the key is already held — first writer wins; the
-        duplicate block stays a plain private block)."""
+    def register_block(self, key: bytes, blk: int,
+                       parent: Optional[bytes] = None) -> bool:
+        """Publish a fully-written block into the content index,
+        attached under ``parent`` (the preceding digest of its chain;
+        None = a chain root). No-op (False) when the key is already
+        held — first writer wins; the duplicate block stays a plain
+        private block — or when the parent digest was evicted (the
+        radix tree refuses orphans)."""
         if self.index is not None:
-            self.index.put(key, blk)
+            return self.index.insert(key, blk, parent=parent)
+        return False
 
     def _alloc_one(self) -> int:
         if self._free:
@@ -372,8 +387,12 @@ class ServeRequest:
     it at every wave boundary, cancelling the row (or dropping the
     queued request) with a terminal ``deadline_exceeded`` status instead
     of serving a result nobody is waiting for. ``priority`` orders LOAD
-    SHEDDING only (admission stays FIFO): when the bounded queue
-    overflows, the LOWEST priority queued request is shed first.
+    SHEDDING only — when the bounded queue overflows, the LOWEST
+    priority queued request is shed first. It does NOT order admission:
+    that is the engine's ``admission_policy`` (round 9 — the default
+    ``cache-aware`` may admit a request with a resident prefix match
+    ahead of older cold arrivals, bounded by ``admission_aging_waves``;
+    ``fifo`` keeps strict arrival order).
     ``retries`` counts engine-death requeues (stamped by the
     ServeFailoverPlanner, echoed into the result)."""
 
@@ -464,6 +483,9 @@ class ServingEngine:
         max_queue_delay_s: float = 0.0,
         clock: Callable[[], float] = time.monotonic,
         attention_path: str = "fused",
+        admission_policy: Any = "cache-aware",
+        admission_aging_waves: int = 8,
+        prefix_completions: bool = True,
     ):
         """``prefill_chunk`` (T): prompt tokens an admitting row consumes
         per decode step. A T-slot feed costs every row T slots of matmul
@@ -520,6 +542,26 @@ class ServingEngine:
         bookkeeping — outputs are token-for-token identical to
         ``prefix_cache=False`` (tested across the fp, int8-KV, and
         speculative tiers).
+
+        Round 9 upgrades the content index to a RADIX TREE over block
+        digests (runtime/prefix_cache.py): branching prefixes (one
+        system prompt, different few-shot tails) share the preamble
+        subtree physically, eviction is leaf-first (a shared interior
+        run outlives its cold tails), and — with
+        ``prefix_completions`` (default on) — a finished row's DECODED
+        blocks are registered into the tree at release, so a
+        multi-turn successor (prompt = a prior request's full prompt +
+        completion) matches the prior turn's whole chain instead of
+        missing past its prompt. ``admission_policy`` selects the
+        wait-queue ordering (runtime/scheduling.py): ``"cache-aware"``
+        (default) admits the request with the longest RESIDENT prefix
+        match first, with an aging bound of ``admission_aging_waves``
+        passed-over waves so nothing starves; ``"fifo"`` is strict
+        arrival order (the pre-round-9 behavior — identical to
+        cache-aware whenever the cache is cold or off). An
+        AdmissionPolicy instance can be passed directly (the pluggable
+        scheduler interface). Ordering changes only WHEN a request is
+        scheduled, never its tokens (tested).
 
         ``max_queue_depth`` (round 7) bounds the wait queue: past it the
         LOWEST-priority queued requests are shed with an honest ``shed``
@@ -662,6 +704,15 @@ class ServingEngine:
         # the fused kernel + Hydragen dispatch ride the paged layout
         # only (dense rows read a contiguous stripe — nothing to fuse)
         self._fused = self._paged and attention_path == "fused"
+        # wait-queue ordering (runtime/scheduling.py): resolved once so
+        # a bad name fails at construction, not mid-serve
+        self._policy = make_admission_policy(
+            admission_policy, aging_waves=admission_aging_waves
+        )
+        # decoded blocks enter the radix tree at row release (the
+        # multi-turn surface); off = the round-6 prompt-only matcher,
+        # kept as the bench A/B baseline
+        self._prefix_completions = bool(prefix_completions)
         # rounds per dispatch: one round = one target forward committing
         # 1..k+1 tokens, so this keeps a spec chunk's committed-token
         # budget comparable to a plain chunk's C single-token steps
@@ -1262,6 +1313,9 @@ class ServingEngine:
             )
             if self._paged else None
         )
+        # the sanitizer's radix-tree audit hook (and the bench's
+        # introspection point): the content index of the LAST serve run
+        self.last_prefix_index = alloc.index if alloc is not None else None
         leases: List[Optional[_BlockLease]] = [None] * b
         caps = [0] * b  # _row_cap per active row
         plen_host = [0] * b  # prompt length per active row
@@ -1280,6 +1334,15 @@ class ServingEngine:
         hit_tokens = 0
         hit_requests = 0
         cow_copies = 0
+        # matched-depth histogram (blocks of tree depth per hit) — the
+        # hit-rate-by-depth ledger the bench scenarios report
+        hit_depth_hist: dict = {}
+        completion_blocks_registered = 0
+        # cache-aware admission bookkeeping: how many waves have
+        # overtaken each still-waiting request (the aging counter) and
+        # the total overtake count (the reordering ledger)
+        passed_over: dict = {}
+        admission_overtakes = 0
         hydragen_waves = 0  # dispatches that ran with a shared run > 0
         hydragen_shared_slots = 0  # Σ shared-run blocks over those waves
         ttfts: List[float] = []
@@ -1435,16 +1498,59 @@ class ServingEngine:
                 finish_queued(victim, STATUS_SHED)
                 shed_count += 1
 
+        def register_completion_blocks(r: int, state: _RowState) -> None:
+            """Decoded blocks enter the radix tree when the row releases
+            — the multi-turn surface: a successor whose prompt is this
+            request's full prompt + completion matches the whole chain,
+            not just the prompt half (the round-6 index registered
+            prompt blocks only, so multi-turn traffic always missed
+            past turn one). Registrable tokens stop ONE short of the
+            last emitted token: its K/V write may not have landed when
+            the host noticed the row was done (a stop token on a
+            chunk's final step is emitted but never fed), and an
+            indexed block must be fully frozen. Every earlier emitted
+            token was fed — its K/V was written with the committed
+            value when its successor was produced."""
+            nonlocal completion_blocks_registered
+            p = plen_host[r]
+            if not state.emitted or pf_ptr[r] < p:
+                return  # nothing decoded, or prefill never finished
+            usable = p + len(state.emitted) - 1
+            n_reg = min(usable // self._block_size, len(leases[r].blocks))
+            if n_reg <= indexed_upto[r]:
+                return
+            full = list(np.asarray(
+                requests[state.request_idx].prompt, dtype=np.int32
+            )) + state.emitted[:-1]
+            keys = chain_keys(full, self._block_size, limit=n_reg)
+            blks = leases[r].blocks
+            while indexed_upto[r] < n_reg:
+                if not chain_extendable(r, keys, blks):
+                    break  # predecessor held by another lease
+                j = indexed_upto[r]
+                if alloc.register_block(
+                    keys[j], blks[j], parent=keys[j - 1] if j else None
+                ):
+                    completion_blocks_registered += 1
+                indexed_upto[r] += 1
+
         def release_row(r: int) -> None:
             """Free a row whose request terminated (completion, deadline
-            cancellation, or drain): refund its lease — the allocator
-            parks shareable prefix blocks (indexed content survives for
+            cancellation, or drain): publish its decoded full blocks
+            into the radix tree (the multi-turn surface — drained rows
+            included, so a requeued request re-matches its own prior
+            work), then refund its lease — the allocator parks
+            shareable prefix blocks (indexed content survives for
             future hits) and frees the rest — and point the table at
             scratch so the frozen slot's rolled-back writes can't touch
             a re-allocated block."""
+            state = rows[r]
             rows[r] = None
             prefill_left[r] = 0
             if self._paged and leases[r] is not None:
+                if (self._prefix and self._prefix_completions
+                        and state is not None):
+                    register_completion_blocks(r, state)
                 leases[r].release()
                 leases[r] = None
                 table_np[r, :] = scratch
@@ -1456,25 +1562,74 @@ class ServingEngine:
         def row_done(state: _RowState) -> bool:
             return state.stopped or len(state.emitted) >= state.budget
 
+        def req_chain_keys(req_idx: int) -> List[bytes]:
+            """The request's full-block hash-chain keys, derived once
+            and cached — the ONE derivation site, shared by the policy
+            ranking signal and the admission matcher so the two can
+            never diverge."""
+            if req_idx not in keys_cache:
+                keys_cache[req_idx] = chain_keys(
+                    np.asarray(requests[req_idx].prompt, dtype=np.int32),
+                    self._block_size,
+                )
+            return keys_cache[req_idx]
+
+        def resident_match_tokens(req_idx: int) -> int:
+            """Prompt tokens of ``req_idx`` matchable against content
+            resident in the radix tree RIGHT NOW (parked or referenced)
+            — the cache-aware policy's ranking signal; 0 without the
+            prefix cache, so every policy degrades to FIFO there."""
+            if not self._prefix:
+                return 0
+            _, matched, _ = alloc.match_prefix(
+                req_chain_keys(req_idx), len(requests[req_idx].prompt)
+            )
+            return matched
+
+        def chain_extendable(r: int, keys, blks) -> bool:
+            """Registration guard: a row may extend the radix tree only
+            under a parent digest HELD BY THE ROW'S OWN BLOCK at that
+            position. When another lease's block holds the predecessor
+            (this row's duplicate registration was refused first-writer
+            -wins — e.g. a turn-1 predecessor finished and registered
+            its completion chain while this row was still prefilling
+            the same content — or the position is a CoW copy whose
+            original stays indexed), attaching this row's REFERENCED
+            block beneath it could leave the other chain's PARKED run
+            with a referenced descendant: descendant closure breaks,
+            audit() fires, and leaf-first eviction could find no
+            reclaimable leaf under pool pressure. Stopping keeps every
+            tree edge between blocks of one publishing chain."""
+            j = indexed_upto[r]
+            return (j == 0
+                    or alloc.index.holder(keys[j - 1]) == blks[j - 1])
+
         def admit_into(free_rows):
             """Fill free rows from the queue — one insert dispatch per
             wave; the prompts stream through the next chunks in-band.
-            Paged: each admission must RESERVE its worst-case PRIVATE
-            block count first (HBM-aware gate); with the prefix cache on,
-            the prompt's longest cached full-block prefix is matched
-            first and mapped SHARED (refcount bumps, no reservation), and
-            prefill starts past it. A pool-full refusal keeps FIFO order
-            (the head waits for refunds, never overtaken); a prefix-DEFER
-            skips the request — its next needed block is being prefilled
-            by an active row right now, so admitting it would duplicate
-            exactly the compute the cache saves; once the leader
-            publishes, the whole deferred group admits together in one
-            wave. Progress is guaranteed: deferral requires an ACTIVE
-            prefilling row, and _validate_request rejects requests that
-            exceed the whole pool outright."""
+            The ORDER admission tries requests is the policy's
+            (runtime/scheduling.py): cache-aware ranks by longest
+            resident radix-tree match (re-matched against the tree
+            every wave, so deferred groups and freshly-parked
+            completion chains re-rank honestly) with FIFO aging so
+            nothing starves; fifo is strict arrival order. Paged: each
+            admission must RESERVE its worst-case PRIVATE block count
+            first (HBM-aware gate); with the prefix cache on, the
+            prompt's longest cached prefix is matched first and mapped
+            SHARED (refcount bumps, no reservation), and prefill starts
+            past it. A pool-full refusal stops the wave — the policy's
+            chosen head waits for refunds and is never overtaken within
+            the order (with aging, that preserves bounded waiting). A
+            prefix-DEFER skips the request — its next needed block is
+            being prefilled by an active row right now, so admitting it
+            would duplicate exactly the compute the cache saves; once
+            the leader publishes, the whole deferred group admits
+            together in one wave. Progress is guaranteed: deferral
+            requires an ACTIVE prefilling row, and _validate_request
+            rejects requests that exceed the whole pool outright."""
             nonlocal cache, buf, ptr_vec, plen_vec, temp_vec, seed_vec
             nonlocal reserved_blocks_total, hit_tokens, hit_requests
-            nonlocal cow_copies
+            nonlocal cow_copies, admission_overtakes
             if not free_rows or not pending:
                 return
             # chain keys active rows will publish soon — the deferral set
@@ -1483,28 +1638,30 @@ class ServingEngine:
                 for r in range(b):
                     if rows[r] is not None and row_keys[r]:
                         inflight.update(row_keys[r][indexed_upto[r]:])
+            arrival_pos = {idx: i for i, idx in enumerate(pending)}
+            order = self._policy.order(
+                list(pending), passed_over, resident_match_tokens
+            )
             wave = []
             # (row, p, budget, lease, matched, cow_src, keys) per slot
             wave_meta = []
-            deferred = []
-            while free_rows and pending:
-                req_idx = pending.popleft()
+            admitted_idx = []
+            deferred = set()
+            for req_idx in order:
+                if not free_rows:
+                    break
                 req = requests[req_idx]
                 prompt, p, budget = self._validate_request(req, req_idx)
                 shared, matched, cow_src = [], 0, None
                 keys: List[bytes] = []
                 if self._prefix:
-                    if req_idx not in keys_cache:
-                        keys_cache[req_idx] = chain_keys(
-                            prompt, self._block_size
-                        )
-                    keys = keys_cache[req_idx]
+                    keys = req_chain_keys(req_idx)
                     shared, matched, cow_src = alloc.match_prefix(keys, p)
                     published = len(shared) + (1 if cow_src is not None
                                                else 0)
                     if (published < len(keys)
                             and keys[published] in inflight):
-                        deferred.append(req_idx)
+                        deferred.add(req_idx)
                         continue
                 lease = None
                 if self._paged:
@@ -1514,8 +1671,7 @@ class ServingEngine:
                     )
                     lease = alloc.admit(need, shared=shared)
                     if lease is None:
-                        pending.appendleft(req_idx)
-                        break  # pool full: head of the queue waits
+                        break  # pool full: the policy head waits
                     reserved_blocks_total += need
                     if cow_src is not None:
                         # copy-on-write: materialize the private copy of
@@ -1526,7 +1682,12 @@ class ServingEngine:
                 if matched:
                     hit_tokens += matched
                     hit_requests += 1
+                    depth = len(shared) + (1 if cow_src is not None else 0)
+                    hit_depth_hist[depth] = (
+                        hit_depth_hist.get(depth, 0) + 1
+                    )
                 row = free_rows.pop(0)
+                admitted_idx.append(req_idx)
                 wave.append((row, req, req_idx, prompt, p, budget, matched))
                 wave_meta.append(
                     (row, p, budget, lease, matched, cow_src, keys)
@@ -1538,7 +1699,28 @@ class ServingEngine:
                         keys[len(shared) + (1 if cow_src is not None
                                             else 0):]
                     )
-            pending.extendleft(reversed(deferred))
+            for req_idx in admitted_idx:
+                pending.remove(req_idx)  # arrival order of the rest kept
+            if admitted_idx:
+                # aging: a still-waiting request was OVERTAKEN when a
+                # later arrival was admitted ahead of it this wave;
+                # after admission_aging_waves of those the policy must
+                # promote it (bounded starvation). Deliberately-deferred
+                # requests don't age — they are waiting on a leader, not
+                # losing races.
+                last_pos = max(arrival_pos[i] for i in admitted_idx)
+                for req_idx in pending:
+                    if (req_idx not in deferred
+                            and arrival_pos[req_idx] < last_pos):
+                        passed_over[req_idx] = (
+                            passed_over.get(req_idx, 0) + 1
+                        )
+                        admission_overtakes += 1
+            if (self._sanitize and alloc is not None
+                    and alloc.index is not None):
+                # the radix-tree invariant, asserted next to the
+                # pool-partition audit (NEXUS_SANITIZE)
+                alloc.index.audit()
             if not wave:
                 return
             (cache, buf, ptr_vec, plen_vec, temp_vec, seed_vec,
@@ -1695,8 +1877,13 @@ class ServingEngine:
                     )
                     blks = leases[r].blocks
                     while indexed_upto[r] < pub:
+                        if not chain_extendable(r, row_keys[r], blks):
+                            break  # predecessor held by another lease
                         j = indexed_upto[r]
-                        alloc.register_block(row_keys[r][j], blks[j])
+                        alloc.register_block(
+                            row_keys[r][j], blks[j],
+                            parent=row_keys[r][j - 1] if j else None,
+                        )
                         indexed_upto[r] += 1
             for r in range(b):
                 state = rows[r]
@@ -1789,6 +1976,12 @@ class ServingEngine:
                 1 for res in results
                 if res is not None and res.status == STATUS_OK
             ),
+            # ---- admission scheduling (round 9) ----
+            "admission_policy": self._policy.name,
+            # admissions that jumped ahead of an older waiting request
+            # (0 under fifo, and under cache-aware whenever the cache
+            # ranking agrees with arrival order)
+            "admission_overtakes": admission_overtakes,
         }
         # admission → first committed token (chunk-granular) and
         # enqueue → admission waits, per request — OMITTED when no
@@ -1859,6 +2052,16 @@ class ServingEngine:
                 metrics["prefix_evictions"] = alloc.evictions
                 metrics["prefix_cached_blocks_final"] = (
                     alloc.cached_blocks
+                )
+                # radix-tree ledger (round 9): hit counts by matched
+                # tree depth (in blocks — multi-turn successors hit
+                # DEEP, cold requests are absent) and how many decoded
+                # completion blocks entered the tree at release
+                metrics["prefix_hit_depth_hist"] = dict(
+                    sorted(hit_depth_hist.items())
+                )
+                metrics["prefix_completion_blocks"] = (
+                    completion_blocks_registered
                 )
         else:
             metrics["kv_pool_bytes"] = b * dense_row_bytes

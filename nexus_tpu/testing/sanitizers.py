@@ -16,8 +16,15 @@ money on TPUs:
     no audit: a leak introduced on the happy path permanently shrinks
     the pool one request at a time.
 
+Round 9 adds a third: the **radix prefix-tree invariant**
+(PrefixCacheIndex.audit — structure, parked ⊆ indexed, descendant
+closure), checked after every serve and at every admission wave inside
+the engine, because a tree-bookkeeping slip (an orphaned chain, a parked
+interior with referenced tails) silently degrades hit rates or strands
+pool capacity without ever failing a token-exactness test.
+
 With ``NEXUS_SANITIZE=1`` (tier-1 conftest wires this), every
-``ServingEngine.serve()`` call is followed by both audits; a violation
+``ServingEngine.serve()`` call is followed by these audits; a violation
 raises :class:`SanitizerError` inside whatever test drove the engine —
 cheap enough to leave on for the whole suite (two dict reads and five
 ``_cache_size()`` probes per serve run).
@@ -114,7 +121,30 @@ def audit_pool_partition(metrics: Dict[str, Any], context: str = "serve") -> Non
 
 
 # ---------------------------------------------------------------------------
-# audit 2: bounded jit recompiles
+# audit 2: radix-tree invariant (prefix cache)
+
+
+def audit_prefix_tree(engine: Any, context: str = "serve") -> None:
+    """Assert the radix prefix index's structural invariant after a
+    serve run (PrefixCacheIndex.audit): runs/accelerator-map agreement,
+    parked ⊆ indexed, and descendant closure (a parked block's cached
+    descendants are parked too — the property that makes leaf-first
+    eviction always able to progress and every parked block honestly
+    reclaimable capacity). Engines without a prefix index (dense layout
+    or cache off) are skipped."""
+    index = getattr(engine, "last_prefix_index", None)
+    if index is None:
+        return
+    try:
+        index.audit()
+    except AssertionError as e:
+        raise SanitizerError(
+            f"{context}: radix prefix-tree invariant violated — {e}"
+        ) from e
+
+
+# ---------------------------------------------------------------------------
+# audit 3: bounded jit recompiles
 
 
 def jit_program_counts(engine: Any) -> Dict[str, int]:
@@ -187,6 +217,7 @@ def install(engine_cls: Optional[type] = None) -> bool:
             self, requests, cancel=cancel, heartbeat=heartbeat
         )
         audit_pool_partition(metrics, context="sanitizer[pool]")
+        audit_prefix_tree(self, context="sanitizer[radix]")
         audit_recompiles(self, context="sanitizer[recompile]")
         return results, metrics
 

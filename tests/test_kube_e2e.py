@@ -167,10 +167,22 @@ def test_controller_main_two_cluster_e2e(clusters):
         ), "secret never reached the shard cluster"
 
         # spec update propagates (the reference mutates VersionTag,
-        # controller_test.go:1325-1335)
-        fresh = ctrl_store.get(NexusAlgorithmTemplate.KIND, NS, "algo-1")
-        fresh.spec.container.version_tag = "v2.0.0"
-        ctrl_store.update(fresh)
+        # controller_test.go:1325-1335). Conflict-retry like any real
+        # client: the running controller's status write-backs bump the
+        # template's resourceVersion concurrently, so a bare update
+        # races 409-stale under load (same idiom as the churn test).
+        for _ in range(40):
+            try:
+                fresh = ctrl_store.get(
+                    NexusAlgorithmTemplate.KIND, NS, "algo-1"
+                )
+                fresh.spec.container.version_tag = "v2.0.0"
+                ctrl_store.update(fresh)
+                break
+            except ConflictError:
+                time.sleep(0.01)
+        else:
+            raise AssertionError("spec writer starved: 40 conflicts")
         assert wait_for(
             lambda: shard_store.get(
                 NexusAlgorithmTemplate.KIND, NS, "algo-1"

@@ -438,6 +438,28 @@ def test_pair_rule_receiver_hint():
     assert findings[0].line == 3  # the chaos.add, never the set.add
 
 
+def test_pair_rule_radix_insert_remove_pair():
+    """index.insert:index.remove (round 9): a function that both
+    publishes a digest into the radix prefix tree and prunes one must
+    prune in a finally block — an exception between them strands a
+    transient entry in the tree (unmatchable content holding a pool
+    block). Receiver-hinted, so list.insert/list.remove on unrelated
+    receivers never pair up."""
+    src = """
+        def speculative_publish(alloc, key, blk):
+            alloc.index.insert(key, blk)
+            probe(alloc)
+            alloc.index.remove(blk)
+
+        def unrelated(lst):
+            lst.insert(0, 1)
+            lst.remove(1)
+    """
+    findings = _lint(src, select=["NX-PAIR"])
+    assert _ids(findings) == ["NX-PAIR001"]
+    assert findings[0].line == 3  # the tree insert, never list.insert
+
+
 def test_pair_rule_nested_functions_are_separate_scopes():
     src = """
         def engine(alloc):

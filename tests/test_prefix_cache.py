@@ -1,12 +1,15 @@
-"""Prefix-cache block sharing (runtime/prefix_cache.py + the ref-counted
-BlockAllocator in runtime/serving.py).
+"""Prefix-cache block sharing (the radix-tree index in
+runtime/prefix_cache.py + the ref-counted BlockAllocator in
+runtime/serving.py + the admission policies in runtime/scheduling.py).
 
-Fast tier: the index and allocator are pure host code, and the engine
-scheduling tests run the cyclic stub model, so the sharing invariants —
-no block freed or evicted while referenced, CoW instead of in-place
-mutation, deferral instead of duplicate prefill — are checked on every
-dev-lane run. The llama-backed exactness tiers (prefix-on == prefix-off
-== isolated decode, across fp / int8 / speculative) live in
+Fast tier: the index, allocator, and policies are pure host code, and
+the engine scheduling tests run the cyclic stub model, so the sharing
+invariants — no block freed or evicted while referenced, leaf-first
+eviction (an interior run outlives its cached tails), CoW instead of
+in-place mutation, deferral instead of duplicate prefill, multi-turn
+completion chains — are checked on every dev-lane run. The llama-backed
+exactness tiers (prefix-on == prefix-off == isolated decode, across
+fp / int8 / speculative, fifo vs cache-aware) live in
 tests/test_serving.py with the rest of the compile-bound contract."""
 
 from types import SimpleNamespace
@@ -17,6 +20,11 @@ import numpy as np
 import pytest
 
 from nexus_tpu.runtime.prefix_cache import PrefixCacheIndex, chain_keys
+from nexus_tpu.runtime.scheduling import (
+    CacheAwareAdmission,
+    FifoAdmission,
+    make_admission_policy,
+)
 from nexus_tpu.runtime.serving import (
     BlockAllocator,
     ServeRequest,
@@ -77,25 +85,88 @@ def test_index_match_park_evict_roundtrip():
     idx = PrefixCacheIndex()
     keys = chain_keys(list(range(12)), 4)
     assert idx.match(keys) == []
-    assert idx.put(keys[0], 7) and idx.put(keys[1], 3)
-    assert idx.put(keys[0], 9) is False  # first writer wins
-    assert idx.put(keys[2], 7) is False  # one identity per block
+    assert idx.insert(keys[0], 7)
+    assert idx.insert(keys[1], 3, parent=keys[0])
+    assert idx.insert(keys[0], 9) is False  # first writer wins
+    assert idx.insert(keys[2], 7, parent=keys[1]) is False  # one id/block
     assert idx.match(keys) == [7, 3]
-    # a miss mid-chain stops the walk (orphans never match)
-    idx.put(chain_keys(list(range(12)), 4)[2], 5)
+    # an orphan insert (ancestor never indexed / already evicted) is
+    # REFUSED — the flat index kept unmatchable orphans, the tree won't
+    assert idx.insert(keys[2], 5, parent=b"missing") is False
+    assert idx.insert(keys[2], 5, parent=keys[1])
+    idx.audit()
+    # a miss mid-chain stops the walk
     assert idx.match([keys[0], b"missing", keys[2]]) == [7]
+    # park in release order (ancestors may park first within a release)
     idx.park(7)
     idx.park(3)
-    idx.unpark(7)  # revived by a shared admission
-    assert idx.parked_count == 1
-    assert idx.evict_lru() == 3
-    assert idx.match(keys) == [7]  # 3's digest is gone
+    idx.park(5)
+    idx.unpark(5)  # revived by a shared admission
+    assert idx.parked_count == 2
+    # LEAF-FIRST: 7 and 3 are both parked and LRU-older than nothing
+    # evictable — but each still has an indexed descendant, and 5 (the
+    # only leaf) is referenced, so eviction must refuse rather than
+    # strand the chain
+    with pytest.raises(RuntimeError):
+        idx.evict_lru()
+    idx.park(5)
+    # now the LRU scan skips the parked ancestors and takes the leaf
+    assert idx.evict_lru() == 5
+    assert idx.match(keys) == [7, 3]  # interior run intact
+    assert idx.evict_lru() == 3  # new leaf tail
+    assert idx.match(keys) == [7]
     with pytest.raises(ValueError):
         idx.park(99)  # never indexed
-    idx.park(7)
-    idx.evict_lru()
+    assert idx.evict_lru() == 7
     with pytest.raises(RuntimeError):
         idx.evict_lru()  # nothing parked
+    idx.audit()
+
+
+def test_radix_branching_chains_share_preamble_subtree():
+    """Two few-shot variants of one system prompt: the shared preamble
+    is ONE interior run, the tails are sibling leaves, and match()
+    returns the longest cached prefix for either branch — the structure
+    the flat single-chain matcher could only represent digest by
+    digest, with no eviction ordering between ancestor and tail."""
+    bs = 4
+    pre = list(range(8))  # 2 preamble blocks
+    a = pre + [101, 102, 103, 104] * 2  # 2 private tail blocks
+    b = pre + [201, 202, 203, 204]  # 1 private tail block
+    ka, kb = chain_keys(a, bs), chain_keys(b, bs)
+    assert ka[:2] == kb[:2]  # digest chaining: shared preamble
+    idx = PrefixCacheIndex()
+    for j, (k, blk) in enumerate(zip(ka, [0, 1, 2, 3])):
+        assert idx.insert(k, blk, parent=ka[j - 1] if j else None)
+    # branch B attaches at the divergence point — mid-run split
+    assert idx.insert(kb[2], 4, parent=kb[1])
+    idx.audit()
+    assert idx.match(ka) == [0, 1, 2, 3]
+    assert idx.match(kb) == [0, 1, 4]
+    # a third branch diverging INSIDE the preamble splits again
+    c = pre[:4] + [7, 7, 7, 7]
+    kc = chain_keys(c, bs)
+    assert idx.insert(kc[1], 5, parent=kc[0])
+    idx.audit()
+    assert idx.match(kc) == [0, 5]
+    assert idx.match(ka) == [0, 1, 2, 3]  # older chains unharmed
+    # leaf-first eviction under the branched tree: park everything in
+    # ancestor-first order; eviction must take tails before the shared
+    # preamble blocks whatever the LRU order says
+    for blk in (0, 1, 2, 3, 4, 5):
+        idx.park(blk)
+    evicted = [idx.evict_lru() for _ in range(6)]
+    for pos, blk in enumerate(evicted):
+        # when a block is evicted, no earlier-evicted... every block
+        # must come out strictly after all its descendants
+        assert blk in (0, 1, 2, 3, 4, 5)
+    # block 0 (the preamble root) must be the LAST standing ancestor
+    assert evicted[-1] == 0
+    # and block 1 (interior with three dependants at peak) comes out
+    # only after 2, 3, and 4
+    assert evicted.index(1) > max(
+        evicted.index(2), evicted.index(3), evicted.index(4)
+    )
 
 
 # ----------------------------------------------------- allocator refs
@@ -107,8 +178,8 @@ def test_allocator_shared_admission_refcounts():
     leader = a.admit(4)
     blks = leader.grow_to(4)
     keys = chain_keys(list(range(16)), 4)
-    for k, blk in zip(keys, blks[:2]):
-        a.register_block(k, blk)
+    for j, (k, blk) in enumerate(zip(keys, blks[:2])):
+        a.register_block(k, blk, parent=keys[j - 1] if j else None)
     # follower maps the two indexed blocks shared + 2 private
     shared, matched, cow = a.match_prefix(keys, prompt_len=16)
     assert shared == blks[:2] and matched == 8 and cow is None
@@ -136,8 +207,8 @@ def test_allocator_full_prompt_hit_returns_cow_source():
     lease = a.admit(3)
     blks = lease.grow_to(3)
     keys = chain_keys(list(range(12)), 4)
-    for k, blk in zip(keys, blks):
-        a.register_block(k, blk)
+    for j, (k, blk) in enumerate(zip(keys, blks)):
+        a.register_block(k, blk, parent=keys[j - 1] if j else None)
     # block-aligned full-prompt hit: the cap at p-1 lands INSIDE the
     # last matched block -> shared stops before it, cow_src returns it
     shared, matched, cow = a.match_prefix(keys, prompt_len=12)
@@ -150,8 +221,8 @@ def test_allocator_evicts_lru_refcount0_only_under_pressure():
     l1 = a.admit(4)
     blks = l1.grow_to(4)
     keys = chain_keys(list(range(16)), 4)
-    for k, blk in zip(keys, blks[:2]):
-        a.register_block(k, blk)
+    for j, (k, blk) in enumerate(zip(keys, blks[:2])):
+        a.register_block(k, blk, parent=keys[j - 1] if j else None)
     l1.release()  # 2 parked (cached), 2 free
     assert a.cached_blocks == 2 and a.free_blocks == 2
     assert a.evictions == 0
@@ -166,6 +237,30 @@ def test_allocator_evicts_lru_refcount0_only_under_pressure():
     assert a.match_prefix(keys, 16) == ([], 0, None)  # content gone
     # while REFERENCED the same blocks are never evictable
     assert a.admit(1) is None
+
+
+# ------------------------------------------------ admission policies
+
+
+def test_admission_policies_order_and_aging():
+    fifo = FifoAdmission()
+    assert fifo.order([3, 1, 2], {}, lambda i: 100) == [3, 1, 2]
+    ca = CacheAwareAdmission(aging_waves=2)
+    match = {1: 0, 2: 32, 3: 16}
+    assert ca.order([1, 2, 3], {}, lambda i: match[i]) == [2, 3, 1]
+    # ties keep arrival order (stable sort): a cold cache degrades the
+    # cache-aware policy to exact FIFO
+    assert ca.order([4, 5, 6], {}, lambda i: 0) == [4, 5, 6]
+    # aged requests outrank every fresher arrival, FIFO among themselves
+    waits = {1: 2, 3: 5}
+    assert ca.order([1, 2, 3], waits, lambda i: match[i]) == [1, 3, 2]
+    with pytest.raises(ValueError):
+        CacheAwareAdmission(aging_waves=0)
+    with pytest.raises(ValueError):
+        make_admission_policy("lifo")
+    assert isinstance(make_admission_policy("fifo"), FifoAdmission)
+    custom = CacheAwareAdmission(aging_waves=3)
+    assert make_admission_policy(custom) is custom  # pluggable instance
 
 
 # -------------------------------------------------- engine scheduling
@@ -265,6 +360,81 @@ def test_engine_eviction_under_tight_pool_stays_exact():
     assert m["kv_peak_allocated_blocks"] <= 4
 
 
+def test_engine_multiturn_completion_chain_hits():
+    """A successor whose prompt is a prior request's full prompt +
+    completion (multi-turn chat) matches the prior turn's WHOLE chain:
+    decoded blocks are registered into the radix tree at release. The
+    round-6 prompt-only matcher (prefix_completions=False) hits only
+    the old prompt half — the A/B the bench scenarios measure."""
+    v = 17
+    cfg, fwd = _cyclic_model(v)
+    rng = np.random.RandomState(11)
+    p1 = rng.randint(0, v, size=16).tolist()
+    turn1 = ServeRequest(prompt=p1, max_new_tokens=17)
+    full1 = _expect(turn1, v)  # 33 tokens: what turn 1 will commit
+    turn2 = ServeRequest(
+        prompt=full1 + rng.randint(0, v, size=7).tolist(),
+        max_new_tokens=6,
+    )
+    metrics = {}
+    for completions in (True, False):
+        eng = ServingEngine(
+            fwd, {}, cfg, batch_size=1, max_len=96, chunk=4,
+            kv_block_size=8, prefix_cache=True,
+            prefix_completions=completions,
+        )
+        results, metrics[completions] = eng.serve([turn1, turn2])
+        assert results[0].tokens == full1
+        assert results[1].tokens == _expect(turn2, v)
+    radix, chain = metrics[True], metrics[False]
+    # frozen turn-1 tokens = 16 + 17 - 1 = 32 -> blocks 2..3 hold
+    # decoded content and enter the tree at release
+    assert radix["prefix_completion_blocks"] == 2
+    assert chain["prefix_completion_blocks"] == 0
+    # turn 2 matches the prior turn's full 4-block chain vs only the
+    # 2 prompt blocks — the multi-turn surface the ROADMAP names
+    assert radix["prefix_hit_tokens"] > chain["prefix_hit_tokens"]
+    assert radix["prefix_hit_depth_hist"].get(4) == 1
+    assert chain["prefix_hit_depth_hist"].get(2) == 1
+
+
+def test_engine_cache_aware_admission_prefers_resident_match():
+    """One row, three requests: once the leader's chain parks, the
+    cache-aware queue admits the request that can reuse it ahead of an
+    OLDER cold request (bounded by aging) — and outputs stay identical
+    to fifo, because ordering is scheduling, never semantics."""
+    v = 13
+    cfg, fwd = _cyclic_model(v)
+    rng = np.random.RandomState(3)
+    warm = rng.randint(0, v, size=16).tolist()
+    cold = rng.randint(0, v, size=16).tolist()
+    reqs = [
+        ServeRequest(prompt=warm, max_new_tokens=4),
+        ServeRequest(prompt=cold, max_new_tokens=4),  # arrives second
+        ServeRequest(prompt=warm + [1, 2, 3], max_new_tokens=4),  # third
+    ]
+    out = {}
+    for policy in ("fifo", "cache-aware"):
+        eng = ServingEngine(
+            fwd, {}, cfg, batch_size=1, max_len=96, chunk=4,
+            kv_block_size=8, prefix_cache=True, admission_policy=policy,
+        )
+        results, m = eng.serve(reqs)
+        for req, r in zip(reqs, results):
+            assert r.tokens == _expect(req, v)
+        out[policy] = (m, [r.queue_s for r in results])
+    m_fifo, q_fifo = out["fifo"]
+    m_ca, q_ca = out["cache-aware"]
+    assert m_fifo["admission_policy"] == "fifo"
+    assert m_ca["admission_policy"] == "cache-aware"
+    assert m_fifo["admission_overtakes"] == 0
+    # cache-aware admitted the warm follower ahead of the older cold
+    # request exactly once (then the cold one went — no starvation)
+    assert m_ca["admission_overtakes"] == 1
+    assert q_ca[2] <= q_ca[1]  # warm follower admitted first
+    assert q_fifo[1] <= q_fifo[2]  # fifo kept arrival order
+
+
 def test_engine_reports_ttft_and_queue_percentiles():
     v = 7
     cfg, fwd = _cyclic_model(v)
@@ -300,3 +470,61 @@ def test_prefix_cache_off_by_dense_layout():
     )
     assert m["kv_layout"] == "dense"
     assert "prefix_hit_tokens" not in m
+
+
+def test_engine_overlapping_turns_keep_tree_closure():
+    """Registration guard regression (round-9 review): a turn-2
+    successor admitted WHILE its turn-1 predecessor still decodes
+    duplicates the completion region in its own blocks; when the
+    predecessor releases first and registers that chain, the
+    successor's duplicate registrations are refused first-writer-wins
+    — and its private TAIL must then NOT attach under the
+    predecessor's now-parked run (a referenced child below a parked
+    block breaks descendant closure: the per-wave radix audit fires,
+    and under pool pressure leaf-first eviction could find no
+    reclaimable leaf). The guard stops the successor's chain at the
+    first position held by another lease's block.
+
+    Timing (chunk 4, prefill_chunk 1, batch 2): B prefills 16 + decodes
+    12 (releases at the step-28 boundary, registering completion block
+    k2); filler C1 frees its row at 24, so A (prompt = B's full
+    28-token chain + 7) admits at 24 matching only the 2 published
+    PROMPT blocks, and crosses the k2 boundary at 32 — after B already
+    holds k2. D, E and F keep admission waves (and the armed per-wave
+    audit) running through the window where A's tail would have
+    attached under B's parked run."""
+    v = 19
+    cfg, fwd = _cyclic_model(v)
+    rng = np.random.RandomState(5)
+    p1 = rng.randint(0, v, size=16).tolist()
+    turn1 = ServeRequest(prompt=p1, max_new_tokens=12)
+    full1 = _expect(turn1, v)  # 28 tokens -> completion block k2
+    turn2 = ServeRequest(
+        prompt=full1 + rng.randint(0, v, size=7).tolist(),
+        max_new_tokens=6,
+    )  # 35-token prompt: k0..k3, k3 unique to A
+    c1 = ServeRequest(prompt=[1, 2, 3], max_new_tokens=19)
+    d = ServeRequest(prompt=[4, 5, 6], max_new_tokens=6)
+    e = ServeRequest(prompt=[7, 8, 9], max_new_tokens=4)
+    f = ServeRequest(prompt=[2, 3, 4], max_new_tokens=4)
+    reqs = [turn1, c1, turn2, d, e, f]
+    eng = ServingEngine(
+        fwd, {}, cfg, batch_size=2, max_len=96, chunk=4,
+        prefill_chunk=1, kv_block_size=8,
+    )
+    eng._sanitize = True  # per-wave radix audit armed
+    results, m = eng.serve(reqs)
+    for req, res in zip(reqs, results):
+        assert res.tokens == _expect(req, v)
+    # the race actually ran: B registered its completion block, and A
+    # admitted seeing only the two published prompt blocks (depth 2)
+    assert m["prefix_completion_blocks"] >= 1
+    assert m["prefix_hit_depth_hist"].get(2) == 1
+    # the guard held: A's tail k3 never entered the tree (its k2
+    # predecessor is held by B's block, not A's), so the full 4-block
+    # chain matches only 3 deep — pre-guard this matched 4 and the
+    # per-wave audit raised on the parked-run/referenced-child state
+    idx = eng.last_prefix_index
+    assert idx is not None
+    assert len(idx.match(chain_keys(turn2.prompt, 8))) == 3
+    idx.audit()

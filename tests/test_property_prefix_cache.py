@@ -1,43 +1,48 @@
-"""Property-based invariants for the ref-counted BlockAllocator.
+"""Property-based invariants for the radix prefix index and the
+ref-counted BlockAllocator.
 
-Hypothesis drives random admit/match/grow/register/release sequences —
-with shared mappings, parked content, and forced pool pressure — and
-asserts the sharing invariants after EVERY operation:
+Two drivers, each with a hypothesis front-end AND an unconditional
+seeded random fallback (the container this repo develops in has no
+hypothesis; the fallback keeps the properties exercised on every tier-1
+run instead of silently skipping):
 
-  * no block is freed or evicted while any lease references it;
-  * pool accounting is exact (free + parked + referenced partitions the
-    pool; refcounts equal the number of leases mapping each block;
-    free + parked always covers the outstanding reservations);
-  * eviction only ever touches refcount-0 (parked) blocks, and only
-    under pool pressure (the free list must drain first);
-  * every live lease can always grow to its full reservation (the
-    eviction-free admission guarantee), and no two leases ever share a
-    PRIVATE block.
+  * the ALLOCATOR driver runs random admit/match/grow/register/release
+    sequences — with shared mappings, parked content, chained
+    registration, and forced pool pressure — asserting after EVERY
+    operation that refcounts are exact, free/parked/referenced
+    partition the pool, eviction touches refcount-0 blocks only and
+    only under pressure, every live lease can grow to its full
+    reservation, and the radix tree audit holds;
 
-importorskip-guarded like test_property_convergence: a checkout without
-hypothesis skips the module instead of failing collection."""
+  * the INDEX driver builds random CHAIN SETS (shared prefixes,
+    branch points, duplicate content) against a pure-python oracle:
+    ``match`` must equal the oracle's longest-common-prefix walk over
+    reachable chains, insert must refuse orphans/duplicates exactly
+    when the oracle says, and ``evict_lru`` must always reclaim the
+    least-recently-used block WITHOUT indexed descendants (leaf-first —
+    an interior run is never evicted before its cached tails).
+"""
 
+import numpy as np
 import pytest
 
-pytest.importorskip("hypothesis")
+from nexus_tpu.runtime.prefix_cache import PrefixCacheIndex, chain_keys
+from nexus_tpu.runtime.serving import BlockAllocator
 
-from hypothesis import HealthCheck, given, settings  # noqa: E402
-from hypothesis import strategies as st  # noqa: E402
+try:
+    from hypothesis import HealthCheck, given, settings
+    from hypothesis import strategies as st
 
-from nexus_tpu.runtime.prefix_cache import PrefixCacheIndex  # noqa: E402
-from nexus_tpu.runtime.serving import BlockAllocator  # noqa: E402
+    HAVE_HYPOTHESIS = True
+except ImportError:
+    HAVE_HYPOTHESIS = False
 
 NUM_BLOCKS = 12
 BLOCK_SIZE = 4
 
-# one operation = (kind, a, b); the driver interprets the integers
-# modulo whatever is currently valid, so every generated sequence is
-# executable and shrinks well
-_op = st.tuples(
-    st.integers(0, 3),  # 0 admit, 1 grow, 2 release, 3 register
-    st.integers(0, 31),
-    st.integers(0, 31),
-)
+
+# ---------------------------------------------------------------------------
+# allocator driver (ops = list of (kind, a, b) integer triples)
 
 
 def _check_invariants(a: BlockAllocator, leases):
@@ -69,19 +74,18 @@ def _check_invariants(a: BlockAllocator, leases):
     # every parked block is still indexed (evict drops both together)
     for blk in parked:
         assert a.index.holds(blk)
+    # the radix-tree structural invariant (parent links, accelerator
+    # maps, descendant closure of the parked set)
+    a.index.audit()
 
 
-@settings(
-    max_examples=120, deadline=None,
-    suppress_health_check=[HealthCheck.too_slow],
-)
-@given(ops=st.lists(_op, max_size=60))
-def test_refcounted_allocator_invariants(ops):
+def _drive_allocator(ops):
     a = BlockAllocator(
         NUM_BLOCKS, BLOCK_SIZE, prefix_index=PrefixCacheIndex()
     )
     leases = []
     registered = []  # indexed blocks, in publish order
+    chain_tail = {}  # id(lease) → last published key of its chain
     key_seq = [0]
 
     for kind, x, y in ops:
@@ -105,23 +109,29 @@ def test_refcounted_allocator_invariants(ops):
             evictions_before = a.evictions
             lease.grow_to(y % (NUM_BLOCKS + 2))
             # pressure rule: evictions happen only once free drained
+            # (leaf-first ORDER is oracle-checked in the index driver;
+            # here the post-op audit asserts no eviction ever stranded
+            # a descendant)
             if a.evictions > evictions_before:
                 assert free_before < (
                     a.evictions - evictions_before
                 ) + len(lease._private), "evicted while free blocks left"
         elif kind == 2 and leases:  # release
             lease = leases.pop(x % len(leases))
+            chain_tail.pop(id(lease), None)
             lease.release()
-        elif kind == 3 and leases:  # publish a private block
+        elif kind == 3 and leases:  # publish the lease's next chain block
             lease = leases[x % len(leases)]
             if lease._private:
                 blk = lease._private[y % len(lease._private)]
                 if not a.index.holds(blk):
                     key_seq[0] += 1
-                    a.register_block(
-                        key_seq[0].to_bytes(8, "big"), blk
-                    )
-                    registered.append(blk)
+                    key = key_seq[0].to_bytes(8, "big")
+                    if a.register_block(
+                        key, blk, parent=chain_tail.get(id(lease))
+                    ):
+                        chain_tail[id(lease)] = key
+                        registered.append(blk)
         _check_invariants(a, leases)
 
     # the eviction-free guarantee, end-state form: every live lease can
@@ -134,3 +144,155 @@ def test_refcounted_allocator_invariants(ops):
         assert not (priv & seen)
         seen |= priv
     _check_invariants(a, leases)
+
+
+# ---------------------------------------------------------------------------
+# index driver: random chain sets vs a longest-common-prefix oracle
+
+
+def _drive_index(ops, rng_tokens):
+    """``ops`` = (kind, a, b) triples; ``rng_tokens`` draws token seqs.
+    Oracle state: ``store`` maps digest → block for everything the tree
+    should hold, ``parent``/``children`` mirror the ancestry, and
+    ``lru`` mirrors the park order — all pure python, no tree."""
+    idx = PrefixCacheIndex()
+    store = {}
+    parent = {}
+    children = {}  # key → set of child keys
+    lru = []  # park order, LRU → MRU (every inserted block parks)
+    chains = [rng_tokens() for _ in range(4)]  # base sequences
+    next_block = [0]
+
+    def oracle_match(keys):
+        out = []
+        for k in keys:
+            if k not in store:
+                break
+            out.append(store[k])
+        return out
+
+    for kind, x, y in ops:
+        if kind in (0, 1):  # insert a prefix of a (maybe mutated) chain
+            toks = list(chains[x % len(chains)])
+            if kind == 1 and toks:  # branch: mutate one token
+                toks[y % len(toks)] = (toks[y % len(toks)] + 1) % 50
+            keys = chain_keys(toks, BLOCK_SIZE)
+            upto = (y % (len(keys) + 1)) if keys else 0
+            for j in range(upto):
+                k = keys[j]
+                par = keys[j - 1] if j else None
+                blk = next_block[0]
+                expect = (
+                    k not in store
+                    and (par is None or par in store)
+                )
+                got = idx.insert(k, blk, parent=par)
+                assert got == expect, (j, got, expect)
+                if got:
+                    next_block[0] += 1
+                    store[k] = blk
+                    parent[k] = par
+                    children.setdefault(par, set()).add(k)
+                    children.setdefault(k, set())
+                    idx.park(blk)
+                    lru.append(blk)
+        elif kind == 2:  # match any chain (also mutated variants)
+            toks = list(chains[x % len(chains)])
+            if toks and y % 2:
+                toks[y % len(toks)] = (toks[y % len(toks)] + 1) % 50
+            keys = chain_keys(toks, BLOCK_SIZE)
+            assert idx.match(keys) == oracle_match(keys)
+        elif kind == 3 and lru:  # evict: leaf-first LRU, oracle-checked
+            by_block = {b: k for k, b in store.items()}
+            expected = None
+            for blk in lru:
+                if not children[by_block[blk]]:
+                    expected = blk
+                    break
+            if expected is None:
+                with pytest.raises(RuntimeError):
+                    idx.evict_lru()
+            else:
+                got = idx.evict_lru()
+                assert got == expected, "not the LRU evictable leaf"
+                k = by_block[got]
+                children[parent[k]].discard(k)
+                del children[k], store[k], parent[k]
+                lru.remove(got)
+        idx.audit()
+        assert len(idx) == len(store)
+    # drain everything: leaf-first eviction can always finish the job
+    while store:
+        blk = idx.evict_lru()
+        k = {b: k for k, b in store.items()}[blk]
+        children[parent[k]].discard(k)
+        del store[k], parent[k], children[k]
+        lru.remove(blk)
+        idx.audit()
+
+
+def _rng_tokens_factory(rng):
+    def draw():
+        return rng.randint(0, 50, size=int(rng.randint(0, 25))).tolist()
+
+    return draw
+
+
+# ---------------------------------------------------------------------------
+# hypothesis front-ends (skipped without hypothesis; the seeded drivers
+# below always run)
+
+if HAVE_HYPOTHESIS:
+    _op = st.tuples(
+        st.integers(0, 3), st.integers(0, 31), st.integers(0, 31)
+    )
+
+    @settings(
+        max_examples=120, deadline=None,
+        suppress_health_check=[HealthCheck.too_slow],
+    )
+    @given(ops=st.lists(_op, max_size=60))
+    def test_refcounted_allocator_invariants(ops):
+        _drive_allocator(ops)
+
+    @settings(
+        max_examples=120, deadline=None,
+        suppress_health_check=[HealthCheck.too_slow],
+    )
+    @given(
+        ops=st.lists(_op, max_size=60),
+        seed=st.integers(0, 2**16),
+    )
+    def test_radix_index_matches_lcp_oracle(ops, seed):
+        _drive_index(
+            ops, _rng_tokens_factory(np.random.RandomState(seed))
+        )
+
+
+def test_refcounted_allocator_invariants_random_driver():
+    """The no-hypothesis fallback: 300 seeded random op sequences
+    through the same driver — the properties hold on every tier-1 run
+    even where hypothesis isn't installed."""
+    rng = np.random.RandomState(20240903)
+    for _ in range(300):
+        n = int(rng.randint(0, 60))
+        ops = [tuple(int(v) for v in rng.randint(0, 32, size=3))
+               for _ in range(n)]
+        ops = [(k % 4, a, b) for k, a, b in ops]
+        _drive_allocator(ops)
+
+
+def test_radix_index_oracle_random_driver():
+    """The no-hypothesis fallback for the index driver: random chain
+    sets (shared prefixes, mutated branches, duplicate inserts) vs the
+    longest-common-prefix oracle, leaf-first eviction oracle-checked,
+    the tree audited after every operation."""
+    rng = np.random.RandomState(77)
+    for _ in range(300):
+        n = int(rng.randint(0, 50))
+        ops = [
+            (int(rng.randint(0, 4)), int(rng.randint(0, 32)),
+             int(rng.randint(0, 32)))
+            for _ in range(n)
+        ]
+        _drive_index(ops, _rng_tokens_factory(rng))

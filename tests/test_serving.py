@@ -802,3 +802,184 @@ def test_prefill_chunk_width_never_changes_output():
         results, _ = engine.serve(reqs)
         outs.append([r.tokens for r in results])
     assert outs[0] == outs[1] == outs[2]
+
+
+# ----------------------- round 9: radix tree + cache-aware admission
+
+
+def _round9_queue(cfg, params, rng):
+    """Multi-turn + branching queue with PRECOMPUTED greedy turn-1
+    completions, so turn-2 prompts are exactly `prior prompt +
+    completion + user tail` — the traffic shape the radix tree targets.
+
+    Layout (block size 8 in the tests that consume this):
+      * two conversations: turn-1 prompt 6 tokens (NO full block — the
+        round-6 prompt-only matcher can register nothing, so its
+        turn-2 hit is exactly 0), budget 12 → the radix tree registers
+        floor((6+12-1)/8) = 2 DECODED blocks at release, and turn 2
+        (prompt = the full 18-token turn-1 chain + 5 user tokens)
+        matches both;
+      * three branching variants + one sampled request over a 16-token
+        (2-block) common preamble with distinct tails — the subtree
+        shape, matched by prompt-block registration alone (both
+        matchers hit these, so the multi-turn DELTA isolates the
+        completion-registration surface);
+      * one cold control.
+    Turn-2 requests arrive LAST: with 2 engine rows the turn-1 rows
+    are long released by the time they admit, whatever the policy.
+    Returns (requests, greedy_refs) with refs=None for the sampled one.
+    """
+    convs = []
+    for _ in range(2):
+        p1 = rng.randint(0, cfg.vocab_size, size=6).tolist()
+        full1 = llama.generate(
+            params, cfg, jnp.asarray(p1, jnp.int32)[None, :],
+            max_new_tokens=12,
+        )
+        full1 = np.array(full1[0]).tolist()
+        assert len(full1) == 18
+        p2 = full1 + rng.randint(0, cfg.vocab_size, size=5).tolist()
+        convs.append((p1, p2))
+    preamble = rng.randint(0, cfg.vocab_size, size=16).tolist()
+    reqs = [ServeRequest(prompt=p1, max_new_tokens=12)
+            for p1, _ in convs]
+    for i in range(3):
+        tail = rng.randint(0, cfg.vocab_size, size=4 + i).tolist()
+        reqs.append(ServeRequest(prompt=preamble + tail,
+                                 max_new_tokens=6))
+    reqs.append(ServeRequest(
+        prompt=rng.randint(0, cfg.vocab_size, size=7).tolist(),
+        max_new_tokens=6,
+    ))
+    reqs.append(ServeRequest(
+        prompt=preamble + rng.randint(0, cfg.vocab_size, size=3).tolist(),
+        max_new_tokens=6, temperature=0.8, seed=5,
+    ))
+    reqs.extend(ServeRequest(prompt=p2, max_new_tokens=6)
+                for _, p2 in convs)
+    refs = []
+    for req in reqs:
+        if req.temperature > 0:
+            refs.append(None)
+            continue
+        ref = llama.generate(
+            params, cfg, jnp.asarray(req.prompt, jnp.int32)[None, :],
+            max_new_tokens=req.max_new_tokens,
+        )
+        refs.append(np.array(ref[0]).tolist())
+    return reqs, refs
+
+
+def test_radix_cache_aware_exactness_all_tiers():
+    """Round-9 acceptance: the radix tree (completion-block
+    registration included) and cache-aware admission ordering are pure
+    scheduling — the multi-turn + branching queue commits IDENTICAL
+    tokens across fused/gather × {radix cache-aware, the round-6
+    single-chain matcher (fifo + prompt-only registration), cache off}
+    on the fp and int8-KV tiers, and the fp tier equals the isolated
+    greedy decode. On top, the hit ledger proves the radix DELTA: the
+    single-chain matcher scores ~0 on the multi-turn legs (turn-1
+    prompts are sub-block, so it can register nothing a successor
+    could match), while the radix tree matches each prior turn's
+    full decoded chain."""
+    tiers = [("fp", tiny_cfg()), ("int8", tiny_cfg(kv_cache_quantized=True))]
+    variants = [
+        ("fused", "radix"), ("fused", "single"), ("fused", "off"),
+        ("gather", "radix"), ("gather", "single"), ("gather", "off"),
+        ("fused", "radix-fifo"),  # ordering-vs-content independence
+    ]
+    for name, cfg in tiers:
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        reqs, refs = _round9_queue(cfg, params, np.random.RandomState(41))
+        outs, metrics = {}, {}
+        for path, mode in variants:
+            if name != "fp" and mode == "radix-fifo":
+                continue
+            kw = dict(kv_block_size=8, attention_path=path)
+            if mode == "single":
+                kw.update(admission_policy="fifo",
+                          prefix_completions=False)
+            elif mode == "radix-fifo":
+                kw.update(admission_policy="fifo")
+            elif mode == "off":
+                kw.update(prefix_cache=False)
+            engine = ServingEngine(
+                llama.forward_decode, params, cfg, batch_size=2,
+                max_len=64, chunk=4, **kw,
+            )
+            results, metrics[(path, mode)] = engine.serve(reqs)
+            outs[(path, mode)] = [r.tokens for r in results]
+        base = outs[("fused", "radix")]
+        for key, toks in outs.items():
+            assert toks == base, f"tier {name}: variant {key} diverges"
+        if name == "fp":
+            for req, ref, toks in zip(reqs, refs, base):
+                if ref is not None:
+                    assert toks == ref, f"prompt {req.prompt[:4]}"
+        for path in ("fused", "gather"):
+            radix = metrics[(path, "radix")]
+            single = metrics[(path, "single")]
+            assert radix["admission_policy"] == "cache-aware"
+            assert single["admission_policy"] == "fifo"
+            # the single-chain matcher registers no decoded blocks, so
+            # both multi-turn successors (2 blocks = 16 tokens each)
+            # are hits ONLY the radix tree can see
+            assert radix["prefix_completion_blocks"] >= 4
+            assert single["prefix_completion_blocks"] == 0
+            assert (radix["prefix_hit_tokens"]
+                    >= single["prefix_hit_tokens"] + 32), (
+                f"tier {name} {path}: multi-turn chains not matched"
+            )
+            # depth ledger: the multi-turn hits land at tree depth 2
+            assert radix["prefix_hit_depth_hist"].get(2, 0) >= 2
+        fifo = metrics.get(("fused", "radix-fifo"))
+        if fifo is not None:
+            assert fifo["admission_overtakes"] == 0
+
+
+def test_radix_failover_requeued_request_rematches_tree():
+    """Kill-mid-decode failover leg (round 9): an engine death drains
+    the multi-turn queue, the planner folds committed tokens into the
+    requeued prompts, and on the replacement engine the requeued
+    requests RE-MATCH on the radix tree (completion chains included) —
+    outputs stay token-identical to the undisturbed isolated greedy
+    decode with zero requests lost and a leak-free pool."""
+    from nexus_tpu.cluster.store import ClusterStore
+    from nexus_tpu.ha.serve_failover import ServeEngineSupervisor
+    from tests.test_serve_failover import (
+        NS, _assert_pool_clean, _chaos_when_step,
+    )
+
+    cfg = tiny_cfg()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(43)
+    reqs, refs = _round9_queue(cfg, params, rng)
+    reqs = [r for r, ref in zip(reqs, refs) if ref is not None]
+    refs = [ref for ref in refs if ref is not None]
+
+    def make_engine():
+        return ServingEngine(
+            llama.forward_decode, params, cfg, batch_size=2, max_len=64,
+            chunk=2, kv_block_size=8,
+        )
+
+    store = ClusterStore("serve-shard-radix")
+    template = "radix"
+    sup = ServeEngineSupervisor(
+        make_engine, store, NS, template, ttl_seconds=0.12, pace_s=0.02,
+    )
+    _chaos_when_step(store, template, 10,
+                     lambda: sup.kill_current(hard=True))
+    results, report = sup.run(reqs, timeout_s=120)
+    assert report["requests_lost"] == 0
+    assert report["restarts"] >= 1, "chaos never landed mid-decode"
+    for req, ref, res in zip(reqs, refs, results):
+        assert res.tokens == ref, f"prompt {req.prompt[:4]}"
+    gens = report["generations"]
+    for gen in gens:
+        _assert_pool_clean(gen)
+    # the replacement engine's tree served hits: requeued merged
+    # prompts (prompt + committed completion) re-match the chains their
+    # cohort re-registers — including decoded blocks on every engine
+    assert gens[-1]["prefix_hit_tokens"] > 0
+    assert sum(g.get("prefix_completion_blocks", 0) for g in gens) > 0

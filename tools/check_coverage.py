@@ -40,6 +40,10 @@ FORCE_INCLUDE = [
     # dedup layer (a bad match serves one request another's K/V) —
     # always gated per-file, whatever future exclusions appear
     r"nexus_tpu/runtime/prefix_cache\.py$",
+    # the round-9 admission-ordering policies: scheduling decisions are
+    # where a starvation bug hides (ordering never changes tokens, so
+    # exactness tests can't see it) — gated per-file
+    r"nexus_tpu/runtime/scheduling\.py$",
     # the round-7 serve-failover planner: the drain-and-requeue math is
     # where a bug silently loses or duplicates user requests — always
     # gated per-file, whatever future exclusions appear
