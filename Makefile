@@ -3,7 +3,7 @@ SHELL := /bin/bash
 NATIVE_SRC := nexus_tpu/native/src/nexus_core.cpp nexus_tpu/native/src/nexus_data.cpp
 NATIVE_LIB := nexus_tpu/native/libnexus_core.so
 
-.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-failover bench-serve-outage chaos-smoke serve-smoke serve-chaos-smoke serve-sanitize-smoke radix-smoke race-smoke clean lint nexuslint analyze
+.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-failover bench-serve-outage chaos-smoke serve-smoke serve-chaos-smoke serve-sanitize-smoke radix-smoke spill-smoke race-smoke clean lint nexuslint analyze
 
 all: native
 
@@ -88,6 +88,20 @@ serve-smoke:
 radix-smoke:
 	NEXUS_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
 	  tests/test_prefix_cache.py tests/test_property_prefix_cache.py -q
+
+# Tiered-KV spill smoke (fast lane, stub-model, seconds on CPU): the
+# round-10 host tier — evict→spill→re-match→restore through the real
+# engine under a pool sized below the working set, the host-store /
+# radix spilled-state units (leaf-first spill, LRU host eviction,
+# int8 demotion error bound), and the property drivers (random
+# admit/evict/spill/restore: resident ∪ spilled partition exactness,
+# spilled never referenced, byte-identical fp restores) — run with the
+# runtime sanitizers ARMED so the pool-partition, tree, and host-cache
+# coherence audits execute at every engine teardown. Wired into the CI
+# fast job; the unarmed run rides `pytest -m "not slow"`.
+spill-smoke:
+	NEXUS_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
+	  tests/test_host_cache.py -q
 
 # Fused block-table attention smoke (fast lane, deterministic — every
 # test seeds its own RandomState): the round-8 kernel's parity tests

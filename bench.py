@@ -1132,6 +1132,179 @@ def _serve_radix_scenarios(preset, progress, block, chunk):
     return out
 
 
+def _serve_tiered_scenarios(preset, progress, block, chunk):
+    """Tiered-KV scenarios (round 10): the PRESSURE traffic shape the
+    host spill tier exists for — warm prompt families whose combined
+    working set exceeds the HBM pool, so pre-round-10 every
+    re-admission recomputed its preamble from scratch the moment
+    eviction fired.
+
+    * PRESSURE A/B (`tiered_*`): 4 warm families (48-token prompts =
+      3 full blocks at block 16) served 3 rounds each through a pool
+      sized below the 12-block warm working set, FIFO admission (the
+      cache-aware policy legitimately batches same-family requests and
+      dodges the pressure — honest A/Bs must not let it). Host tier
+      OFF = the round-9 engine: evictions destroy, hit tokens collapse.
+      Host tier ON: the same evictions demote, re-admissions restore
+      (`tiered_restore_hit_tokens` > 0) and prefill step-slots drop
+      (`tiered_prefill_reduction`). Exactness is re-proven IN-BENCH:
+      the host-tier queue re-serves cache-OFF and must commit identical
+      tokens (`tiered_exact`).
+
+    * HIT-RATE-VS-POOL-SIZE (`tiered_hit_rate_by_pool`): the same
+      queue swept across pool sizes with the tier on and off — the
+      curve ROADMAP's tiered-KV item asks for: with the tier off, hit
+      rate decays toward zero as the pool shrinks below the working
+      set; with it on, the rate holds (restores replace residency),
+      which is the "effective cache larger than HBM" claim in one
+      table.
+
+    * INT8 POOL (`tiered_int8_*`): the same pressure queue on
+      kvPoolDtype='int8' — roughly double the resident blocks per HBM
+      byte, spills byte-identical (already int8) — exactness asserted
+      against its own cache-off baseline (quantized writes differ from
+      fp numerically, so the baseline must be quantized too)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from nexus_tpu.models import llama
+        from nexus_tpu.runtime.serving import ServeRequest, ServingEngine
+        from nexus_tpu.utils.hw import is_tpu
+
+        dtype = jnp.bfloat16 if is_tpu() else jnp.float32
+        cfg = llama.config(preset, dtype=dtype, max_seq_len=256)
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+    except Exception as e:  # noqa: BLE001 — harness must not kill bench
+        progress(f"tiered scenarios unavailable: {type(e).__name__}: "
+                 f"{str(e)[:160]}")
+        return {}
+
+    rng = np.random.RandomState(100)
+    fams = [
+        rng.randint(0, cfg.vocab_size, size=3 * block).tolist()
+        for _ in range(4)
+    ]
+    queue = []
+    for _ in range(3):
+        for fam in fams:
+            queue.append(ServeRequest(
+                prompt=fam + rng.randint(0, cfg.vocab_size,
+                                         size=block // 2).tolist(),
+                max_new_tokens=block,
+            ))
+    prompt_tokens = sum(len(r.prompt) for r in queue)
+    # one request's envelope: prompt 3.5 blocks + budget 1 block +
+    # slack (chunk) + held slot — the floor every pool must clear
+    cap_blocks = -(-(
+        3 * block + block // 2 + block + chunk + 1
+    ) // block)
+
+    def serve(pool_blocks, host_bytes, pool_dtype="native",
+              cache=True):
+        eng = ServingEngine(
+            llama.forward_decode, params, cfg, batch_size=2,
+            max_len=256, chunk=chunk, prefill_chunk=1,
+            kv_block_size=block, kv_num_blocks=pool_blocks,
+            prefix_cache=cache, admission_policy="fifo",
+            host_cache_bytes=host_bytes, kv_pool_dtype=pool_dtype,
+        )
+        results, m = eng.serve(queue)
+        return [r.tokens for r in results], m
+
+    out = {}
+    tight = max(cap_blocks, 2 * cap_blocks - 2)  # below the working set
+    try:
+        toks_on, m_on = serve(tight, 1 << 30)
+        toks_off, m_off = serve(tight, 0)
+        toks_nocache, _ = serve(tight, 0, cache=False)
+    except Exception as e:  # noqa: BLE001
+        progress(f"tiered pressure leg failed: {type(e).__name__}: "
+                 f"{str(e)[:160]}")
+        out["tiered_exact"] = False
+        return out
+    out["tiered_pool_blocks"] = tight
+    out["tiered_warm_working_set_blocks"] = 4 * 3
+    out["tiered_restore_hit_tokens"] = int(
+        m_on.get("restore_hit_tokens") or 0
+    )
+    out["tiered_spilled_blocks"] = int(m_on.get("spilled_blocks") or 0)
+    out["tiered_host_cache_bytes_peak"] = int(
+        m_on.get("host_cache_bytes_peak") or 0
+    )
+    out["tiered_hit_tokens_on"] = int(m_on.get("prefix_hit_tokens") or 0)
+    out["tiered_hit_tokens_off"] = int(
+        m_off.get("prefix_hit_tokens") or 0
+    )
+    out["tiered_prefill_steps_on"] = int(m_on.get("prefill_steps") or 0)
+    out["tiered_prefill_steps_off"] = int(
+        m_off.get("prefill_steps") or 0
+    )
+    out["tiered_prefill_reduction"] = round(
+        out["tiered_prefill_steps_off"]
+        / max(1, out["tiered_prefill_steps_on"]), 3,
+    )
+    exact = toks_on == toks_off == toks_nocache
+    if not exact:
+        progress("tiered pressure: EXACTNESS VIOLATION — host-tier "
+                 "tokens diverge from spill-off/cache-off")
+    progress(
+        f"tiered pressure (pool {tight} blocks vs {4 * 3}-block warm "
+        f"set): restore_hit_tokens {out['tiered_restore_hit_tokens']}, "
+        f"hits on/off {out['tiered_hit_tokens_on']}/"
+        f"{out['tiered_hit_tokens_off']}, prefill steps "
+        f"{out['tiered_prefill_steps_on']} vs "
+        f"{out['tiered_prefill_steps_off']} "
+        f"({out['tiered_prefill_reduction']}x)"
+    )
+    # ---- hit-rate-vs-pool-size curve (tier on vs off) ----
+    curve = {}
+    for pool in (tight, tight + 4, 4 * 3 + cap_blocks):
+        row = {}
+        for tag, hb in (("on", 1 << 30), ("off", 0)):
+            try:
+                toks, m = serve(pool, hb)
+            except Exception as e:  # noqa: BLE001
+                progress(f"tiered curve pool={pool} {tag} failed: "
+                         f"{type(e).__name__}: {str(e)[:120]}")
+                continue
+            exact = exact and toks == toks_nocache
+            row[tag] = round(
+                (m.get("prefix_hit_tokens") or 0) / prompt_tokens, 3
+            )
+        if row:
+            curve[str(pool)] = row
+    out["tiered_hit_rate_by_pool"] = curve
+    progress(f"tiered hit-rate-vs-pool-size: {curve}")
+    # ---- int8 pool leg (its own quantized cache-off baseline) ----
+    try:
+        toks_q_on, m_q = serve(tight, 1 << 30, pool_dtype="int8")
+        toks_q_off, _ = serve(tight, 0, pool_dtype="int8", cache=False)
+        out["tiered_int8_pool_restore_hit_tokens"] = int(
+            m_q.get("restore_hit_tokens") or 0
+        )
+        out["tiered_int8_pool_bytes"] = int(m_q.get("kv_pool_bytes") or 0)
+        out["tiered_fp_pool_bytes"] = int(m_on.get("kv_pool_bytes") or 0)
+        out["tiered_int8_pool_bytes_reduction"] = round(
+            out["tiered_fp_pool_bytes"]
+            / max(1, out["tiered_int8_pool_bytes"]), 3,
+        )
+        exact = exact and toks_q_on == toks_q_off
+        progress(
+            "tiered int8 pool: restore_hit_tokens "
+            f"{out['tiered_int8_pool_restore_hit_tokens']}, pool bytes "
+            f"{out['tiered_int8_pool_bytes']} vs fp "
+            f"{out['tiered_fp_pool_bytes']} "
+            f"({out['tiered_int8_pool_bytes_reduction']}x)"
+        )
+    except Exception as e:  # noqa: BLE001
+        progress(f"tiered int8 leg failed: {type(e).__name__}: "
+                 f"{str(e)[:160]}")
+    out["tiered_exact"] = exact
+    return out
+
+
 def _serve_only_stage(progress):
     """Serve-only stage (`make bench-serve`, NEXUS_BENCH_SERVE=only):
     the paged-KV ledger and the row-scaling point, CPU-runnable — the
@@ -1280,6 +1453,13 @@ def _serve_only_stage(progress):
         "0", "false"
     ):
         out.update(_serve_radix_scenarios(preset, progress, block, chunk))
+    # ---- tiered-KV scenarios (round 10): pool-pressure A/B with the
+    # host spill tier on/off, the hit-rate-vs-pool-size curve, and the
+    # int8 block pool — the tentpole's acceptance ledger
+    if os.environ.get("NEXUS_BENCH_SERVE_TIERED", "1") not in (
+        "0", "false"
+    ):
+        out.update(_serve_tiered_scenarios(preset, progress, block, chunk))
     # ---- outage leg (round 7): kill-mid-decode → detector → requeue →
     # token-identical recovery, plus bounded-queue shed honesty — its
     # time-to-recover / requests-lost keys ride the per-round artifact

@@ -496,6 +496,27 @@ class ServeSpec:
     # admission waves a request may be passed over before it outranks
     # every fresher arrival (the cache-aware starvation bound)
     admission_aging_waves: int = 8
+    # ---- tiered KV cache (round 10) ----
+    # KV block-pool dtype: "int8" runs the quantized pool (K/V int8 +
+    # per-(position, head) f32 scales — the int8-KV decode tier both
+    # attention kernels already dequantize), roughly DOUBLING resident
+    # blocks per HBM byte; "native" stores at the model dtype. The HBM
+    # gate prices the pool at the chosen dtype.
+    kv_pool_dtype: str = "native"
+    # host-RAM spill tier budget (bytes; 0 = off): pool pressure
+    # DEMOTES evicted parked prefix blocks into a host-side LRU store
+    # instead of destroying them — the radix-tree entry is marked
+    # spilled, admission matches resident AND spilled spans, and a hit
+    # swaps the spilled blocks back through one fixed-shape upload per
+    # wave (prefill starts past the restored span). The effective
+    # prefix cache is bounded by host RAM, not the pool. Requires the
+    # paged layout + prefixCache.
+    host_cache_bytes: int = 0
+    # "int8" demotes fp payloads on spill (~2x spilled blocks per host
+    # byte, at the quantizer's documented max|x|/254 per-element
+    # error); "native" keeps every restore byte-identical. An int8
+    # POOL's spills are byte-identical either way (already int8).
+    host_cache_dtype: str = "native"
     # ---- serve-plane fault tolerance (round 7) ----
     # bounded wait queue: past this depth the LOWEST-priority queued
     # requests shed with an explicit `shed` status instead of queuing
@@ -539,7 +560,19 @@ class ServeSpec:
         admission can always place the declared concurrency. The ONE
         sizing formula shared by the HBM gate (hbm_budget_gb) and the
         serve entrypoint, so validation and the engine's actual pool can
-        never diverge. 0 when the spec runs the dense layout."""
+        never diverge. 0 when the spec runs the dense layout.
+
+        The pool sizes the HBM tier of a (round 10) TIERED cache, not
+        the whole cache: with ``hostCacheBytes`` set, evicted prefix
+        blocks demote to host RAM and swap back on a hit, so the
+        EFFECTIVE prefix-cache capacity is pool + host budget. The pool
+        still bounds what is simultaneously READABLE — every block a
+        live row attends over (restored spans included) must be pool-
+        resident, which is why this envelope ignores the host tier:
+        concurrency is priced against HBM alone, and the host tier only
+        widens how much warm history survives between admissions
+        (``kvPoolDtype: int8`` is the knob that stretches the HBM tier
+        itself, ~2x blocks per byte)."""
         bs = self.kv_block_size
         if bs <= 0:
             return 0
@@ -603,6 +636,12 @@ class ServeSpec:
             d["admissionPolicy"] = self.admission_policy
         if self.admission_aging_waves != 8:
             d["admissionAgingWaves"] = self.admission_aging_waves
+        if self.kv_pool_dtype != "native":
+            d["kvPoolDtype"] = self.kv_pool_dtype
+        if self.host_cache_bytes:
+            d["hostCacheBytes"] = self.host_cache_bytes
+        if self.host_cache_dtype != "native":
+            d["hostCacheDtype"] = self.host_cache_dtype
         if self.max_queue_depth:
             d["maxQueueDepth"] = self.max_queue_depth
         if self.max_queue_delay_s:
@@ -634,6 +673,9 @@ class ServeSpec:
                 8 if d.get("admissionAgingWaves") is None
                 else d["admissionAgingWaves"]
             ),
+            kv_pool_dtype=str(d.get("kvPoolDtype") or "native"),
+            host_cache_bytes=int(d.get("hostCacheBytes", 0) or 0),
+            host_cache_dtype=str(d.get("hostCacheDtype") or "native"),
             max_queue_depth=int(d.get("maxQueueDepth", 0) or 0),
             max_queue_delay_s=float(d.get("maxQueueDelaySeconds", 0) or 0),
             request_deadline_s=float(
@@ -868,11 +910,17 @@ class JaxXlaRuntime:
                 # int8 cache: 1 byte/element plus the per-(pos, head)
                 # f32 scale planes (4 bytes per head_dim elements) —
                 # budgeting it at the compute dtype would reject exactly
-                # the configs the flag exists to make fit
+                # the configs the flag exists to make fit. The serve
+                # spec's kvPoolDtype='int8' selects the same layout at
+                # the serve level (round 10) and must price the same.
+                quant_cache = bool(
+                    self.model.overrides.get("kv_cache_quantized")
+                ) or (
+                    self.mode == "serve"
+                    and self.serve.kv_pool_dtype == "int8"
+                )
                 cache_bytes_per_elem = (
-                    1.0 + 4.0 / hd
-                    if self.model.overrides.get("kv_cache_quantized")
-                    else float(dt_bytes)
+                    1.0 + 4.0 / hd if quant_cache else float(dt_bytes)
                 )
                 if self.mode == "serve" and self.serve.kv_block_size > 0:
                     # paged serve: the engine holds a block POOL sized
@@ -1104,6 +1152,43 @@ class JaxXlaRuntime:
                     "cache-aware starvation bound), got "
                     f"{sv.admission_aging_waves}"
                 )
+            if sv.kv_pool_dtype not in ("native", "int8"):
+                errs.append(
+                    "serve.kvPoolDtype must be 'native' or 'int8' "
+                    "(the quantized block pool — ~2x resident blocks "
+                    f"per HBM byte), got {sv.kv_pool_dtype!r}"
+                )
+            if sv.kv_pool_dtype == "int8" and sv.kv_block_size <= 0:
+                errs.append(
+                    "serve.kvPoolDtype='int8' sizes the paged block "
+                    "pool; the dense layout (kvBlockSize 0) quantizes "
+                    "via model.overrides.kv_cache_quantized"
+                )
+            if sv.host_cache_bytes < 0:
+                errs.append(
+                    "serve.hostCacheBytes must be >= 0 (0 = no host "
+                    f"spill tier), got {sv.host_cache_bytes}"
+                )
+            if sv.host_cache_dtype not in ("native", "int8"):
+                errs.append(
+                    "serve.hostCacheDtype must be 'native' "
+                    "(byte-identical restores) or 'int8' (demote on "
+                    "spill, ~2x blocks per host byte), got "
+                    f"{sv.host_cache_dtype!r}"
+                )
+            if sv.host_cache_bytes > 0 and sv.kv_block_size <= 0:
+                errs.append(
+                    "serve.hostCacheBytes requires the paged layout "
+                    "(kvBlockSize > 0): the spill tier demotes pool "
+                    "BLOCKS — a dense cache has none"
+                )
+            if sv.host_cache_bytes > 0 and not sv.prefix_cache:
+                errs.append(
+                    "serve.hostCacheBytes requires prefixCache: "
+                    "spilled state lives in the radix prefix tree, so "
+                    "without the cache nothing could ever be re-matched "
+                    "and restored"
+                )
             if sv.shared_prefix_length < 0:
                 errs.append(
                     "serve.sharedPrefixLength must be >= 0, got "
@@ -1210,7 +1295,13 @@ class JaxXlaRuntime:
                                 f"serve.kvNumBlocks ({sv.kv_num_blocks}) "
                                 "cannot hold the queue's largest request "
                                 f"({need} blocks of {sv.kv_block_size} "
-                                f"for its {cap}-position envelope)"
+                                f"for its {cap}-position envelope) — "
+                                "the HBM pool alone bounds what one "
+                                "live row can read (hostCacheBytes "
+                                "widens the prefix cache between "
+                                "admissions, never a single request's "
+                                "resident need; kvPoolDtype 'int8' is "
+                                "the knob that stretches the pool)"
                             )
         if self.infer.draft is not None and self.mode == "infer":
             from nexus_tpu.models.registry import get_family, list_families

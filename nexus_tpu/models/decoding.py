@@ -149,6 +149,51 @@ def copy_kv_blocks(
     return cache
 
 
+def gather_kv_block(
+    cache: Dict[str, Any], blk: jnp.ndarray
+) -> Dict[str, Any]:
+    """Gather ONE pool block's planes across every layer of a PAGED
+    cache — the device half of a host-tier SPILL (demotion): the
+    serving engine jits this once with ``blk`` as a TRACED scalar (one
+    compiled program whatever block pool pressure reclaims), fetches
+    the result, and hands the numpy planes to the host block store
+    (runtime/host_cache.py). Returns ``{"k": (L, Bs, Hkv, D), "v": ...}``
+    plus the int8 cache's ``(L, Bs, Hkv)`` scale planes when present.
+    The victim is always a parked (refcount-0, fully-written) block, so
+    the download is of FROZEN content — device-stream ordering plus the
+    host fetch's synchronization guarantee every write has landed."""
+    out: Dict[str, Any] = {}
+    for key in ("k", "v", "k_scale", "v_scale"):
+        if key in cache:
+            out[key] = lax.dynamic_index_in_dim(
+                cache[key], blk, axis=1, keepdims=False
+            )
+    return out
+
+
+def write_kv_blocks(
+    cache: Dict[str, Any], dst: jnp.ndarray, planes: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Scatter host-provided block planes into pool blocks ``dst[i]``
+    across every K/V plane of a PAGED cache — the device half of a
+    host-tier RESTORE (promotion), and the upload sibling of
+    ``copy_kv_blocks``: one fixed-shape dispatch per admission wave
+    covers every restored block (``dst`` is a fixed-width (W,) int32
+    vector; out-of-range entries are padding and drop). ``planes``
+    carries ``(L, W, Bs, ...)`` stacks in the pool's own dtypes (the
+    engine dequantizes int8-demoted payloads back to the pool dtype
+    BEFORE minting them — or uploads int8 + scales verbatim into a
+    quantized pool). Everything else in the cache (tables, lengths)
+    passes through untouched."""
+    cache = dict(cache)
+    for key in ("k", "v", "k_scale", "v_scale"):
+        if key in cache:
+            cache[key] = cache[key].at[:, dst].set(
+                planes[key], mode="drop"
+            )
+    return cache
+
+
 def generic_forward_decode(
     params: Dict[str, Any],
     cfg: Any,

@@ -952,6 +952,11 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None):
             attention_path=sv.attention_path,
             admission_policy=sv.admission_policy,
             admission_aging_waves=sv.admission_aging_waves,
+            # tiered KV cache (round 10): the quantized block pool and
+            # the host-RAM spill tier under it
+            kv_pool_dtype=sv.kv_pool_dtype,
+            host_cache_bytes=sv.host_cache_bytes,
+            host_cache_dtype=sv.host_cache_dtype,
         )
         results, metrics = engine.serve(
             requests, cancel=cancel, heartbeat=heartbeat,

@@ -1,0 +1,187 @@
+"""Host-RAM spill tier for the paged serving KV cache (round 10).
+
+Under pool pressure the ref-counted :class:`BlockAllocator`
+(runtime/serving.py) reclaims parked (refcount-0) prefix blocks. Before
+round 10 reclaim DESTROYED the content — a warm system prompt or a
+multi-turn conversation's history, exactly what the radix prefix tree
+was built to re-match, was recomputed from scratch the moment HBM ran
+tight. This module is the second storage tier that turns eviction into
+DEMOTION: the evicted block's K/V planes are downloaded into a bounded,
+byte-budgeted host-side store keyed by the block's chain digest, the
+radix-tree entry is marked *spilled* instead of removed
+(runtime/prefix_cache.py), and a later prefix match PROMOTES the block
+back — the allocator maps a fresh pool block and the engine uploads the
+host copy in one fixed-shape dispatch per admission wave
+(models/decoding.py::write_kv_blocks). The effective prefix cache is
+bounded by host RAM, not HBM (Prompt Cache's modular reuse, PAPERS.md).
+
+The store is a plain LRU over digests with exact byte accounting:
+
+  * ``put`` charges every plane's ``nbytes``; when the budget is
+    exceeded the CALLER (the allocator) evicts — leaf-first through
+    ``PrefixCacheIndex.evict_spilled_lru`` — so the tree and the store
+    can never disagree about what is restorable (the invariant the
+    sanitizer's host-cache audit asserts: store keys == spilled tree
+    entries, bit for bit).
+  * ``dtype="int8"`` DEMOTES floating-point payloads on spill: K/V are
+    quantized per (layer, position, head) vector to int8 with an f32
+    scale plane — the same max-abs/127 rule the device-side int8 cache
+    uses (models/decoding.py::_quantize_kv), so a restored block's
+    worst-case per-element error is ``max|x| / 254`` of its vector's
+    magnitude (half a quantization step). Roughly 2x more spilled
+    blocks per host byte, at the documented precision cost; restores of
+    an ALREADY-int8 pool's blocks are byte-identical (nothing to
+    demote), as are ``dtype="native"`` restores of any pool.
+
+Pure numpy + stdlib — no jax, no clocks (LRU order is operation order,
+so spill/restore schedules replay exactly under the injectable-clock
+test discipline)."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+HOST_CACHE_DTYPES = ("native", "int8")
+
+
+def quantize_kv_host(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(..., D) float → (int8 values, (...) f32 per-vector scales) — the
+    host mirror of models/decoding.py::_quantize_kv (max-abs/127 per
+    trailing vector), so int8 demotion and the device int8 cache share
+    ONE documented error model: |x - dequant(x)| <= scale/2 =
+    max|x|/254 per vector."""
+    scale = (
+        np.abs(x.astype(np.float32)).max(axis=-1) / 127.0
+    ).astype(np.float32)
+    safe = np.where(scale == 0.0, 1.0, scale)
+    q = np.clip(
+        np.round(x.astype(np.float32) / safe[..., None]), -127, 127
+    ).astype(np.int8)
+    return q, scale
+
+
+def dequantize_kv_host(q: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`quantize_kv_host` (f32 output; the engine casts
+    to the pool dtype at upload)."""
+    return q.astype(np.float32) * scale[..., None].astype(np.float32)
+
+
+class HostBlockStore:
+    """Bounded LRU store of spilled KV blocks, keyed by chain digest.
+
+    An entry is the full plane dict of ONE pool block as downloaded by
+    the engine — ``{"k", "v"}`` for an fp pool, plus ``{"k_scale",
+    "v_scale"}`` for an int8 pool (or after int8 demotion; demoted
+    entries reuse the quantized pool's plane names so promotion has one
+    layout to reason about). ``budget_bytes`` bounds the SUM of plane
+    nbytes; the store never evicts on its own — ``over_budget`` tells
+    the allocator to reclaim through the radix tree's leaf-first
+    spilled-LRU so tree and store stay in lockstep."""
+
+    def __init__(self, budget_bytes: int, dtype: str = "native") -> None:
+        if budget_bytes < 0:
+            raise ValueError(
+                f"budget_bytes must be >= 0, got {budget_bytes}"
+            )
+        if dtype not in HOST_CACHE_DTYPES:
+            raise ValueError(
+                f"host cache dtype must be one of {HOST_CACHE_DTYPES}, "
+                f"got {dtype!r}"
+            )
+        self.budget_bytes = int(budget_bytes)
+        self.dtype = dtype
+        # digest → plane dict; insertion order == LRU → MRU
+        self._entries: "OrderedDict[bytes, Dict[str, np.ndarray]]" = (
+            OrderedDict()
+        )
+        self._demoted: set = set()  # keys stored int8-demoted from fp
+        self._bytes = 0
+        self.bytes_peak = 0
+        self.puts = 0
+        self.takes = 0
+        self.drops = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self._entries
+
+    @property
+    def bytes(self) -> int:
+        return self._bytes
+
+    def over_budget(self) -> bool:
+        return self._bytes > self.budget_bytes
+
+    def keys(self) -> List[bytes]:
+        """Digests held, LRU → MRU (the audit's view)."""
+        return list(self._entries)
+
+    @staticmethod
+    def _nbytes(planes: Dict[str, np.ndarray]) -> int:
+        return sum(int(a.nbytes) for a in planes.values())
+
+    def put(self, key: bytes, planes: Dict[str, np.ndarray]) -> None:
+        """Store one spilled block's planes under ``key`` (MRU end).
+
+        ``dtype="int8"`` demotes floating-point K/V on the way in;
+        payloads that are ALREADY int8 (a quantized pool's blocks) pass
+        through byte-identical. One entry per digest — the tree marks a
+        digest spilled exactly once, so a duplicate put is a
+        bookkeeping bug, not a cache policy decision."""
+        if key in self._entries:
+            raise ValueError("digest already spilled — tree/store "
+                             "bookkeeping diverged")
+        planes = {k: np.asarray(v) for k, v in planes.items()}
+        if self.dtype == "int8" and planes["k"].dtype != np.int8:
+            kq, ks = quantize_kv_host(planes["k"])
+            vq, vs = quantize_kv_host(planes["v"])
+            planes = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
+            self._demoted.add(key)
+        self._entries[key] = planes
+        self._bytes += self._nbytes(planes)
+        self.bytes_peak = max(self.bytes_peak, self._bytes)
+        self.puts += 1
+
+    def take(self, key: bytes) -> Tuple[Dict[str, np.ndarray], bool]:
+        """Remove and return ``(planes, demoted)`` for a restore —
+        ``demoted`` tells the engine to dequantize before uploading
+        into an fp pool. The entry leaves the store: once resident the
+        pool block is the content's one home again (re-spilling later
+        re-downloads, so the host copy can never go stale)."""
+        planes = self._entries.pop(key)
+        self._bytes -= self._nbytes(planes)
+        self.takes += 1
+        demoted = key in self._demoted
+        self._demoted.discard(key)
+        return planes, demoted
+
+    def drop(self, key: bytes) -> None:
+        """Discard an entry (host-budget eviction — the caller already
+        removed the tree's spilled marker via evict_spilled_lru)."""
+        planes = self._entries.pop(key)
+        self._bytes -= self._nbytes(planes)
+        self._demoted.discard(key)
+        self.drops += 1
+
+    def audit(self) -> None:
+        """Byte-accounting coherence: the running total equals the sum
+        over live entries, and demotion markers track live keys only —
+        asserted by the sanitizer's host-cache audit next to the
+        tree/store key cross-check."""
+        actual = sum(self._nbytes(p) for p in self._entries.values())
+        if actual != self._bytes:
+            raise AssertionError(
+                f"host cache byte accounting diverged: tracked "
+                f"{self._bytes}, live entries hold {actual}"
+            )
+        stray = self._demoted - set(self._entries)
+        if stray:
+            raise AssertionError(
+                f"demotion markers for {len(stray)} dropped entr"
+                "(y/ies) were never cleared"
+            )

@@ -30,6 +30,21 @@ parent-linked insert (an orphan whose ancestor was evicted is refused,
 never silently unmatchable), leaf-first eviction, and per-depth hit
 accounting.
 
+Round 10 adds a THIRD residency state: **spilled**. With a host-RAM
+tier attached (runtime/host_cache.py), pool pressure DEMOTES the
+eviction victim instead of destroying it — ``spill`` keeps the entry's
+digest in the tree (its block slot becomes the ``SPILLED`` sentinel and
+the K/V bytes move to the host store), and a later ``match_tiered``
+reports the spilled span after the resident prefix so admission can
+PROMOTE it: ``restore`` rebinds the digest to a freshly-allocated pool
+block the engine uploads the host copy into. Spill is leaf-first like
+eviction, and restore always extends the resident frontier downward, so
+every root-to-leaf path is a resident prefix followed by a spilled
+suffix — the closure ``audit`` asserts, and the reason a resident
+``match`` can simply stop at the first spilled entry. Host-budget
+pressure removes spilled entries leaf-first too (``evict_spilled_lru``)
+so a dropped tail can never strand a restorable ancestor chain.
+
 The K/V of prompt position i is a function of tokens 0..i alone, and
 the serving engine writes each registered position exactly once before
 publishing it, so an indexed block is FROZEN — sharing it is pure
@@ -45,6 +60,10 @@ from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+#: block-slot sentinel for a tree entry whose K/V live in the host tier
+#: (the digest stays matchable, the pool block is gone)
+SPILLED = -1
 
 
 def chain_keys(
@@ -133,6 +152,17 @@ class PrefixCacheIndex:
         self._park_clock = 0
         self._park_seq: Dict[int, int] = {}
         self._leaf_heap: List[Tuple[int, int]] = []
+        # ---- the SPILLED tier (round 10) ----
+        # digest → spill sequence for entries whose K/V moved to the
+        # host store; plus the host-budget eviction accelerator — a
+        # min-heap of (spill sequence, digest) FULL-LEAF candidates
+        # with the same lazy invalidation as _leaf_heap. Leaf-first
+        # spill means descendants spill before ancestors, so spill
+        # sequence order is naturally tail-first and LRU host eviction
+        # drops cold tails before the chains that need them.
+        self._spill_clock = 0
+        self._spilled: Dict[bytes, int] = {}
+        self._spilled_heap: List[Tuple[int, bytes]] = []
 
     def __len__(self) -> int:
         return len(self._by_key)
@@ -140,6 +170,11 @@ class PrefixCacheIndex:
     @property
     def parked_count(self) -> int:
         return len(self._parked)
+
+    @property
+    def spilled_count(self) -> int:
+        """Tree entries whose K/V live in the host tier."""
+        return len(self._spilled)
 
     # ------------------------------------------------------------ insert
 
@@ -223,12 +258,28 @@ class PrefixCacheIndex:
 
     def match(self, keys: Sequence[bytes]) -> List[int]:
         """Walk the tree from the root along ``keys`` → the blocks of
-        the longest cached prefix. Because digests chain, the walk stops
-        at the first divergence — whether that is a miss at a branch
-        point, mid-run, or simply the end of what is cached. Chains
-        extended by completion blocks match exactly like prompt chains
-        (the tree does not know the difference)."""
+        the longest RESIDENT cached prefix. Because digests chain, the
+        walk stops at the first divergence — a miss at a branch point,
+        mid-run, the end of what is cached, or a SPILLED entry (whose
+        K/V are in the host tier, not the pool; ``match_tiered``
+        reports that continuation). Chains extended by completion
+        blocks match exactly like prompt chains (the tree does not know
+        the difference)."""
+        return self.match_tiered(keys)[0]
+
+    def match_tiered(
+        self, keys: Sequence[bytes]
+    ) -> Tuple[List[int], List[bytes]]:
+        """Walk the tree along ``keys`` → ``(resident_blocks,
+        spilled_keys)``: the pool blocks of the longest resident prefix,
+        then the digests of the CONTIGUOUS spilled span that extends it
+        (restorable from the host store). Spill is leaf-first and
+        restore extends the resident frontier downward, so along any
+        root path residency is a prefix — the first spilled entry ends
+        the resident span for good, and the spilled span ends at the
+        first divergence or un-spilled gap."""
         blocks: List[int] = []
+        spilled: List[bytes] = []
         node = self._root
         i = 0
         while i < len(keys):
@@ -238,25 +289,35 @@ class PrefixCacheIndex:
             node = nxt
             for j in range(len(node.keys)):
                 if i < len(keys) and node.keys[j] == keys[i]:
-                    blocks.append(node.blocks[j])
+                    if node.keys[j] in self._spilled:
+                        spilled.append(node.keys[j])
+                    elif spilled:
+                        # a resident entry below a spilled one would
+                        # violate the residency-prefix closure audit()
+                        # asserts — never extend the span across it
+                        return blocks, spilled
+                    else:
+                        blocks.append(node.blocks[j])
                     i += 1
                 else:
-                    return blocks  # diverged mid-run / keys exhausted
-        return blocks
+                    return blocks, spilled  # diverged / keys exhausted
+        return blocks, spilled
 
     def holds(self, block: int) -> bool:
         return block in self._by_block
 
     def holder(self, key: bytes) -> Optional[int]:
-        """The block currently holding ``key``'s content, or None. The
-        serving engine's registration guard uses this: a row may extend
-        the tree only under a parent digest held by the row's OWN block
-        — attaching a referenced block beneath ANOTHER lease's block
-        (duplicate-content race, CoW source) could leave a parked run
-        with referenced descendants, which breaks the descendant
-        closure that leaf-first eviction's progress relies on."""
+        """The POOL block currently holding ``key``'s content, or None
+        (unknown digest, or spilled — host bytes are nobody's lease).
+        The serving engine's registration guard uses this: a row may
+        extend the tree only under a parent digest held by the row's
+        OWN block — attaching a referenced block beneath ANOTHER
+        lease's block (duplicate-content race, CoW source) could leave
+        a parked run with referenced descendants, which breaks the
+        descendant closure that leaf-first eviction's progress relies
+        on."""
         loc = self._by_key.get(key)
-        if loc is None:
+        if loc is None or key in self._spilled:
             return None
         node, off = loc
         return node.blocks[off]
@@ -297,21 +358,74 @@ class PrefixCacheIndex:
 
     # ----------------------------------------------------------- evict
 
+    def _descendant_entries(
+        self, node: _RadixNode, off: int
+    ) -> List[bytes]:
+        """The IMMEDIATE descendant digests of the entry at (node, off):
+        the run's next entry, or every child's first entry at the run
+        end. Closure arguments only ever need the immediate layer."""
+        if off + 1 < len(node.keys):
+            return [node.keys[off + 1]]
+        return [ch.keys[0] for ch in node.children.values()]
+
     def evictable(self, block: int) -> bool:
-        """True when ``block`` has no indexed descendant — it is the
-        tail of a childless run, so removing it cannot strand a cached
-        chain (leaf-first eviction's unit test)."""
+        """True when ``block`` has no RESIDENT indexed descendant — its
+        descendants (if any) are all spilled, so reclaiming (or
+        spilling) it cannot strand a resident chain. Without a host
+        tier nothing is ever spilled and this is exactly the old
+        no-descendants-at-all rule (leaf-first eviction's unit
+        test)."""
         key = self._by_block.get(block)
         if key is None:
             return False
         node, off = self._by_key[key]
-        return off == len(node.keys) - 1 and not node.children
+        return all(
+            d in self._spilled
+            for d in self._descendant_entries(node, off)
+        )
+
+    def _remove_entry(self, key: bytes) -> None:
+        """Shared tail surgery for ``remove`` (resident leaf) and
+        ``remove_spilled`` (spilled leaf): pop the entry from its run,
+        unlink an emptied node, and re-arm the heap entry of whatever
+        leaf the removal exposes — a PARKED new tail re-enters
+        ``_leaf_heap`` at its original park sequence (victim choice
+        stays exactly park-LRU), a SPILLED new full-leaf re-enters
+        ``_spilled_heap`` at its original spill sequence. Callers have
+        already validated leaf-ness and cleared their own state maps."""
+        node, _ = self._by_key.pop(key)
+        node.keys.pop()
+        node.blocks.pop()
+        exposed: Optional[_RadixNode] = None
+        if not node.keys and node.parent is not None:
+            # the run emptied: unlink the node (its first — only — key
+            # was `key`, which is how the parent indexed it)
+            del node.parent.children[key]
+            exposed = node.parent
+        elif node.keys:
+            exposed = node
+        if (exposed is None or exposed.parent is None
+                or not exposed.keys or exposed.children):
+            return
+        tail_key = exposed.keys[-1]
+        sseq = self._spilled.get(tail_key)
+        if sseq is not None:
+            heapq.heappush(self._spilled_heap, (sseq, tail_key))
+            return
+        tail = exposed.blocks[-1]
+        seq = self._park_seq.get(tail)
+        if seq is not None:
+            heapq.heappush(self._leaf_heap, (seq, tail))
 
     def remove(self, block: int) -> None:
         """Remove an indexed LEAF block from the tree: drop its digest
         so it can never match again. Refuses (RuntimeError) to remove a
         block with indexed descendants — interior runs must outlive
-        their tails by construction, never by caller discipline."""
+        their tails by construction, never by caller discipline.
+        (Spilled descendants refuse too: discarding a resident entry
+        under which host-tier content hangs would strand it
+        unmatchable — the allocator spills, never removes, when a host
+        tier is attached.)"""
         key = self._by_block.get(block)
         if key is None:
             raise ValueError(f"block {block} is not indexed")
@@ -321,63 +435,152 @@ class PrefixCacheIndex:
                 f"block {block} still has cached descendants — "
                 "leaf-first eviction must reclaim the tails first"
             )
-        node.keys.pop()
-        node.blocks.pop()
-        del self._by_key[key]
         del self._by_block[block]
         self._parked.pop(block, None)
         self._park_seq.pop(block, None)
-        exposed: Optional[_RadixNode] = None
-        if not node.keys and node.parent is not None:
-            # the run emptied: unlink the node (its first — only — key
-            # was `key`, which is how the parent indexed it)
-            del node.parent.children[key]
-            exposed = node.parent
-        elif node.keys:
-            exposed = node
-        # the removal may expose a NEW evictable leaf (the run's new
-        # tail, or the parent's tail once its last child unlinks) — if
-        # that block is parked, (re)arm its heap entry at its original
-        # park sequence so eviction order stays exactly park-LRU
-        if (exposed is not None and exposed.parent is not None
-                and exposed.keys and not exposed.children):
-            tail = exposed.blocks[-1]
-            seq = self._park_seq.get(tail)
-            if seq is not None:
-                heapq.heappush(self._leaf_heap, (seq, tail))
+        self._remove_entry(key)
 
-    def evict_lru(self) -> int:
-        """Reclaim the least-recently-used parked block WITHOUT cached
-        descendants (leaf-first): drop its digest, return it for
-        reallocation. Only refcount-0 blocks are ever parked, so
-        eviction can never touch a block some row still reads — the
-        allocator calls this only when its free list is empty (pool
-        pressure). The allocator keeps references prefix-closed, which
-        makes the parked set descendant-closed — so whenever anything
-        is parked, a parked evictable leaf exists."""
+    def _pop_victim(self) -> int:
+        """The least-recently-used parked block without resident
+        descendants — the ONE victim-selection rule ``evict_lru``
+        (discard) and ``spill_lru`` (demote to the host tier) share, so
+        attaching a host tier never changes WHICH block pool pressure
+        reclaims. Lazy-invalidation pop: a stale entry is one whose
+        block was unparked (sequence gone), re-parked (sequence moved),
+        or grew a resident child since it was pushed — skip it; each
+        stale entry is dropped exactly once, so selection stays
+        amortized O(log n) instead of re-scanning parked interior runs
+        every call. The popped block is STILL parked and indexed — the
+        caller immediately removes or spills it."""
         if not self._parked:
             raise RuntimeError(
                 "no evictable cached blocks (every indexed block is "
                 "referenced) — the allocator's admission gate should "
                 "have refused before reaching here"
             )
-        # lazy-invalidation pop: a stale entry is one whose block was
-        # unparked (sequence gone), re-parked (sequence moved), or grew
-        # a child since it was pushed — skip it; each stale entry is
-        # dropped exactly once, so eviction stays amortized O(log n)
-        # instead of re-scanning parked interior runs every call
         while self._leaf_heap:
             seq, block = heapq.heappop(self._leaf_heap)
             if self._park_seq.get(block) != seq:
                 continue
             if not self.evictable(block):
                 continue
-            self.remove(block)
             return block
         raise RuntimeError(
             "every parked block has cached descendants that are "
             "still referenced — the allocator's prefix-closed "
             "reference invariant is broken (see audit())"
+        )
+
+    def evict_lru(self) -> int:
+        """Reclaim the least-recently-used parked block WITHOUT
+        resident descendants (leaf-first): drop its digest, return it
+        for reallocation. Only refcount-0 blocks are ever parked, so
+        eviction can never touch a block some row still reads — the
+        allocator calls this only when its free list is empty (pool
+        pressure) and no host tier is attached (with one, ``spill_lru``
+        demotes the same victim instead). The allocator keeps
+        references prefix-closed, which makes the parked set
+        descendant-closed — so whenever anything is parked, a parked
+        evictable leaf exists."""
+        block = self._pop_victim()
+        self.remove(block)
+        return block
+
+    # ----------------------------------------------------- spill tier
+
+    def spill(self, block: int) -> bytes:
+        """DEMOTE a parked evictable block: its digest stays in the
+        tree (block slot becomes the ``SPILLED`` sentinel) so the chain
+        remains matchable, while the pool block returns to the caller
+        for reallocation — the caller has already downloaded the K/V
+        into the host store under the returned digest. Mirrors
+        ``remove``'s preconditions (parked, no resident descendant) and
+        its exposure bookkeeping: the predecessor entry may become
+        newly evictable (its descendant is now spilled), so a parked
+        predecessor re-arms in ``_leaf_heap`` at its original park
+        sequence."""
+        key = self._by_block.get(block)
+        if key is None:
+            raise ValueError(f"block {block} is not indexed")
+        if block not in self._parked:
+            raise ValueError(f"block {block} is referenced, not parked")
+        node, off = self._by_key[key]
+        if not self.evictable(block):
+            raise RuntimeError(
+                f"block {block} still has resident descendants — "
+                "leaf-first spill must demote the tails first"
+            )
+        node.blocks[off] = SPILLED
+        del self._by_block[block]
+        self._parked.pop(block, None)
+        self._park_seq.pop(block, None)
+        self._spill_clock += 1
+        self._spilled[key] = self._spill_clock
+        if off == len(node.keys) - 1 and not node.children:
+            # a FULL leaf (no indexed descendants at all) is a
+            # host-budget eviction candidate right away; interior
+            # spilled entries arm later, when _remove_entry exposes them
+            heapq.heappush(
+                self._spilled_heap, (self._spill_clock, key)
+            )
+        # the predecessor entry just lost its only resident descendant
+        # this side — if parked and now evictable, (re)arm it
+        if off > 0:
+            pred = node.blocks[off - 1]
+        elif node.parent is not None and node.parent.keys:
+            pred = node.parent.blocks[-1]
+        else:
+            pred = SPILLED
+        if pred != SPILLED:
+            seq = self._park_seq.get(pred)
+            if seq is not None and self.evictable(pred):
+                heapq.heappush(self._leaf_heap, (seq, pred))
+        return key
+
+    def spill_lru(self) -> Tuple[int, bytes]:
+        """Victim selection + demotion in one step: the SAME block
+        ``evict_lru`` would reclaim, spilled instead of removed →
+        ``(block, digest)`` for the caller to download and free."""
+        block = self._pop_victim()
+        return block, self.spill(block)
+
+    def restore(self, key: bytes, block: int) -> None:
+        """PROMOTE a spilled entry: bind its digest to ``block`` (a
+        freshly-allocated pool block the engine is uploading the host
+        copy into). The entry comes back REFERENCED — the restoring
+        lease maps it — never parked; any live ``_spilled_heap`` entry
+        goes stale by sequence lookup."""
+        if key not in self._spilled:
+            raise ValueError("digest is not spilled")
+        if block in self._by_block:
+            raise ValueError(f"block {block} already holds content")
+        del self._spilled[key]
+        node, off = self._by_key[key]
+        node.blocks[off] = block
+        self._by_block[block] = key
+
+    def evict_spilled_lru(self) -> bytes:
+        """Host-budget pressure: drop the least-recently-SPILLED entry
+        with no indexed descendant at all (the spilled fringe's full
+        leaves) from the tree and return its digest — the caller drops
+        the matching host-store entry, keeping tree and store in
+        lockstep. Leaf-first spill stamps descendants with earlier
+        sequences than their ancestors, so LRU order here is naturally
+        tail-first and a restorable ancestor chain is never stranded
+        behind a dropped tail."""
+        while self._spilled_heap:
+            seq, key = heapq.heappop(self._spilled_heap)
+            if self._spilled.get(key) != seq:
+                continue
+            node, off = self._by_key[key]
+            if off != len(node.keys) - 1 or node.children:
+                continue  # grew a descendant; re-armed on its removal
+            del self._spilled[key]
+            self._remove_entry(key)
+            return key
+        raise RuntimeError(
+            "no spilled entry is a full leaf — the spilled tier's "
+            "leaf-first closure is broken (see audit())"
         )
 
     # ----------------------------------------------------------- audit
@@ -391,13 +594,19 @@ class PrefixCacheIndex:
             digest/block accelerator maps agree exactly with the runs
             (each block holds one identity, reachable from the root);
           * parked ⊆ indexed (LRU entries always have content);
+          * spilled coherence: an entry is in ``_spilled`` iff its run
+            slot carries the ``SPILLED`` sentinel (no pool block);
           * descendant closure: a PARKED block's immediate descendants
-            are all parked too — the arithmetic reason leaf-first
-            eviction can always make progress and the allocator may
-            count every parked block as reclaimable capacity.
+            are all parked or spilled (nothing referenced hangs below
+            reclaimable capacity), and a SPILLED entry's immediate
+            descendants are all spilled — residency is a prefix of
+            every root path, the arithmetic reason leaf-first
+            eviction/spill can always make progress and a resident
+            ``match`` may stop at the first spilled entry.
         """
         seen_keys: Dict[bytes, Tuple[_RadixNode, int]] = {}
         seen_blocks: Dict[int, bytes] = {}
+        seen_spilled = set()
         stack = [self._root]
         while stack:
             node = stack.pop()
@@ -406,12 +615,15 @@ class PrefixCacheIndex:
             if len(node.keys) != len(node.blocks):
                 raise AssertionError("run keys/blocks length mismatch")
             for i, (k, b) in enumerate(zip(node.keys, node.blocks)):
-                if k in seen_keys or b in seen_blocks:
-                    raise AssertionError(
-                        f"digest or block {b} indexed twice"
-                    )
+                if k in seen_keys:
+                    raise AssertionError("digest indexed twice")
                 seen_keys[k] = (node, i)
-                seen_blocks[b] = k
+                if b == SPILLED:
+                    seen_spilled.add(k)
+                else:
+                    if b in seen_blocks:
+                        raise AssertionError(f"block {b} indexed twice")
+                    seen_blocks[b] = k
             for first, child in node.children.items():
                 if child.parent is not node:
                     raise AssertionError("child parent link broken")
@@ -428,6 +640,11 @@ class PrefixCacheIndex:
             raise AssertionError(
                 "block accelerator map diverged from the tree"
             )
+        if seen_spilled != set(self._spilled):
+            raise AssertionError(
+                "spilled-entry map diverged from the tree's SPILLED "
+                "slots"
+            )
         for blk in self._parked:
             if blk not in self._by_block:
                 raise AssertionError(
@@ -436,15 +653,24 @@ class PrefixCacheIndex:
         parked = set(self._parked)
         for blk in parked:
             node, off = self._by_key[self._by_block[blk]]
-            if off + 1 < len(node.keys):
-                descendants = [node.blocks[off + 1]]
-            else:
-                descendants = [ch.blocks[0] for ch in node.children.values()]
-            for d in descendants:
-                if d not in parked:
+            for d in self._descendant_entries(node, off):
+                if d in self._spilled:
+                    continue  # spilled = refcount-0 by construction
+                dnode, doff = self._by_key[d]
+                if dnode.blocks[doff] not in parked:
                     raise AssertionError(
                         f"parked block {blk} has referenced descendant "
-                        f"{d} — references are no longer prefix-closed"
+                        f"{dnode.blocks[doff]} — references are no "
+                        "longer prefix-closed"
+                    )
+        for key in self._spilled:
+            node, off = self._by_key[key]
+            for d in self._descendant_entries(node, off):
+                if d not in self._spilled:
+                    raise AssertionError(
+                        "spilled entry has a resident descendant — "
+                        "residency is no longer a prefix of its root "
+                        "path"
                     )
         # eviction accelerator coherence: the sequence map tracks the
         # parked set exactly, and every parked EVICTABLE block has a
@@ -460,4 +686,17 @@ class PrefixCacheIndex:
                 raise AssertionError(
                     f"parked evictable block {blk} has no live "
                     "eviction-heap entry"
+                )
+        # the spilled tier's analogue: every spilled FULL LEAF (no
+        # indexed descendant at all — the host-budget eviction frontier)
+        # has a live heap entry, else evict_spilled_lru could raise
+        # with droppable entries left
+        live_spilled = set(self._spilled_heap)
+        for key, seq in self._spilled.items():
+            node, off = self._by_key[key]
+            if (off == len(node.keys) - 1 and not node.children
+                    and (seq, key) not in live_spilled):
+                raise AssertionError(
+                    "spilled full-leaf entry has no live "
+                    "host-eviction-heap entry"
                 )

@@ -34,7 +34,7 @@ combined with aging preserves the no-starvation guarantee.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Sequence, Union
+from typing import Callable, Dict, List, Sequence, Tuple, Union
 
 ADMISSION_POLICIES = ("fifo", "cache-aware")
 
@@ -45,11 +45,16 @@ class AdmissionPolicy:
     ``order`` receives the pending request indices in ARRIVAL order, the
     per-request passed-over counts (how many admission waves have
     overtaken each request so far), and a ``resident_match`` callback
-    returning the number of prompt tokens currently matchable against
-    resident cache content. It returns the indices in the order
-    admission should try them. Policies must be deterministic and pure
-    (no clocks — aging is counted in waves, so scheduling replays
-    exactly under the injectable-clock test discipline).
+    returning the prompt tokens currently matchable against cache
+    content — either a plain int (resident tokens, the round-9
+    signature) or a ``(resident, spilled)`` pair once the host spill
+    tier is attached (round 10): a SPILLED hit still needs a restore
+    upload, so it ranks below a resident hit of any depth but above a
+    cold miss — tiers compare lexicographically. It returns the indices
+    in the order admission should try them. Policies must be
+    deterministic and pure (no clocks — aging is counted in waves, so
+    scheduling replays exactly under the injectable-clock test
+    discipline).
 
     Cost note: the engine calls ``order`` once per admission wave over
     the whole pending queue (cache-aware additionally re-matches each
@@ -86,10 +91,12 @@ class CacheAwareAdmission(AdmissionPolicy):
 
     Aged requests (passed over >= ``aging_waves`` admission waves) go
     first, in arrival order; everyone else is sorted by descending
-    resident match length with arrival order as the tie-break — so a
-    cache-cold queue degrades to exact FIFO, and a request can be
-    overtaken at most ``aging_waves`` times before it outranks every
-    fresher arrival."""
+    resident match length — with the host spill tier attached, by the
+    ``(resident, spilled)`` pair lexicographically, so a spilled hit
+    (which costs a restore upload) outranks a miss but never a resident
+    hit — with arrival order as the tie-break. A cache-cold queue
+    degrades to exact FIFO, and a request can be overtaken at most
+    ``aging_waves`` times before it outranks every fresher arrival."""
 
     name = "cache-aware"
 
@@ -99,6 +106,15 @@ class CacheAwareAdmission(AdmissionPolicy):
                 f"aging_waves must be >= 1, got {aging_waves}"
             )
         self.aging_waves = int(aging_waves)
+
+    @staticmethod
+    def _tiers(match) -> Tuple[int, int]:
+        """Normalize the ranking signal: a plain int is resident-only
+        (the round-9 signature and every custom callback written
+        against it); a pair is (resident, spilled)."""
+        if isinstance(match, tuple):
+            return match
+        return (match, 0)
 
     def order(self, pending, passed_over, resident_match):
         pending = list(pending)
@@ -111,7 +127,12 @@ class CacheAwareAdmission(AdmissionPolicy):
             i for i in pending
             if passed_over.get(i, 0) < self.aging_waves
         ]
-        fresh.sort(key=lambda i: (-resident_match(i), pos[i]))
+
+        def key(i):
+            resident, spilled = self._tiers(resident_match(i))
+            return (-resident, -spilled, pos[i])
+
+        fresh.sort(key=key)
         return aged + fresh
 
 

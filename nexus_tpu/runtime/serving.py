@@ -91,11 +91,25 @@ from jax import lax
 from nexus_tpu.models.decoding import (
     constrain_kv_sharding,
     copy_kv_blocks,
+    gather_kv_block,
     init_kv_cache,
     init_paged_kv_cache,
+    write_kv_blocks,
+)
+from nexus_tpu.runtime.host_cache import (
+    HOST_CACHE_DTYPES,
+    HostBlockStore,
+    dequantize_kv_host,
 )
 from nexus_tpu.runtime.prefix_cache import PrefixCacheIndex, chain_keys
 from nexus_tpu.runtime.scheduling import make_admission_policy
+
+#: serve-level KV pool dtypes (ServeSpec.kvPoolDtype): "native" stores
+#: K/V at the model dtype, "int8" runs the quantized block pool (the
+#: int8-KV decode tier models/decoding.py already dequantizes in both
+#: the fused and gather kernels) — roughly double the resident blocks
+#: per HBM byte
+KV_POOL_DTYPES = ("native", "int8")
 
 
 class BlockAllocator:
@@ -122,18 +136,37 @@ class BlockAllocator:
     under pool pressure (the free list running dry mid-``grow_to``) —
     so cached prefixes survive exactly as long as the pool has room.
 
+    Round 10 adds the HOST TIER: with a ``host_cache``
+    (runtime/host_cache.py) attached, pool pressure DEMOTES the
+    eviction victim instead of destroying it — the engine-supplied
+    ``spill_fn`` downloads the block's K/V planes, the store keeps them
+    under the block's chain digest (byte-budgeted; over-budget drains
+    leaf-first through the tree so store and tree never disagree), and
+    the radix entry is marked *spilled*. ``match_prefix`` then reports
+    the spilled span after the resident one, and ``admit(restore=...)``
+    PROMOTES it: each spilled digest gets a freshly-allocated pool
+    block (refcount 1, rebound in the tree) that the engine uploads the
+    host copy into — the warm prefix swaps back instead of being
+    recomputed.
+
     Invariant: ``len(_free) + parked >= _reserved`` at all times
     (admission gates on ``available_blocks`` and counts the parked
-    blocks it revives), which is why an in-reservation ``grow_to`` can
-    never fail mid-generation and eviction can only ever see
-    refcount-0 blocks."""
+    blocks it revives plus the spilled blocks it restores), which is
+    why an in-reservation ``grow_to`` can never fail mid-generation and
+    eviction can only ever see refcount-0 blocks."""
 
     def __init__(self, num_blocks: int, block_size: int,
-                 prefix_index: Optional[PrefixCacheIndex] = None):
+                 prefix_index: Optional[PrefixCacheIndex] = None,
+                 host_cache: Optional[HostBlockStore] = None):
         if num_blocks < 1:
             raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if host_cache is not None and prefix_index is None:
+            raise ValueError(
+                "a host cache needs the prefix index (spilled state "
+                "lives in the radix tree)"
+            )
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         # pop() from the tail → blocks hand out in ascending id order
@@ -141,8 +174,16 @@ class BlockAllocator:
         self._ref = [0] * self.num_blocks  # leases mapping each block
         self._reserved = 0  # promised to admitted rows, not yet allocated
         self.index = prefix_index
+        self.host_cache = host_cache
+        # engine-wired download: (block id, chain digest) → numpy plane
+        # dict (the device half of a spill); spills are DISABLED until
+        # set
+        self.spill_fn: Optional[Callable[[int, bytes], dict]] = None
         self.peak_allocated = 0
         self.evictions = 0
+        self.spills = 0  # evictions demoted to the host tier
+        self.restores = 0  # spilled blocks promoted back into the pool
+        self.host_evictions = 0  # spilled entries dropped (host budget)
 
     @property
     def scratch_block(self) -> int:
@@ -223,47 +264,99 @@ class BlockAllocator:
 
     def match_prefix(self, keys, prompt_len: int):
         """Longest cached prefix of a prompt whose full-block hash chain
-        is ``keys`` → ``(shared_blocks, matched_len, cow_src)``.
+        is ``keys`` → ``(shared_blocks, spilled_keys, matched_len,
+        cow_src)``: the RESIDENT pool blocks first, then the digests of
+        the contiguous SPILLED span extending them (restorable from the
+        host tier via ``admit(restore=...)``; always empty without
+        one).
 
-        ``matched_len`` is capped at ``prompt_len - 1``: the row must
-        still run >= 1 prompt position through the model to produce its
-        first token's logits. On a FULL-prompt hit (block-aligned prompt
-        entirely cached) that cap lands inside the last matched block —
-        it is returned as ``cow_src`` for the engine to COPY into a
-        private block (copy-on-write) so recomputing position p-1 never
-        writes into a block other rows read."""
+        ``matched_len`` covers both spans and is capped at
+        ``prompt_len - 1``: the row must still run >= 1 prompt position
+        through the model to produce its first token's logits. On a
+        FULL-prompt hit (block-aligned prompt entirely cached) that cap
+        lands inside the last matched block — a RESIDENT last block is
+        returned as ``cow_src`` for the engine to COPY into a private
+        block (copy-on-write) so recomputing position p-1 never writes
+        into a block other rows read; a SPILLED last block is simply
+        dropped from the span (the row re-prefills that one block — a
+        restore-then-recompute-into-the-copy dance buys one block of
+        prefill at two dispatches' cost)."""
         if self.index is None or not keys:
-            return [], 0, None
-        blocks = self.index.match(keys)
-        if not blocks:
-            return [], 0, None
-        matched = len(blocks) * self.block_size
+            return [], [], 0, None
+        blocks, skeys = self.index.match_tiered(keys)
+        if self.host_cache is None:
+            skeys = []  # unrestorable without a store (never happens:
+            # spilled entries only exist when a host cache is attached)
+        if not blocks and not skeys:
+            return [], [], 0, None
+        total = len(blocks) + len(skeys)
+        matched = total * self.block_size
         cow_src = None
         if matched > prompt_len - 1:
-            cow_src = blocks[-1]
-            blocks = blocks[:-1]
-            matched = prompt_len - 1
-        return blocks, matched, cow_src
+            if skeys:
+                skeys = skeys[:-1]
+                matched = (total - 1) * self.block_size
+            else:
+                cow_src = blocks[-1]
+                blocks = blocks[:-1]
+                matched = prompt_len - 1
+        return blocks, skeys, matched, cow_src
 
-    def admit(self, need_blocks: int, shared=()) -> Optional["_BlockLease"]:
-        """Reserve ``need_blocks`` private blocks for one row and map the
+    def admit(self, need_blocks: int, shared=(),
+              restore=()) -> Optional["_BlockLease"]:
+        """Reserve ``need_blocks`` private blocks for one row, map the
         ``shared`` (already-written, indexed) blocks into it with a
-        refcount bump each; None when the pool can't promise the privates
-        plus the parked blocks this admission would revive (the caller
-        keeps the request queued — a refusal stops the admission wave,
-        so the refused request waits for refunds rather than being
-        overtaken within the policy's order, whatever ordering the
-        engine's admission policy chose). Nothing is mutated on
+        refcount bump each, and PROMOTE the ``restore`` spilled digests
+        — each gets a freshly-allocated pool block (refcount 1, rebound
+        in the radix tree) appended to the lease's shared span in chain
+        order; the ENGINE uploads the host payloads into those blocks
+        before the next chunk reads them. None when the pool can't
+        promise the privates plus the parked blocks this admission
+        would revive plus the restored blocks it must materialize (the
+        caller keeps the request queued — a refusal stops the admission
+        wave, so the refused request waits for refunds rather than
+        being overtaken within the policy's order, whatever ordering
+        the engine's admission policy chose). Nothing is mutated on
         refusal."""
         revive = sum(1 for b in shared if self._ref[b] == 0)
-        if need_blocks + revive > self.available_blocks:
+        if need_blocks + revive + len(restore) > self.available_blocks:
             return None
         for b in shared:
             if self._ref[b] == 0:
                 self.index.unpark(b)  # leaves the evictable LRU set
             self._ref[b] += 1
+        restored = []
+        payloads = []
+        for key in restore:
+            # shared refs are bumped FIRST, so the pressure this
+            # allocation may exert (evict/spill of parked blocks) can
+            # never touch the span being admitted; restored blocks are
+            # referenced immediately, so neither can later restores.
+            # The host payload leaves the store HERE — tree and store
+            # transition together, whatever the caller does next.
+            # drain=False: a spill inside THIS loop may push the store
+            # over budget, and draining now could drop a digest later
+            # in ``restore`` (it is still a spilled full leaf until its
+            # turn comes) — the drain runs once at the end instead,
+            # when every pending digest is resident.
+            blk = self._take_block(drain=False)
+            self._ref[blk] += 1
+            self.index.restore(key, blk)
+            payload, demoted = self.host_cache.take(key)
+            restored.append(blk)
+            payloads.append((blk, payload, demoted))
+            self.restores += 1
+            self.peak_allocated = max(
+                self.peak_allocated, self.allocated_blocks
+            )
+        if restore:
+            self._drain_host_budget()
         self._reserved += need_blocks
-        return _BlockLease(self, need_blocks, shared)
+        lease = _BlockLease(self, need_blocks, list(shared) + restored)
+        # (block, planes, demoted) per restored block — the engine
+        # drains this into its upload wave before the next chunk reads
+        lease.restored_payloads = payloads
+        return lease
 
     def register_block(self, key: bytes, blk: int,
                        parent: Optional[bytes] = None) -> bool:
@@ -277,14 +370,50 @@ class BlockAllocator:
             return self.index.insert(key, blk, parent=parent)
         return False
 
-    def _alloc_one(self) -> int:
+    def _drain_host_budget(self) -> None:
+        """Bring the host store back under its byte budget, dropping
+        spilled entries leaf-first through the tree (store and tree
+        transition together). Runs at OPERATION boundaries — never
+        mid-``admit``, where a drain could drop a digest the admission
+        is still about to restore (the store may transiently exceed its
+        budget inside one operation; by the boundary every pending
+        restore is resident and therefore undroppable)."""
+        if self.host_cache is None:
+            return
+        while (self.host_cache.over_budget()
+                and self.index.spilled_count):
+            self.host_cache.drop(self.index.evict_spilled_lru())
+            self.host_evictions += 1
+
+    def _take_block(self, drain: bool = True) -> int:
+        """One physical block off the free list — or, under pool
+        pressure, reclaimed from the least-recently-used refcount-0
+        cached block (the ONLY evictable kind by construction). With a
+        host tier attached the victim is DEMOTED, not destroyed: its
+        planes are downloaded through the engine's ``spill_fn``, stored
+        under its chain digest, and the radix entry is marked spilled
+        (still matchable, restorable on a future hit); over-budget
+        store bytes drain leaf-first through the tree so the two stay
+        in lockstep — deferred to the caller's boundary when
+        ``drain=False`` (``admit``'s restore loop, whose pending
+        digests must not be dropped out from under it). Same victim
+        either way (one selection rule,
+        ``PrefixCacheIndex._pop_victim``)."""
         if self._free:
-            blk = self._free.pop()
+            return self._free.pop()
+        if self.host_cache is not None and self.spill_fn is not None:
+            blk, key = self.index.spill_lru()
+            self.host_cache.put(key, self.spill_fn(blk, key))
+            self.spills += 1
+            if drain:
+                self._drain_host_budget()
         else:
-            # pool pressure: reclaim the least-recently-used refcount-0
-            # cached block — the ONLY evictable kind by construction
             blk = self.index.evict_lru()
-            self.evictions += 1
+        self.evictions += 1
+        return blk
+
+    def _alloc_one(self) -> int:
+        blk = self._take_block()
         self._ref[blk] += 1
         self._reserved -= 1  # reservation converts to allocation
         self.peak_allocated = max(self.peak_allocated, self.allocated_blocks)
@@ -315,6 +444,10 @@ class _BlockLease:
         self.shared: List[int] = list(shared or [])
         self._private: List[int] = []
         self._released = False
+        # (block, host planes, demoted) per block the admitting
+        # allocator RESTORED from the host tier — the engine uploads
+        # these before the row's first chunk reads them
+        self.restored_payloads: List[tuple] = []
 
     @property
     def blocks(self) -> List[int]:
@@ -486,6 +619,9 @@ class ServingEngine:
         admission_policy: Any = "cache-aware",
         admission_aging_waves: int = 8,
         prefix_completions: bool = True,
+        kv_pool_dtype: str = "native",
+        host_cache_bytes: int = 0,
+        host_cache_dtype: str = "native",
     ):
         """``prefill_chunk`` (T): prompt tokens an admitting row consumes
         per decode step. A T-slot feed costs every row T slots of matmul
@@ -598,7 +734,35 @@ class ServingEngine:
 
         Outputs are token-for-token identical across both paths and the
         dense layout (tested across the fp / int8-KV / speculative
-        tiers with the prefix cache on and off)."""
+        tiers with the prefix cache on and off).
+
+        ``kv_pool_dtype`` (round 10, paged layout only): ``"int8"``
+        runs the QUANTIZED block pool — K/V stored int8 with
+        per-(position, head) f32 scales, the same layout
+        ``cfg.kv_cache_quantized`` selects (either switch works; the
+        serve-level knob exists so a spec can halve its pool bytes
+        without a model override) — roughly double the resident blocks
+        per HBM byte, dequantized in-kernel by both attention paths.
+
+        ``host_cache_bytes`` (round 10) attaches the HOST-RAM SPILL
+        TIER under the paged pool: when pool pressure must reclaim a
+        parked prefix block, its K/V planes are downloaded into a
+        byte-budgeted host store (runtime/host_cache.py) and the radix
+        entry is marked *spilled* instead of removed — admission then
+        matches resident AND spilled spans, restores the spilled one
+        through freshly-allocated blocks + ONE fixed-shape upload
+        dispatch per wave, and starts chunked prefill past the whole
+        restored span. The effective prefix cache is bounded by host
+        RAM instead of the pool. 0 disables (the pre-round-10
+        discard-on-evict behavior); requires the prefix cache (inert
+        without it). ``host_cache_dtype="int8"`` DEMOTES fp payloads to
+        int8 + scales on spill (~2x more spilled blocks per host byte,
+        at the documented max|x|/254 per-element error — restores of an
+        int8 pool are byte-identical, nothing to demote). With
+        ``"native"`` every restore is byte-identical and the exactness
+        contract extends verbatim: spill/restore is scheduling, never
+        semantics (tested cache-on == cache-off across fused/gather ×
+        fp/int8 pools)."""
         self._fwd = forward_decode
         self._params = params
         self._cfg = cfg
@@ -713,6 +877,36 @@ class ServingEngine:
         # multi-turn surface); off = the round-6 prompt-only matcher,
         # kept as the bench A/B baseline
         self._prefix_completions = bool(prefix_completions)
+        if kv_pool_dtype not in KV_POOL_DTYPES:
+            raise ValueError(
+                f"kv_pool_dtype must be one of {KV_POOL_DTYPES}, got "
+                f"{kv_pool_dtype!r}"
+            )
+        if kv_pool_dtype == "int8" and not self._paged:
+            raise ValueError(
+                "kv_pool_dtype='int8' sizes the paged block pool; the "
+                "dense layout quantizes via cfg.kv_cache_quantized"
+            )
+        self._kv_pool_int8 = kv_pool_dtype == "int8"
+        self._host_cache_bytes = int(host_cache_bytes)
+        if self._host_cache_bytes < 0:
+            raise ValueError(
+                f"host_cache_bytes must be >= 0, got {host_cache_bytes}"
+            )
+        if host_cache_dtype not in HOST_CACHE_DTYPES:
+            raise ValueError(
+                f"host_cache_dtype must be one of {HOST_CACHE_DTYPES}, "
+                f"got {host_cache_dtype!r}"
+            )
+        self._host_cache_dtype = host_cache_dtype
+        # the spill tier rides the radix tree (spilled state lives in
+        # it), so it follows the prefix cache's paged-only inertness
+        self._host_tier = self._prefix and self._host_cache_bytes > 0
+        # restored blocks upload in fixed-width waves (one compiled
+        # program; a wave with more restores than the width loops the
+        # SAME program) — sized past the common case of every row
+        # restoring a few blocks at once
+        self._restore_wave = max(4, 2 * self._b)
         # rounds per dispatch: one round = one target forward committing
         # 1..k+1 tokens, so this keeps a spec chunk's committed-token
         # budget comparable to a plain chunk's C single-token steps
@@ -1010,9 +1204,31 @@ class ServingEngine:
         )
         # copy-on-write program (paged only): copy pool blocks src→dst
         # across every K/V plane in one tiny dispatch; padding pairs
-        # carry an out-of-range dst and drop (models/decoding.py)
+        # carry an out-of-range dst and drop (models/decoding.py).
+        # Each engine jits its OWN trivial closure rather than the
+        # module-level function: jax shares one compiled-program cache
+        # across every `jax.jit(same_fn)` wrapper, so a bare wrap would
+        # let OTHER engines' compiles (different shapes in other tests
+        # or co-resident engines) leak into this engine's
+        # `_cache_size()` — the per-engine recompile sanitizer's counts
+        # must be per-engine facts.
         self._copy_fn = jax.jit(
-            copy_kv_blocks, donate_argnums=(0,) if donate else ()
+            lambda cache, src, dst: copy_kv_blocks(cache, src, dst),
+            donate_argnums=(0,) if donate else (),
+        )
+        # host-tier programs (round 10, models/decoding.py): the spill
+        # download gathers ONE block's planes (block id TRACED — one
+        # program whatever pool pressure reclaims), the restore upload
+        # scatters a fixed-width wave of host payloads into
+        # freshly-allocated blocks (OOB padding drops)
+        self._spill_gather_fn = jax.jit(
+            lambda cache, blk: gather_kv_block(cache, blk)
+        )
+        self._restore_write_fn = jax.jit(
+            lambda cache, dst, planes: write_kv_blocks(
+                cache, dst, planes
+            ),
+            donate_argnums=(0,) if donate else (),
         )
         self._spec_chunk = jax.jit(
             _spec_chunk, donate_argnums=(1, 5) if donate else ()
@@ -1058,9 +1274,13 @@ class ServingEngine:
                 raise ValueError(
                     f"request {req_idx}: needs {need} KV blocks "
                     f"(prompt {p} + budget {budget} + slack "
-                    f"{self._slack}) but the pool has only "
-                    f"{self._num_blocks}; raise kv_num_blocks or shrink "
-                    "the request"
+                    f"{self._slack}) but the HBM pool has only "
+                    f"{self._num_blocks}; raise kv_num_blocks, run the "
+                    "int8 pool (kv_pool_dtype doubles blocks per HBM "
+                    "byte), or shrink the request — the host spill "
+                    "tier cannot help here: restored blocks still "
+                    "live in the pool while a row reads them, so one "
+                    "request's worst case must fit the HBM tier alone"
                 )
         return prompt, p, budget
 
@@ -1158,8 +1378,13 @@ class ServingEngine:
         # int8 KV serving rides the same scaffold as static decode: the
         # chunk program quantizes on write and the insert path never
         # touches K/V (chunked prefill streams the prompt in-band), so
-        # the scale planes need no admission-time handling at all
-        quantized = bool(getattr(cfg, "kv_cache_quantized", False))
+        # the scale planes need no admission-time handling at all.
+        # kv_pool_dtype='int8' (round 10) selects the same quantized
+        # layout at the serve level — one pool, two switches.
+        quantized = (
+            bool(getattr(cfg, "kv_cache_quantized", False))
+            or self._kv_pool_int8
+        )
 
         def fresh_cache():
             """The serve cache at its REAL layout (paged pool + scratch
@@ -1251,6 +1476,41 @@ class ServingEngine:
                     *zero_shared,
                 )
                 np.asarray(out[3])
+
+        def restore_plane_zeros(c, n):
+            """(L, n, Bs, ...) zero stacks matching every K/V plane of
+            cache ``c`` — the restore wave's padding template (and its
+            warm-up payload)."""
+            planes = {}
+            for key in ("k", "v", "k_scale", "v_scale"):
+                if key in c:
+                    shp = c[key].shape
+                    planes[key] = np.zeros(
+                        (shp[0], n) + tuple(shp[2:]),
+                        dtype=np.dtype(c[key].dtype),
+                    )
+            return planes
+
+        if self._paged and self._host_tier:
+            # compile the host-tier programs outside the timed window
+            # (they first fire mid-run, under pool pressure): the spill
+            # download with a traced block id, and the restore upload
+            # at its fixed wave width with all-OOB (dropped) padding
+            wc = fresh_cache()
+            jax.device_get(
+                self._spill_gather_fn(wc, self._mint(np.int32(0)))
+            )
+            wc = self._restore_write_fn(
+                wc,
+                self._mint(np.full(
+                    (self._restore_wave,), self._num_blocks + 1,
+                    np.int32,
+                )),
+                {k: self._mint(v) for k, v in
+                 restore_plane_zeros(wc, self._restore_wave).items()},
+            )
+            np.asarray(wc["length"])
+            del wc
         del warm_cache, warm_buf, out
 
         t0 = self._clock()
@@ -1306,16 +1566,39 @@ class ServingEngine:
                 cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
                 * int(np.dtype(cfg.dtype).itemsize) * 2
             )
+        host_store = (
+            HostBlockStore(
+                self._host_cache_bytes, dtype=self._host_cache_dtype
+            )
+            if self._paged and self._host_tier else None
+        )
         alloc = (
             BlockAllocator(
                 self._num_blocks, self._block_size,
                 prefix_index=PrefixCacheIndex() if self._prefix else None,
+                host_cache=host_store,
             )
             if self._paged else None
         )
+        if host_store is not None:
+            def spill_download(blk: int, _key: bytes) -> dict:
+                """The device half of a demotion: gather the victim's
+                planes (one compiled program — the id is traced) and
+                fetch them to the host. The victim is parked (frozen,
+                fully written) and the fetch synchronizes behind every
+                enqueued dispatch, so the payload is exact."""
+                planes = jax.device_get(self._spill_gather_fn(
+                    cache, self._mint(np.int32(blk))
+                ))
+                return {k: np.asarray(v) for k, v in planes.items()}
+
+            alloc.spill_fn = spill_download
         # the sanitizer's radix-tree audit hook (and the bench's
         # introspection point): the content index of the LAST serve run
         self.last_prefix_index = alloc.index if alloc is not None else None
+        # the sanitizer's host-tier audit hook: spilled tree entries and
+        # store keys must agree bit for bit
+        self.last_host_store = host_store
         leases: List[Optional[_BlockLease]] = [None] * b
         caps = [0] * b  # _row_cap per active row
         plen_host = [0] * b  # prompt length per active row
@@ -1334,6 +1617,11 @@ class ServingEngine:
         hit_tokens = 0
         hit_requests = 0
         cow_copies = 0
+        # host-tier ledger (round 10): prompt tokens served by swapping
+        # spilled blocks back in (a subset of hit_tokens), and the
+        # requests that restored at least one block
+        restore_hit_tokens = 0
+        restore_hit_requests = 0
         # matched-depth histogram (blocks of tree depth per hit) — the
         # hit-rate-by-depth ledger the bench scenarios report
         hit_depth_hist: dict = {}
@@ -1574,17 +1862,27 @@ class ServingEngine:
                 )
             return keys_cache[req_idx]
 
-        def resident_match_tokens(req_idx: int) -> int:
-            """Prompt tokens of ``req_idx`` matchable against content
-            resident in the radix tree RIGHT NOW (parked or referenced)
-            — the cache-aware policy's ranking signal; 0 without the
-            prefix cache, so every policy degrades to FIFO there."""
+        def resident_match_tokens(req_idx: int):
+            """The cache-aware policy's ranking signal for ``req_idx``.
+
+            Without a host tier this is the round-9 contract verbatim —
+            a plain int of resident-matchable prompt tokens (custom
+            AdmissionPolicy implementations written against it keep
+            working). With the tier attached it is the TIERED
+            ``(resident, spilled)`` pair (a spilled hit costs a restore
+            upload, so it ranks below a resident hit but above a miss;
+            runtime/scheduling.py orders lexicographically and accepts
+            both forms). 0 without the prefix cache, so every policy
+            degrades to FIFO there."""
             if not self._prefix:
-                return 0
-            _, matched, _ = alloc.match_prefix(
+                return 0 if host_store is None else (0, 0)
+            shared, skeys, matched, _ = alloc.match_prefix(
                 req_chain_keys(req_idx), len(requests[req_idx].prompt)
             )
-            return matched
+            if host_store is None:
+                return matched
+            spilled_tok = len(skeys) * self._block_size
+            return (matched - spilled_tok, spilled_tok)
 
         def chain_extendable(r: int, keys, blks) -> bool:
             """Registration guard: a row may extend the radix tree only
@@ -1630,6 +1928,7 @@ class ServingEngine:
             nonlocal cache, buf, ptr_vec, plen_vec, temp_vec, seed_vec
             nonlocal reserved_blocks_total, hit_tokens, hit_requests
             nonlocal cow_copies, admission_overtakes
+            nonlocal restore_hit_tokens, restore_hit_requests
             if not free_rows or not pending:
                 return
             # chain keys active rows will publish soon — the deferral set
@@ -1647,18 +1946,23 @@ class ServingEngine:
             wave_meta = []
             admitted_idx = []
             deferred = set()
+            # (dst block, numpy planes) per restored block this wave —
+            # uploaded in fixed-width dispatches after the insert
+            restore_jobs = []
             for req_idx in order:
                 if not free_rows:
                     break
                 req = requests[req_idx]
                 prompt, p, budget = self._validate_request(req, req_idx)
-                shared, matched, cow_src = [], 0, None
+                shared, skeys, matched, cow_src = [], [], 0, None
                 keys: List[bytes] = []
                 if self._prefix:
                     keys = req_chain_keys(req_idx)
-                    shared, matched, cow_src = alloc.match_prefix(keys, p)
-                    published = len(shared) + (1 if cow_src is not None
-                                               else 0)
+                    shared, skeys, matched, cow_src = alloc.match_prefix(
+                        keys, p
+                    )
+                    published = (len(shared) + len(skeys)
+                                 + (1 if cow_src is not None else 0))
                     if (published < len(keys)
                             and keys[published] in inflight):
                         deferred.add(req_idx)
@@ -1667,22 +1971,48 @@ class ServingEngine:
                 if self._paged:
                     need = (
                         alloc.blocks_for(self._row_cap(p, budget))
-                        - len(shared)
+                        - len(shared) - len(skeys)
                     )
-                    lease = alloc.admit(need, shared=shared)
+                    lease = alloc.admit(need, shared=shared,
+                                        restore=skeys)
                     if lease is None:
                         break  # pool full: the policy head waits
                     reserved_blocks_total += need
+                    if skeys:
+                        # promotion: the allocator rebound each spilled
+                        # digest to a fresh block and popped its host
+                        # payload (tree and store transition together);
+                        # queue the uploads — int8-demoted payloads
+                        # dequantize back to the pool dtype HERE,
+                        # quantized pools take theirs verbatim
+                        for blk, payload, demoted in (
+                            lease.restored_payloads
+                        ):
+                            if demoted:
+                                payload = {
+                                    "k": dequantize_kv_host(
+                                        payload["k"], payload["k_scale"]
+                                    ),
+                                    "v": dequantize_kv_host(
+                                        payload["v"], payload["v_scale"]
+                                    ),
+                                }
+                            restore_jobs.append((blk, payload))
+                        restore_hit_tokens += (
+                            len(skeys) * self._block_size
+                        )
+                        restore_hit_requests += 1
                     if cow_src is not None:
                         # copy-on-write: materialize the private copy of
                         # the partially-reused block NOW (within the
                         # reservation — can't fail) and queue the device
                         # copy for right after the insert dispatch
-                        lease.grow_to(len(shared) + 1)
+                        lease.grow_to(len(lease.shared) + 1)
                 if matched:
                     hit_tokens += matched
                     hit_requests += 1
-                    depth = len(shared) + (1 if cow_src is not None else 0)
+                    depth = (len(shared) + len(skeys)
+                             + (1 if cow_src is not None else 0))
                     hit_depth_hist[depth] = (
                         hit_depth_hist.get(depth, 0) + 1
                     )
@@ -1695,10 +2025,10 @@ class ServingEngine:
                 # the keys THIS row will publish defer same-prefix
                 # followers later in this very wave (intra-wave dedup)
                 if self._prefix:
-                    inflight.update(
-                        keys[len(shared) + (1 if cow_src is not None
-                                            else 0):]
-                    )
+                    inflight.update(keys[
+                        len(shared) + len(skeys)
+                        + (1 if cow_src is not None else 0):
+                    ])
             for req_idx in admitted_idx:
                 pending.remove(req_idx)  # arrival order of the rest kept
             if admitted_idx:
@@ -1767,6 +2097,30 @@ class ServingEngine:
                     cache, self._mint(src), self._mint(dst)
                 )
                 cow_copies += len(cow_pairs)
+            if restore_jobs:
+                # promotion upload: ONE fixed-shape dispatch per wave
+                # (a wave restoring more blocks than the width loops
+                # the same compiled program) scatters every restored
+                # host payload into its freshly-allocated block —
+                # stream ordering lands it before the next chunk reads,
+                # exactly like the CoW copy above. Unused slots carry
+                # an out-of-range id and drop.
+                W = self._restore_wave
+                for j0 in range(0, len(restore_jobs), W):
+                    batch = restore_jobs[j0:j0 + W]
+                    ids = np.full((W,), self._num_blocks + 1, np.int32)
+                    planes = restore_plane_zeros(cache, W)
+                    for i, (blk, payload) in enumerate(batch):
+                        ids[i] = blk
+                        for k_ in planes:
+                            planes[k_][:, i] = np.asarray(
+                                payload[k_]
+                            ).astype(planes[k_].dtype, copy=False)
+                    cache = self._restore_write_fn(
+                        cache, self._mint(ids),
+                        {k_: self._mint(v_)
+                         for k_, v_ in planes.items()},
+                    )
 
         police_deadlines()
         admit_into([r for r in range(b) if rows[r] is None])
@@ -2063,6 +2417,37 @@ class ServingEngine:
                 metrics["prefix_completion_blocks"] = (
                     completion_blocks_registered
                 )
+                # host-tier ledger (round 10): demotion/promotion
+                # traffic and the store's residency — spilled_blocks is
+                # total demotions (evictions that kept their content),
+                # restore_hit_tokens the prompt tokens served by
+                # swapping spilled blocks back instead of recomputing
+                metrics["host_cache_enabled"] = host_store is not None
+                if host_store is not None:
+                    metrics["spilled_blocks"] = alloc.spills
+                    metrics["restored_blocks"] = alloc.restores
+                    metrics["restore_hit_tokens"] = restore_hit_tokens
+                    metrics["restore_hit_requests"] = (
+                        restore_hit_requests
+                    )
+                    metrics["host_cache_bytes"] = host_store.bytes
+                    metrics["host_cache_bytes_peak"] = (
+                        host_store.bytes_peak
+                    )
+                    metrics["host_cache_dtype"] = host_store.dtype
+                    metrics["host_cache_evictions"] = (
+                        alloc.host_evictions
+                    )
+                    # the spilled tier's partition slot: entries still
+                    # demoted at teardown (tree ⟺ store, the sanitizer
+                    # cross-checks) — like parked blocks, they survive
+                    # the run for future hits
+                    metrics["kv_spilled_blocks_final"] = (
+                        alloc.index.spilled_count
+                    )
+                    metrics["host_cache_entries_final"] = len(
+                        host_store
+                    )
         else:
             metrics["kv_pool_bytes"] = b * dense_row_bytes
             metrics["kv_bytes_per_request"] = dense_row_bytes

@@ -23,6 +23,15 @@ the engine, because a tree-bookkeeping slip (an orphaned chain, a parked
 interior with referenced tails) silently degrades hit rates or strands
 pool capacity without ever failing a token-exactness test.
 
+Round 10 adds a fourth: **host spill-tier coherence** — the pool
+partition audit gains the spilled slot (spilled tree entries must
+account 1:1 against host-store payloads; free + parked + referenced
+still partition the POOL, spilled blocks live outside it in host RAM),
+and ``audit_host_cache`` cross-checks the store's digest set against
+the tree's spilled markers plus the store's byte accounting, because a
+one-sided spill (marker without payload, or payload without marker) is
+either an unmatchable promise or a slow host-RAM leak.
+
 With ``NEXUS_SANITIZE=1`` (tier-1 conftest wires this), every
 ``ServingEngine.serve()`` call is followed by these audits; a violation
 raises :class:`SanitizerError` inside whatever test drove the engine —
@@ -56,6 +65,8 @@ ENGINE_JIT_ATTRS = (
     "_insert_fn",
     "_copy_fn",
     "_spec_chunk",
+    "_spill_gather_fn",
+    "_restore_write_fn",
 )
 
 
@@ -118,6 +129,26 @@ def audit_pool_partition(metrics: Dict[str, Any], context: str = "serve") -> Non
             f"{context}: free+parked != pool — block(s) fell out of the "
             f"partition entirely ({partition})"
         )
+    # the SPILLED tier (round 10): spilled entries are NOT pool blocks
+    # (their K/V live in host RAM), but they must account 1:1 against
+    # the host store — a spilled marker without a payload is an
+    # unmatchable promise, a payload without a marker is a host-RAM
+    # leak. Absent keys = host tier off, nothing to check.
+    if metrics.get("host_cache_enabled"):
+        spilled = metrics.get("kv_spilled_blocks_final")
+        entries = metrics.get("host_cache_entries_final")
+        if spilled is None or entries is None:
+            raise SanitizerError(
+                f"{context}: host tier enabled but the spilled-tier "
+                "ledger (kv_spilled_blocks_final / "
+                "host_cache_entries_final) is missing"
+            )
+        if spilled != entries:
+            raise SanitizerError(
+                f"{context}: {spilled} spilled tree entr(y/ies) vs "
+                f"{entries} host-store payload(s) — the spilled tier "
+                "leaked (tree and store must transition together)"
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -140,6 +171,38 @@ def audit_prefix_tree(engine: Any, context: str = "serve") -> None:
     except AssertionError as e:
         raise SanitizerError(
             f"{context}: radix prefix-tree invariant violated — {e}"
+        ) from e
+
+
+# ---------------------------------------------------------------------------
+# audit 2b: host spill tier ⟺ radix tree coherence
+
+
+def audit_host_cache(engine: Any, context: str = "serve") -> None:
+    """Assert the host spill tier and the radix tree agree bit for bit
+    after a serve run: the store's digests equal the tree's spilled
+    entries exactly (a one-sided entry is either an unmatchable promise
+    or leaked host RAM), and the store's byte accounting reproduces
+    from its live payloads. Engines without a host tier are skipped."""
+    store = getattr(engine, "last_host_store", None)
+    if store is None:
+        return
+    index = getattr(engine, "last_prefix_index", None)
+    store_keys = set(store.keys())
+    tree_keys = set(getattr(index, "_spilled", {})) if index else set()
+    if store_keys != tree_keys:
+        only_store = len(store_keys - tree_keys)
+        only_tree = len(tree_keys - store_keys)
+        raise SanitizerError(
+            f"{context}: host store and radix tree disagree on the "
+            f"spilled set ({only_store} payload(s) without a tree "
+            f"marker, {only_tree} marker(s) without a payload)"
+        )
+    try:
+        store.audit()
+    except AssertionError as e:
+        raise SanitizerError(
+            f"{context}: host cache byte accounting violated — {e}"
         ) from e
 
 
@@ -218,6 +281,7 @@ def install(engine_cls: Optional[type] = None) -> bool:
         )
         audit_pool_partition(metrics, context="sanitizer[pool]")
         audit_prefix_tree(self, context="sanitizer[radix]")
+        audit_host_cache(self, context="sanitizer[host-cache]")
         audit_recompiles(self, context="sanitizer[recompile]")
         return results, metrics
 

@@ -460,6 +460,29 @@ def test_pair_rule_radix_insert_remove_pair():
     assert findings[0].line == 3  # the tree insert, never list.insert
 
 
+def test_pair_rule_spill_restore_pair():
+    """index.spill:index.restore (round 10, the demote/promote pair): a
+    function that demotes a tree entry to the host tier and promotes it
+    back must restore in a finally block — an exception between them
+    leaves the entry spilled with its payload already consumed (an
+    unmatchable promise the sanitizer's host-cache audit would flag at
+    the next teardown). Receiver-hinted, so an unrelated .spill() or a
+    checkpoint .restore() on another receiver never pairs up."""
+    src = """
+        def swap_through_host(alloc, key, blk):
+            digest = alloc.index.spill(blk)
+            stage(alloc, digest)
+            alloc.index.restore(digest, blk)
+
+        def unrelated(ckpt, bucket):
+            bucket.spill()
+            ckpt.restore()
+    """
+    findings = _lint(src, select=["NX-PAIR"])
+    assert _ids(findings) == ["NX-PAIR001"]
+    assert findings[0].line == 3  # the tree spill, never bucket.spill
+
+
 def test_pair_rule_nested_functions_are_separate_scopes():
     src = """
         def engine(alloc):

@@ -181,8 +181,9 @@ def test_allocator_shared_admission_refcounts():
     for j, (k, blk) in enumerate(zip(keys, blks[:2])):
         a.register_block(k, blk, parent=keys[j - 1] if j else None)
     # follower maps the two indexed blocks shared + 2 private
-    shared, matched, cow = a.match_prefix(keys, prompt_len=16)
+    shared, skeys, matched, cow = a.match_prefix(keys, prompt_len=16)
     assert shared == blks[:2] and matched == 8 and cow is None
+    assert skeys == []  # nothing spilled without a host tier
     follower = a.admit(2, shared=shared)
     assert follower is not None
     assert follower.blocks[:2] == blks[:2]
@@ -211,8 +212,9 @@ def test_allocator_full_prompt_hit_returns_cow_source():
         a.register_block(k, blk, parent=keys[j - 1] if j else None)
     # block-aligned full-prompt hit: the cap at p-1 lands INSIDE the
     # last matched block -> shared stops before it, cow_src returns it
-    shared, matched, cow = a.match_prefix(keys, prompt_len=12)
+    shared, skeys, matched, cow = a.match_prefix(keys, prompt_len=12)
     assert shared == blks[:2] and matched == 11 and cow == blks[2]
+    assert skeys == []
 
 
 def test_allocator_evicts_lru_refcount0_only_under_pressure():
@@ -234,7 +236,7 @@ def test_allocator_evicts_lru_refcount0_only_under_pressure():
     got = l2.grow_to(4)
     assert a.evictions == 2
     assert sorted(got) == [0, 1, 2, 3]
-    assert a.match_prefix(keys, 16) == ([], 0, None)  # content gone
+    assert a.match_prefix(keys, 16) == ([], [], 0, None)  # content gone
     # while REFERENCED the same blocks are never evictable
     assert a.admit(1) is None
 

@@ -44,6 +44,10 @@ FORCE_INCLUDE = [
     # where a starvation bug hides (ordering never changes tokens, so
     # exactness tests can't see it) — gated per-file
     r"nexus_tpu/runtime/scheduling\.py$",
+    # the round-10 host spill tier: demotion/promotion bookkeeping is
+    # where a silent host-RAM leak or a stale-payload restore hides
+    # (spill/restore never changes tokens either) — gated per-file
+    r"nexus_tpu/runtime/host_cache\.py$",
     # the round-7 serve-failover planner: the drain-and-requeue math is
     # where a bug silently loses or duplicates user requests — always
     # gated per-file, whatever future exclusions appear

@@ -34,7 +34,8 @@ from tools.nexuslint.core import FileContext, Finding, dotted_name, rule
 
 DEFAULT_PAIRS = (
     "admit:release, acquire:release, try_acquire:release, grow_to:release, "
-    "chaos.add:chaos.clear, subscribe:unsubscribe, index.insert:index.remove"
+    "chaos.add:chaos.clear, subscribe:unsubscribe, "
+    "index.insert:index.remove, index.spill:index.restore"
 )
 
 
