@@ -3,7 +3,7 @@ SHELL := /bin/bash
 NATIVE_SRC := nexus_tpu/native/src/nexus_core.cpp nexus_tpu/native/src/nexus_data.cpp
 NATIVE_LIB := nexus_tpu/native/libnexus_core.so
 
-.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-failover bench-serve-outage chaos-smoke serve-smoke serve-chaos-smoke serve-sanitize-smoke radix-smoke spill-smoke race-smoke clean lint nexuslint analyze
+.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-serve-spec bench-failover bench-serve-outage chaos-smoke serve-smoke serve-chaos-smoke serve-sanitize-smoke radix-smoke spill-smoke spec-serve-smoke race-smoke clean lint nexuslint analyze
 
 all: native
 
@@ -120,6 +120,26 @@ fused-smoke-sanitize:
 	NEXUS_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
 	  tests/test_fused_attention.py \
 	  "tests/test_nexuslint.py::test_recompile_audit_fused_hydragen_one_program_on_mesh" -q
+
+# Speculative-serving smoke (fast lane, round 11): the verify seam's
+# exactness drills — lookup + draft tiers vs the dense oracles across
+# fused/gather x cache on/off x fp/int8 pools, rollback-never-publishes
+# (the committed-publication audit, positive AND poisoned-tree
+# negative), kill-mid-round failover requeue exactness, committed-only
+# tok/s + dispatches-per-token accounting, and the 8-device-mesh
+# one-program probe with speculation live — run with the runtime
+# sanitizers ARMED. Stub + tiny-llama driven; wired into the CI fast
+# job (the unarmed run rides `pytest -m "not slow"`).
+spec-serve-smoke:
+	NEXUS_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
+	  tests/test_spec_serve.py -q
+
+# Round-11 speculation A/B only (minutes, CPU): prompt-lookup spec
+# on/off on the shared-preamble burst + multi-turn scenarios, writing
+# the per-round docs/bench_serve_r<N>.json artifact.
+bench-serve-spec:
+	NEXUS_BENCH_SERVE=only NEXUS_BENCH_SERVE_SPEC=only \
+	  NEXUS_BENCH_INIT_PROBE=0 JAX_PLATFORMS=cpu python bench.py
 
 # Thread-safety smoke for the store/informer/lister under parallel fan-out.
 race-smoke:

@@ -404,20 +404,88 @@ def _expert_param_split(cfg) -> "tuple[int, int]":
 
 
 def serve_dispatch_slack(
-    chunk: int, prompt_lookup_ngram: int, num_speculative: int
+    chunk: int, prompt_lookup_ngram: int, num_speculative: int,
+    draft: bool = False,
 ) -> int:
     """Worst-case cache-slot overrun of ONE serving dispatch: ``chunk``
-    plain decode steps, or ``rounds*(k+1) + k`` under prompt-lookup
-    speculation (each round commits up to k+1 tokens and the final
-    verify block writes k proposal K/Vs past the last commit). Shared by
+    plain decode steps, or ``rounds*(k+1) + k`` under speculation
+    (prompt-lookup OR a draft model — both verify a k+1 window per
+    round: each round commits up to k+1 tokens and the final verify
+    block writes k proposal K/Vs past the last commit). Shared by
     ServeSpec.serve_slack() (spec-level admission validation) and
     ServingEngine.__init__ (the engine's own budget rule) — one formula,
     so the two can never silently diverge."""
-    if prompt_lookup_ngram > 0:
+    if prompt_lookup_ngram > 0 or draft:
         k = max(1, num_speculative)
         rounds = max(1, -(-chunk // (k + 1)))
         return rounds * (k + 1) + k
     return chunk
+
+
+def _draft_ref_errors(model_ref, draft_ref, label: str,
+                      require_ctx_cover: bool = False):
+    """Validate a speculative draft ModelRef against the target model —
+    the ONE checker behind both ``infer.draft`` and ``serve.draft``:
+    the family must be an LM family with a decode path, and (because
+    speculative acceptance compares token IDS) the draft must share the
+    target's vocabulary. ``require_ctx_cover`` additionally demands the
+    draft's max_seq_len cover the target's — the SERVE engine runs the
+    draft cache at the target's max_len (the infer path instead clamps
+    its shapes to min(target, draft), so it passes False). Resolves
+    each config in its own try so a bad target spec is attributed to
+    model.*, not to the draft."""
+    from nexus_tpu.models.registry import get_family, list_families
+
+    errs = []
+    draft_family = draft_ref.family
+    if draft_family == "mlp" or draft_family not in list_families():
+        errs.append(
+            f"{label}.family {draft_family!r} must be an LM "
+            "family with a decode path (one of "
+            f"{[f for f in list_families() if f != 'mlp']})"
+        )
+        return errs
+    t_cfg = d_cfg = None
+    try:
+        t_cfg = get_family(model_ref.family).config(
+            model_ref.preset, **dict(model_ref.overrides)
+        )
+    except Exception as e:  # config() errors are arbitrary
+        errs.append(f"model does not resolve: {e!r}")
+    try:
+        d_cfg = get_family(draft_family).config(
+            draft_ref.preset, **dict(draft_ref.overrides),
+        )
+    except Exception as e:
+        errs.append(f"{label} does not resolve: {e!r}")
+    if (
+        t_cfg is not None
+        and d_cfg is not None
+        and getattr(t_cfg, "vocab_size", None)
+        != getattr(d_cfg, "vocab_size", None)
+    ):
+        errs.append(
+            "speculative draft must share the target vocab: "
+            f"draft {d_cfg.vocab_size} != target "
+            f"{t_cfg.vocab_size} (override the draft's "
+            "vocab_size)"
+        )
+    if (
+        require_ctx_cover
+        and t_cfg is not None
+        and d_cfg is not None
+        and int(getattr(d_cfg, "max_seq_len", 0))
+        < int(getattr(t_cfg, "max_seq_len", 0))
+    ):
+        errs.append(
+            "speculative draft must cover the serve context: draft "
+            f"max_seq_len {d_cfg.max_seq_len} < target "
+            f"{t_cfg.max_seq_len} — the serve engine runs the draft "
+            "cache (rope tables included) at the target's max_len, so "
+            "a shorter draft would silently propose garbage past its "
+            "range (override the draft's max_seq_len)"
+        )
+    return errs
 
 
 @dataclass
@@ -452,6 +520,17 @@ class ServeSpec:
     # text (runtime/serving.py). Greedy-exact; requires temperature == 0
     prompt_lookup_ngram: int = 0
     num_speculative: int = 4
+    # DRAFT-MODEL speculation on the serve engine (round 11): a cheap
+    # draft (family/preset/overrides, shared vocab — the serve mirror of
+    # infer.draft) proposes numSpeculative tokens per round and the
+    # target verifies the whole window in one dispatch through the block
+    # table; accepted tokens commit, rejected ones roll the row's lease
+    # pointer back. Greedy-exact; mutually exclusive with
+    # promptLookupNgram (the zero-extra-model tier behind the same seam)
+    draft: Optional["ModelRef"] = None
+    # Orbax checkpoint for the serve draft's weights (random init when
+    # unset — a timing/mechanism run, acceptance will be ~0)
+    draft_checkpoint_directory: str = ""
     # prompt tokens an admitting row streams through the model per decode
     # step (chunked prefill — admission never stalls the other rows; the
     # speculative path prefills at numSpeculative+1 per round instead)
@@ -599,7 +678,8 @@ class ServeSpec:
         ServingEngine also imports, so spec validation can never diverge
         from the engine's admission rule."""
         return serve_dispatch_slack(
-            self.chunk, self.prompt_lookup_ngram, self.num_speculative
+            self.chunk, self.prompt_lookup_ngram, self.num_speculative,
+            draft=self.draft is not None,
         )
 
     def to_dict(self) -> Dict[str, Any]:
@@ -620,6 +700,13 @@ class ServeSpec:
         if self.prompt_lookup_ngram > 0:
             d["promptLookupNgram"] = self.prompt_lookup_ngram
             d["numSpeculative"] = self.num_speculative
+        if self.draft is not None:
+            d["draft"] = self.draft.to_dict()
+            d["numSpeculative"] = self.num_speculative
+            if self.draft_checkpoint_directory:
+                d["draftCheckpointDirectory"] = (
+                    self.draft_checkpoint_directory
+                )
         if self.prefill_chunk != 8:
             d["prefillChunk"] = self.prefill_chunk
         if self.kv_block_size != 32:
@@ -695,6 +782,12 @@ class ServeSpec:
             prompt_lookup_ngram=int(d.get("promptLookupNgram", 0) or 0),
             num_speculative=int(
                 4 if d.get("numSpeculative") is None else d["numSpeculative"]
+            ),
+            draft=(
+                ModelRef.from_dict(d["draft"]) if d.get("draft") else None
+            ),
+            draft_checkpoint_directory=str(
+                d.get("draftCheckpointDirectory", "") or ""
             ),
         )
 
@@ -1238,10 +1331,17 @@ class JaxXlaRuntime:
                 errs.append(
                     f"serve.temperature must be >= 0, got {sv.temperature}"
                 )
-            if sv.prompt_lookup_ngram > 0:
+            if sv.prompt_lookup_ngram > 0 and sv.draft is not None:
+                errs.append(
+                    "serve.promptLookupNgram and serve.draft are "
+                    "mutually exclusive (draft-free vs draft-model "
+                    "speculation — two proposers behind one verify seam)"
+                )
+            if sv.prompt_lookup_ngram > 0 or sv.draft is not None:
                 if sv.temperature > 0:
                     errs.append(
-                        "serve.promptLookupNgram requires temperature == 0 "
+                        "serve speculation (promptLookupNgram / draft) "
+                        "requires temperature == 0 "
                         "(speculative serving is greedy-exact only)"
                     )
                 if sv.num_speculative < 1:
@@ -1249,6 +1349,11 @@ class JaxXlaRuntime:
                         "serve.numSpeculative must be >= 1, got "
                         f"{sv.num_speculative}"
                     )
+            if sv.draft is not None:
+                errs.extend(_draft_ref_errors(
+                    self.model, sv.draft, "serve.draft",
+                    require_ctx_cover=True,
+                ))
             if sv.prompts and (
                 self.model.weights is None
                 or not self.model.weights.tokenizer
@@ -1283,6 +1388,34 @@ class JaxXlaRuntime:
                             "leaves no decode budget within max_seq_len "
                             f"{s_cfg.max_seq_len}"
                         )
+                    if ((sv.prompt_lookup_ngram > 0
+                            or sv.draft is not None)
+                            and sv.kv_block_size > 0):
+                        # the speculation window must fit inside the
+                        # per-row block budget's SLACK share: when the
+                        # dispatch slack (rounds*(k+1)+k) alone covers
+                        # the whole per-request envelope, every row's
+                        # blocks would be verify scratch with no room
+                        # left for prompt + committed budget — reject
+                        # the window instead of admitting rows that can
+                        # only ever roll back
+                        bs = sv.kv_block_size
+                        slack = sv.serve_slack()
+                        cap = sv.kv_request_cap(s_cfg.max_seq_len)
+                        slack_blocks = -(-slack // bs)
+                        useful_blocks = max(1, -(-(cap - slack) // bs))
+                        if slack_blocks > useful_blocks:
+                            errs.append(
+                                "serve speculation window too large: "
+                                f"numSpeculative {sv.num_speculative} "
+                                f"at chunk {sv.chunk} reserves "
+                                f"{slack_blocks} verify-scratch blocks "
+                                "per row — more than the "
+                                f"{useful_blocks} block(s) the row's "
+                                "whole prompt + decode budget needs; "
+                                "shrink numSpeculative or raise "
+                                "max_seq_len"
+                            )
                     if sv.kv_num_blocks > 0 and sv.kv_block_size > 0:
                         # an EXPLICIT pool must fit the queue's largest
                         # possible request, or the engine can never admit
@@ -1304,46 +1437,9 @@ class JaxXlaRuntime:
                                 "the knob that stretches the pool)"
                             )
         if self.infer.draft is not None and self.mode == "infer":
-            from nexus_tpu.models.registry import get_family, list_families
-
-            draft_family = self.infer.draft.family
-            if draft_family == "mlp" or draft_family not in list_families():
-                errs.append(
-                    f"infer.draft.family {draft_family!r} must be an LM "
-                    "family with a decode path (one of "
-                    f"{[f for f in list_families() if f != 'mlp']})"
-                )
-            else:
-                # static vocab check: speculative acceptance compares token
-                # ids, so the draft must share the target's vocabulary.
-                # Resolve each config in its own try so a bad target spec
-                # is attributed to model.*, not to the draft.
-                t_cfg = d_cfg = None
-                try:
-                    t_cfg = get_family(self.model.family).config(
-                        self.model.preset, **dict(self.model.overrides)
-                    )
-                except Exception as e:  # config() errors are arbitrary
-                    errs.append(f"model does not resolve: {e!r}")
-                try:
-                    d_cfg = get_family(draft_family).config(
-                        self.infer.draft.preset,
-                        **dict(self.infer.draft.overrides),
-                    )
-                except Exception as e:
-                    errs.append(f"infer.draft does not resolve: {e!r}")
-                if (
-                    t_cfg is not None
-                    and d_cfg is not None
-                    and getattr(t_cfg, "vocab_size", None)
-                    != getattr(d_cfg, "vocab_size", None)
-                ):
-                    errs.append(
-                        "speculative draft must share the target vocab: "
-                        f"draft {d_cfg.vocab_size} != target "
-                        f"{t_cfg.vocab_size} (override the draft's "
-                        "vocab_size)"
-                    )
+            errs.extend(_draft_ref_errors(
+                self.model, self.infer.draft, "infer.draft"
+            ))
         if (
             self.mode == "infer"
             and self.infer.prompt

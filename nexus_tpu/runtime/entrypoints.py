@@ -493,13 +493,16 @@ def _sharded_abstract_params(family, cfg, mesh, key):
     )
 
 
-def _load_draft_params(runtime, draft_family, draft_cfg, mesh, key):
+def _load_draft_params(runtime, draft_family, draft_cfg, mesh, key,
+                       ck_dir=None):
     """Draft weights for speculative decoding: params-only restore from
-    ``infer.draftCheckpointDirectory`` when set (the checkpoint's own
-    metadata supplies the rest of the restore skeleton, so the draft may
-    have been trained with ANY optimizer schedule), else random init.
-    Returns (params, loaded)."""
-    ck_dir = runtime.infer.draft_checkpoint_directory
+    ``ck_dir`` (defaults to ``infer.draftCheckpointDirectory``; the
+    serve path passes ``serve.draftCheckpointDirectory``) when set (the
+    checkpoint's own metadata supplies the rest of the restore
+    skeleton, so the draft may have been trained with ANY optimizer
+    schedule), else random init. Returns (params, loaded)."""
+    if ck_dir is None:
+        ck_dir = runtime.infer.draft_checkpoint_directory
     if ck_dir:
         import os
 
@@ -835,6 +838,32 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None):
     tr = runtime.train
     pmax = min(sv.prompt_length_max, cfg.max_seq_len // 2)
     pmin = min(sv.prompt_length_min, pmax)
+    # resolve the serve draft model up front (mirrors _run_infer): a
+    # bad draft spec must fail before any weights load, and the vocab
+    # check is a hard engine precondition (acceptance compares token ids)
+    draft_family = draft_cfg = None
+    if sv.draft is not None:
+        from nexus_tpu.models.registry import get_family as _get_family
+
+        draft_family = _get_family(sv.draft.family)
+        draft_cfg = draft_family.config(
+            sv.draft.preset, **dict(sv.draft.overrides)
+        )
+        if draft_cfg.vocab_size != cfg.vocab_size:
+            raise ValueError(
+                "speculative serve draft must share the target vocab: "
+                f"{draft_cfg.vocab_size} != {cfg.vocab_size}"
+            )
+        if draft_cfg.max_seq_len < cfg.max_seq_len:
+            # the engine runs the draft cache at the TARGET's max_len
+            # (the infer path clamps to min(target, draft) instead) —
+            # a shorter draft would propose garbage past its rope range
+            raise ValueError(
+                "speculative serve draft must cover the target "
+                f"context: draft max_seq_len {draft_cfg.max_seq_len} < "
+                f"target {cfg.max_seq_len} (override the draft's "
+                "max_seq_len)"
+            )
     # literal prompts: tokenize BEFORE loading weights (a prompt that
     # doesn't fit must fail fast), mirroring _run_infer's ordering
     tokenizer = None
@@ -930,6 +959,27 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None):
             cache_sharding = NamedSharding(
                 mesh, P(None, batch_axes, None, kv_axis, None)
             )
+        draft_kw = {}
+        if draft_family is not None:
+            # the draft rides a DENSE cache (runtime/serving.py): kv
+            # heads over tensor when they tile, rows over the data axes
+            d_kv_axis = (
+                "tensor" if tp > 1 and draft_cfg.n_kv_heads % tp == 0
+                else None
+            )
+            draft_params, draft_loaded = _load_draft_params(
+                runtime, draft_family, draft_cfg, mesh,
+                jax.random.fold_in(jax.random.PRNGKey(tr.seed), 99),
+                ck_dir=sv.draft_checkpoint_directory,
+            )
+            draft_kw = dict(
+                draft_forward=draft_family.forward_decode,
+                draft_params=draft_params,
+                draft_cfg=draft_cfg,
+                draft_cache_sharding=NamedSharding(
+                    mesh, P(None, batch_axes, None, d_kv_axis, None)
+                ),
+            )
         engine = ServingEngine(
             family.forward_decode, params, cfg,
             batch_size=tr.batch_size,
@@ -939,6 +989,7 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None):
             cache_sharding=cache_sharding,
             lookup_ngram=sv.prompt_lookup_ngram,
             num_speculative=sv.num_speculative,
+            **draft_kw,
             prefill_chunk=sv.prefill_chunk,
             kv_block_size=sv.kv_block_size,
             # the ONE sizing formula validate()'s HBM gate also uses —
@@ -994,6 +1045,10 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None):
         "batch_rows": tr.batch_size,
         "n_devices": mesh.devices.size,
     }
+    if draft_family is not None:
+        out["draft_family"] = sv.draft.family
+        out["draft_preset"] = sv.draft.preset
+        out["draft_weights_loaded"] = draft_loaded
     if latencies:  # omitted when nothing was served (all shed/expired)
         out["request_latency_p50_s"] = round(p50, 4)
         out["request_latency_p95_s"] = round(p95, 4)

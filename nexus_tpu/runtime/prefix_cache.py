@@ -176,6 +176,15 @@ class PrefixCacheIndex:
         """Tree entries whose K/V live in the host tier."""
         return len(self._spilled)
 
+    def indexed_keys(self) -> List[bytes]:
+        """Every digest the tree currently indexes — resident AND
+        spilled (a spilled entry keeps its digest; only its block moved
+        to the host tier). The committed-publication audit's iteration
+        surface (testing/sanitizers.py): after a serve run, every one
+        of these must be a hash-chain prefix of text some request
+        actually committed."""
+        return list(self._by_key)
+
     # ------------------------------------------------------------ insert
 
     def insert(

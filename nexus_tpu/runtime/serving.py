@@ -622,6 +622,10 @@ class ServingEngine:
         kv_pool_dtype: str = "native",
         host_cache_bytes: int = 0,
         host_cache_dtype: str = "native",
+        draft_forward: Optional[Callable] = None,
+        draft_params: Any = None,
+        draft_cfg: Any = None,
+        draft_cache_sharding: Optional[Any] = None,
     ):
         """``prefill_chunk`` (T): prompt tokens an admitting row consumes
         per decode step. A T-slot feed costs every row T slots of matmul
@@ -762,7 +766,26 @@ class ServingEngine:
         ``"native"`` every restore is byte-identical and the exactness
         contract extends verbatim: spill/restore is scheduling, never
         semantics (tested cache-on == cache-off across fused/gather ×
-        fp/int8 pools)."""
+        fp/int8 pools).
+
+        ``draft_forward``/``draft_params``/``draft_cfg`` (round 11)
+        attach the DRAFT-MODEL speculation tier: each round a cheap
+        draft proposes ``num_speculative`` tokens through its own dense
+        KV cache (a k+1-step width-1 scan inside the same dispatch) and
+        the target verifies the window exactly like the prompt-lookup
+        tier — the two proposers share one verify seam, so the
+        commit/rollback invariants (accepted tokens commit blocks,
+        rejected ones rewind the lease pointer, a partially-rejected
+        block is never published to the radix tree or host tier) exist
+        once. The draft has no prefix cache: it teacher-forces each
+        admitted prompt from position 0 at k+1 tokens per round, so
+        after a prefix-cache hit it LAGS the target and catches up
+        through the committed text (proposals are fallback-garbage
+        until then — exactness never depends on them, tested).
+        Mutually exclusive with ``lookup_ngram``; greedy-exact only;
+        the draft must share the target's vocabulary.
+        ``draft_cache_sharding`` pins the draft cache's layout
+        (dense (L, B, S, Hkv, D)) on a sharded mesh."""
         self._fwd = forward_decode
         self._params = params
         self._cfg = cfg
@@ -805,7 +828,51 @@ class ServingEngine:
         self._base_key = jax.random.PRNGKey(int(sample_seed))
         self._lookup = int(lookup_ngram)
         self._k = int(num_speculative)
-        if self._lookup and self._k < 1:
+        # ---- speculation tiers (one verify seam, two proposers) ----
+        # prompt-lookup (lookup_ngram > 0): zero extra model — proposals
+        # are n-gram copies of the row's own committed text; draft-model
+        # (draft_forward set): a cheap model proposes k tokens per round
+        # through its own dense KV cache. Either way the TARGET scores
+        # the whole k+1 window in ONE dispatch through the block table
+        # and rejected positions roll the lease pointer back.
+        self._draft = draft_forward is not None
+        self._draft_fwd = draft_forward
+        self._draft_params = draft_params
+        self._draft_cfg = draft_cfg
+        self._draft_cache_sharding = draft_cache_sharding
+        if self._draft and self._lookup:
+            raise ValueError(
+                "lookup_ngram and draft_forward are mutually exclusive "
+                "(draft-free vs draft-model speculation — two proposers "
+                "behind the same verify seam)"
+            )
+        if self._draft and draft_cfg is None:
+            raise ValueError("draft_forward requires draft_cfg")
+        if self._draft and (
+            getattr(draft_cfg, "vocab_size", None)
+            != getattr(cfg, "vocab_size", None)
+        ):
+            raise ValueError(
+                "speculative draft must share the target vocab: "
+                f"draft {getattr(draft_cfg, 'vocab_size', None)} != "
+                f"target {getattr(cfg, 'vocab_size', None)}"
+            )
+        if self._draft and (
+            int(getattr(draft_cfg, "max_seq_len", 0)) < self._max_len
+        ):
+            # the draft's dense cache runs the ENGINE's max_len (its
+            # rope tables included) — a draft configured for fewer
+            # positions would silently propose garbage past its range
+            # (acceptance collapse, no error), the hazard the infer
+            # path's min(target, draft) context clamp exists to prevent
+            raise ValueError(
+                "speculative draft must cover the serve context: "
+                f"draft max_seq_len {getattr(draft_cfg, 'max_seq_len', 0)}"
+                f" < engine max_len {self._max_len} (override the "
+                "draft's max_seq_len or shrink max_len)"
+            )
+        self._spec = bool(self._lookup) or self._draft
+        if self._spec and self._k < 1:
             raise ValueError(
                 f"num_speculative must be >= 1, got {self._k}"
             )
@@ -918,7 +985,7 @@ class ServingEngine:
         from nexus_tpu.api.runtime_spec import serve_dispatch_slack
 
         self._slack = serve_dispatch_slack(
-            self._chunk, self._lookup, self._k
+            self._chunk, self._lookup, self._k, draft=self._draft
         )
 
         cfg_ = cfg
@@ -1076,34 +1143,105 @@ class ServingEngine:
             seed_vec = seed_vec.at[rows].set(seeds, mode="drop")
             return cache, buf, ptr, plen, temp_vec, seed_vec
 
-        # ---- speculative (prompt-lookup) variants ----
+        # ---- speculative variants (the proposer seam, round 11) ----
+        # ONE verify structure, two proposers: prompt-lookup (n-gram
+        # copies from the committed text, computed in-trace — zero extra
+        # model) and a DRAFT MODEL (a k+1-step width-1 scan through its
+        # own dense KV cache). The verify program is identical either
+        # way — proposals enter it as a (B, k) value — so a future
+        # proposer (Medusa heads, host-side grammar jumps) plugs in
+        # without touching the commit/rollback invariants.
         k_spec, g_spec, R = self._k, self._lookup, self._rounds
         W = k_spec + 1
         rows_idx = jnp.arange(B)
+        d_fwd, d_cfg = draft_forward, draft_cfg
 
-        def _spec_chunk(params, cache, tok, ptr, done, buf, plen,
-                        shared_blocks, shared_table):
+        def _draft_propose(d_params, d_cache, tok, frontier, done,
+                           active, buf):
+            """One round's draft-model proposals: a k+1-step scan of
+            width-1 draft feeds. Each step feeds EITHER the next
+            committed token from ``buf`` (teacher forcing, whenever the
+            draft's cache pointer sits below the row's committed
+            ``frontier`` — this one rule covers prompt prefill AND the
+            catch-up after a prefix-cache hit let the TARGET skip
+            positions the draft still has to ingest) or the draft's own
+            previous prediction (speculative proposing past the
+            frontier). Rows with nothing to feed (done; prefilling rows
+            whose prompt ran out mid-scan) ride along at n_valid=0 —
+            no K/V write, no pointer advance. Returns the (B, k)
+            proposals (garbage for rows that were teacher-forcing —
+            the verify rejects them, exactness never depends on
+            proposal quality) and the updated draft cache."""
+            def dstep(carry, _):
+                d_cache, dtok = carry
+                pos = d_cache["length"]  # (B,) the draft's next slot
+                teach = pos < frontier
+                feed = jnp.where(
+                    teach,
+                    jnp.take_along_axis(
+                        buf,
+                        jnp.clip(pos, 0, max_len_ - 1)[:, None],
+                        axis=1,
+                    )[:, 0],
+                    dtok,
+                )
+                n_valid = jnp.where(
+                    done, 0, jnp.where(teach | active, 1, 0)
+                ).astype(jnp.int32)
+                dc = dict(d_cache)
+                dc["n_valid"] = n_valid
+                logits, d_cache2 = d_fwd(
+                    d_params, d_cfg, feed[:, None], dc
+                )
+                d_cache2 = {
+                    k2: v2 for k2, v2 in dict(d_cache2).items()
+                    if k2 != "n_valid"
+                }
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(tok.dtype)
+                return (d_cache2, nxt), nxt
+
+            (d_cache, _), drafted = lax.scan(
+                dstep, (d_cache, tok), None, length=W
+            )
+            # drafted (W, B): step i's output proposes position i+1 of
+            # the window; the final step's output is discarded but its
+            # feed put the last proposal's K/V in the draft cache (the
+            # all-accepted case resumes after it)
+            return drafted.swapaxes(0, 1)[:, :k_spec], d_cache
+
+        def _make_spec_chunk(with_draft):
             """R speculative rounds in ONE dispatch: decode rows propose
-            k by n-gram lookup in their committed text and verify in one
-            k+1-wide forward; PREFILLING rows ride the same forward with
-            k+1 prompt tokens in their window instead (chunked prefill
-            at T = k+1), emitting their first token the round their
-            prompt completes. Commit + rollback-by-pointer go through
-            models/decoding.py's shared helpers."""
+            k tokens (n-gram lookup or the draft model) and verify in
+            one k+1-wide target forward; PREFILLING rows ride the same
+            forward with k+1 prompt tokens in their window instead
+            (chunked prefill at T = k+1), emitting their first token
+            the round their prompt completes. Commit +
+            rollback-by-pointer go through models/decoding.py's shared
+            helpers."""
             from nexus_tpu.models.decoding import (
                 _commit_speculation,
                 _greedy_accept,
                 prompt_lookup_propose,
             )
 
-            def round_(carry, _):
-                cache, tok, ptr, buf = carry
+            def round_body(params, d_params, cache, d_cache, tok, ptr,
+                           done, buf, plen, shared_blocks, shared_table):
                 prefilling = (ptr < plen) & ~done
                 active = ~done & ~prefilling
                 last_pos = cache["length"]  # (B,) == tok's buffer position
-                proposals, _found = prompt_lookup_propose(
-                    buf, last_pos, k_spec, g_spec
-                )
+                if with_draft:
+                    # committed frontier: positions of buf the draft may
+                    # teacher-force (the prompt while prefilling; the
+                    # committed text incl. tok once active)
+                    frontier = jnp.where(prefilling, plen, last_pos + 1)
+                    proposals, d_cache = _draft_propose(
+                        d_params, d_cache, tok, frontier, done, active,
+                        buf,
+                    )
+                else:
+                    proposals, _found = prompt_lookup_propose(
+                        buf, last_pos, k_spec, g_spec
+                    )
                 pf_pos = jnp.clip(
                     ptr[:, None] + jnp.arange(W)[None, :], 0, max_len_ - 1
                 )
@@ -1164,15 +1302,63 @@ class ServingEngine:
                 n_emit = jnp.where(
                     active, accepted + 1, jnp.where(finish, 1, 0)
                 )
-                return (cache2, new_tok, ptr2, buf), (
+                if with_draft:
+                    # draft rollback-by-pointer, in lockstep with the
+                    # target's: an active row's rejected draft positions
+                    # rewind to the committed length (their K/V is
+                    # overwritten by the next round's feeds);
+                    # teacher-forcing rows keep their own advance — it
+                    # never passes the committed frontier, which is
+                    # always <= the row's committed length
+                    d_len = d_cache["length"]
+                    d_cache = dict(d_cache)
+                    d_cache["length"] = jnp.where(
+                        active, jnp.minimum(d_len, new_len), d_len
+                    )
+                return (cache2, d_cache, new_tok, ptr2, buf), (
                     out, accepted, n_emit, active,
                 )
 
-            (cache, tok, ptr, buf), (outs, accs, n_emits, actives) = (
-                lax.scan(round_, (cache, tok, ptr, buf), None, length=R)
-            )
-            # outs (R, B, k+1); accs/n_emits/actives (R, B)
-            return cache, tok, ptr, buf, outs, accs, n_emits, actives
+            if with_draft:
+                def _spec_chunk(params, d_params, cache, d_cache, tok,
+                                ptr, done, buf, plen, shared_blocks,
+                                shared_table):
+                    def round_(carry, _):
+                        cache, d_cache, tok, ptr, buf = carry
+                        return round_body(
+                            params, d_params, cache, d_cache, tok, ptr,
+                            done, buf, plen, shared_blocks, shared_table,
+                        )
+
+                    ((cache, d_cache, tok, ptr, buf),
+                     (outs, accs, n_emits, actives)) = lax.scan(
+                        round_, (cache, d_cache, tok, ptr, buf), None,
+                        length=R,
+                    )
+                    # outs (R, B, k+1); accs/n_emits/actives (R, B)
+                    return (cache, d_cache, tok, ptr, buf, outs, accs,
+                            n_emits, actives)
+            else:
+                def _spec_chunk(params, cache, tok, ptr, done, buf, plen,
+                                shared_blocks, shared_table):
+                    def round_(carry, _):
+                        cache, d_cache, tok, ptr, buf = carry
+                        return round_body(
+                            params, None, cache, d_cache, tok, ptr,
+                            done, buf, plen, shared_blocks, shared_table,
+                        )
+
+                    # the proposer carry slot rides empty (None is an
+                    # empty pytree — same scan structure both tiers)
+                    ((cache, _dc, tok, ptr, buf),
+                     (outs, accs, n_emits, actives)) = lax.scan(
+                        round_, (cache, None, tok, ptr, buf), None,
+                        length=R,
+                    )
+                    # outs (R, B, k+1); accs/n_emits/actives (R, B)
+                    return cache, tok, ptr, buf, outs, accs, n_emits, actives
+
+            return _spec_chunk
 
         # donate the cache (and the spec path's token buffer): XLA updates
         # the K/V buffers in place instead of copying the whole cache
@@ -1230,9 +1416,32 @@ class ServingEngine:
             ),
             donate_argnums=(0,) if donate else (),
         )
-        self._spec_chunk = jax.jit(
-            _spec_chunk, donate_argnums=(1, 5) if donate else ()
-        )
+        if self._draft:
+            self._spec_chunk = jax.jit(
+                _make_spec_chunk(True),
+                donate_argnums=(2, 3, 7) if donate else (),
+            )
+
+            def _draft_reset(d_cache, rows):
+                """Reset admitted rows' DRAFT cache pointers to 0 in one
+                tiny dispatch (the draft has no prefix cache — it
+                teacher-forces the whole prompt from the round scans'
+                width-1 feeds). Unused wave slots carry an out-of-range
+                row index and scatter-drop, mirroring the insert wave."""
+                d_cache = dict(d_cache)
+                d_cache["length"] = d_cache["length"].at[rows].set(
+                    0, mode="drop"
+                )
+                return d_cache
+
+            self._draft_reset_fn = jax.jit(
+                _draft_reset, donate_argnums=(0,) if donate else ()
+            )
+        else:
+            self._spec_chunk = jax.jit(
+                _make_spec_chunk(False),
+                donate_argnums=(1, 5) if donate else (),
+            )
 
     def _mint(self, x, dtype=None):
         """Host value → device array with a dispatch-stable commitment
@@ -1248,10 +1457,11 @@ class ServingEngine:
         p = int(prompt.shape[0])
         if p < 1:
             raise ValueError(f"request {req_idx}: empty prompt")
-        if self._lookup and req.temperature > 0:
+        if self._spec and req.temperature > 0:
             raise ValueError(
-                f"request {req_idx}: speculative (prompt-lookup) serving "
-                "is greedy-exact only; temperature must be 0"
+                f"request {req_idx}: speculative serving (prompt-lookup "
+                "or draft-model) is greedy-exact only; temperature must "
+                "be 0"
             )
         # budget: leave the dispatch's worst-case overrun + 1 below the
         # cache end so an almost-finished chunk can never run the row
@@ -1310,7 +1520,7 @@ class ServingEngine:
         temps = np.zeros((b,), dtype=np.float32)
         seeds = np.zeros((b,), dtype=np.int32)
         out = []
-        width = (self._k + 1) if self._lookup else self._t
+        width = (self._k + 1) if self._spec else self._t
         now = self._clock()
         for i, (row, req, req_idx, prompt, p, budget, matched) in enumerate(
             admissions
@@ -1416,6 +1626,37 @@ class ServingEngine:
                 }
             return c
 
+        def fresh_draft_cache():
+            """The draft proposer's own KV cache: DENSE rows at the
+            draft's shapes (a draft is small by design, so a worst-case
+            ``batch × max_len`` stripe is cheap next to the target's
+            pool) with vector lengths — rollback is the same
+            pointer-rewind the dense speculative loops use. No block
+            table, no prefix sharing: the draft teacher-forces every
+            admitted prompt from position 0 (see _draft_propose)."""
+            d_cfg = self._draft_cfg
+            dc = init_kv_cache(
+                d_cfg.n_layers, d_cfg.n_kv_heads, d_cfg.head_dim,
+                d_cfg.dtype, b, max_len,
+                quantized=getattr(d_cfg, "kv_cache_quantized", False),
+            )
+            dc["length"] = jnp.zeros((b,), jnp.int32)
+            dc = constrain_kv_sharding(dc, self._draft_cache_sharding)
+            if self._host_sharding is not None:
+                # commit EVERY leaf on the mesh (k/v replicated when no
+                # explicit draft sharding was given): a fresh cache
+                # whose commitment differs from the steady-state jit
+                # outputs is a second compile key for the verify and
+                # draft-reset programs — the PR 7 recompile class
+                kv = ("k", "v", "k_scale", "v_scale")
+                keep = kv if self._draft_cache_sharding is not None else ()
+                dc = {
+                    k: (v if k in keep
+                        else jax.device_put(v, self._host_sharding))
+                    for k, v in dc.items()
+                }
+            return dc
+
         # ---- warm-up (outside the timed window) ----
         # warm with the REAL layout or jit compiles a second program for
         # the constrained cache on the first timed chunk (scale planes
@@ -1451,7 +1692,25 @@ class ServingEngine:
             self._mint(np.full((b,), b, np.int32)),
             self._mint(np.zeros((b, max_len), np.int32)), zi(), zi(), zf(), zi(),
         )
-        if self._lookup:
+        if self._draft:
+            # warm in SERVE order — reset on the eager fresh cache,
+            # then the verify chunk on the reset's jit output — so both
+            # commitment flavors the timed run produces are the ones
+            # already compiled (mirrors the insert→chunk threading
+            # above; the reset first fires at the first admission wave,
+            # inside the timed window)
+            warm_d = self._draft_reset_fn(
+                fresh_draft_cache(),
+                self._mint(np.full((b,), b, np.int32)),
+            )
+            out = self._spec_chunk(
+                self._params, self._draft_params, warm_cache, warm_d,
+                zi(), warm_ptr, self._mint(np.ones((b,), np.bool_)),
+                warm_buf, warm_plen, *zero_shared,
+            )
+            np.asarray(out[5])  # host fetch: the warm-up really completed
+            del warm_d
+        elif self._lookup:
             out = self._spec_chunk(
                 self._params, warm_cache, zi(), warm_ptr,
                 self._mint(np.ones((b,), np.bool_)), warm_buf, warm_plen,
@@ -1517,6 +1776,7 @@ class ServingEngine:
         self.last_drain = None
         interrupted = False
         cache = fresh_cache()  # vector length from step 0
+        d_cache = fresh_draft_cache() if self._draft else None
         buf = self._mint(np.zeros((b, max_len), np.int32))
         tok_vec = zi()
         ptr_vec = zi()
@@ -1925,7 +2185,8 @@ class ServingEngine:
             together in one wave. Progress is guaranteed: deferral
             requires an ACTIVE prefilling row, and _validate_request
             rejects requests that exceed the whole pool outright."""
-            nonlocal cache, buf, ptr_vec, plen_vec, temp_vec, seed_vec
+            nonlocal cache, d_cache, buf, ptr_vec, plen_vec, temp_vec
+            nonlocal seed_vec
             nonlocal reserved_blocks_total, hit_tokens, hit_requests
             nonlocal cow_copies, admission_overtakes
             nonlocal restore_hit_tokens, restore_hit_requests
@@ -2057,6 +2318,17 @@ class ServingEngine:
              admitted) = self._admit_wave(
                 cache, buf, ptr_vec, plen_vec, temp_vec, seed_vec, wave,
             )
+            if self._draft:
+                # the admitted rows' DRAFT pointers reset to 0 (the
+                # draft re-ingests each prompt teacher-forced; the
+                # target may start past a prefix-cache match, the draft
+                # catches up through the same frontier rule)
+                d_rows = np.full((b,), b, dtype=np.int32)
+                for i, (row, _st, _steps) in enumerate(admitted):
+                    d_rows[i] = row
+                d_cache = self._draft_reset_fn(
+                    d_cache, self._mint(d_rows)
+                )
             cow_pairs = []
             for (row, state, steps), (_, p, budget, lease, matched,
                                       cow_src, keys) in zip(
@@ -2168,12 +2440,20 @@ class ServingEngine:
                 np.asarray([r is None or row_done(r) for r in rows]),
                 jnp.bool_,
             )
-            if self._lookup:
-                (cache, tok_vec, ptr_vec, buf, outs, accs, n_emits,
-                 actives) = self._spec_chunk(
-                    self._params, cache, tok_vec, ptr_vec, done_vec, buf,
-                    plen_vec, *shared_ops,
-                )
+            if self._spec:
+                if self._draft:
+                    (cache, d_cache, tok_vec, ptr_vec, buf, outs, accs,
+                     n_emits, actives) = self._spec_chunk(
+                        self._params, self._draft_params, cache, d_cache,
+                        tok_vec, ptr_vec, done_vec, buf, plen_vec,
+                        *shared_ops,
+                    )
+                else:
+                    (cache, tok_vec, ptr_vec, buf, outs, accs, n_emits,
+                     actives) = self._spec_chunk(
+                        self._params, cache, tok_vec, ptr_vec, done_vec,
+                        buf, plen_vec, *shared_ops,
+                    )
                 chunks += 1
                 # one verify scores k+1 positions; utilization over them
                 # is acceptance-sensitive by design
@@ -2243,7 +2523,7 @@ class ServingEngine:
                 state = rows[r]
                 if state is None:
                     continue
-                if self._lookup:
+                if self._spec:
                     for ri in range(self._rounds):
                         if row_done(state):
                             break
@@ -2311,7 +2591,7 @@ class ServingEngine:
             "insert_dispatches": self._insert_dispatches,
             "prefill_steps": self._prefill_steps,
             "prefill_chunk": (
-                (self._k + 1) if self._lookup else self._t
+                (self._k + 1) if self._spec else self._t
             ),
             # ---- robustness ledger (round 7) ----
             "interrupted": interrupted,
@@ -2459,12 +2739,36 @@ class ServingEngine:
             round(dense_row_bytes / metrics["kv_bytes_per_request"], 3)
             if metrics["kv_bytes_per_request"] else 1.0
         )
-        if self._lookup:
-            metrics["speculative_kind"] = "prompt_lookup"
-            metrics["prompt_lookup_ngram"] = self._lookup
+        # ---- speculation ledger (rounds 3/11) ----
+        # decode_dispatches_per_committed_token is THE spec-decoding
+        # cost metric: target verify forwards spent per token that
+        # actually COMMITTED (drafted-then-rejected tokens are pure
+        # cost, never output — they appear here as a ratio > the ideal
+        # 1/(k+1), never as throughput). Plain decode is 1.0 by
+        # construction — every committed token is exactly one scheduled
+        # forward step of its row — so the A/B leg reads off directly:
+        # < 1.0 means speculation beats one-forward-per-token.
+        if self._spec:
+            metrics["speculative_kind"] = (
+                "draft_model" if self._draft else "prompt_lookup"
+            )
+            if self._lookup:
+                metrics["prompt_lookup_ngram"] = self._lookup
             metrics["num_speculative"] = self._k
             metrics["target_forwards"] = target_forwards
             metrics["acceptance_rate"] = (
                 round(accepted_total / drafted, 4) if drafted else 0.0
+            )
+            metrics["accepted_per_round"] = (
+                round(accepted_total / target_forwards, 4)
+                if target_forwards else 0.0
+            )
+            metrics["decode_dispatches_per_committed_token"] = (
+                round(target_forwards / committed, 4) if committed
+                else 0.0
+            )
+        else:
+            metrics["decode_dispatches_per_committed_token"] = (
+                1.0 if committed else 0.0
             )
         return results, metrics

@@ -23,6 +23,14 @@ the engine, because a tree-bookkeeping slip (an orphaned chain, a parked
 interior with referenced tails) silently degrades hit rates or strands
 pool capacity without ever failing a token-exactness test.
 
+Round 11 adds the **committed-publication audit**
+(``audit_committed_publication``): every digest the radix tree indexes
+after a serve must be a hash-chain prefix of text some request actually
+committed — the tree-side proof that a speculation round's rejected
+tokens (whose K/V the verify window wrote before acceptance was known)
+can never be published to the prefix tree or, through it, the host
+tier.
+
 Round 10 adds a fourth: **host spill-tier coherence** — the pool
 partition audit gains the spilled slot (spilled tree entries must
 account 1:1 against host-store payloads; free + parked + referenced
@@ -67,6 +75,10 @@ ENGINE_JIT_ATTRS = (
     "_spec_chunk",
     "_spill_gather_fn",
     "_restore_write_fn",
+    # round 11: the draft-tier row-reset program (draft engines only —
+    # absent attrs are skipped); the verify-window program itself is
+    # `_spec_chunk`, shared by both speculation tiers
+    "_draft_reset_fn",
 )
 
 
@@ -207,6 +219,76 @@ def audit_host_cache(engine: Any, context: str = "serve") -> None:
 
 
 # ---------------------------------------------------------------------------
+# audit 2c: committed-text publication (rollback-never-publishes)
+
+
+def audit_committed_publication(
+    engine: Any, requests, results, context: str = "serve"
+) -> None:
+    """Assert every digest the radix tree indexes after a serve run is
+    a full-block hash-chain prefix of text some request actually
+    COMMITTED — its prompt (published block by block as prefill writes
+    them) or its prompt + emitted tokens up to ONE short of the newest
+    (whose K/V may never have landed; runtime/serving.py::
+    register_completion_blocks).
+
+    This is the tree-side proof that speculation's rollback is airtight
+    (round 11): a verify window writes K/V for proposed-then-REJECTED
+    tokens into a row's tail blocks before acceptance is known, and the
+    rollback is a pointer rewind — the garbage stays in the pool until
+    overwritten. A publication path that indexed a block spanning
+    rejected positions would therefore serve OTHER requests rejected-
+    draft K/V under a digest that looks committed, and no token-
+    exactness test of the publishing request would ever notice. The
+    invariant isn't speculation-specific (plain engines are audited
+    too); speculation is just the mechanism most likely to break it.
+
+    Drained rows (engine death) are covered through ``last_drain`` —
+    their committed snapshots publish at release exactly like finished
+    rows."""
+    index = getattr(engine, "last_prefix_index", None)
+    bs = int(getattr(engine, "_block_size", 0) or 0)
+    if index is None or bs <= 0:
+        return
+    from nexus_tpu.runtime.prefix_cache import chain_keys
+
+    allowed = set()
+
+    def admit_text(toks) -> None:
+        for key in chain_keys([int(t) for t in toks], bs):
+            allowed.add(key)
+
+    for req, res in zip(requests, results or []):
+        if res is None:
+            continue
+        toks = [int(t) for t in res.tokens]
+        p = len(list(req.prompt))
+        if len(toks) > p:
+            # one chain covers both publication sites: its first
+            # floor(p/bs) digests ARE the prompt chain (hash chains of
+            # a shared prefix are identical)
+            admit_text(toks[:-1])
+        else:
+            admit_text(toks[:p])
+    for d in (getattr(engine, "last_drain", None) or []):
+        req = requests[d.request_idx]
+        prompt = [int(t) for t in req.prompt]
+        committed = [int(t) for t in d.committed]
+        if committed:
+            admit_text((prompt + committed)[:-1])
+        else:
+            admit_text(prompt)
+    stray = [k for k in index.indexed_keys() if k not in allowed]
+    if stray:
+        raise SanitizerError(
+            f"{context}: {len(stray)} indexed radix digest(s) match no "
+            "request's committed text — a block whose tokens were never "
+            "committed (e.g. a partially-rejected speculation window) "
+            "was published to the prefix tree"
+        )
+
+
+# ---------------------------------------------------------------------------
 # audit 3: bounded jit recompiles
 
 
@@ -282,6 +364,9 @@ def install(engine_cls: Optional[type] = None) -> bool:
         audit_pool_partition(metrics, context="sanitizer[pool]")
         audit_prefix_tree(self, context="sanitizer[radix]")
         audit_host_cache(self, context="sanitizer[host-cache]")
+        audit_committed_publication(
+            self, requests, results, context="sanitizer[spec-publish]"
+        )
         audit_recompiles(self, context="sanitizer[recompile]")
         return results, metrics
 

@@ -248,3 +248,71 @@ def test_fused_under_jit_with_traced_operands():
             np.asarray(got), np.asarray(ref), err_msg=f"sb={sb}", **TOL
         )
     assert run._cache_size() == 1
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_verify_window_multi_query_causal_through_table(quantized):
+    """The round-11 speculation contract on the kernel itself: a k+1
+    VERIFY WINDOW of queries (each at its own causal position — query j
+    of the window sees positions <= start+j, INCLUDING the window's own
+    earlier K/V slots, which speculation writes before it scores)
+    attends through the block table identically on the fused path, the
+    gather oracle, and with the Hydragen split live — across fp and
+    int8 pools and a sliding window. This is the single program the
+    serve engine dispatches once per speculation round."""
+    rng = np.random.RandomState(41)
+    k = 4  # num_speculative; the verify window is k+1 wide
+    b, hq, hkv, hd, bs, m, nb = 3, 4, 2, 8, 4, 8, 32
+    t = k + 1
+    q = jnp.asarray(rng.randn(b, t, hq, hd), jnp.float32)
+    k_pool, v_pool, ks, vs = _pool(rng, nb, bs, hkv, hd,
+                                   quantized=quantized)
+    # per-row depths land the window at arbitrary block offsets,
+    # including straddling a block boundary mid-window
+    start = jnp.asarray([5, 11, 18], jnp.int32)
+    table = _random_table(rng, b, m, nb, share_rows=True)
+    for window in (0, 9):
+        ref = paged_decode_attention(
+            q, k_pool, v_pool, table, start, window=window,
+            k_scale=ks, v_scale=vs,
+        )
+        got = fused_paged_decode_attention(
+            q, k_pool, v_pool, table, start, window=window,
+            k_scale=ks, v_scale=vs,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref),
+            err_msg=f"window={window}", **TOL
+        )
+        hyd = fused_paged_decode_attention(
+            q, k_pool, v_pool, table, start, window=window,
+            k_scale=ks, v_scale=vs,
+            shared_blocks=jnp.int32(2), shared_table=table[0],
+        )
+        np.testing.assert_allclose(
+            np.asarray(hyd), np.asarray(ref),
+            err_msg=f"hydragen window={window}", **TOL
+        )
+    # in-window causality: zeroing K/V at positions ABOVE each row's
+    # window must not change the output (nothing there is visible even
+    # to the window's newest query). Rows get DISJOINT tables here — a
+    # shared block's tail can be another (deeper) row's visible middle.
+    table = _random_table(rng, b, m, nb, share_rows=False)
+    hi_pos = np.asarray(start) + t  # first invisible position per row
+    k_mut, v_mut = np.asarray(k_pool).copy(), np.asarray(v_pool).copy()
+    tbl = np.asarray(table)
+    for r in range(b):
+        for slot in range(m):
+            blk = int(tbl[r, slot])
+            for off in range(bs):
+                if slot * bs + off >= hi_pos[r]:
+                    k_mut[blk, off] = 0
+                    v_mut[blk, off] = 0
+    got2 = fused_paged_decode_attention(
+        q, jnp.asarray(k_mut, k_pool.dtype), jnp.asarray(v_mut, v_pool.dtype),
+        table, start, k_scale=ks, v_scale=vs,
+    )
+    base = fused_paged_decode_attention(
+        q, k_pool, v_pool, table, start, k_scale=ks, v_scale=vs,
+    )
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(base), **TOL)

@@ -1,0 +1,607 @@
+"""Speculative decoding on the paged serve engine (round 11).
+
+The verify seam serves two proposers — prompt-lookup (n-gram copies of
+the committed text, zero extra model) and a DRAFT MODEL (its own dense
+KV cache, k+1-step scans inside the same dispatch) — and one contract:
+speculative greedy output is token-identical to plain greedy across
+fused/gather x prefix-cache on/off x fp/int8 pools, rejected draft
+positions roll the lease pointer back, and a block whose tokens were
+partially rejected is NEVER published to the radix tree or the host
+tier (the committed-publication sanitizer proves it).
+
+Engine-level lanes (stub + tiny llama, seconds-to-low-minutes on CPU):
+`make spec-serve-smoke` runs this module with the sanitizers armed.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nexus_tpu.models import llama
+from nexus_tpu.models.decoding import (
+    prompt_lookup_generate,
+    speculative_generate,
+)
+from nexus_tpu.runtime.serving import ServeRequest, ServingEngine
+from nexus_tpu.testing import sanitizers
+from tests.test_serving import _cyclic_model, tiny_cfg
+
+
+def _mismatched_cyclic_pair(v: int):
+    """(cfg, target fwd, draft fwd): target decodes (t+1) % v, the
+    draft proposes (t+2) % v — every proposal REJECTS, so each round
+    commits exactly the one correction token (the rollback-heavy
+    worst case)."""
+    cfg, target = _cyclic_model(v, -1)
+
+    def draft(params, cfg_, tokens, cache):
+        logits = jax.nn.one_hot((tokens + 2) % v, v) * 10.0
+        new = {
+            k: x for k, x in cache.items()
+            if k not in ("n_valid", "shared_blocks", "shared_table")
+        }
+        nv = cache.get("n_valid")
+        adv = tokens.shape[1] if nv is None else nv
+        new["length"] = cache["length"] + adv
+        return logits.astype(jnp.float32), new
+
+    return cfg, target, draft
+
+
+# --------------------------------------------------- exactness vs oracles
+
+
+def test_lookup_randomized_accept_reject_rollback_vs_dense_oracle():
+    """Randomized accept/reject/rollback against the DENSE oracle
+    (models/decoding.py::prompt_lookup_generate), two lanes:
+
+    * tiny llama (random weights — real attention, near-zero
+      acceptance, so every round exercises the rejection rollback);
+    * the deterministic cyclic stub with randomized prompts — its
+      completions are self-repetitive, so n-gram proposals start
+      missing (no match yet → reject) and converge to full acceptance
+      once a cycle has committed, exercising BOTH paths in one run.
+
+    The paged spec engine must equal the oracle request by request in
+    both lanes."""
+    cfg = tiny_cfg()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(11)
+    reqs = [
+        ServeRequest(
+            prompt=rng.randint(0, cfg.vocab_size, size=5 + i).tolist(),
+            max_new_tokens=6 + i,
+        )
+        for i in range(4)
+    ]
+    engine = ServingEngine(
+        llama.forward_decode, params, cfg, batch_size=2, max_len=64,
+        chunk=6, lookup_ngram=2, num_speculative=3, kv_block_size=8,
+    )
+    results, metrics = engine.serve(reqs)
+    for i, (req, res) in enumerate(zip(reqs, results)):
+        ref, _stats = prompt_lookup_generate(
+            llama.forward_decode, params, cfg,
+            jnp.asarray(req.prompt, jnp.int32)[None, :],
+            req.max_new_tokens, num_speculative=3, ngram=2,
+        )
+        np.testing.assert_array_equal(
+            np.array(res.tokens), np.array(ref[0]),
+            err_msg=f"llama request {i}",
+        )
+    assert metrics["speculative_kind"] == "prompt_lookup"
+    assert metrics["target_forwards"] > 0
+
+    v = 13
+    s_cfg, s_fwd = _cyclic_model(v, -1)
+    s_reqs = [
+        ServeRequest(
+            prompt=rng.randint(0, v, size=3 + (i % 4)).tolist(),
+            max_new_tokens=10 + 2 * i,
+        )
+        for i in range(5)
+    ]
+    s_eng = ServingEngine(
+        s_fwd, {}, s_cfg, batch_size=2, max_len=96, chunk=6,
+        lookup_ngram=2, num_speculative=3, kv_block_size=8,
+    )
+    s_results, s_metrics = s_eng.serve(s_reqs)
+    for i, (req, res) in enumerate(zip(s_reqs, s_results)):
+        ref, _stats = prompt_lookup_generate(
+            s_fwd, {}, s_cfg,
+            jnp.asarray(req.prompt, jnp.int32)[None, :],
+            req.max_new_tokens, num_speculative=3, ngram=2,
+        )
+        np.testing.assert_array_equal(
+            np.array(res.tokens), np.array(ref[0]),
+            err_msg=f"cyclic request {i}",
+        )
+    # both paths provably exercised: some proposals accepted (the
+    # committed cycle matches), some rejected (pre-cycle rounds)
+    drafted = s_metrics["target_forwards"] * s_metrics["num_speculative"]
+    accepted = round(s_metrics["acceptance_rate"] * drafted)
+    assert 0 < accepted < drafted, s_metrics
+    assert s_metrics["decode_dispatches_per_committed_token"] < 1.0
+
+
+def test_draft_tier_exactness_vs_speculative_generate_oracle():
+    """The draft-model tier: engine outputs equal the dense
+    ``speculative_generate`` oracle AND plain greedy, with a
+    SELF-draft (draft == target: near-total acceptance) on the fused
+    path with the prefix cache on, and an unrelated draft (rejection-
+    heavy) on the gather path with it off."""
+    cfg = tiny_cfg()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    other = llama.init(jax.random.PRNGKey(9), cfg)
+    rng = np.random.RandomState(5)
+    common = rng.randint(0, cfg.vocab_size, size=16).tolist()
+    reqs = [
+        ServeRequest(
+            prompt=common + rng.randint(0, cfg.vocab_size, size=p).tolist(),
+            max_new_tokens=n,
+        )
+        for p, n in ((8, 6), (5, 8), (12, 7))
+    ]
+    plain = ServingEngine(
+        llama.forward_decode, params, cfg, batch_size=2, max_len=64,
+        chunk=5, kv_block_size=8,
+    )
+    ref, _ = plain.serve(reqs)
+    variants = [
+        # cache OFF: the draft prefills in lockstep with the target, so
+        # a self-draft's proposals are the target's own choices —
+        # acceptance is (near-)total
+        ("self", params, dict(prefix_cache=False,
+                              attention_path="fused")),
+        # cache ON: prefix hits make the target skip prefill the draft
+        # still has to ingest (the catch-up rule) — exactness must hold
+        # while acceptance honestly sags
+        ("self-cached", params, dict(prefix_cache=True,
+                                     attention_path="fused")),
+        ("other", other, dict(prefix_cache=False,
+                              attention_path="gather")),
+    ]
+    for name, d_params, kw in variants:
+        eng = ServingEngine(
+            llama.forward_decode, params, cfg, batch_size=2, max_len=64,
+            chunk=5, num_speculative=3, kv_block_size=8,
+            draft_forward=llama.forward_decode, draft_params=d_params,
+            draft_cfg=cfg, **kw,
+        )
+        got, m = eng.serve(reqs)
+        assert m["speculative_kind"] == "draft_model"
+        for i, (a, b) in enumerate(zip(ref, got)):
+            assert a.tokens == b.tokens, (name, i)
+        # the dense two-model oracle agrees too (greedy speculative
+        # output == plain greedy on both implementations)
+        oracle, _stats = speculative_generate(
+            llama.forward_decode, params, cfg,
+            llama.forward_decode, d_params, cfg,
+            jnp.asarray(reqs[0].prompt, jnp.int32)[None, :],
+            reqs[0].max_new_tokens, num_speculative=3,
+        )
+        np.testing.assert_array_equal(
+            np.array(got[0].tokens), np.array(oracle[0]), err_msg=name
+        )
+        if name == "self":
+            # a draft that IS the target proposes the target's own
+            # greedy choices — acceptance is (near-)total when the
+            # draft prefills in lockstep (no cache skips to catch up
+            # through)
+            assert m["acceptance_rate"] > 0.9, m
+            assert m["decode_dispatches_per_committed_token"] < 0.6, m
+
+
+def test_draft_tier_exactness_int8_pool_with_prefix_hits():
+    """Draft tier x int8 block pool x real block-aligned prefix hits
+    (the 16-token preamble spans two 8-blocks): exact vs plain, with
+    the catch-up rule live — after a hit the TARGET starts past the
+    match while the draft re-ingests from 0."""
+    cfg = tiny_cfg()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(7)
+    common = rng.randint(0, cfg.vocab_size, size=16).tolist()
+    reqs = [
+        ServeRequest(
+            prompt=common + rng.randint(0, cfg.vocab_size, size=p).tolist(),
+            max_new_tokens=n,
+        )
+        for p, n in ((8, 6), (5, 7), (6, 5))
+    ]
+    plain = ServingEngine(
+        llama.forward_decode, params, cfg, batch_size=2, max_len=64,
+        chunk=5, kv_block_size=8, kv_pool_dtype="int8",
+    )
+    ref, _ = plain.serve(reqs)
+    eng = ServingEngine(
+        llama.forward_decode, params, cfg, batch_size=2, max_len=64,
+        chunk=5, num_speculative=3, kv_block_size=8,
+        kv_pool_dtype="int8", prefix_cache=True,
+        draft_forward=llama.forward_decode, draft_params=params,
+        draft_cfg=cfg,
+    )
+    got, m = eng.serve(reqs)
+    for i, (a, b) in enumerate(zip(ref, got)):
+        assert a.tokens == b.tokens, i
+    assert m["prefix_hit_tokens"] > 0, m
+
+
+# ----------------------------------------- rollback never publishes
+
+
+def test_rollback_never_publishes_multi_turn_exact():
+    """The publication contract under speculation: turn-1 requests run
+    rejection-heavy speculation (verify windows write rejected K/V
+    into tail blocks before rollback), their completions register into
+    the radix tree at release, and turn-2 successors MATCH those
+    chains — if any partially-rejected block had been published, the
+    successors would read garbage K/V and diverge from isolated
+    greedy. The committed-publication audit is asserted explicitly."""
+    cfg = tiny_cfg()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(13)
+    turn1 = [
+        ServeRequest(
+            prompt=rng.randint(0, cfg.vocab_size, size=9).tolist(),
+            max_new_tokens=12,
+        )
+        for _ in range(2)
+    ]
+
+    def make_engine():
+        return ServingEngine(
+            llama.forward_decode, params, cfg, batch_size=2, max_len=64,
+            chunk=5, lookup_ngram=2, num_speculative=3, kv_block_size=4,
+            prefix_cache=True,
+        )
+
+    # learn turn-1 completions on a throwaway engine
+    r1, _ = make_engine().serve(turn1)
+    turn2 = [
+        ServeRequest(
+            prompt=list(r.tokens)
+            + rng.randint(0, cfg.vocab_size, size=3).tolist(),
+            max_new_tokens=6,
+        )
+        for r in r1
+    ]
+    queue = turn1 + turn2
+    engine = make_engine()
+    results, metrics = engine.serve(queue)
+    # the tree only ever holds committed-text digests (rollback never
+    # published a rejected window) — the round-11 audit, explicit
+    sanitizers.audit_committed_publication(engine, queue, results)
+    assert metrics["prefix_completion_blocks"] > 0, metrics
+    assert metrics["prefix_hit_tokens"] > 0, metrics
+    for i, (req, res) in enumerate(zip(queue, results)):
+        ref = llama.generate(
+            params, cfg, jnp.asarray(req.prompt, jnp.int32)[None, :],
+            max_new_tokens=res.new_tokens,
+        )
+        np.testing.assert_array_equal(
+            np.array(res.tokens), np.array(ref[0]),
+            err_msg=f"queue[{i}]",
+        )
+
+
+def test_committed_publication_audit_detects_poisoned_tree():
+    """Negative control: a digest that matches no request's committed
+    text (the signature a rejected-window publication would leave)
+    makes the audit raise."""
+    cfg = tiny_cfg()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    reqs = [ServeRequest(prompt=[1, 2, 3, 4, 5, 6, 7, 8, 9],
+                         max_new_tokens=6)]
+    engine = ServingEngine(
+        llama.forward_decode, params, cfg, batch_size=1, max_len=64,
+        chunk=4, kv_block_size=4, prefix_cache=True,
+    )
+    results, _ = engine.serve(reqs)
+    sanitizers.audit_committed_publication(engine, reqs, results)
+    engine.last_prefix_index.insert(b"\x00" * 32, 10_000, parent=None)
+    with pytest.raises(sanitizers.SanitizerError):
+        sanitizers.audit_committed_publication(engine, reqs, results)
+
+
+# --------------------------------------------- committed-only accounting
+
+
+def test_tok_s_and_ttft_count_committed_tokens_only():
+    """Bench honesty (round 11): the throughput/latency ledger counts
+    COMMITTED tokens only. With a draft that always mismatches, every
+    round drafts k tokens and commits exactly 1 — committed_tokens,
+    tokens_per_sec, and dispatches-per-token must reflect the 1, never
+    the k."""
+    v = 11
+    cfg, target, draft = _mismatched_cyclic_pair(v)
+    reqs = [ServeRequest(prompt=[0, 1, 2], max_new_tokens=9)
+            for _ in range(3)]
+    engine = ServingEngine(
+        target, {}, cfg, batch_size=2, max_len=96, chunk=8,
+        num_speculative=4, draft_forward=draft, draft_params={},
+        draft_cfg=cfg,
+    )
+    results, m = engine.serve(reqs)
+    for res in reqs and results:
+        assert res.new_tokens == 9
+        assert 0.0 <= res.ttft_s <= res.latency_s
+    committed = sum(r.new_tokens for r in results)
+    assert m["committed_tokens"] == committed == 27
+    assert m["acceptance_rate"] == 0.0
+    # all-rejected: ONE verify forward per committed token for every
+    # decode round (each row's FIRST token rides its prefill-finish
+    # round instead — 3 requests, 3 such tokens) — drafted-then-
+    # rejected tokens appear as COST in this ratio, never as
+    # throughput
+    assert m["target_forwards"] == committed - 3
+    assert m["decode_dispatches_per_committed_token"] == pytest.approx(
+        (committed - 3) / committed, abs=1e-3
+    )
+    assert m["tokens_per_sec"] == pytest.approx(
+        committed / m["wall_s"], rel=0.2
+    )
+    # and the accepting case beats one-forward-per-token
+    cfg2, fwd2 = _cyclic_model(7, -1)
+    eng2 = ServingEngine(
+        fwd2, {}, cfg2, batch_size=2, max_len=96, chunk=8,
+        num_speculative=4, draft_forward=fwd2, draft_params={},
+        draft_cfg=cfg2,
+    )
+    _, m2 = eng2.serve(reqs)
+    assert m2["acceptance_rate"] == 1.0
+    assert m2["decode_dispatches_per_committed_token"] < 0.5
+    # plain engines report the 1.0 baseline by construction
+    eng3 = ServingEngine(fwd2, {}, cfg2, batch_size=2, max_len=96,
+                         chunk=8)
+    _, m3 = eng3.serve(reqs)
+    assert m3["decode_dispatches_per_committed_token"] == 1.0
+
+
+def test_spec_rejects_sampled_requests_both_tiers():
+    cfg, target, draft = _mismatched_cyclic_pair(6)
+    for kw in (
+        dict(lookup_ngram=2),
+        dict(draft_forward=draft, draft_params={}, draft_cfg=cfg),
+    ):
+        engine = ServingEngine(target, {}, cfg, batch_size=1,
+                               max_len=64, chunk=4, **kw)
+        with pytest.raises(ValueError, match="greedy-exact"):
+            engine.serve([ServeRequest(prompt=[1, 2], max_new_tokens=4,
+                                       temperature=0.5)])
+
+
+def test_draft_and_lookup_mutually_exclusive():
+    cfg, target, draft = _mismatched_cyclic_pair(6)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ServingEngine(target, {}, cfg, batch_size=1, max_len=64,
+                      chunk=4, lookup_ngram=2, draft_forward=draft,
+                      draft_params={}, draft_cfg=cfg)
+
+
+# --------------------------------------------- kill-mid-round failover
+
+
+def test_spec_serve_kill_mid_round_requeues_exactly():
+    """Failover with speculation in flight: a hard-killed spec engine
+    drains at the wave boundary (committed tokens only — never a
+    half-verified window), the planner folds them into requeued
+    prompts, and the replacement spec engine completes token-identical
+    to undisturbed isolated greedy with a leak-free pool."""
+    from nexus_tpu.cluster.store import ClusterStore
+    from nexus_tpu.ha.serve_failover import ServeEngineSupervisor
+    from nexus_tpu.runtime.serving import STATUS_FAILED_OVER
+    from tests.test_serve_failover import (
+        NS,
+        _assert_pool_clean,
+        _chaos_when_step,
+    )
+
+    cfg = tiny_cfg()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(31)
+    base = rng.randint(0, cfg.vocab_size, size=5).tolist()
+    reqs = []
+    for i in range(4):
+        # repeated-n-gram prompts keep acceptance > 0 so kills land
+        # with real multi-token rounds in flight
+        tail = rng.randint(0, cfg.vocab_size, size=2 + i).tolist()
+        reqs.append(ServeRequest(prompt=base + base + tail,
+                                 max_new_tokens=16))
+    refs = [
+        llama.generate(
+            params, cfg, jnp.asarray(r.prompt, jnp.int32)[None, :],
+            max_new_tokens=r.max_new_tokens,
+        )
+        for r in reqs
+    ]
+
+    def make_engine():
+        return ServingEngine(
+            llama.forward_decode, params, cfg, batch_size=2, max_len=80,
+            chunk=4, lookup_ngram=2, num_speculative=3, kv_block_size=8,
+        )
+
+    store = ClusterStore("serve-shard-spec")
+    sup = ServeEngineSupervisor(
+        make_engine, store, NS, "llm-spec",
+        ttl_seconds=0.12, pace_s=0.02,
+    )
+    _chaos_when_step(store, "llm-spec", 6,
+                     lambda: sup.kill_current(hard=True))
+    results, report = sup.run(reqs, timeout_s=180)
+    assert report["requests_lost"] == 0
+    assert report["restarts"] >= 1, "chaos never landed mid-serve"
+    recovered = [r for r in results if r.status == STATUS_FAILED_OVER]
+    assert recovered and all(r.retries >= 1 for r in recovered)
+    for req, ref, res in zip(reqs, refs, results):
+        np.testing.assert_array_equal(
+            np.array(res.tokens), np.array(ref[0]),
+            err_msg=f"prompt {req.prompt[:4]}",
+        )
+        assert res.new_tokens == req.max_new_tokens
+    for gen in report["generations"]:
+        _assert_pool_clean(gen)
+
+
+# ------------------------------------------------ recompile audit (mesh)
+
+
+def test_spec_recompile_one_program_on_mesh_both_tiers():
+    """Round-11 regression probe: on the 8-device mesh, a paged FUSED
+    engine with SPECULATION LIVE (Hydragen shared runs included) still
+    compiles exactly one program per callable — the verify window's
+    proposals, shared-run operands, and per-round acceptance are all
+    traced VALUES, never compile keys. Covers the lookup tier and the
+    draft tier (whose draft-reset program must also stay at one)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    assert len(devs) == 8, "conftest forces 8 host-platform devices"
+    mesh = Mesh(devs, ("d",))
+    v = 11
+    cfg, target, draft = _mismatched_cyclic_pair(v)
+    preamble = [1, 2, 3, 4, 5, 6, 7, 8]
+    reqs = [
+        ServeRequest(prompt=preamble + [9 + (i % 2), 10],
+                     max_new_tokens=6)
+        for i in range(6)
+    ]
+    tiers = [
+        dict(lookup_ngram=2),
+        dict(draft_forward=draft, draft_params={}, draft_cfg=cfg),
+    ]
+    for kw in tiers:
+        eng = ServingEngine(
+            target, {}, cfg, batch_size=4, max_len=128, chunk=6,
+            num_speculative=3, kv_block_size=4, prefix_cache=True,
+            attention_path="fused",
+            cache_sharding=NamedSharding(mesh, P()),
+            **kw,
+        )
+        results, metrics = eng.serve(reqs)
+        assert all(r.new_tokens == 6 for r in results)
+        assert metrics["hydragen_waves"] >= 1, (
+            "the shared-preamble queue must engage Hydragen with "
+            "speculation live"
+        )
+        counts = sanitizers.jit_program_counts(eng)
+        assert counts["_spec_chunk"] == 1, counts
+        assert counts["_insert_fn"] == 1, counts
+        if "draft_forward" in kw:
+            assert counts["_draft_reset_fn"] == 1, counts
+        sanitizers.audit_recompiles(eng, bound=1)
+
+
+# ------------------------------------------------------- spec & wiring
+
+
+def test_serve_spec_draft_roundtrip_and_validation():
+    from nexus_tpu.api.runtime_spec import (
+        JaxXlaRuntime,
+        ModelRef,
+        ParallelismSpec,
+        ServeSpec,
+        TpuSliceSpec,
+        TrainSpec,
+    )
+
+    def rt(serve, model=None):
+        return JaxXlaRuntime(
+            mode="serve",
+            model=model or ModelRef(family="llama", preset="tiny"),
+            tpu=TpuSliceSpec(accelerator="v5e", topology="1x1",
+                             slice_count=1),
+            parallelism=ParallelismSpec(),
+            train=TrainSpec(batch_size=2, seq_len=32),
+            serve=serve,
+        )
+
+    draft = ModelRef(family="llama", preset="tiny")
+    sv = ServeSpec(draft=draft, num_speculative=3,
+                   draft_checkpoint_directory="/ck/d")
+    rt1 = rt(sv)
+    rt2 = JaxXlaRuntime.from_dict(rt1.to_dict())
+    assert rt2.serve.draft is not None
+    assert rt2.serve.draft.family == "llama"
+    assert rt2.serve.draft_checkpoint_directory == "/ck/d"
+    assert rt2.serve.num_speculative == 3
+    # slack formula: the draft tier budgets the same verify-window
+    # overrun the lookup tier does
+    assert sv.serve_slack() == ServeSpec(
+        prompt_lookup_ngram=3, num_speculative=3
+    ).serve_slack()
+    assert not rt1.validate(), rt1.validate()
+
+    bad = rt(ServeSpec(draft=draft, prompt_lookup_ngram=2))
+    assert any("mutually exclusive" in e for e in bad.validate())
+    bad = rt(ServeSpec(draft=draft, temperature=0.5))
+    assert any("greedy-exact" in e for e in bad.validate())
+    bad = rt(ServeSpec(draft=draft, num_speculative=0))
+    assert any("numSpeculative" in e for e in bad.validate())
+    bad = rt(ServeSpec(
+        draft=ModelRef(family="llama", preset="tiny",
+                       overrides={"vocab_size": 999}),
+    ))
+    assert any("share the target vocab" in e for e in bad.validate())
+    bad = rt(ServeSpec(draft=ModelRef(family="mlp", preset="tiny")))
+    assert any("decode path" in e for e in bad.validate())
+    # the serve engine runs the draft cache at the TARGET's max_len, so
+    # a shorter-context draft is rejected (the infer path clamps
+    # instead — its shapes are its own)
+    bad = rt(ServeSpec(
+        draft=ModelRef(family="llama", preset="tiny",
+                       overrides={"max_seq_len": 64}),
+    ), model=ModelRef(family="llama", preset="tiny",
+                      overrides={"max_seq_len": 256}))
+    assert any("cover the serve context" in e for e in bad.validate())
+    # the speculation window must leave the per-row block budget room
+    # for more than its own verify scratch
+    bad = rt(ServeSpec(
+        prompt_lookup_ngram=2, num_speculative=20, chunk=8,
+        prompt_length_max=4, prompt_length_min=4, max_new_max=1,
+        max_new_min=1, kv_block_size=32,
+    ), model=ModelRef(family="llama", preset="tiny",
+                      overrides={"max_seq_len": 128}))
+    assert any("speculation window too large" in e
+               for e in bad.validate()), bad.validate()
+
+
+def test_run_template_runtime_serve_draft_tier():
+    """End-to-end template wiring: mode='serve' with serve.draft runs
+    the draft tier through the real entrypoint (random draft weights —
+    mechanism, not acceptance) and lands the spec ledger in the
+    metrics."""
+    from nexus_tpu.api.runtime_spec import (
+        JaxXlaRuntime,
+        ModelRef,
+        ParallelismSpec,
+        ServeSpec,
+        TpuSliceSpec,
+        TrainSpec,
+    )
+    from nexus_tpu.runtime.entrypoints import run_template_runtime
+
+    runtime = JaxXlaRuntime(
+        mode="serve",
+        model=ModelRef(family="llama", preset="tiny",
+                       overrides={"max_seq_len": 128}),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1",
+                         slice_count=1),
+        parallelism=ParallelismSpec(),
+        train=TrainSpec(batch_size=2, seq_len=32, seed=3),
+        serve=ServeSpec(
+            num_requests=3, prompt_length_min=4, prompt_length_max=10,
+            max_new_min=4, max_new_max=8, chunk=6, num_speculative=3,
+            draft=ModelRef(family="llama", preset="tiny",
+                           overrides={"max_seq_len": 128}),
+        ),
+    )
+    assert not runtime.validate(), runtime.validate()
+    m = run_template_runtime(runtime)
+    assert m["speculative_kind"] == "draft_model"
+    assert m["finished_requests"] == 3
+    assert m["draft_family"] == "llama"
+    assert m["draft_weights_loaded"] is False
+    assert 0.0 <= m["acceptance_rate"] <= 1.0
+    assert 0.0 < m["decode_dispatches_per_committed_token"] <= 1.0
