@@ -3,7 +3,7 @@ SHELL := /bin/bash
 NATIVE_SRC := nexus_tpu/native/src/nexus_core.cpp nexus_tpu/native/src/nexus_data.cpp
 NATIVE_LIB := nexus_tpu/native/libnexus_core.so
 
-.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-serve-spec bench-failover bench-serve-outage chaos-smoke serve-smoke serve-chaos-smoke serve-sanitize-smoke radix-smoke spill-smoke spec-serve-smoke race-smoke clean lint nexuslint analyze
+.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-serve-spec bench-serve-obs bench-failover bench-serve-outage chaos-smoke serve-smoke serve-chaos-smoke serve-sanitize-smoke radix-smoke spill-smoke spec-serve-smoke obs-smoke race-smoke race-smoke-telemetry clean lint nexuslint analyze
 
 all: native
 
@@ -141,9 +141,30 @@ bench-serve-spec:
 	NEXUS_BENCH_SERVE=only NEXUS_BENCH_SERVE_SPEC=only \
 	  NEXUS_BENCH_INIT_PROBE=0 JAX_PLATFORMS=cpu python bench.py
 
+# Round-12 observability A/B only (minutes, CPU): tracing on/off on the
+# shared-preamble burst (<= 2% tok/s overhead budget) + the per-wave
+# timeline artifact, writing the per-round docs/bench_serve_r<N>.json.
+bench-serve-obs:
+	NEXUS_BENCH_SERVE=only NEXUS_BENCH_SERVE_OBS=only \
+	  NEXUS_BENCH_INIT_PROBE=0 JAX_PLATFORMS=cpu python bench.py
+
+# Observability smoke (fast lane, round 12, stub-model, seconds on CPU):
+# a traced mini-serve validated against the span-timeline schema, a
+# kill-mid-serve whose flight-recorder dump matches the drain snapshot,
+# and the Prometheus/JSON exposition over the live gauge registry
+# (dumps land in /tmp/nexus_obs_smoke for trace_summary.py to render).
+obs-smoke:
+	JAX_PLATFORMS=cpu python tools/obs_smoke.py
+
 # Thread-safety smoke for the store/informer/lister under parallel fan-out.
 race-smoke:
 	python tools/race_smoke_store.py --threads 8 --seconds 3
+
+# Thread-safety smoke for the in-process metrics registry (round 12):
+# N emitters + a snapshot/exposition reader hammering one StatsdClient —
+# per-series monotonicity, no lost final writes, bounded history.
+race-smoke-telemetry:
+	python tools/race_smoke_telemetry.py --threads 8 --seconds 2
 
 # Serving smoke with the runtime sanitizers armed: every engine serve()
 # in these lanes is followed by the pool-partition leak audit and the

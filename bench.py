@@ -1427,6 +1427,233 @@ def _serve_spec_scenarios(preset, progress, block, chunk):
     return out
 
 
+def _serve_obs_scenarios(preset, progress, block, chunk, trials=None):
+    """Round-12 observability leg: tracing ON vs OFF on the
+    shared-preamble burst through ONE engine
+    (``set_observability``) — the acceptance gate is <= 2% median
+    tok/s overhead with the FULL obs surface live (span tracer +
+    flight recorder + wave-boundary live gauges) vs the same engine
+    with all three off. Same-engine toggling is load-bearing: two
+    separately-built engines differ by several percent on the CPU box
+    even when configured identically (measured during round 12 — the
+    null A/B of two identical engines read 6-11%), which would swamp a
+    2% budget; one engine serving alternately compares identical
+    compiled programs, pool state, and tree warmth, and the overhead
+    is the median of PAIRED per-trial ratios (adjacent serves, so the
+    box's multi-minute speed phases cancel within each pair). Also
+    emits the per-wave timeline artifact (the traced arm's
+    flight-recorder wave events) and schema-validates the trace so the
+    artifact never records an invalid dump as a win.
+
+    Keys: obs_tokens_per_sec_plain / obs_tokens_per_sec_traced
+    (medians), obs_tracing_overhead_pct (median of paired overheads;
+    positive = tracing slower), obs_trace_spans / obs_trace_valid /
+    obs_flight_events / obs_gauge_publishes, obs_exact (traced outputs
+    == untraced), and obs_wave_timeline (dict: the last traced trial's
+    wave-event tail)."""
+    import statistics
+
+    trials = trials or int(os.environ.get("NEXUS_BENCH_SERVE_TRIALS") or 9)
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from nexus_tpu.models import llama
+        from nexus_tpu.obs import ServeTracer, validate_trace
+        from nexus_tpu.runtime.serving import ServeRequest, ServingEngine
+        from nexus_tpu.utils.hw import is_tpu
+
+        dtype = jnp.bfloat16 if is_tpu() else jnp.float32
+        cfg = llama.config(preset, dtype=dtype, max_seq_len=1024)
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+    except Exception as e:  # noqa: BLE001 — harness must not kill bench
+        progress(f"obs A/B unavailable: {type(e).__name__}: "
+                 f"{str(e)[:160]}")
+        return {}
+
+    # the row-scaling harness's shared-preamble shape: 64-token
+    # preamble (4 whole blocks at block 16), short private tails, so
+    # waves are many and cheap — the configuration where per-wave
+    # host-side bookkeeping is the LARGEST relative cost (an honest
+    # worst case for the overhead budget)
+    preamble = np.random.RandomState(999).randint(
+        0, cfg.vocab_size, size=64
+    ).tolist()
+    rng = np.random.RandomState(1002)
+    # longer serves than the row-scaling leg's (48 tokens/request, 64
+    # requests ≈ 2-3s each on the CPU box): each paired ratio averages
+    # over more waves, which is what actually narrows the noise here
+    queue = [
+        ServeRequest(
+            prompt=list(preamble) + rng.randint(
+                0, cfg.vocab_size, size=16
+            ).tolist(),
+            max_new_tokens=48,
+        )
+        for _ in range(64)
+    ]
+
+    tracer = ServeTracer()
+    try:
+        eng = ServingEngine(
+            llama.forward_decode, params, cfg, batch_size=8,
+            max_len=1024, chunk=chunk, prefill_chunk=1,
+            kv_block_size=block, flight_recorder=False,
+            live_gauges=False,
+        )
+        eng.serve([ServeRequest(prompt=list(preamble),
+                                max_new_tokens=4)])  # warm + park
+    except Exception as e:  # noqa: BLE001
+        progress(f"obs A/B engine failed: {type(e).__name__}: "
+                 f"{str(e)[:160]}")
+        return {}
+    progress("obs A/B engine ready (same-engine toggle)")
+
+    def arm(key):
+        if key == "traced":
+            eng.set_observability(
+                tracer=tracer, flight_recorder=eng.flight_recorder,
+                live_gauges=True, gauge_tags=["engine:bench-obs"],
+            )
+        else:
+            eng.set_observability()  # everything off
+
+    runs = {"traced": [], "plain": []}
+    exact = True
+    last = {}
+    flight_tail = []
+    for t in range(trials):
+        order = ["traced", "plain"]
+        if t % 2:
+            order.reverse()
+        for key in order:
+            arm(key)
+            try:
+                res, m = eng.serve(queue)
+            except Exception as e:  # noqa: BLE001
+                progress(f"obs A/B serve {key} failed: "
+                         f"{type(e).__name__}: {str(e)[:160]}")
+                return {}
+            runs[key].append(m["tokens_per_sec"])
+            last[key] = (res, m)
+            if key == "traced":
+                flight_tail = eng.flight_recorder.tail(64)
+            progress(f"obs A/B trial {t} {key}: "
+                     f"{m['tokens_per_sec']:.0f} tok/s")
+    for a, b in zip(last["traced"][0], last["plain"][0]):
+        if a.tokens != b.tokens:
+            exact = False
+            break
+    med = {k: statistics.median(v) for k, v in runs.items()}
+    # paired per-trial overheads: the two serves of a trial ran seconds
+    # apart, so the box's slow/fast phases cancel within each pair
+    paired = [
+        100.0 * (p - tr) / max(1e-9, p)
+        for tr, p in zip(runs["traced"], runs["plain"])
+    ]
+    overhead = round(statistics.median(paired), 2)
+    dump = tracer.to_dict()
+    problems = validate_trace(dump)
+    m_traced = last["traced"][1]
+    # deterministic HOST-COST estimate, immune to box phase noise: time
+    # the three obs primitives in REPRESENTATIVE states — a clock read
+    # + round() in the span lambda (the call sites pay both), the
+    # WIDEST span shape (admitted, 10 fields) for every span, the
+    # rolling windows FILLED to capacity before timing publish (each
+    # publish copies+sorts both windows) — and charge them at the
+    # traced run's actual event counts against its wall clock. Reported
+    # next to the noisy empirical ratio so the artifact can't mistake
+    # box phases for tracing cost (the null A/B of two identical
+    # engines reads 6-11% on this box). An estimate, not a hard bound:
+    # it excludes interpreter-state effects the primitives can't see
+    # (cache pressure, GC pacing), which is exactly what the empirical
+    # leg exists to catch grossly.
+    import time as _time
+    import timeit as _timeit
+
+    from nexus_tpu.obs import FlightRecorder, LiveGauges
+    from nexus_tpu.utils.telemetry import StatsdClient
+
+    bt = ServeTracer()
+    bt.begin(1)
+    t_event = min(_timeit.repeat(
+        lambda: bt.event(0, "admitted", t=round(_time.monotonic(), 6),
+                         row=0, queue_s=0.1, prompt_tokens=80,
+                         budget=48, matched_tokens=64, shared_blocks=4,
+                         restored_blocks=0, cow_copy=False,
+                         reserved_blocks=4),
+        number=2000, repeat=3)) / 2000
+    br = FlightRecorder()
+    t_record = min(_timeit.repeat(
+        lambda: br.record("wave", t=_time.monotonic(), wave=1,
+                          queue_depth=0, running_rows=8, committed=0,
+                          free_blocks=0, spills=0, restores=0,
+                          evictions=0, host_bytes=0),
+        number=2000, repeat=3)) / 2000
+    bg = LiveGauges(client=StatsdClient("obs-bound"))
+    for i in range(256):  # full windows: publish sorts what it sees
+        bg.observe_finish(0.1 + i * 1e-4, 0.05 + i * 1e-4)
+    t_publish = min(_timeit.repeat(
+        lambda: bg.publish(queue_depth=1, running_rows=8,
+                           free_pool_blocks=1, host_cache_bytes=0,
+                           committed_tokens=1, waves=1),
+        number=500, repeat=3)) / 500
+    n_spans = sum(len(e["timeline"]) for e in dump["spans"])
+    obs_host_s = (
+        n_spans * t_event
+        + m_traced.get("flight_recorder_events", 0) * t_record
+        + m_traced.get("live_gauge_publishes", 0) * t_publish
+    )
+    host_cost_pct = round(
+        100.0 * obs_host_s / max(1e-9, m_traced.get("wall_s") or 0.0), 3
+    )
+    wave_tail = [
+        {k2: ev[k2] for k2 in ("t", "wave", "queue_depth",
+                               "running_rows", "committed",
+                               "free_blocks")}
+        for ev in flight_tail if ev["kind"] == "wave"
+    ]
+    paired_sorted = sorted(paired)
+    spread = round(
+        paired_sorted[(3 * len(paired_sorted)) // 4]
+        - paired_sorted[len(paired_sorted) // 4], 2,
+    )
+    out = {
+        "obs_trials": trials,
+        "obs_tokens_per_sec_plain": round(med["plain"], 2),
+        "obs_tokens_per_sec_traced": round(med["traced"], 2),
+        "obs_tracing_overhead_pct": overhead,
+        # IQR of the paired overheads — the empirical measurement's
+        # RESOLUTION on this box (read the host-cost estimate when it
+        # dwarfs 2%)
+        "obs_pair_spread_pct": spread,
+        "obs_overhead_host_cost_pct": host_cost_pct,
+        "obs_exact": exact,
+        "obs_trace_spans": n_spans,
+        "obs_trace_valid": not problems,
+        "obs_flight_events": m_traced.get("flight_recorder_events"),
+        "obs_gauge_publishes": m_traced.get("live_gauge_publishes"),
+        # the per-wave timeline artifact: queue depth / running rows /
+        # committed tokens / free blocks, wave by wave, from the LAST
+        # traced trial — the live-signal record the fleet item tunes on
+        "obs_wave_timeline": {
+            "source": "flight_recorder",
+            "waves": len(wave_tail),
+            "events": wave_tail[-24:],
+        },
+    }
+    progress(
+        f"obs A/B medians (n={trials}): plain "
+        f"{out['obs_tokens_per_sec_plain']:.0f} -> traced "
+        f"{out['obs_tokens_per_sec_traced']:.0f} tok/s (paired-median "
+        f"overhead {overhead}%, host-cost est {host_cost_pct}%, budget "
+        f"2%); {out['obs_trace_spans']} spans, "
+        f"valid={out['obs_trace_valid']}, exact={exact}"
+    )
+    return out
+
+
 def _serve_only_stage(progress):
     """Serve-only stage (`make bench-serve`, NEXUS_BENCH_SERVE=only):
     the paged-KV ledger and the row-scaling point, CPU-runnable — the
@@ -1459,6 +1686,12 @@ def _serve_only_stage(progress):
     spec_env = os.environ.get("NEXUS_BENCH_SERVE_SPEC", "1")
     if spec_env == "only":
         out.update(_serve_spec_scenarios(preset, progress, block, chunk))
+        return out
+    # NEXUS_BENCH_SERVE_OBS=only: just the round-12 observability A/B
+    # (tracing overhead budget + wave timeline) — same focused pattern
+    obs_env = os.environ.get("NEXUS_BENCH_SERVE_OBS", "1")
+    if obs_env == "only":
+        out.update(_serve_obs_scenarios(preset, progress, block, chunk))
         return out
     legs = {}
     for rows in (4, 16):
@@ -1594,6 +1827,11 @@ def _serve_only_stage(progress):
     # exactness — the tentpole's acceptance ledger
     if spec_env not in ("0", "false"):
         out.update(_serve_spec_scenarios(preset, progress, block, chunk))
+    # ---- observability A/B (round 12): tracing on/off overhead on the
+    # shared-preamble burst (<= 2% budget) + the per-wave timeline
+    # artifact — the tentpole's acceptance ledger
+    if obs_env not in ("0", "false"):
+        out.update(_serve_obs_scenarios(preset, progress, block, chunk))
     # ---- outage leg (round 7): kill-mid-decode → detector → requeue →
     # token-identical recovery, plus bounded-queue shed honesty — its
     # time-to-recover / requests-lost keys ride the per-round artifact
@@ -1660,6 +1898,28 @@ def _write_serve_artifact(sv):
             "value": round(red, 3),
             "unit": "x_vs_prefix_off",
             "vs_baseline": round(red / 2.0, 3),
+        }
+    elif "obs_tracing_overhead_pct" in sv:
+        # focused round-12 runs (NEXUS_BENCH_SERVE_OBS=only): headline
+        # the tracing overhead against its 2% budget (vs_baseline > 0
+        # == under budget, the acceptance direction). The recorded
+        # value is the DETERMINISTIC host-cost estimate (measured
+        # per-event costs x actual event counts / wall) whenever the
+        # empirical paired A/B's spread shows the box can't resolve
+        # 2% — the empirical median and its IQR ride along unredacted
+        # (obs_tracing_overhead_pct / obs_pair_spread_pct).
+        ovh = float(sv.get("obs_tracing_overhead_pct") or 0.0)
+        cost = sv.get("obs_overhead_host_cost_pct")
+        spread = float(sv.get("obs_pair_spread_pct") or 0.0)
+        if cost is not None and spread > 2.0:
+            value, unit = float(cost), "host_cost_est_pct_budget_2"
+        else:
+            value, unit = ovh, "pct_tok_s_vs_untraced_budget_2pct"
+        rec = {
+            "metric": "serve_obs_tracing_overhead_pct",
+            "value": round(value, 3),
+            "unit": unit,
+            "vs_baseline": round((2.0 - value) / 2.0, 4),
         }
     else:
         # focused runs (e.g. NEXUS_BENCH_SERVE_SPEC=only) carry no

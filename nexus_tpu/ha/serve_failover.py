@@ -46,6 +46,7 @@ detector must confirm both.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -359,6 +360,10 @@ class ServeEngineSupervisor:
             "requeued": 0,
             "fenced_alive": False,
             "generations": [],
+            # one flight-recorder dump per drained generation (the
+            # engine trips its ring on drain; nexus_tpu/obs/recorder.py)
+            # — the kill-mid-decode postmortem record
+            "flight_dumps": [],
         }
         deadline = self._clock() + float(timeout_s)
         pending_recover_t0: Optional[float] = None
@@ -456,6 +461,8 @@ class ServeEngineSupervisor:
                         entry, res
                     )
             drained = getattr(engine, "last_drain", None) or []
+            if drained:
+                self._collect_flight_dump(engine, report, attempt)
             if not drained:
                 if pending_recover_t0 is not None:
                     # the generation completed before the monitor ever
@@ -487,6 +494,30 @@ class ServeEngineSupervisor:
             attempt += 1
         report["requests_lost"] = sum(1 for r in results if r is None)
         return results, report
+
+    def _collect_flight_dump(self, engine, report: Dict[str, Any],
+                             attempt: int) -> None:
+        """Harvest the dead generation's flight-recorder dump (the
+        engine tripped its ring at the drain boundary) into the report,
+        and — when ``NEXUS_FLIGHT_DUMP_DIR`` is set — persist it as a
+        JSON postmortem artifact. Best-effort by design: a missing or
+        unwritable dump must never block recovery."""
+        dump = getattr(engine, "last_flight_dump", None)
+        if dump is None:
+            return
+        report["flight_dumps"].append(dump)
+        dump_dir = os.environ.get("NEXUS_FLIGHT_DUMP_DIR", "")
+        if not dump_dir:
+            return
+        try:
+            from nexus_tpu.obs.recorder import write_dump
+
+            write_dump(dump, os.path.join(
+                dump_dir,
+                f"flight-{self.template}-gen{attempt}.json",
+            ))
+        except Exception:  # noqa: BLE001 — telemetry must not block recovery
+            logger.debug("flight dump not persisted", exc_info=True)
 
     def _await_confirmation(self, deadline: float) -> float:
         """Probe until the detector confirms the serve lease expired (a

@@ -1,0 +1,56 @@
+"""Serve-plane observability (PR 12).
+
+Before this package the serving engine's metrics existed only as the
+aggregate dict ``ServeEngine.serve()`` assembles at return — nothing
+was observable *while* the engine ran, and a chaos postmortem had
+nothing but terminal statuses to reconstruct from. This package is the
+substrate the ROADMAP's fleet-scale and disaggregation items tune
+against (vLLM/SGLang treat per-step engine stats and per-request
+timelines the same way — PAPERS.md):
+
+  * :mod:`~nexus_tpu.obs.trace` — :class:`ServeTracer`: a span timeline
+    per request (enqueued → admitted → prefill chunks → decode-wave
+    participations → terminal) with per-span cache attribution (radix
+    hit tokens, host-tier restores, CoW copies, speculative
+    accepted/rejected tokens, lease growth). Plain dict appends on the
+    host — no JAX ops, no clock reads (the engine stamps every event
+    with its own injectable clock).
+  * :mod:`~nexus_tpu.obs.gauges` — :class:`LiveGauges` +
+    :class:`RollingPercentiles`: wave-boundary publication of queue
+    depth / running rows / free pool blocks / host-tier bytes / rolling
+    ttft & queue percentiles into the in-process telemetry registry
+    (and statsd when an address is configured — off by default).
+  * :mod:`~nexus_tpu.obs.recorder` — :class:`FlightRecorder`: a bounded
+    ring of recent wave events that dumps a JSON snapshot when a
+    sanitizer trips, a deadline/shed storm hits, or the failover path
+    drains a dead engine.
+  * :mod:`~nexus_tpu.obs.exposition` — Prometheus-text + JSON snapshot
+    renderers over the telemetry registry.
+  * :mod:`~nexus_tpu.obs.profiling` — flag-gated ``jax.profiler``
+    named-trace annotations around the engine's dispatch sites
+    (CPU-safe; ``NEXUS_OBS_JAX_TRACE=1``).
+
+Cost discipline: everything here must be cheap enough to leave on — the
+serve bench's tracing A/B budgets <= 2% tok/s overhead
+(docs/bench_serve_r12.json). Clock discipline: monotonic clocks only
+(nexuslint NX-CLOCK003 enforces it for this package); wall-clock time
+never enters a span, so timelines subtract cleanly and replay exactly
+under the injectable-clock test discipline.
+"""
+
+from nexus_tpu.obs.exposition import (  # noqa: F401
+    registry_snapshot,
+    render_prometheus,
+)
+from nexus_tpu.obs.gauges import LiveGauges, RollingPercentiles  # noqa: F401
+from nexus_tpu.obs.recorder import (  # noqa: F401
+    FlightRecorder,
+    validate_flight_dump,
+    write_dump,
+)
+from nexus_tpu.obs.trace import (  # noqa: F401
+    SPAN_FIELDS,
+    TRACE_SCHEMA_VERSION,
+    ServeTracer,
+    validate_trace,
+)

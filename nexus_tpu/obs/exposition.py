@@ -1,0 +1,86 @@
+"""Exposition: render the in-process telemetry registry for scrapers.
+
+Two formats over one consistent :meth:`StatsdClient.snapshot`:
+
+  * :func:`render_prometheus` — Prometheus text exposition (v0.0.4):
+    one ``# TYPE`` line per metric family (everything the registry
+    holds is a gauge), one sample line per (name, tags) series, with
+    DogStatsD ``key:value`` tags translated to Prometheus labels and
+    metric names sanitized to ``[a-zA-Z0-9_:]``. Serve this from any
+    HTTP handler (or dump it to a file) — no client library needed.
+  * :func:`registry_snapshot` — a JSON-safe dict of the same view, for
+    tooling that would rather not parse text.
+
+Both read a single locked copy of the registry (the concurrency
+contract tools/race_smoke_telemetry.py hammers): a render never sees a
+torn write, and emitters are never blocked longer than one dict copy.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from nexus_tpu.utils.telemetry import StatsdClient, get_client
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    """DogStatsD dotted name → Prometheus metric name."""
+    name = _NAME_SANITIZE.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _prom_labels(tags) -> str:
+    """DogStatsD ``key:value`` tag list → ``{key="value",...}`` (tags
+    without a colon become ``tag="<raw>"``)."""
+    if not tags:
+        return ""
+    parts = []
+    for t in tags:
+        k, sep, v = str(t).partition(":")
+        if not sep:
+            k, v = "tag", str(t)
+        k = _LABEL_SANITIZE.sub("_", k) or "tag"
+        v = str(v).replace("\\", r"\\").replace('"', r"\"")
+        parts.append(f'{k}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def render_prometheus(client: Optional[StatsdClient] = None) -> str:
+    """The registry as Prometheus text exposition (deterministic order:
+    families and series sorted by name/labels, so two renders of one
+    registry state are byte-identical — the format tests rely on it)."""
+    snap = (client or get_client()).snapshot()
+    series = snap["series"]
+    by_family: dict = {}
+    for (name, tags), value in series.items():
+        by_family.setdefault(_prom_name(name), []).append(
+            (_prom_labels(tags), value)
+        )
+    lines = []
+    for fam in sorted(by_family):
+        lines.append(f"# TYPE {fam} gauge")
+        for labels, value in sorted(by_family[fam]):
+            lines.append(f"{fam}{labels} {value}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def registry_snapshot(client: Optional[StatsdClient] = None) -> dict:
+    """JSON-safe snapshot of the registry: ``gauges`` (untagged
+    last-value map) plus ``series`` (one entry per (name, tags) with
+    the tags spelled out) — the machine-readable twin of
+    :func:`render_prometheus`."""
+    snap = (client or get_client()).snapshot()
+    return {
+        "gauges": {k: v for k, v in sorted(snap["gauges"].items())},
+        "series": [
+            {"name": name, "tags": list(tags), "value": value}
+            for (name, tags), value in sorted(snap["series"].items())
+        ],
+        "history_len": snap["history_len"],
+    }

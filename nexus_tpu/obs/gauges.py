@@ -1,0 +1,132 @@
+"""Wave-boundary live gauges for the serving engine.
+
+:class:`LiveGauges` publishes the engine's per-wave vitals — queue
+depth, running rows, free pool blocks, host-tier bytes, committed
+tokens, and ROLLING ttft/queue percentiles — into the in-process
+telemetry registry (and over DogStatsD when an address is configured;
+without one the client is registry-only, so statsd stays off by
+default). This replaces the end-of-run-only visibility the engine had
+before PR 12: a router or autoscaler (the fleet-scale ROADMAP item) can
+now read ``serve_ttft_p95_s`` / ``serve_queue_depth`` from the registry
+while the engine runs, and ``nexus_tpu/obs/exposition.py`` renders the
+same registry as Prometheus text.
+
+:class:`RollingPercentiles` is the bounded-window estimator behind the
+percentile gauges: a deque of the last N observations scored with the
+SHARED nearest-rank helper (utils/telemetry.py
+``percentile_nearest_rank`` — the same formula the end-of-run rollups
+use, so live and final numbers can never disagree about the estimator).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import List, Optional
+
+from nexus_tpu.utils.telemetry import (
+    METRIC_SERVE_COMMITTED,
+    METRIC_SERVE_FREE_BLOCKS,
+    METRIC_SERVE_HOST_BYTES,
+    METRIC_SERVE_QUEUE_DEPTH,
+    METRIC_SERVE_QUEUE_P50,
+    METRIC_SERVE_QUEUE_P95,
+    METRIC_SERVE_RUNNING_ROWS,
+    METRIC_SERVE_TTFT_P50,
+    METRIC_SERVE_TTFT_P95,
+    METRIC_SERVE_WAVES,
+    StatsdClient,
+    get_client,
+    percentile_nearest_rank,
+)
+
+
+class RollingPercentiles:
+    """Nearest-rank percentiles over a bounded sliding window.
+
+    O(1) add; O(w log w) score (the window is small — default 256 — and
+    scored once per wave, not per observation). An empty window scores
+    NaN, matching the end-of-run convention: a gauge is OMITTED rather
+    than published as a flattering 0.0."""
+
+    def __init__(self, window: int = 256) -> None:
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self._xs: deque = deque(maxlen=int(window))
+        self.count = 0  # total observations ever added
+
+    def add(self, x: float) -> None:
+        self._xs.append(float(x))
+        self.count += 1
+
+    def __len__(self) -> int:
+        return len(self._xs)
+
+    def percentile(self, q: float) -> float:
+        return percentile_nearest_rank(list(self._xs), q)
+
+    def percentiles(self, qs) -> List[float]:
+        """Several ranks off ONE sorted copy of the window — the
+        publish path scores p50+p95 of each window per wave, and
+        copying+sorting the window once instead of per-rank halves the
+        dominant per-publish cost at full windows. Same nearest-rank
+        estimator (NaN for every rank of an empty window)."""
+        if not self._xs:
+            return [float("nan")] * len(qs)
+        s = sorted(self._xs)
+        n = len(s)
+        return [s[min(n - 1, int(round(q * (n - 1))))] for q in qs]
+
+
+class LiveGauges:
+    """Publish one wave boundary's vitals into the telemetry registry.
+
+    The engine owns the rolling windows (fed at request completion) and
+    calls :meth:`publish` once per wave with plain ints — everything
+    here is a handful of ``gauge()`` calls (lock + dict write each).
+    ``tags`` (e.g. ``["engine:serve-0"]``) distinguish replicas sharing
+    one process registry — the fleet item's per-replica signals."""
+
+    def __init__(self, client: Optional[StatsdClient] = None,
+                 tags: Optional[List[str]] = None,
+                 ttft_window: int = 256, queue_window: int = 256) -> None:
+        self._client = client  # None → resolve the process default lazily
+        self.tags = list(tags or [])
+        self.ttft = RollingPercentiles(ttft_window)
+        self.queue_wait = RollingPercentiles(queue_window)
+        self.publishes = 0
+
+    @property
+    def client(self) -> StatsdClient:
+        if self._client is None:
+            self._client = get_client()
+        return self._client
+
+    def observe_finish(self, ttft_s: float, queue_s: float) -> None:
+        """Feed one SERVED request's observations into the rolling
+        windows (the engine calls this where it appends to its
+        end-of-run populations, so the two views see identical data)."""
+        self.ttft.add(ttft_s)
+        self.queue_wait.add(queue_s)
+
+    def publish(self, queue_depth: int, running_rows: int,
+                free_pool_blocks: int, host_cache_bytes: int,
+                committed_tokens: int, waves: int) -> None:
+        c = self.client
+        tags = self.tags or None
+        c.gauge(METRIC_SERVE_QUEUE_DEPTH, queue_depth, tags=tags)
+        c.gauge(METRIC_SERVE_RUNNING_ROWS, running_rows, tags=tags)
+        c.gauge(METRIC_SERVE_FREE_BLOCKS, free_pool_blocks, tags=tags)
+        c.gauge(METRIC_SERVE_HOST_BYTES, host_cache_bytes, tags=tags)
+        c.gauge(METRIC_SERVE_COMMITTED, committed_tokens, tags=tags)
+        c.gauge(METRIC_SERVE_WAVES, waves, tags=tags)
+        for (name50, name95), win in (
+            ((METRIC_SERVE_TTFT_P50, METRIC_SERVE_TTFT_P95), self.ttft),
+            ((METRIC_SERVE_QUEUE_P50, METRIC_SERVE_QUEUE_P95),
+             self.queue_wait),
+        ):
+            p50, p95 = win.percentiles((0.50, 0.95))
+            for name, v in ((name50, p50), (name95, p95)):
+                if not math.isnan(v):  # empty window: omit, never 0.0
+                    c.gauge(name, round(v, 6), tags=tags)
+        self.publishes += 1

@@ -1,0 +1,54 @@
+"""Flag-gated ``jax.profiler`` named-trace annotations.
+
+With ``NEXUS_OBS_JAX_TRACE=1`` the serving engine wraps its dispatch
+sites (decode chunk, insert wave, restore upload) in
+``jax.profiler.TraceAnnotation`` scopes, so a profiler capture
+(train/trainer.py:270's ``start_trace`` window, or ``jax.profiler``
+driven externally) shows named serve phases instead of anonymous XLA
+launches — and ``tools/trace_summary.py`` rolls them up by name.
+
+CPU-safe: ``TraceAnnotation`` is a no-op-ish host-side scope on every
+backend. Still flag-gated OFF by default because the hot loop enters
+the scope once per dispatch and the engine's overhead budget
+(docs/bench_serve_r12.json) is measured with the default
+configuration; the flag is read ONCE at import (the sanitizers'
+pattern — flipping it mid-process is not a supported path).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("NEXUS_OBS_JAX_TRACE", "").strip().lower() in (
+        "1", "true", "yes", "on",
+    )
+
+
+TRACE_ANNOTATIONS_ENABLED = _env_enabled()
+
+
+class _NullAnnotation:
+    """Shared no-op context (the disabled path's entire cost: one
+    attribute load + two trivial calls per dispatch)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullAnnotation()
+
+
+def dispatch_annotation(name: str):
+    """A context manager naming the enclosed dispatch in profiler
+    traces — the shared null scope unless ``NEXUS_OBS_JAX_TRACE`` was
+    set at import."""
+    if not TRACE_ANNOTATIONS_ENABLED:
+        return _NULL
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,158 @@
+"""Flight recorder: a bounded ring of recent engine wave events.
+
+The serving engine appends one compact event per wave boundary (plus
+admission/shed/deadline/drain events as they happen) into a fixed-size
+ring. In steady state that's all it is — O(1) appends into a deque,
+nothing retained beyond ``capacity`` events. When something goes wrong
+the ring becomes the postmortem: :meth:`FlightRecorder.trip` freezes a
+JSON-safe snapshot of the recent past, stamped with the trip reason.
+
+The engine trips it on three conditions (ISSUE 12 tentpole):
+
+  * a runtime SANITIZER fires mid-serve (scratch-tail / radix-tree
+    audit) — the dump shows the waves leading up to the invariant
+    break, which the raising AssertionError alone cannot;
+  * a deadline/shed STORM — one wave boundary terminating >=
+    ``storm_threshold`` requests means the engine is in overload or
+    clock trouble, exactly when end-of-run metrics are least useful;
+  * an engine DRAIN (cancellation / confirmed death) — the failover
+    supervisor (ha/serve_failover.py) collects the dump into its
+    report, so a kill-mid-decode chaos postmortem shows precisely what
+    the engine was doing when it died, request by request.
+
+Like the tracer, the recorder never reads a clock of its own — the
+engine stamps every event with its injectable clock (monotonic-only,
+enforced by nexuslint NX-CLOCK003 for this package).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import deque
+from typing import Any, List, Optional
+
+FLIGHT_SCHEMA_VERSION = 1
+
+#: event kinds the engine records (the dump validator accepts exactly
+#: these; every event additionally carries ``seq`` and ``t``)
+FLIGHT_EVENT_KINDS = (
+    "run_start",      # serve() entered its wave loop
+    "wave",           # one decode-chunk boundary (the per-wave gauges)
+    "admission",      # an admission wave placed >= 1 request
+    "shed",           # a queued request shed (depth / delay bound)
+    "deadline",       # a request terminated deadline_exceeded
+    "drain_request",  # one request drained off a dying engine
+    "run_end",        # serve() returned normally
+)
+
+
+class FlightRecorder:
+    """Bounded ring of wave events + trip-to-snapshot.
+
+    ``record`` is the hot-path append; ``trip`` freezes the ring into a
+    dump dict (also kept in ``self.dumps`` / ``self.last_dump`` so the
+    failover supervisor can collect it after the engine thread exits).
+    One recorder may serve an engine across multiple serve() runs — the
+    ring just keeps rolling; ``seq`` is monotonic over the recorder's
+    lifetime so dumps from successive trips order globally. ``dumps``
+    is itself a bounded ring (``max_dumps``, newest kept): a long-lived
+    engine under sustained overload trips once per serve() run, and
+    telemetry must never grow RSS."""
+
+    def __init__(self, capacity: int = 512, max_dumps: int = 16) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if max_dumps < 1:
+            raise ValueError(f"max_dumps must be >= 1, got {max_dumps}")
+        self.capacity = int(capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._seq = 0
+        self.dumps: deque = deque(maxlen=int(max_dumps))
+        self.last_dump: Optional[dict] = None
+
+    def record(self, kind: str, t: float, **fields: Any) -> None:
+        """Append one event (``t``: seconds since the run's t0, stamped
+        by the engine's injectable clock)."""
+        ev = {"seq": self._seq, "t": round(float(t), 6), "kind": kind}
+        ev.update(fields)
+        self._seq += 1
+        self._ring.append(ev)
+
+    @property
+    def events_recorded(self) -> int:
+        """Total events ever recorded (>= len(ring) once it wraps)."""
+        return self._seq
+
+    def tail(self, n: int = 16) -> List[dict]:
+        """The most recent ``n`` events (oldest first)."""
+        if n <= 0:
+            return []
+        return list(self._ring)[-n:]
+
+    def trip(self, reason: str, t: float,
+             detail: Optional[dict] = None) -> dict:
+        """Freeze the ring → dump dict (also appended to ``dumps``)."""
+        dump = {
+            "schema_version": FLIGHT_SCHEMA_VERSION,
+            "reason": reason,
+            "tripped_t": round(float(t), 6),
+            "detail": dict(detail or {}),
+            "events": list(self._ring),
+        }
+        self.dumps.append(dump)
+        self.last_dump = dump
+        return dump
+
+
+def write_dump(dump: dict, path: str) -> str:
+    """Persist a trip dump as JSON (postmortem artifact). Creates parent
+    directories; returns ``path``."""
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(dump, f, indent=1, sort_keys=False)
+        f.write("\n")
+    return path
+
+
+def validate_flight_dump(dump: dict) -> List[str]:
+    """Schema check of a trip dump → problem list (empty = valid):
+    version, reason present, events are known kinds with monotonic
+    ``seq`` and numeric ``t``. ``make obs-smoke`` and the chaos tests
+    gate on this."""
+    problems: List[str] = []
+    if dump.get("schema_version") != FLIGHT_SCHEMA_VERSION:
+        problems.append(
+            f"schema_version {dump.get('schema_version')!r} != "
+            f"{FLIGHT_SCHEMA_VERSION}"
+        )
+    if not dump.get("reason"):
+        problems.append("missing trip reason")
+    events = dump.get("events")
+    if not isinstance(events, list):
+        problems.append("events is not a list")
+        return problems
+    last_seq = -1
+    for ev in events:
+        kind = ev.get("kind")
+        if kind not in FLIGHT_EVENT_KINDS:
+            problems.append(f"event seq={ev.get('seq')}: unknown kind "
+                            f"{kind!r}")
+        seq = ev.get("seq")
+        if not isinstance(seq, int) or seq <= last_seq:
+            problems.append(
+                f"event seq {seq!r} not strictly increasing after "
+                f"{last_seq}"
+            )
+        else:
+            last_seq = seq
+        if not isinstance(ev.get("t"), (int, float)):
+            problems.append(f"event seq={seq}: t is not a number")
+    return problems
+
+
+# typing helper for engine call sites that accept "a recorder or the
+# explicit off switch" (flight_recorder=False disables the default)
+RecorderLike = Optional[Any]

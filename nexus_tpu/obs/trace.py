@@ -1,0 +1,182 @@
+"""Per-request span timelines for the serving engine.
+
+One :class:`ServeTracer` records one serve run: a list of SPANS per
+request, appended by the engine at the host-side points where it
+already touches per-row state (admission wave, chunk-boundary commit
+loop, release). A span is a plain dict — ``kind`` first, then the
+fields :data:`SPAN_FIELDS` fixes for that kind, in that order — so the
+dump's field names and ordering are a stable schema golden-file tests
+can pin (tests/golden/serve_trace_schema.json) and downstream tooling
+(tools/trace_summary.py) can rely on.
+
+Design constraints, in order:
+
+  1. **Cheap.** Recording is a method call + one dict literal per
+     event; no JAX ops, no device fetches, no string formatting. The
+     engine guards every call site with ``if tracer is not None`` so
+     the untraced path pays a single predictable branch.
+  2. **No clocks.** The tracer NEVER reads time — the engine stamps
+     every event with ``t`` (seconds since the run's ``t0``, from its
+     own injectable clock), so traced timelines replay exactly under
+     the fake-clock test discipline and the nexuslint monotonic-only
+     rule for this package is trivially satisfied.
+  3. **Attributable.** Admission spans carry the cache economics of
+     the decision (radix-matched tokens, shared/restored block counts,
+     CoW), decode spans carry speculation accept/reject counts, and
+     lease growth is its own span kind — the per-request
+     restore-vs-recompute attribution the disaggregation ROADMAP item
+     needs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+TRACE_SCHEMA_VERSION = 1
+
+#: Span kinds and their REQUIRED fields, in emission order. ``kind`` is
+#: always the first key of a span dict; the listed fields follow in
+#: this exact order (insertion-ordered dicts make that observable).
+#: This table IS the trace schema — the golden-file test and
+#: :func:`validate_trace` both read it, and tools/trace_summary.py
+#: renders from it.
+SPAN_FIELDS: Dict[str, tuple] = {
+    # request entered the engine's wait queue (serve() start)
+    "enqueued": ("t", "prompt_tokens", "max_new_tokens"),
+    # request won a decode row; cache attribution of the admission
+    "admitted": (
+        "t", "row", "queue_s", "prompt_tokens", "budget",
+        "matched_tokens", "shared_blocks", "restored_blocks",
+        "cow_copy", "reserved_blocks",
+    ),
+    # one dispatch's worth of chunked-prefill progress for the row
+    "prefill_chunk": ("t", "row", "wave", "from_pos", "to_pos"),
+    # the row's first committed token (the ttft observation)
+    "first_token": ("t", "row", "wave", "ttft_s"),
+    # one dispatch's worth of committed decode tokens for the row;
+    # accepted/rejected attribute the speculative tiers (0/0 for plain
+    # decode — every committed token was one scheduled forward slot)
+    "decode_wave": ("t", "row", "wave", "tokens", "accepted", "rejected"),
+    # the row's lease mapped additional pool blocks this wave
+    "lease_grow": ("t", "row", "wave", "blocks_mapped"),
+    # terminal disposition (ok / deadline_exceeded / shed / drained)
+    "terminal": ("t", "status", "new_tokens", "latency_s",
+                 "finished_by_stop"),
+    # engine death: the request was drained with its committed tokens
+    # preserved for the failover requeue (not a terminal status — the
+    # request lives on, on a replacement engine)
+    "drained": ("t", "committed_tokens", "admitted"),
+}
+
+
+class ServeTracer:
+    """Span timeline of one serve run, keyed by request index.
+
+    The engine drives it::
+
+        tracer = ServeTracer()
+        engine.serve(requests, ...)   # engine constructed with tracer=
+        dump = tracer.to_dict()       # JSON-safe, schema-stable
+
+    ``to_dict()`` output::
+
+        {"schema_version": 1,
+         "requests": N,
+         "spans": [{"request": i, "timeline": [span, ...]}, ...]}
+
+    Timelines are in emission order, which is time order per request
+    (the engine appends at wave boundaries). A tracer may be reused
+    across serve() calls; ``begin()`` resets it."""
+
+    def __init__(self) -> None:
+        self._timelines: List[List[dict]] = []
+        self.runs = 0
+
+    def begin(self, n_requests: int) -> None:
+        """Reset for a run of ``n_requests`` (the engine calls this
+        right after its warm-up, before enqueuing spans)."""
+        self._timelines = [[] for _ in range(int(n_requests))]
+        self.runs += 1
+
+    def event(self, request_idx: int, kind: str, **fields: Any) -> None:
+        """Append one span. ``fields`` must be exactly
+        ``SPAN_FIELDS[kind]`` — enforced cheaply by construction order
+        here (the dict literal walks the schema), loudly by
+        :func:`validate_trace` in tests and the obs smoke."""
+        span = {"kind": kind}
+        for f in SPAN_FIELDS[kind]:
+            span[f] = fields[f]
+        self._timelines[request_idx].append(span)
+
+    def timeline(self, request_idx: int) -> List[dict]:
+        return self._timelines[request_idx]
+
+    def to_dict(self) -> dict:
+        return {
+            "schema_version": TRACE_SCHEMA_VERSION,
+            "requests": len(self._timelines),
+            "spans": [
+                {"request": i, "timeline": list(tl)}
+                for i, tl in enumerate(self._timelines)
+            ],
+        }
+
+
+def validate_trace(dump: dict) -> List[str]:
+    """Schema check of a :meth:`ServeTracer.to_dict` dump → problem
+    list (empty = valid). Checks: version, top-level shape, every span's
+    kind is known, every span's keys are exactly ``("kind",) +
+    SPAN_FIELDS[kind]`` IN ORDER, per-request ``t`` never decreases,
+    and every non-empty timeline starts ``enqueued`` and ends
+    ``terminal`` or ``drained``. The obs smoke (``make obs-smoke``) and
+    the golden-file test both gate on this."""
+    problems: List[str] = []
+    if dump.get("schema_version") != TRACE_SCHEMA_VERSION:
+        problems.append(
+            f"schema_version {dump.get('schema_version')!r} != "
+            f"{TRACE_SCHEMA_VERSION}"
+        )
+    spans = dump.get("spans")
+    if not isinstance(spans, list):
+        problems.append("spans is not a list")
+        return problems
+    for entry in spans:
+        rid = entry.get("request")
+        tl = entry.get("timeline", [])
+        last_t: Optional[float] = None
+        for j, span in enumerate(tl):
+            kind = span.get("kind")
+            if kind not in SPAN_FIELDS:
+                problems.append(f"request {rid} span {j}: unknown kind "
+                                f"{kind!r}")
+                continue
+            expect = ("kind",) + SPAN_FIELDS[kind]
+            got = tuple(span.keys())
+            if got != expect:
+                problems.append(
+                    f"request {rid} span {j} ({kind}): fields {got} != "
+                    f"schema {expect}"
+                )
+            t = span.get("t")
+            if not isinstance(t, (int, float)):
+                problems.append(
+                    f"request {rid} span {j} ({kind}): t is not a number"
+                )
+            elif last_t is not None and t < last_t:
+                problems.append(
+                    f"request {rid} span {j} ({kind}): t went backwards "
+                    f"({last_t} -> {t})"
+                )
+            else:
+                last_t = t
+        if tl:
+            if tl[0].get("kind") != "enqueued":
+                problems.append(
+                    f"request {rid}: timeline does not start 'enqueued'"
+                )
+            if tl[-1].get("kind") not in ("terminal", "drained"):
+                problems.append(
+                    f"request {rid}: timeline ends "
+                    f"{tl[-1].get('kind')!r}, not terminal/drained"
+                )
+    return problems

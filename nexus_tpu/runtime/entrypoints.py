@@ -831,8 +831,8 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None):
         STATUS_OK,
         ServeRequest,
         ServingEngine,
-        percentile_nearest_rank,
     )
+    from nexus_tpu.utils.telemetry import percentile_nearest_rank
 
     sv = runtime.serve
     tr = runtime.train
@@ -980,8 +980,20 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None):
                     mesh, P(None, batch_axes, None, d_kv_axis, None)
                 ),
             )
+        # NEXUS_SERVE_TRACE=<path>: attach a span tracer to this run
+        # and persist the timeline dump as JSON — the entrypoint-level
+        # hook for `tools/trace_summary.py <path>` without code changes
+        # (nexus_tpu/obs/; flight recorder and live gauges ride the
+        # engine defaults)
+        trace_path = os.environ.get("NEXUS_SERVE_TRACE", "").strip()
+        tracer = None
+        if trace_path:
+            from nexus_tpu.obs import ServeTracer
+
+            tracer = ServeTracer()
         engine = ServingEngine(
             family.forward_decode, params, cfg,
+            tracer=tracer,
             batch_size=tr.batch_size,
             max_len=cfg.max_seq_len,
             stop_token_id=sv.stop_token_id,
@@ -1012,6 +1024,15 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None):
         results, metrics = engine.serve(
             requests, cancel=cancel, heartbeat=heartbeat,
         )
+        if tracer is not None:
+            import json as _json
+
+            try:
+                with open(trace_path, "w") as f:
+                    _json.dump(tracer.to_dict(), f, indent=1)
+                    f.write("\n")
+            except OSError:  # telemetry is best-effort
+                pass
     finished = sum(1 for r in results if r is not None)
     # the latency rollups describe SERVED requests only — shed and
     # deadline-missed terminals would flatter the p50 with their

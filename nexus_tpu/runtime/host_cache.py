@@ -168,6 +168,23 @@ class HostBlockStore:
         self._demoted.discard(key)
         self.drops += 1
 
+    def stats(self) -> Dict[str, int]:
+        """One JSON-safe snapshot of the store's vitals (round 12):
+        the engine's end-of-run host-cache ledger reads this, and it is
+        the documented read surface for external tooling
+        (docs/observability.md) — the per-wave hot path still reads
+        ``.bytes`` directly, one attribute being cheaper than a dict
+        per wave."""
+        return {
+            "entries": len(self._entries),
+            "bytes": self._bytes,
+            "bytes_peak": self.bytes_peak,
+            "budget_bytes": self.budget_bytes,
+            "puts": self.puts,
+            "takes": self.takes,
+            "drops": self.drops,
+        }
+
     def audit(self) -> None:
         """Byte-accounting coherence: the running total equals the sum
         over live entries, and demotion markers track live keys only —

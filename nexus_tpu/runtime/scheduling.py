@@ -68,6 +68,19 @@ class AdmissionPolicy:
 
     name = "custom"  # subclasses name themselves for the metrics ledger
 
+    def __init__(self) -> None:
+        # observability surface (round 12): a policy MAY refresh this
+        # dict inside ``order`` with cheap facts about the wave it just
+        # ranked (``pending`` size, how many aged requests jumped the
+        # queue, ...); the engine copies it into the flight recorder's
+        # admission event, so chaos postmortems show WHY the queue was
+        # ordered the way it was. Never read by scheduling logic —
+        # purely a telemetry export. INSTANCE-owned (assigned here, not
+        # a class default): two engines' policies in one process must
+        # never report each other's wave meta, even if a subclass
+        # mutates the dict in place.
+        self.last_wave_meta: Dict[str, int] = {}
+
     def order(
         self,
         pending: Sequence[int],
@@ -83,6 +96,7 @@ class FifoAdmission(AdmissionPolicy):
     name = "fifo"
 
     def order(self, pending, passed_over, resident_match):
+        self.last_wave_meta = {"pending": len(pending), "aged": 0}
         return list(pending)
 
 
@@ -101,6 +115,7 @@ class CacheAwareAdmission(AdmissionPolicy):
     name = "cache-aware"
 
     def __init__(self, aging_waves: int = 8) -> None:
+        super().__init__()
         if aging_waves < 1:
             raise ValueError(
                 f"aging_waves must be >= 1, got {aging_waves}"
@@ -133,6 +148,7 @@ class CacheAwareAdmission(AdmissionPolicy):
             return (-resident, -spilled, pos[i])
 
         fresh.sort(key=key)
+        self.last_wave_meta = {"pending": len(pending), "aged": len(aged)}
         return aged + fresh
 
 

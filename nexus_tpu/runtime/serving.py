@@ -96,6 +96,9 @@ from nexus_tpu.models.decoding import (
     init_paged_kv_cache,
     write_kv_blocks,
 )
+from nexus_tpu.obs.gauges import LiveGauges
+from nexus_tpu.obs.profiling import dispatch_annotation
+from nexus_tpu.obs.recorder import FlightRecorder
 from nexus_tpu.runtime.host_cache import (
     HOST_CACHE_DTYPES,
     HostBlockStore,
@@ -103,6 +106,10 @@ from nexus_tpu.runtime.host_cache import (
 )
 from nexus_tpu.runtime.prefix_cache import PrefixCacheIndex, chain_keys
 from nexus_tpu.runtime.scheduling import make_admission_policy
+from nexus_tpu.utils.telemetry import percentile_nearest_rank  # noqa: F401
+# ^ re-exported: the nearest-rank helper moved to utils/telemetry.py in
+# PR 12 (one shared estimator for the engine, the bench harness, and
+# the obs layer's rolling gauges); existing importers keep working.
 
 #: serve-level KV pool dtypes (ServeSpec.kvPoolDtype): "native" stores
 #: K/V at the model dtype, "int8" runs the quantized block pool (the
@@ -479,21 +486,6 @@ class _BlockLease:
         self.shared, self._private = [], []
 
 
-def percentile_nearest_rank(xs: Sequence[float], q: float) -> float:
-    """Nearest-rank percentile of a sequence — serve latency/ttft/queue
-    populations are a handful of values per run, so the simple estimator
-    is the honest one. Shared by the engine's metrics and the
-    entrypoint's request-latency rollups so the rank formula can't
-    diverge between them.
-
-    An EMPTY population returns NaN, never 0.0: an all-shed round must
-    not report a perfect p95 (callers omit the metric instead)."""
-    if not xs:
-        return float("nan")
-    s = sorted(xs)
-    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
-
-
 # ---- terminal request statuses (ServeResult.status) ----
 STATUS_OK = "ok"
 STATUS_DEADLINE_EXCEEDED = "deadline_exceeded"
@@ -626,6 +618,11 @@ class ServingEngine:
         draft_params: Any = None,
         draft_cfg: Any = None,
         draft_cache_sharding: Optional[Any] = None,
+        tracer: Any = None,
+        flight_recorder: Any = None,
+        live_gauges: bool = True,
+        gauge_tags: Optional[Sequence[str]] = None,
+        storm_threshold: int = 8,
     ):
         """``prefill_chunk`` (T): prompt tokens an admitting row consumes
         per decode step. A T-slot feed costs every row T slots of matmul
@@ -785,7 +782,27 @@ class ServingEngine:
         Mutually exclusive with ``lookup_ngram``; greedy-exact only;
         the draft must share the target's vocabulary.
         ``draft_cache_sharding`` pins the draft cache's layout
-        (dense (L, B, S, Hkv, D)) on a sharded mesh."""
+        (dense (L, B, S, Hkv, D)) on a sharded mesh.
+
+        Observability (round 12, nexus_tpu/obs/): ``tracer`` — an
+        optional :class:`~nexus_tpu.obs.trace.ServeTracer` recording a
+        span timeline per request (enqueued → admitted → prefill
+        chunks → decode waves → terminal, with per-span cache
+        attribution); None (default) records nothing and costs one
+        branch per site. ``flight_recorder`` — a
+        :class:`~nexus_tpu.obs.recorder.FlightRecorder` ring of recent
+        wave events, dumped when a sanitizer trips, a deadline/shed
+        storm terminates >= ``storm_threshold`` requests at one wave
+        boundary, or a cancellation drains the engine (the failover
+        postmortem); None (default) creates a private recorder, False
+        disables. ``live_gauges`` (default on) publishes queue depth /
+        running rows / free pool blocks / host-tier bytes / rolling
+        ttft & queue percentiles into the in-process telemetry
+        registry at every wave boundary (statsd rides along only when
+        an address is configured — off by default), tagged with
+        ``gauge_tags``. All of it is host-side dataclass/dict
+        bookkeeping — no JAX ops; the serve bench budgets the whole
+        layer at <= 2% tok/s (docs/bench_serve_r12.json)."""
         self._fwd = forward_decode
         self._params = params
         self._cfg = cfg
@@ -898,6 +915,22 @@ class ServingEngine:
         from nexus_tpu.testing.sanitizers import sanitizers_enabled
 
         self._sanitize = sanitizers_enabled()
+        # ---- observability (round 12, nexus_tpu/obs/) ----
+        self._tracer = tracer
+        if flight_recorder is False:
+            self.flight_recorder = None
+        else:
+            self.flight_recorder = flight_recorder or FlightRecorder()
+        self._live_gauges = bool(live_gauges)
+        self._gauge_tags = list(gauge_tags or [])
+        self._storm_threshold = int(storm_threshold)
+        if self._storm_threshold < 1:
+            raise ValueError(
+                f"storm_threshold must be >= 1, got {storm_threshold}"
+            )
+        # the last drain/sanitizer/storm trip's snapshot (the failover
+        # supervisor collects it into its report after engine death)
+        self.last_flight_dump: Optional[dict] = None
         # drain snapshot of the last cancelled serve() run (engine death):
         # the ServeFailoverPlanner's input
         self.last_drain: Optional[List[DrainedRequest]] = None
@@ -1443,6 +1476,27 @@ class ServingEngine:
                 donate_argnums=(1, 5) if donate else (),
             )
 
+    def set_observability(self, tracer: Any = None,
+                          flight_recorder: Any = False,
+                          live_gauges: bool = False,
+                          gauge_tags: Optional[Sequence[str]] = None):
+        """Swap the obs attachments between serve() runs.
+
+        The supported same-engine toggle: the bench's tracing-overhead
+        A/B serves one engine alternately with the obs surface on and
+        off, so the measurement compares identical compiled programs,
+        pool state, and prefix-tree warmth — engine-identity noise (two
+        separately-built engines measurably differ on the CPU lane even
+        when configured identically) never enters the ratio. Takes
+        effect at the next serve() call; never call it mid-serve."""
+        self._tracer = tracer
+        if flight_recorder is False:
+            self.flight_recorder = None
+        else:
+            self.flight_recorder = flight_recorder or FlightRecorder()
+        self._live_gauges = bool(live_gauges)
+        self._gauge_tags = list(gauge_tags or [])
+
     def _mint(self, x, dtype=None):
         """Host value → device array with a dispatch-stable commitment
         (replicated on the cache mesh when one is set — see __init__)."""
@@ -1540,11 +1594,12 @@ class ServingEngine:
             # step-slots the matched prefix did NOT consume — the
             # direct compute saving of the prefix cache
             self._prefill_steps_saved += -(-p // width) - steps
-        cache, buf, ptr, plen, temp_vec, seed_vec = self._insert_fn(
-            cache, buf, ptr, plen, temp_vec, seed_vec,
-            self._mint(rows), self._mint(prompts), self._mint(ps),
-            self._mint(starts), self._mint(temps), self._mint(seeds),
-        )
+        with dispatch_annotation("nexus.serve.insert_wave"):
+            cache, buf, ptr, plen, temp_vec, seed_vec = self._insert_fn(
+                cache, buf, ptr, plen, temp_vec, seed_vec,
+                self._mint(rows), self._mint(prompts), self._mint(ps),
+                self._mint(starts), self._mint(temps), self._mint(seeds),
+            )
         self._insert_dispatches += 1
         return cache, buf, ptr, plen, temp_vec, seed_vec, out
 
@@ -1774,6 +1829,41 @@ class ServingEngine:
 
         t0 = self._clock()
         self.last_drain = None
+        self.last_flight_dump = None
+        # ---- observability hookup (round 12, nexus_tpu/obs/) ----
+        # all three are pure host-side bookkeeping: the tracer and the
+        # flight recorder are dict appends, the gauges a handful of
+        # registry writes per wave — each site guards on None so the
+        # disabled path costs one branch
+        tracer = self._tracer
+        flight = self.flight_recorder
+        gauges = (
+            LiveGauges(tags=self._gauge_tags) if self._live_gauges
+            else None
+        )
+        tripped: set = set()
+
+        def trip_flight(reason: str, detail: Optional[dict] = None):
+            """Freeze the flight ring — once per reason per run, so a
+            storm that persists across waves yields one dump of its
+            onset instead of a dump per wave."""
+            if flight is None or reason in tripped:
+                return
+            tripped.add(reason)
+            self.last_flight_dump = flight.trip(
+                reason, t=self._clock() - t0, detail=detail,
+            )
+
+        if tracer is not None:
+            tracer.begin(len(requests))
+            for i, req_ in enumerate(requests):
+                tracer.event(
+                    i, "enqueued", t=0.0,
+                    prompt_tokens=len(req_.prompt),
+                    max_new_tokens=int(req_.max_new_tokens),
+                )
+        if flight is not None:
+            flight.record("run_start", t=0.0, requests=len(requests))
         interrupted = False
         cache = fresh_cache()  # vector length from step 0
         d_cache = fresh_draft_cache() if self._draft else None
@@ -1907,6 +1997,7 @@ class ServingEngine:
             chunk program passes the table through its returned cache,
             so the device copy stays valid until the host changes it."""
             nonlocal cache
+            grow_t = None  # one clock read per wave with growth, lazily
             for r in range(b):
                 state = rows[r]
                 if state is None or leases[r] is None:
@@ -1920,13 +2011,28 @@ class ServingEngine:
                 if len(blks) != before:
                     table_np[r, : len(blks)] = blks
                     table_dirty[0] = True
+                    if tracer is not None:
+                        if grow_t is None:
+                            grow_t = round(self._clock() - t0, 6)
+                        tracer.event(
+                            state.request_idx, "lease_grow", t=grow_t,
+                            row=r, wave=chunks + 1,
+                            blocks_mapped=len(blks),
+                        )
             if self._sanitize:
                 # the unmapped-tail contract: everything past a row's
-                # mapped blocks points at the scratch block, always
-                alloc.audit_scratch_tails(table_np, [
-                    len(leases[r].blocks) if leases[r] is not None else 0
-                    for r in range(b)
-                ])
+                # mapped blocks points at the scratch block, always —
+                # a violation trips the flight recorder on its way out,
+                # so the postmortem shows the waves that led up to it
+                try:
+                    alloc.audit_scratch_tails(table_np, [
+                        len(leases[r].blocks) if leases[r] is not None
+                        else 0
+                        for r in range(b)
+                    ])
+                except AssertionError as e:
+                    trip_flight("sanitizer", {"error": str(e)})
+                    raise
             if table_dirty[0]:
                 cache = dict(cache)
                 cache["block_table"] = self._mint(table_np)
@@ -1973,13 +2079,18 @@ class ServingEngine:
                 # p95 of the work that actually completed
                 ttfts.append(ttft)
                 queues.append(queue_s)
+                if gauges is not None:
+                    # same population as the end-of-run rollup, so the
+                    # rolling p95 and the final p95 agree on the data
+                    gauges.observe_finish(ttft, queue_s)
+            done_t = self._clock() - t0
             results[state.request_idx] = ServeResult(
                 tokens=list(np.asarray(
                     requests[state.request_idx].prompt, dtype=np.int32
                 )) + state.emitted,
                 new_tokens=len(state.emitted),
                 finished_by_stop=state.stopped,
-                latency_s=self._clock() - t0,
+                latency_s=done_t,
                 ttft_s=round(ttft, 6),
                 queue_s=round(queue_s, 6),
                 status=status,
@@ -1987,21 +2098,46 @@ class ServingEngine:
                     requests[state.request_idx], "retries", 0
                 )),
             )
+            if tracer is not None:
+                tracer.event(
+                    state.request_idx, "terminal",
+                    t=round(done_t, 6), status=status,
+                    new_tokens=len(state.emitted),
+                    latency_s=round(done_t, 6),
+                    finished_by_stop=state.stopped,
+                )
+            if flight is not None and status == STATUS_DEADLINE_EXCEEDED:
+                flight.record(
+                    "deadline", t=done_t, request=state.request_idx,
+                    queued=False,
+                )
 
         def finish_queued(req_idx: int, status: str) -> None:
             """Terminal result for a request REFUSED before admission
             (shed / queued-deadline-miss): prompt only, zero compute."""
             req = requests[req_idx]
+            done_t = self._clock() - t0
             results[req_idx] = ServeResult(
                 tokens=[int(t) for t in np.asarray(
                     req.prompt, dtype=np.int32
                 )],
                 new_tokens=0,
                 finished_by_stop=False,
-                latency_s=self._clock() - t0,
+                latency_s=done_t,
                 status=status,
                 retries=int(getattr(req, "retries", 0)),
             )
+            if tracer is not None:
+                tracer.event(
+                    req_idx, "terminal", t=round(done_t, 6),
+                    status=status, new_tokens=0,
+                    latency_s=round(done_t, 6), finished_by_stop=False,
+                )
+            if flight is not None:
+                flight.record(
+                    "shed" if status == STATUS_SHED else "deadline",
+                    t=done_t, request=req_idx, queued=True,
+                )
 
         def police_deadlines() -> None:
             """Pre-admission policing: queued requests past their
@@ -2310,8 +2446,13 @@ class ServingEngine:
             if (self._sanitize and alloc is not None
                     and alloc.index is not None):
                 # the radix-tree invariant, asserted next to the
-                # pool-partition audit (NEXUS_SANITIZE)
-                alloc.index.audit()
+                # pool-partition audit (NEXUS_SANITIZE); a violation
+                # trips the flight recorder for the postmortem
+                try:
+                    alloc.index.audit()
+                except AssertionError as e:
+                    trip_flight("sanitizer", {"error": str(e)})
+                    raise
             if not wave:
                 return
             (cache, buf, ptr_vec, plen_vec, temp_vec, seed_vec,
@@ -2336,6 +2477,29 @@ class ServingEngine:
             ):
                 rows[row] = state
                 prefill_left[row] = steps
+                if tracer is not None:
+                    # cache attribution of the admission decision: how
+                    # much of the prompt the radix tree served, split
+                    # resident vs host-tier-restored, plus the CoW and
+                    # the private reservation the pool promised
+                    restored_n = (
+                        len(lease.restored_payloads) if lease else 0
+                    )
+                    adm_t = round(max(0.0, state.admitted_t - t0), 6)
+                    tracer.event(
+                        state.request_idx, "admitted", t=adm_t, row=row,
+                        queue_s=adm_t, prompt_tokens=p, budget=budget,
+                        matched_tokens=matched,
+                        shared_blocks=(
+                            len(lease.shared) - restored_n if lease
+                            else 0
+                        ),
+                        restored_blocks=restored_n,
+                        cow_copy=cow_src is not None,
+                        reserved_blocks=(
+                            lease.reservation if lease else 0
+                        ),
+                    )
                 if self._paged:
                     leases[row] = lease
                     caps[row] = self._row_cap(p, budget)
@@ -2388,15 +2552,35 @@ class ServingEngine:
                             planes[k_][:, i] = np.asarray(
                                 payload[k_]
                             ).astype(planes[k_].dtype, copy=False)
-                    cache = self._restore_write_fn(
-                        cache, self._mint(ids),
-                        {k_: self._mint(v_)
-                         for k_, v_ in planes.items()},
-                    )
+                    with dispatch_annotation("nexus.serve.restore_upload"):
+                        cache = self._restore_write_fn(
+                            cache, self._mint(ids),
+                            {k_: self._mint(v_)
+                             for k_, v_ in planes.items()},
+                        )
+            if flight is not None:
+                flight.record(
+                    "admission", t=self._clock() - t0,
+                    n=len(admitted), queue_depth=len(pending),
+                    policy=self._policy.name,
+                    aged=int(getattr(
+                        self._policy, "last_wave_meta", {}
+                    ).get("aged", 0)),
+                    restores=len(restore_jobs), cow=len(cow_pairs),
+                )
 
         police_deadlines()
         admit_into([r for r in range(b) if rows[r] is None])
         police_depth()
+        if shed_count + deadline_miss_count >= self._storm_threshold:
+            # the arrival burst itself overflowed the bounded queue —
+            # the t0 flavor of a shed storm
+            trip_flight(
+                "shed_storm" if shed_count >= deadline_miss_count
+                else "deadline_storm",
+                {"wave": 0, "shed": shed_count,
+                 "deadline": deadline_miss_count},
+            )
 
         while any(r is not None for r in rows):
             if cancel is not None and cancel.cancelled():
@@ -2418,13 +2602,42 @@ class ServingEngine:
                         admitted=True,
                         elapsed_s=elapsed,
                     ))
+                    if tracer is not None:
+                        tracer.event(
+                            state.request_idx, "drained", t=elapsed,
+                            committed_tokens=len(state.emitted),
+                            admitted=True,
+                        )
+                    if flight is not None:
+                        flight.record(
+                            "drain_request", t=elapsed,
+                            request=state.request_idx,
+                            committed=len(state.emitted), admitted=True,
+                        )
                     release_row(r)
                 for req_idx in pending:
                     drained.append(DrainedRequest(
                         request_idx=req_idx, elapsed_s=elapsed,
                     ))
+                    if tracer is not None:
+                        tracer.event(
+                            req_idx, "drained", t=elapsed,
+                            committed_tokens=0, admitted=False,
+                        )
+                    if flight is not None:
+                        flight.record(
+                            "drain_request", t=elapsed, request=req_idx,
+                            committed=0, admitted=False,
+                        )
                 pending.clear()
                 self.last_drain = drained
+                # the failover postmortem: freeze the recent waves with
+                # the drained cohort stamped into the trip detail (the
+                # chaos test cross-checks dump tail vs drained set)
+                trip_flight("drain", {
+                    "wave": chunks,
+                    "drained": [d.request_idx for d in drained],
+                })
                 interrupted = True
                 break
             if self._paged:
@@ -2441,19 +2654,20 @@ class ServingEngine:
                 jnp.bool_,
             )
             if self._spec:
-                if self._draft:
-                    (cache, d_cache, tok_vec, ptr_vec, buf, outs, accs,
-                     n_emits, actives) = self._spec_chunk(
-                        self._params, self._draft_params, cache, d_cache,
-                        tok_vec, ptr_vec, done_vec, buf, plen_vec,
-                        *shared_ops,
-                    )
-                else:
-                    (cache, tok_vec, ptr_vec, buf, outs, accs, n_emits,
-                     actives) = self._spec_chunk(
-                        self._params, cache, tok_vec, ptr_vec, done_vec,
-                        buf, plen_vec, *shared_ops,
-                    )
+                with dispatch_annotation("nexus.serve.spec_chunk"):
+                    if self._draft:
+                        (cache, d_cache, tok_vec, ptr_vec, buf, outs,
+                         accs, n_emits, actives) = self._spec_chunk(
+                            self._params, self._draft_params, cache,
+                            d_cache, tok_vec, ptr_vec, done_vec, buf,
+                            plen_vec, *shared_ops,
+                        )
+                    else:
+                        (cache, tok_vec, ptr_vec, buf, outs, accs,
+                         n_emits, actives) = self._spec_chunk(
+                            self._params, cache, tok_vec, ptr_vec,
+                            done_vec, buf, plen_vec, *shared_ops,
+                        )
                 chunks += 1
                 # one verify scores k+1 positions; utilization over them
                 # is acceptance-sensitive by design
@@ -2472,10 +2686,11 @@ class ServingEngine:
                     )
                     else self._decode_chunk_narrow
                 )
-                cache, tok_vec, ptr_vec, toks, emits = chunk_fn(
-                    self._params, cache, tok_vec, ptr_vec, done_vec,
-                    buf, plen_vec, temp_vec, seed_vec, *shared_ops,
-                )
+                with dispatch_annotation("nexus.serve.decode_chunk"):
+                    cache, tok_vec, ptr_vec, toks, emits = chunk_fn(
+                        self._params, cache, tok_vec, ptr_vec, done_vec,
+                        buf, plen_vec, temp_vec, seed_vec, *shared_ops,
+                    )
                 chunks += 1
                 scheduled_slots += self._chunk * b
                 # one batched device→host fetch (each np.asarray would
@@ -2503,9 +2718,17 @@ class ServingEngine:
                     if rows[r] is None or leases[r] is None:
                         continue
                     if pf_ptr[r] < plen_host[r]:
+                        pf_was = pf_ptr[r]
                         pf_ptr[r] = min(
                             plen_host[r], pf_ptr[r] + pf_advance
                         )
+                        if tracer is not None and pf_ptr[r] > pf_was:
+                            tracer.event(
+                                rows[r].request_idx, "prefill_chunk",
+                                t=round(now - t0, 6), row=r,
+                                wave=chunks, from_pos=pf_was,
+                                to_pos=pf_ptr[r],
+                            )
                     pub = min(
                         pf_ptr[r] // self._block_size, len(row_keys[r])
                     )
@@ -2519,10 +2742,15 @@ class ServingEngine:
                             parent=row_keys[r][j - 1] if j else None,
                         )
                         indexed_upto[r] += 1
+            shed_wave0 = shed_count
+            miss_wave0 = deadline_miss_count
             for r in range(b):
                 state = rows[r]
                 if state is None:
                     continue
+                row_n0 = len(state.emitted)
+                row_accepted = 0
+                row_rounds = 0
                 if self._spec:
                     for ri in range(self._rounds):
                         if row_done(state):
@@ -2531,6 +2759,8 @@ class ServingEngine:
                             target_forwards += 1
                             drafted += self._k
                             accepted_total += int(host_accs[ri, r])
+                            row_accepted += int(host_accs[ri, r])
+                            row_rounds += 1
                         for t in host_outs[ri, r, :int(host_emits[ri, r])]:
                             if row_done(state):
                                 break
@@ -2551,6 +2781,33 @@ class ServingEngine:
                         state.emitted.append(t)
                         if self._stop >= 0 and t == self._stop:
                             state.stopped = True
+                if tracer is not None:
+                    row_delta = len(state.emitted) - row_n0
+                    if row_n0 == 0 and row_delta > 0:
+                        tracer.event(
+                            state.request_idx, "first_token",
+                            t=round(now - t0, 6), row=r, wave=chunks,
+                            ttft_s=round(max(
+                                0.0, state.first_tok_t - state.admitted_t
+                            ), 6),
+                        )
+                    if row_delta > 0:
+                        # plain decode: every committed token was one
+                        # scheduled slot (accepted == tokens, rejected
+                        # 0); speculative rows attribute the round's
+                        # accept/reject split
+                        rej = (
+                            max(0, row_rounds * self._k - row_accepted)
+                            if self._spec else 0
+                        )
+                        tracer.event(
+                            state.request_idx, "decode_wave",
+                            t=round(now - t0, 6), row=r, wave=chunks,
+                            tokens=row_delta,
+                            accepted=(row_accepted if self._spec
+                                      else row_delta),
+                            rejected=rej,
+                        )
                 if row_done(state):
                     finish(state)
                     release_row(r)
@@ -2575,7 +2832,48 @@ class ServingEngine:
             police_deadlines()
             admit_into([r for r in range(b) if rows[r] is None])
             police_depth()
+            # ---- wave-boundary observability (round 12) ----
+            shed_d = shed_count - shed_wave0
+            miss_d = deadline_miss_count - miss_wave0
+            if shed_d + miss_d >= self._storm_threshold:
+                # a deadline/shed STORM: one boundary terminated a
+                # burst of requests — exactly when the end-of-run dict
+                # is least useful, so freeze the recent waves now
+                trip_flight(
+                    "shed_storm" if shed_d >= miss_d
+                    else "deadline_storm",
+                    {"wave": chunks, "shed": shed_d, "deadline": miss_d},
+                )
+            if flight is not None or gauges is not None:
+                live_rows = sum(1 for s in rows if s is not None)
+                free_blocks = alloc.free_blocks if alloc else 0
+                host_bytes = (
+                    host_store.bytes if host_store is not None else 0
+                )
+            if flight is not None:
+                # fresh stamp, not the pre-boundary `now`: finish/shed/
+                # admission events recorded above carry later clock
+                # reads, and the ring's time axis must not run
+                # backwards within one boundary's seq order
+                flight.record(
+                    "wave", t=self._clock() - t0, wave=chunks,
+                    queue_depth=len(pending), running_rows=live_rows,
+                    committed=committed, free_blocks=free_blocks,
+                    spills=alloc.spills if alloc else 0,
+                    restores=alloc.restores if alloc else 0,
+                    evictions=alloc.evictions if alloc else 0,
+                    host_bytes=host_bytes,
+                )
+            if gauges is not None:
+                gauges.publish(
+                    queue_depth=len(pending), running_rows=live_rows,
+                    free_pool_blocks=free_blocks,
+                    host_cache_bytes=host_bytes,
+                    committed_tokens=committed, waves=chunks,
+                )
         wall = self._clock() - t0
+        if flight is not None and not interrupted:
+            flight.record("run_end", t=wall, committed=committed)
         _pctl = percentile_nearest_rank
         metrics = {
             "requests": len(requests),
@@ -2616,6 +2914,15 @@ class ServingEngine:
             # (0 under fifo, and under cache-aware whenever the cache
             # ranking agrees with arrival order)
             "admission_overtakes": admission_overtakes,
+            # ---- observability ledger (round 12, nexus_tpu/obs/) ----
+            "traced": tracer is not None,
+            "flight_recorder_events": (
+                flight.events_recorded if flight is not None else 0
+            ),
+            "flight_dumps": len(tripped),
+            "live_gauge_publishes": (
+                gauges.publishes if gauges is not None else 0
+            ),
         }
         # admission → first committed token (chunk-granular) and
         # enqueue → admission waits, per request — OMITTED when no
@@ -2704,16 +3011,15 @@ class ServingEngine:
                 # swapping spilled blocks back instead of recomputing
                 metrics["host_cache_enabled"] = host_store is not None
                 if host_store is not None:
+                    hs = host_store.stats()
                     metrics["spilled_blocks"] = alloc.spills
                     metrics["restored_blocks"] = alloc.restores
                     metrics["restore_hit_tokens"] = restore_hit_tokens
                     metrics["restore_hit_requests"] = (
                         restore_hit_requests
                     )
-                    metrics["host_cache_bytes"] = host_store.bytes
-                    metrics["host_cache_bytes_peak"] = (
-                        host_store.bytes_peak
-                    )
+                    metrics["host_cache_bytes"] = hs["bytes"]
+                    metrics["host_cache_bytes_peak"] = hs["bytes_peak"]
                     metrics["host_cache_dtype"] = host_store.dtype
                     metrics["host_cache_evictions"] = (
                         alloc.host_evictions
@@ -2725,9 +3031,7 @@ class ServingEngine:
                     metrics["kv_spilled_blocks_final"] = (
                         alloc.index.spilled_count
                     )
-                    metrics["host_cache_entries_final"] = len(
-                        host_store
-                    )
+                    metrics["host_cache_entries_final"] = hs["entries"]
         else:
             metrics["kv_pool_bytes"] = b * dense_row_bytes
             metrics["kv_bytes_per_request"] = dense_row_bytes

@@ -18,7 +18,8 @@ import os
 import socket
 import threading
 import time
-from typing import Dict, List, Optional, Tuple, Union
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 METRIC_RECONCILE_LATENCY = "reconcile_latency"
 METRIC_WORKQUEUE_LENGTH = "workqueue_length"
@@ -45,6 +46,39 @@ METRIC_SHARD_HEALTHY = "shard_healthy"
 METRIC_FAILOVERS_TOTAL = "failovers_total"
 METRIC_FAILOVER_DETECTION_SECONDS = "failover_detection_seconds"
 METRIC_FAILOVER_STEPS_LOST = "failover_steps_lost"
+# Serve-plane live gauges (nexus_tpu/obs/gauges.py publishes these at
+# every wave boundary of a running engine — the PR 12 replacement for
+# end-of-run-only visibility; docs/observability.md has the catalogue):
+# wait-queue depth, occupied decode rows, free pool blocks, host-tier
+# resident bytes, cumulative committed tokens / wave count, and the
+# rolling nearest-rank ttft/queue-wait percentiles.
+METRIC_SERVE_QUEUE_DEPTH = "serve_queue_depth"
+METRIC_SERVE_RUNNING_ROWS = "serve_running_rows"
+METRIC_SERVE_FREE_BLOCKS = "serve_free_pool_blocks"
+METRIC_SERVE_HOST_BYTES = "serve_host_cache_bytes"
+METRIC_SERVE_COMMITTED = "serve_committed_tokens"
+METRIC_SERVE_WAVES = "serve_waves_total"
+METRIC_SERVE_TTFT_P50 = "serve_ttft_p50_s"
+METRIC_SERVE_TTFT_P95 = "serve_ttft_p95_s"
+METRIC_SERVE_QUEUE_P50 = "serve_queue_p50_s"
+METRIC_SERVE_QUEUE_P95 = "serve_queue_p95_s"
+
+
+def percentile_nearest_rank(xs: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile of a sequence — serve latency/ttft/queue
+    populations are a handful of values per run (or a bounded rolling
+    window), so the simple estimator is the honest one. THE one shared
+    rank formula: the engine's end-of-run rollups, the entrypoint's
+    request-latency rollups, the outage bench, and the obs layer's
+    rolling gauges all call this, so the estimator can't diverge
+    between them (moved here from runtime/serving.py in PR 12).
+
+    An EMPTY population returns NaN, never 0.0: an all-shed round must
+    not report a perfect p95 (callers omit the metric instead)."""
+    if not xs:
+        return float("nan")
+    s = sorted(xs)
+    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
 
 
 def configure_logger(
@@ -207,7 +241,20 @@ class StatsdClient:
     """Minimal DogStatsD client: gauges with tags, fire-and-forget UDP.
 
     With no address configured it is a pure in-memory registry (the test /
-    no-Datadog path)."""
+    no-Datadog path).
+
+    CONCURRENCY (PR 12 hardening): the registry is written from the
+    serve engine's wave loop, controller threads, and the failover
+    supervisor at once, and read by the exposition renderer while they
+    emit. Every mutable structure is guarded by ``_lock``, the history
+    is a bounded deque (append is O(1) — the old list-slice trim copied
+    10k entries per emission once full), and readers that need a
+    CONSISTENT view use :meth:`snapshot` (one lock hold, deep-enough
+    copies) instead of iterating the live dicts.
+    ``tools/race_smoke_telemetry.py`` hammers exactly this contract."""
+
+    #: history ring capacity (bounded — telemetry must never grow RSS)
+    HISTORY_CAP = 10000
 
     def __init__(
         self, app_name: str = "nexus-tpu", address: Optional[str] = None
@@ -217,8 +264,13 @@ class StatsdClient:
         self._sock: Optional[socket.socket] = None
         # UDP (host, port) tuple or a unix-socket path string
         self._addr: Optional[Union[Tuple[str, int], str]] = None
-        self.gauges: Dict[str, float] = {}
-        self.history: List[Tuple[str, float, Tuple[str, ...]]] = []
+        self.gauges: Dict[str, float] = {}  # guarded-by: _lock
+        # last value per (name, tags) SERIES — the exposition surface:
+        # the plain ``gauges`` dict collapses differently-tagged
+        # emissions of one metric into a single cell, which is fine for
+        # tests but loses the per-series values Prometheus text needs
+        self.tagged: Dict[Tuple[str, Tuple[str, ...]], float] = {}  # guarded-by: _lock
+        self.history: deque = deque(maxlen=self.HISTORY_CAP)  # guarded-by: _lock
         address = address or os.environ.get("NEXUS__STATSD_ADDRESS", "")
         if address.startswith("unix://"):
             # DogStatsD unix socket (the Datadog agent socket the reference
@@ -234,11 +286,11 @@ class StatsdClient:
         self, name: str, value: float, tags: Optional[List[str]] = None, rate: float = 1.0
     ) -> None:
         full = f"{self.app_name}.{name}"
+        tag_tuple = tuple(tags or [])
         with self._lock:
             self.gauges[full] = value
-            self.history.append((full, value, tuple(tags or [])))
-            if len(self.history) > 10000:
-                self.history = self.history[-10000:]
+            self.tagged[(full, tag_tuple)] = value
+            self.history.append((full, value, tag_tuple))
         if self._sock and self._addr:
             tag_str = f"|#{','.join(tags)}" if tags else ""
             payload = f"{full}:{value}|g|@{rate}{tag_str}".encode()
@@ -257,6 +309,19 @@ class StatsdClient:
         """Gauge of elapsed seconds since a ``time.monotonic()`` stamp
         (GaugeDuration equivalent, reference controller.go:389)."""
         self.gauge(name, time.monotonic() - since, tags=tags, rate=rate)
+
+    def snapshot(self) -> Dict[str, object]:
+        """One CONSISTENT copy of the registry (single lock hold): the
+        exposition renderer's read path. ``gauges`` is the untagged
+        last-value map, ``series`` the per-(name, tags) map — returned
+        as plain copies so the caller can iterate while emitters keep
+        writing."""
+        with self._lock:
+            return {
+                "gauges": dict(self.gauges),
+                "series": dict(self.tagged),
+                "history_len": len(self.history),
+            }
 
 
 _default_client: Optional[StatsdClient] = None

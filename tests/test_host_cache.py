@@ -92,6 +92,17 @@ def test_store_put_take_bytes_roundtrip():
     store.put(b"a", p1)
     with pytest.raises(ValueError):
         store.put(b"a", p1)  # one entry per digest
+    # stats(): the round-12 ledger/tooling snapshot mirrors the
+    # attributes exactly (the engine's host-cache metrics read it)
+    assert store.stats() == {
+        "entries": 1,
+        "bytes": store.bytes,
+        "bytes_peak": store.bytes_peak,
+        "budget_bytes": 1 << 20,
+        "puts": store.puts,
+        "takes": store.takes,
+        "drops": store.drops,
+    }
     with pytest.raises(ValueError):
         HostBlockStore(-1)
     with pytest.raises(ValueError):

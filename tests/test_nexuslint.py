@@ -125,6 +125,70 @@ def test_clock_rule_config_include_scopes_undetectable_modules():
     assert not _lint(src, path="other/mod.py", config=cfg, select=["NX-CLOCK"])
 
 
+def _monotonic_cfg(scope="nexus_tpu/obs/*"):
+    return LintConfig(options={"NX-CLOCK": {"monotonic_only": scope}})
+
+
+def test_monotonic_only_rule_flags_wall_clock_reads():
+    """NX-CLOCK003 (PR 12): in a monotonic-only zone (the obs package),
+    epoch-stepping reads — time.time, datetime.now/utcnow/today — are
+    banned outright; span timestamps must subtract cleanly."""
+    src = """
+        import time
+        import datetime
+
+        def stamp():
+            return time.time()
+
+        def stamp2():
+            return datetime.datetime.utcnow()
+    """
+    ids = _ids(_lint(src, path="nexus_tpu/obs/mod.py",
+                     config=_monotonic_cfg(), select=["NX-CLOCK003"]))
+    assert ids == ["NX-CLOCK003", "NX-CLOCK003"]
+
+
+def test_monotonic_only_rule_allows_monotonic_family():
+    """time.monotonic()/perf_counter() ARE monotonic clocks — legal in
+    the zone (they trip NX-CLOCK001 separately iff the module also
+    offers clock injection, which is the discipline the obs modules
+    follow by never reading clocks at all)."""
+    src = """
+        import time
+
+        def stamp():
+            return time.monotonic(), time.perf_counter()
+    """
+    assert _lint(src, path="nexus_tpu/obs/mod.py",
+                 config=_monotonic_cfg(), select=["NX-CLOCK003"]) == []
+
+
+def test_monotonic_only_rule_scoped_by_config_glob():
+    src = """
+        import time
+
+        def stamp():
+            return time.time()
+    """
+    assert _lint(src, path="nexus_tpu/runtime/mod.py",
+                 config=_monotonic_cfg(), select=["NX-CLOCK003"]) == []
+    # the repo config pins nexus_tpu/obs/* — load it and verify
+    repo_cfg = load_config(os.path.join(REPO_ROOT, "nexuslint.ini"))
+    assert _ids(_lint(src, path="nexus_tpu/obs/mod.py", config=repo_cfg,
+                      select=["NX-CLOCK003"])) == ["NX-CLOCK003"]
+
+
+def test_monotonic_only_rule_respects_suppression_comment():
+    src = """
+        import time
+
+        def stamp():
+            return time.time()  # nexuslint: disable=NX-CLOCK003
+    """
+    assert _lint(src, path="nexus_tpu/obs/mod.py",
+                 config=_monotonic_cfg(), select=["NX-CLOCK003"]) == []
+
+
 # ---------------------------------------------------------------------------
 # NX-LOCK
 

@@ -363,7 +363,12 @@ def test_freeze_engine_detector_confirms_without_crash():
 def test_hard_kill_confirmed_by_silence_and_requeued():
     """The crashed-engine drill: a launcher-style hard kill stops the
     engine (and its renewer) outright; the detector confirms by silence
-    and the drained queue completes exactly on the replacement."""
+    and the drained queue completes exactly on the replacement. The
+    dead generation leaves a FLIGHT-RECORDER dump (PR 12) whose last
+    events name exactly the drained requests — the kill-mid-decode
+    postmortem the observability tentpole promises."""
+    from nexus_tpu.obs import validate_flight_dump
+
     v = 13
     store = ClusterStore("serve-shard-kill")
     sup = ServeEngineSupervisor(
@@ -382,6 +387,28 @@ def test_hard_kill_confirmed_by_silence_and_requeued():
         assert res.tokens == _cyclic_expected(req, v)
     for gen in report["generations"]:
         _assert_pool_clean(gen)
+    # ---- flight recorder (PR 12): one dump per drained generation ----
+    assert len(report["flight_dumps"]) == 1
+    dump = report["flight_dumps"][0]
+    assert dump["reason"] == "drain"
+    assert validate_flight_dump(dump) == []
+    # the drained cohort == every request that survived a retry; the
+    # dump's detail AND its tail drain_request events both name it
+    drained = {i for i, r in enumerate(results) if r.retries >= 1}
+    assert drained  # chaos landed mid-decode
+    assert set(dump["detail"]["drained"]) == drained
+    tail_kinds = [e["kind"] for e in dump["events"]]
+    assert "wave" in tail_kinds  # the waves leading up to the death
+    tail_drains = [e for e in dump["events"]
+                   if e["kind"] == "drain_request"]
+    assert {e["request"] for e in tail_drains} == drained
+    # the dump's tail IS the drain: nothing recorded after it
+    assert tail_kinds[-len(tail_drains):] == (
+        ["drain_request"] * len(tail_drains)
+    )
+    # in-flight rows drained with their committed prefixes on record
+    assert any(e["admitted"] and e["committed"] > 0
+               for e in tail_drains)
 
 
 # -------------------------------------- satellite: requeue exactness (llama)

@@ -1,14 +1,21 @@
-"""Telemetry: DogStatsD wire format (UDP + unix socket) and the Datadog
-log sink (the slog-datadog equivalent, reference main.go:43-44)."""
+"""Telemetry: DogStatsD wire format (UDP + unix socket), the Datadog
+log sink (the slog-datadog equivalent, reference main.go:43-44), and —
+since PR 12 — the hardened concurrent registry, the shared nearest-rank
+percentile helper, and the Prometheus/JSON exposition formats."""
 
 import json
 import logging
+import math
 import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from nexus_tpu.utils.telemetry import DatadogLogHandler, StatsdClient
+from nexus_tpu.utils.telemetry import (
+    DatadogLogHandler,
+    StatsdClient,
+    percentile_nearest_rank,
+)
 
 
 def test_statsd_udp_wire_format():
@@ -33,6 +40,135 @@ def test_statsd_unix_socket(tmp_path):
     payload = rx.recv(1024).decode()
     rx.close()
     assert payload.startswith("nexus-tpu.workqueue_length:3")
+
+
+# ------------------------------------------- PR 12: shared percentile helper
+
+def test_percentile_nearest_rank_lives_in_telemetry():
+    """The ONE rank formula: moved here from runtime/serving.py so the
+    engine, the bench harness, and the rolling gauges share it. Empty
+    population is NaN (an all-shed round must not report a perfect
+    p95); the serving re-export keeps old importers working."""
+    assert math.isnan(percentile_nearest_rank([], 0.95))
+    assert percentile_nearest_rank([3.0, 1.0, 2.0], 0.5) == 2.0
+    assert percentile_nearest_rank([1.0], 0.95) == 1.0
+    from nexus_tpu.runtime.serving import (
+        percentile_nearest_rank as reexport,
+    )
+
+    assert reexport is percentile_nearest_rank
+
+
+# ---------------------------------------- PR 12: hardened concurrent registry
+
+def test_registry_snapshot_is_consistent_and_tagged():
+    c = StatsdClient("snap")
+    c.gauge("x", 1, tags=["k:a"])
+    c.gauge("x", 2, tags=["k:b"])
+    c.gauge("y", 3)
+    snap = c.snapshot()
+    assert snap["gauges"] == {"snap.x": 2, "snap.y": 3}
+    assert snap["series"][("snap.x", ("k:a",))] == 1
+    assert snap["series"][("snap.x", ("k:b",))] == 2
+    assert snap["series"][("snap.y", ())] == 3
+    # the snapshot is a COPY: later emissions don't mutate it
+    c.gauge("y", 9)
+    assert snap["gauges"]["snap.y"] == 3
+
+
+def test_registry_history_is_bounded_deque():
+    c = StatsdClient("hist")
+    for i in range(StatsdClient.HISTORY_CAP + 50):
+        c.gauge("n", i)
+    assert len(c.history) == StatsdClient.HISTORY_CAP
+    # oldest entries rolled off, newest survived
+    assert c.history[-1][1] == StatsdClient.HISTORY_CAP + 49
+
+
+def test_registry_concurrent_emitters_and_snapshot_reader():
+    """The engine-wave-loop + controller-thread shape: per-series
+    monotonic counters from N emitters, a reader snapshotting
+    concurrently — no exceptions, no lost final writes, no series ever
+    observed going backwards (tools/race_smoke_telemetry.py is the
+    longer-running twin)."""
+    c = StatsdClient("race")
+    stop = threading.Event()
+    errors = []
+    last = [0] * 4
+
+    def emit(i):
+        n = 0
+        try:
+            while not stop.is_set():
+                n += 1
+                c.gauge("ctr", n, tags=[f"e:{i}"])
+                last[i] = n
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def read():
+        seen = {}
+        try:
+            while not stop.is_set():
+                for (name, tags), v in c.snapshot()["series"].items():
+                    prev = seen.get((name, tags), 0)
+                    if v < prev:
+                        errors.append(
+                            AssertionError(f"{name}{tags}: {prev}->{v}")
+                        )
+                        return
+                    seen[(name, tags)] = v
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=emit, args=(i,), daemon=True)
+               for i in range(4)]
+    threads.append(threading.Thread(target=read, daemon=True))
+    for t in threads:
+        t.start()
+    time.sleep(0.4)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors, errors[:3]
+    series = c.snapshot()["series"]
+    for i in range(4):
+        assert series[("race.ctr", (f"e:{i}",))] == last[i]
+
+
+# ------------------------------------------------- PR 12: exposition formats
+
+def test_prometheus_exposition_format():
+    from nexus_tpu.obs.exposition import render_prometheus
+
+    c = StatsdClient("nexus-tpu")
+    c.gauge("serve_queue_depth", 5, tags=["engine:r0"])
+    c.gauge("serve_ttft_p95_s", 0.125, tags=["engine:r0"])
+    c.gauge("workqueue_length", 2)
+    text = render_prometheus(c)
+    lines = text.splitlines()
+    # every family gets one TYPE header; names sanitized to prom charset
+    assert "# TYPE nexus_tpu_serve_queue_depth gauge" in lines
+    assert 'nexus_tpu_serve_queue_depth{engine="r0"} 5' in lines
+    assert 'nexus_tpu_serve_ttft_p95_s{engine="r0"} 0.125' in lines
+    assert "nexus_tpu_workqueue_length 2" in lines
+    assert text.endswith("\n")
+    # tags without a colon become tag="<raw>"; quotes escape
+    c.gauge("odd", 1, tags=['we"ird'])
+    assert 'nexus_tpu_odd{tag="we\\"ird"} 1' in render_prometheus(c)
+
+
+def test_registry_snapshot_exposition_is_json_safe():
+    from nexus_tpu.obs.exposition import registry_snapshot
+
+    c = StatsdClient("snapx")
+    c.gauge("a.b-c", 1.5, tags=["k:v"])
+    snap = registry_snapshot(c)
+    json.dumps(snap)
+    assert snap["gauges"]["snapx.a.b-c"] == 1.5
+    assert snap["series"] == [
+        {"name": "snapx.a.b-c", "tags": ["k:v"], "value": 1.5}
+    ]
 
 
 class _Intake(ThreadingHTTPServer):

@@ -208,7 +208,7 @@ def main() -> int:
     ap.add_argument("--pace", type=float, default=0.008)
     args = ap.parse_args()
 
-    from nexus_tpu.runtime.serving import percentile_nearest_rank
+    from nexus_tpu.utils.telemetry import percentile_nearest_rank
 
     def _p50(xs):
         """Nearest-rank p50 rounded for the artifact, None for an empty

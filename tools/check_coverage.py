@@ -52,6 +52,11 @@ FORCE_INCLUDE = [
     # where a bug silently loses or duplicates user requests — always
     # gated per-file, whatever future exclusions appear
     r"nexus_tpu/ha/serve_failover\.py$",
+    # the round-12 observability package surface: the __init__ re-export
+    # shim is gated like ha/'s so a broken export can't hide (the
+    # trace/recorder/gauges/exposition modules are gated per-file
+    # already — nothing excludes them)
+    r"nexus_tpu/obs/__init__\.py$",
     # the round-8 enforcement layer itself: a rule or audit whose own
     # coverage rots is a gate that silently stops gating — nexuslint's
     # package __init__ (rule registration) and every rule module, plus

@@ -23,6 +23,22 @@ Inside a disciplined module, rules:
                variants) / ``datetime.now()`` / ``datetime.utcnow()``
   NX-CLOCK002  direct ``time.sleep()`` (inject a sleeper / pace hook)
 
+A third discipline (PR 12) covers MONOTONIC-ONLY zones — modules whose
+timestamps must subtract cleanly (span timelines, flight-recorder
+events): wall clocks there are not merely untestable, they make
+*timelines lie* across NTP steps and DST. Files matching the
+``[rule:NX-CLOCK] monotonic_only`` globs in ``nexuslint.ini`` (the repo
+pins ``nexus_tpu/obs/*``) get:
+
+  NX-CLOCK003  wall-clock read (``time.time[_ns]()`` /
+               ``datetime.now()`` / ``utcnow()`` / ``today()``) in a
+               monotonic-only module; ``time.monotonic()`` and
+               ``perf_counter()`` remain legal there (they ARE the
+               monotonic family — though the obs modules themselves
+               take engine-stamped timestamps and read no clock at
+               all, which rules 001/002 separately enforce wherever a
+               ``clock`` parameter appears).
+
 References (not calls) stay legal — ``clock: Callable = time.monotonic``
 as a default value IS the injection idiom. Deliberately-informational
 wall stamps (e.g. a lease's ``renewTime``, never compared by anyone) are
@@ -32,6 +48,9 @@ suppressed at the site with a justification comment.
 from __future__ import annotations
 
 import ast
+import fnmatch
+import os
+import re
 from typing import Dict, List
 
 from tools.nexuslint.core import (
@@ -48,6 +67,11 @@ _TIME_FUNCS = {
 }
 _DT_FUNCS = {"now", "utcnow"}
 _INJECT_PARAMS = {"clock", "sleep"}
+# the WALL-clock subset (NX-CLOCK003): reads whose epoch can step under
+# NTP/DST — banned outright in monotonic-only zones, where timestamps
+# exist to be subtracted
+_WALL_TIME_FUNCS = {"time", "time_ns"}
+_WALL_DT_FUNCS = {"now", "utcnow", "today"}
 
 
 def _alias_maps(tree: ast.Module):
@@ -114,6 +138,57 @@ def check_clock_reads(ctx: FileContext) -> List[Finding]:
                 "NX-CLOCK001", ctx.path, node.lineno, node.col_offset,
                 f"direct {hit[1]}() in a clock-disciplined module; "
                 "route it through the injectable clock",
+            ))
+    return out
+
+
+def _monotonic_only_scope(ctx: FileContext) -> bool:
+    """Is this file in the ``monotonic_only`` globs of nexuslint.ini?"""
+    raw = ctx.config.option("NX-CLOCK", "monotonic_only", "")
+    pats = [x.strip() for x in re.split(r"[,\n]", raw) if x.strip()]
+    for pat in pats:
+        if (fnmatch.fnmatch(ctx.path, pat)
+                or fnmatch.fnmatch(os.path.basename(ctx.path), pat)):
+            return True
+    return False
+
+
+def _classify_wall_call(call: ast.Call, mods, funcs):
+    """canonical name for WALL-clock reads (the NX-CLOCK003 ban set:
+    epoch-stepping reads only — the monotonic family stays legal)."""
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    if parts[0] in funcs:
+        parts = funcs[parts[0]].split(".") + parts[1:]
+    if parts[0] in mods:
+        parts = [mods[parts[0]]] + parts[1:]
+    canonical = ".".join(parts)
+    if parts[0] == "time" and len(parts) == 2 and parts[1] in _WALL_TIME_FUNCS:
+        return canonical
+    if (parts[0] == "datetime" and parts[-1] in _WALL_DT_FUNCS
+            and len(parts) <= 3):
+        return canonical
+    return None
+
+
+@rule("NX-CLOCK003", "wall-clock read in a monotonic-only module")
+def check_monotonic_only(ctx: FileContext) -> List[Finding]:
+    if not _monotonic_only_scope(ctx):
+        return []
+    mods, funcs = _alias_maps(ctx.tree)
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        hit = _classify_wall_call(node, mods, funcs)
+        if hit:
+            out.append(Finding(
+                "NX-CLOCK003", ctx.path, node.lineno, node.col_offset,
+                f"wall-clock {hit}() in a monotonic-only module; span "
+                "and flight-recorder timestamps must subtract cleanly "
+                "— use the engine-stamped monotonic t instead",
             ))
     return out
 

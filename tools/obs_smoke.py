@@ -1,0 +1,164 @@
+"""Observability smoke: a traced mini-serve, validated against schema.
+
+``make obs-smoke`` runs this. It drives the REAL serving engine (cyclic
+stub model — CPU, seconds) through the full obs surface and gates every
+artifact on its validator:
+
+  1. a traced serve run → ``ServeTracer`` dump validates
+     (``validate_trace``), every request's timeline is
+     enqueued → ... → terminal, and token outputs are EXACT (tracing
+     must never perturb serving);
+  2. a cancelled serve run → the flight recorder trips on drain, the
+     dump validates (``validate_flight_dump``), and its drain events
+     match the engine's drain snapshot request for request;
+  3. live gauges land in the in-process registry and the Prometheus /
+     JSON expositions render them.
+
+Writes the two dumps under ``--out`` (default /tmp/nexus_obs_smoke) so
+``python tools/trace_summary.py <dump>.json`` has something real to
+render. Exit 0 = clean, 1 = violation (details printed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# ONE copy of the cyclic serve stub (next = (token + 1) % v, honoring
+# the chunked-prefill n_valid contract) lives in tools/ — reuse the
+# outage bench's, so an engine cache-contract change is fixed once
+from tools.bench_serve_outage import _cyclic_model, _expected  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="/tmp/nexus_obs_smoke")
+    args = ap.parse_args(argv)
+
+    from nexus_tpu.obs import (
+        ServeTracer,
+        registry_snapshot,
+        render_prometheus,
+        validate_flight_dump,
+        validate_trace,
+        write_dump,
+    )
+    from nexus_tpu.runtime.serving import ServeRequest, ServingEngine
+    from nexus_tpu.utils.signals import CancelToken
+    from nexus_tpu.utils.telemetry import StatsdClient, with_statsd
+
+    problems: list = []
+    v = 13
+    cfg, fwd = _cyclic_model(v)
+    # a fresh process-default registry so the gauge assertions below
+    # see exactly this smoke's series
+    client: StatsdClient = with_statsd("obs-smoke")
+
+    # ---- 1. traced serve run: schema + exactness ----
+    tracer = ServeTracer()
+    eng = ServingEngine(
+        fwd, {}, cfg, batch_size=2, max_len=128, chunk=4,
+        kv_block_size=8, tracer=tracer, gauge_tags=["engine:smoke-0"],
+    )
+    # shared preamble so the radix tree attributes hits in the spans
+    reqs = [
+        ServeRequest(prompt=[0, 1, 2, 3, 4, 5, 6, 7, (i % 5) + 1],
+                     max_new_tokens=12)
+        for i in range(6)
+    ]
+    results, metrics = eng.serve(reqs)
+    for i, (req, res) in enumerate(zip(reqs, results)):
+        if res.tokens != _expected(req, v):
+            problems.append(f"request {i}: traced output diverged")
+    dump = tracer.to_dict()
+    problems += [f"trace: {p}" for p in validate_trace(dump)]
+    for entry in dump["spans"]:
+        kinds = [s["kind"] for s in entry["timeline"]]
+        for needed in ("enqueued", "admitted", "first_token", "terminal"):
+            if needed not in kinds:
+                problems.append(
+                    f"request {entry['request']}: no {needed!r} span "
+                    f"(got {kinds})"
+                )
+    if metrics.get("live_gauge_publishes", 0) < 1:
+        problems.append("engine published no live gauges")
+    trace_path = write_dump(dump, os.path.join(args.out, "serve_trace.json"))
+
+    # ---- 2. kill-mid-serve: the flight recorder trips on drain ----
+    eng2 = ServingEngine(
+        fwd, {}, cfg, batch_size=1, max_len=128, chunk=4, kv_block_size=8,
+    )
+    cancel = CancelToken()
+    beats = [0]
+
+    def hb(_committed):
+        beats[0] += 1
+        if beats[0] >= 2:  # mid-decode, after real waves committed
+            cancel.cancel(hard=True)
+
+    reqs2 = [ServeRequest(prompt=[0, i + 1], max_new_tokens=40)
+             for i in range(3)]
+    _res2, m2 = eng2.serve(reqs2, cancel=cancel, heartbeat=hb)
+    if not m2.get("interrupted"):
+        problems.append("cancel never drained the engine")
+    fdump = eng2.last_flight_dump
+    if fdump is None:
+        problems.append("drain did not trip the flight recorder")
+    else:
+        problems += [f"flight: {p}" for p in validate_flight_dump(fdump)]
+        if fdump["reason"] != "drain":
+            problems.append(f"trip reason {fdump['reason']!r} != 'drain'")
+        drained_ids = sorted(
+            d.request_idx for d in (eng2.last_drain or [])
+        )
+        dump_ids = sorted(fdump["detail"].get("drained", []))
+        if drained_ids != dump_ids:
+            problems.append(
+                f"dump drained set {dump_ids} != engine drain snapshot "
+                f"{drained_ids}"
+            )
+        tail_ids = sorted(
+            ev["request"] for ev in fdump["events"]
+            if ev["kind"] == "drain_request"
+        )
+        if tail_ids != drained_ids:
+            problems.append(
+                f"dump tail drain events {tail_ids} != drain snapshot "
+                f"{drained_ids}"
+            )
+        write_dump(fdump, os.path.join(args.out, "flight_drain.json"))
+
+    # ---- 3. exposition over the live registry ----
+    text = render_prometheus(client)
+    if "nexus_tpu" in text:
+        problems.append("exposition leaked another app's registry")
+    for metric in ("obs_smoke.serve_queue_depth",
+                   "obs_smoke.serve_committed_tokens"):
+        prom = metric.replace(".", "_").replace("-", "_")
+        if prom not in text:
+            problems.append(f"{metric} missing from Prometheus text")
+    snap = registry_snapshot(client)
+    if not any(s["tags"] == ["engine:smoke-0"] for s in snap["series"]):
+        problems.append("gauge_tags never reached the registry series")
+
+    if problems:
+        print("OBS SMOKE FAILED:")
+        for p in problems[:20]:
+            print(f"  - {p}")
+        return 1
+    print(
+        f"obs smoke clean: {metrics['requests']} traced requests, "
+        f"{sum(len(e['timeline']) for e in dump['spans'])} spans, "
+        f"{metrics['flight_recorder_events']} flight events, "
+        f"{metrics['live_gauge_publishes']} gauge publishes; dumps in "
+        f"{args.out} (render: python tools/trace_summary.py "
+        f"{trace_path})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

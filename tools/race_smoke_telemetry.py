@@ -1,0 +1,141 @@
+"""Race smoke: hammer one StatsdClient registry from N emitter threads.
+
+The in-process metrics registry stopped being a write-at-end,
+read-at-end structure in PR 12: the serve engine's wave loop publishes
+live gauges while controller/supervisor threads emit their own series
+and the exposition renderer reads snapshots concurrently (the
+``/metrics``-scrape shape). This smoke exercises exactly that mix —
+the telemetry twin of ``tools/race_smoke_store.py``.
+
+Invariants checked:
+
+  * no exception escapes any emitter or reader thread;
+  * PER-SERIES MONOTONICITY through snapshots: each emitter publishes a
+    strictly increasing counter into its own (name, tags) series, so a
+    snapshot that ever shows a series value going backwards caught a
+    torn/lost write;
+  * renders are internally consistent: every sample line in the
+    Prometheus text parses, and after quiesce the final snapshot holds
+    every emitter's LAST published value exactly;
+  * the history ring stays bounded at ``StatsdClient.HISTORY_CAP``.
+
+Exit code 0 = clean, 1 = violation (details printed).
+
+Usage: python tools/race_smoke_telemetry.py [--threads 8] [--seconds 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nexus_tpu.obs.exposition import (  # noqa: E402
+    registry_snapshot,
+    render_prometheus,
+)
+from nexus_tpu.utils.telemetry import StatsdClient  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--seconds", type=float, default=2.0)
+    args = ap.parse_args(argv)
+
+    client = StatsdClient("race-smoke")
+    stop = threading.Event()
+    violations: list = []
+    last_published = [0] * args.threads
+
+    def emitter(i: int) -> None:
+        n = 0
+        try:
+            while not stop.is_set():
+                n += 1
+                client.gauge("serve_counter", n, tags=[f"emitter:{i}"])
+                # a shared untagged series too — last-writer-wins race
+                client.gauge("serve_shared", n)
+                last_published[i] = n
+        except Exception as e:  # noqa: BLE001 — the smoke's whole point
+            violations.append(f"emitter {i}: {type(e).__name__}: {e}")
+
+    def reader() -> None:
+        seen: dict = {}
+        try:
+            while not stop.is_set():
+                snap = registry_snapshot(client)
+                for s in snap["series"]:
+                    key = (s["name"], tuple(s["tags"]))
+                    prev = seen.get(key, 0)
+                    if s["value"] < prev and s["name"].endswith("counter"):
+                        violations.append(
+                            f"series {key} went backwards: "
+                            f"{prev} -> {s['value']}"
+                        )
+                        return
+                    seen[key] = max(prev, s["value"])
+                text = render_prometheus(client)
+                for line in text.splitlines():
+                    if line.startswith("#"):
+                        continue
+                    # name{labels} value — the value must parse
+                    try:
+                        float(line.rsplit(" ", 1)[1])
+                    except (IndexError, ValueError):
+                        violations.append(f"unparseable sample: {line!r}")
+                        return
+        except Exception as e:  # noqa: BLE001
+            violations.append(f"reader: {type(e).__name__}: {e}")
+
+    threads = [
+        threading.Thread(target=emitter, args=(i,), daemon=True)
+        for i in range(args.threads)
+    ] + [threading.Thread(target=reader, daemon=True)]
+    for t in threads:
+        t.start()
+    time.sleep(args.seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+
+    # quiesced: the final snapshot must hold every emitter's last value
+    snap = registry_snapshot(client)
+    series = {
+        (s["name"], tuple(s["tags"])): s["value"] for s in snap["series"]
+    }
+    for i in range(args.threads):
+        key = ("race-smoke.serve_counter", (f"emitter:{i}",))
+        got = series.get(key)
+        if got != last_published[i]:
+            violations.append(
+                f"final snapshot lost emitter {i}'s last write: "
+                f"{got} != {last_published[i]}"
+            )
+    if snap["history_len"] > StatsdClient.HISTORY_CAP:
+        violations.append(
+            f"history unbounded: {snap['history_len']} > "
+            f"{StatsdClient.HISTORY_CAP}"
+        )
+
+    if violations:
+        print("TELEMETRY RACE SMOKE FAILED:")
+        for v in violations[:20]:
+            print(f"  - {v}")
+        return 1
+    total = sum(last_published)
+    print(
+        f"telemetry race smoke clean: {args.threads} emitters x "
+        f"{args.seconds}s, {total} gauge writes, "
+        f"{len(series)} series surviving, history_len="
+        f"{snap['history_len']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,39 +1,129 @@
-"""Summarize a jax.profiler TensorBoard trace: top device ops by self time."""
-import glob, gzip, json, sys, collections
+"""Trace summaries: jax.profiler device traces AND serve-plane dumps.
 
-root = sys.argv[1] if len(sys.argv) > 1 else "/tmp/nexus_prof"
-paths = sorted(glob.glob(f"{root}/**/*.trace.json.gz", recursive=True))
-if not paths:
-    sys.exit(f"no trace under {root}")
-path = paths[-1]
-with gzip.open(path, "rt") as f:
-    data = json.load(f)
-events = data.get("traceEvents", [])
-# device lanes: pid names containing TPU/device
-pid_names = {e["pid"]: e["args"].get("name", "") for e in events
-             if e.get("ph") == "M" and e.get("name") == "process_name"}
-dev_pids = {p for p, n in pid_names.items()
-            if any(s in n.lower() for s in ("tpu", "device", "xla"))}
-if not dev_pids:  # unknown backend naming (e.g. '/host:CPU'): use every lane
-    dev_pids = set(pid_names)
-tot = collections.Counter()
-cnt = collections.Counter()
-span = [None, None]
-for e in events:
-    if e.get("ph") == "X" and e.get("pid") in dev_pids:
-        name = e.get("name", "?")
-        dur = e.get("dur", 0)  # us
-        tot[name] += dur
-        cnt[name] += 1
-        ts = e.get("ts", 0)
-        if span[0] is None or ts < span[0]: span[0] = ts
-        te = ts + dur
-        if span[1] is None or te > span[1]: span[1] = te
-print(f"trace: {path}")
-print(f"pids: { {p: pid_names[p] for p in dev_pids} }")
-if span[0] is not None:
-    print(f"device span: {(span[1]-span[0])/1e3:.1f} ms")
-busy = sum(tot.values())
-print(f"total device busy: {busy/1e3:.1f} ms")
-for name, us in tot.most_common(30):
-    print(f"{us/1e3:9.2f} ms  x{cnt[name]:4d}  {name[:110]}")
+Two input kinds, auto-detected:
+
+  * a directory of jax.profiler TensorBoard traces (the original mode):
+    top device ops by self time;
+  * a ``.json`` file holding a serve-plane observability dump
+    (nexus_tpu/obs/): a ``ServeTracer.to_dict()`` span timeline or a
+    flight-recorder trip dump — rendered as a human-readable
+    per-request timeline / event tail.
+
+Usage::
+
+    python tools/trace_summary.py /tmp/nexus_prof          # profiler
+    python tools/trace_summary.py serve_trace.json         # span dump
+    python tools/trace_summary.py flight-tmpl-gen0.json    # flight dump
+"""
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def summarize_profiler(root: str) -> None:
+    """Top device ops by self time from a jax.profiler trace dir."""
+    paths = sorted(glob.glob(f"{root}/**/*.trace.json.gz", recursive=True))
+    if not paths:
+        sys.exit(f"no trace under {root}")
+    path = paths[-1]
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    # device lanes: pid names containing TPU/device
+    pid_names = {e["pid"]: e["args"].get("name", "") for e in events
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+    dev_pids = {p for p, n in pid_names.items()
+                if any(s in n.lower() for s in ("tpu", "device", "xla"))}
+    if not dev_pids:  # unknown backend naming (e.g. '/host:CPU'): every lane
+        dev_pids = set(pid_names)
+    tot = collections.Counter()
+    cnt = collections.Counter()
+    span = [None, None]
+    for e in events:
+        if e.get("ph") == "X" and e.get("pid") in dev_pids:
+            name = e.get("name", "?")
+            dur = e.get("dur", 0)  # us
+            tot[name] += dur
+            cnt[name] += 1
+            ts = e.get("ts", 0)
+            if span[0] is None or ts < span[0]:
+                span[0] = ts
+            te = ts + dur
+            if span[1] is None or te > span[1]:
+                span[1] = te
+    print(f"trace: {path}")
+    print(f"pids: { {p: pid_names[p] for p in dev_pids} }")
+    if span[0] is not None:
+        print(f"device span: {(span[1]-span[0])/1e3:.1f} ms")
+    busy = sum(tot.values())
+    print(f"total device busy: {busy/1e3:.1f} ms")
+    for name, us in tot.most_common(30):
+        print(f"{us/1e3:9.2f} ms  x{cnt[name]:4d}  {name[:110]}")
+
+
+def _span_line(span: dict) -> str:
+    """One span → one compact timeline line (schema-ordered fields,
+    ``kind`` and ``t`` pulled to the front)."""
+    kind = span.get("kind", "?")
+    t = span.get("t", 0.0)
+    rest = ", ".join(
+        f"{k}={v}" for k, v in span.items() if k not in ("kind", "t")
+    )
+    return f"  {t:9.4f}s  {kind:<14s} {rest}"
+
+
+def summarize_serve_trace(dump: dict) -> None:
+    """Human-readable per-request timeline of a ServeTracer dump."""
+    print(f"serve trace: schema v{dump.get('schema_version')}, "
+          f"{dump.get('requests')} request(s)")
+    for entry in dump.get("spans", []):
+        tl = entry.get("timeline", [])
+        term = tl[-1] if tl else {}
+        status = term.get("status", term.get("kind", "?"))
+        print(f"request {entry.get('request')}: {len(tl)} span(s), "
+              f"final={status}")
+        for span in tl:
+            print(_span_line(span))
+
+
+def summarize_flight_dump(dump: dict) -> None:
+    """Event tail of a flight-recorder trip dump."""
+    print(f"flight dump: reason={dump.get('reason')!r} "
+          f"tripped_t={dump.get('tripped_t')}s "
+          f"({len(dump.get('events', []))} event(s) in ring)")
+    detail = dump.get("detail") or {}
+    if detail:
+        print(f"detail: {json.dumps(detail, sort_keys=True)}")
+    for ev in dump.get("events", []):
+        rest = ", ".join(
+            f"{k}={v}" for k, v in ev.items()
+            if k not in ("seq", "t", "kind")
+        )
+        print(f"  #{ev.get('seq', '?'):>5} {ev.get('t', 0.0):9.4f}s  "
+              f"{ev.get('kind', '?'):<14s} {rest}")
+
+
+def main(argv) -> None:
+    target = argv[1] if len(argv) > 1 else "/tmp/nexus_prof"
+    if os.path.isfile(target) and target.endswith(".json"):
+        with open(target) as f:
+            dump = json.load(f)
+        if "spans" in dump:
+            summarize_serve_trace(dump)
+        elif "events" in dump:
+            summarize_flight_dump(dump)
+        else:
+            sys.exit(f"{target}: neither a serve trace (spans) nor a "
+                     "flight dump (events)")
+        return
+    summarize_profiler(target)
+
+
+if __name__ == "__main__":
+    try:
+        main(sys.argv)
+    except BrokenPipeError:  # `| head` closed the pipe — not an error
+        sys.exit(0)
