@@ -3,7 +3,7 @@ SHELL := /bin/bash
 NATIVE_SRC := nexus_tpu/native/src/nexus_core.cpp nexus_tpu/native/src/nexus_data.cpp
 NATIVE_LIB := nexus_tpu/native/libnexus_core.so
 
-.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-serve-spec bench-serve-obs bench-failover bench-serve-outage chaos-smoke serve-smoke serve-chaos-smoke serve-sanitize-smoke radix-smoke spill-smoke spec-serve-smoke obs-smoke race-smoke race-smoke-telemetry clean lint nexuslint analyze
+.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-serve-spec bench-serve-obs bench-serve-fleet bench-failover bench-serve-outage chaos-smoke serve-smoke serve-chaos-smoke serve-sanitize-smoke radix-smoke spill-smoke spec-serve-smoke fleet-smoke obs-smoke race-smoke race-smoke-telemetry clean lint nexuslint analyze
 
 all: native
 
@@ -146,6 +146,30 @@ bench-serve-spec:
 # timeline artifact, writing the per-round docs/bench_serve_r<N>.json.
 bench-serve-obs:
 	NEXUS_BENCH_SERVE=only NEXUS_BENCH_SERVE_OBS=only \
+	  NEXUS_BENCH_INIT_PROBE=0 JAX_PLATFORMS=cpu python bench.py
+
+# Fleet-serving smoke (fast lane, round 14, stub + tiny-llama, under a
+# minute on CPU): the router/autoscaler/placement units (affinity
+# single-homing, rendezvous churn minimality, spill-over bounds,
+# breach/clear hysteresis, frozen-gauge staleness), the deterministic
+# multi-replica drive's exactness + hit-rate preservation, and the
+# kill-one-replica chaos drill (detector-confirmed death →
+# drain-and-requeue onto survivors, token-identical, zero lost, zero
+# leaked blocks) — run with the runtime sanitizers ARMED so every
+# replica engine's pool-partition/radix audits execute at teardown.
+# Wired into the CI fast job; the unarmed run rides `pytest -m "not
+# slow"`.
+fleet-smoke:
+	NEXUS_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
+	  tests/test_fleet.py -q
+
+# Round-14 fleet A/B only (minutes, CPU): replicas 1/2/4 aggregate
+# tok/s + goodput-under-SLO on the shared-preamble family queue,
+# affinity vs random routing (prefix hit rate + ttft p95), and the
+# kill-one-replica leg — writing the per-round
+# docs/bench_serve_r<N>.json via the merge-not-clobber artifact writer.
+bench-serve-fleet:
+	NEXUS_BENCH_SERVE=only NEXUS_BENCH_SERVE_FLEET=only \
 	  NEXUS_BENCH_INIT_PROBE=0 JAX_PLATFORMS=cpu python bench.py
 
 # Observability smoke (fast lane, round 12, stub-model, seconds on CPU):

@@ -1654,6 +1654,311 @@ def _serve_obs_scenarios(preset, progress, block, chunk, trials=None):
     return out
 
 
+def _serve_fleet_scenarios(preset, progress, block, chunk):
+    """Fleet-scale serving scenarios (round 14, nexus_tpu/fleet/;
+    docs/fleet.md): the SAME shared-preamble family queue served by
+    1/2/4 engine replicas behind the prefix-affinity router, plus the
+    affinity-vs-random routing A/B and a kill-one-replica chaos leg.
+
+    Workload: 16 families × 8 requests, each family opening with its
+    own 64-token preamble (system-prompt shape) and diverging in an
+    8-token tail; arrivals interleave ACROSS families, so a cache-blind
+    router has no arrival-order crutch — exactly the traffic where
+    scattering a family re-prefills its preamble once per replica it
+    lands on.
+
+    Measurement honesty: the CPU lane TIME-MULTIPLEXES replicas (one
+    box), so aggregate tok/s is total committed tokens over the
+    SLOWEST replica's engine-timed serve wall (``fleet_wall_max_s`` —
+    compiles excluded, exactly the single-engine bench convention):
+    the wall N independent shards would realize, with the single-box
+    ``fleet_busy_sum_s`` reported alongside. Goodput-under-SLO pins the
+    SLO at 0.6× the replicas-1 leg's median request latency on this
+    box and counts each leg's ok-requests under it — the fraction the
+    fleet serves within a latency budget one engine can only give half
+    the queue.
+
+    Every leg re-serves the identical queue; ``fleet_exact`` asserts
+    token-identity against a cache-OFF single engine (routing is
+    scheduling, never semantics). Keys (artifact:
+    docs/bench_serve_r<N>.json): per-leg aggregate tok/s + goodput +
+    prefix hit rate + ttft p95, the r2/r1 and r4/r1 scaling ratios,
+    the affinity-vs-random hit-rate pair, and the kill leg's
+    requests-lost / detection / exactness."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from nexus_tpu.fleet import PrefixAffinityRouter, serve_fleet_local
+        from nexus_tpu.models import llama
+        from nexus_tpu.runtime.serving import ServeRequest, ServingEngine
+        from nexus_tpu.utils.hw import is_tpu
+        from nexus_tpu.utils.telemetry import percentile_nearest_rank
+
+        dtype = jnp.bfloat16 if is_tpu() else jnp.float32
+        cfg = llama.config(preset, dtype=dtype, max_seq_len=256)
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+    except Exception as e:  # noqa: BLE001 — harness must not kill bench
+        progress(f"fleet scenarios unavailable: {type(e).__name__}: "
+                 f"{str(e)[:160]}")
+        return {}
+
+    rng = np.random.RandomState(140)
+    families, per_fam, preamble_len, tail_len, budget = 16, 8, 64, 8, 32
+    preambles = [
+        rng.randint(0, cfg.vocab_size, size=preamble_len).tolist()
+        for _ in range(families)
+    ]
+    queue = []
+    for _ in range(per_fam):
+        for f in range(families):  # arrivals interleave across families
+            tail = rng.randint(0, cfg.vocab_size, size=tail_len).tolist()
+            queue.append(ServeRequest(
+                prompt=preambles[f] + tail, max_new_tokens=budget,
+            ))
+    prompt_tokens = sum(len(r.prompt) for r in queue)
+    depth = max(1, preamble_len // block)
+    rows = 4
+
+    def engines_for(n):
+        return {
+            f"r{i}": ServingEngine(
+                llama.forward_decode, params, cfg, batch_size=rows,
+                max_len=256, chunk=chunk, prefill_chunk=1,
+                kv_block_size=block, gauge_tags=[f"engine:r{i}"],
+            )
+            for i in range(n)
+        }
+
+    out = {
+        "fleet_rows_per_replica": rows,
+        "fleet_queue_requests": len(queue),
+        "fleet_families": families,
+        "fleet_preamble_tokens": preamble_len,
+        "fleet_affinity_depth": depth,
+    }
+    try:
+        ref_engine = ServingEngine(
+            llama.forward_decode, params, cfg, batch_size=rows,
+            max_len=256, chunk=chunk, prefill_chunk=1,
+            kv_block_size=block, prefix_cache=False,
+        )
+        ref_results, _ = ref_engine.serve(list(queue))
+        ref_tokens = [r.tokens for r in ref_results]
+    except Exception as e:  # noqa: BLE001
+        progress(f"fleet reference failed: {type(e).__name__}: "
+                 f"{str(e)[:160]}")
+        return {}
+    exact = True
+    leg_results = {}
+    for n, policy in ((1, "affinity"), (2, "affinity"), (4, "affinity"),
+                      (4, "random")):
+        tag = f"r{n}" if policy == "affinity" else f"r{n}_random"
+        engines = engines_for(n)
+        # spill-over load signal for the offline routing pass: the
+        # requests already routed to each replica (the pending-queue
+        # count the live fleet stacks on its gauges) — power-of-two-
+        # choices bounds how far family-granularity can skew the
+        # partition while same-prefix traffic keeps single-homing
+        router = PrefixAffinityRouter(
+            list(engines), block_size=block, affinity_depth=depth,
+            policy=policy, spill_threshold=8, seed=14,
+        )
+        # offline pass: pending routed counts are the spill-over load
+        # (no engine has published gauges yet); serve_fleet_local
+        # enables this by default, made explicit here for the record
+        router.enable_pending_load()
+        try:
+            results, m = serve_fleet_local(engines, router, queue)
+        except Exception as e:  # noqa: BLE001
+            progress(f"fleet leg {tag} failed: {type(e).__name__}: "
+                     f"{str(e)[:160]}")
+            # never ship scaling numbers without an exactness verdict
+            out["fleet_exact"] = False
+            return out
+        if [r.tokens for r in results] != ref_tokens:
+            exact = False
+            progress(f"fleet leg {tag}: EXACTNESS VIOLATION — routed "
+                     "tokens diverge from the cache-off single engine")
+        leg_results[tag] = results
+        hit_rate = m["fleet_prefix_hit_tokens"] / max(1, prompt_tokens)
+        ttfts = sorted(r.ttft_s for r in results if r.status == "ok")
+        out[f"fleet_{tag}_tok_s"] = m["tokens_per_sec"]
+        out[f"fleet_{tag}_wall_max_s"] = m["fleet_wall_max_s"]
+        out[f"fleet_{tag}_busy_sum_s"] = m["fleet_busy_sum_s"]
+        out[f"fleet_{tag}_hit_tokens"] = m["fleet_prefix_hit_tokens"]
+        out[f"fleet_{tag}_hit_rate"] = round(hit_rate, 3)
+        out[f"fleet_{tag}_ttft_p95_s"] = round(
+            percentile_nearest_rank(ttfts, 0.95), 4
+        )
+        out[f"fleet_{tag}_spills"] = m["router_spills"]
+        progress(
+            f"fleet leg {tag}: {m['tokens_per_sec']:.1f} agg tok/s "
+            f"(wall {m['fleet_wall_max_s']:.2f}s, hit rate "
+            f"{hit_rate:.3f}, ttft p95 {out[f'fleet_{tag}_ttft_p95_s']}s)"
+        )
+    # SLO pinned off the replicas-1 leg: 0.6x its median ok latency
+    r1_lat = sorted(
+        r.latency_s for r in leg_results["r1"] if r.status == "ok"
+    )
+    slo_s = round(0.6 * percentile_nearest_rank(r1_lat, 0.50), 4)
+    out["fleet_slo_s"] = slo_s
+    for tag, results in leg_results.items():
+        ok_under = [r for r in results
+                    if r.status == "ok" and r.latency_s <= slo_s]
+        out[f"fleet_{tag}_slo_attainment"] = round(
+            len(ok_under) / max(1, len(results)), 3
+        )
+        out[f"fleet_{tag}_goodput_tok_s"] = round(
+            sum(r.new_tokens for r in ok_under)
+            / max(1e-9, out[f"fleet_{tag}_wall_max_s"]), 2,
+        )
+    out["fleet_agg_scaling_r2"] = round(
+        out["fleet_r2_tok_s"] / max(1e-9, out["fleet_r1_tok_s"]), 3
+    )
+    out["fleet_agg_scaling_r4"] = round(
+        out["fleet_r4_tok_s"] / max(1e-9, out["fleet_r1_tok_s"]), 3
+    )
+    out["fleet_affinity_hit_rate"] = out["fleet_r4_hit_rate"]
+    out["fleet_random_hit_rate"] = out["fleet_r4_random_hit_rate"]
+    out["fleet_single_engine_hit_rate"] = out["fleet_r1_hit_rate"]
+    out["fleet_exact"] = exact
+    progress(
+        f"fleet scaling: r2 {out['fleet_agg_scaling_r2']}x, r4 "
+        f"{out['fleet_agg_scaling_r4']}x; hit rate affinity "
+        f"{out['fleet_affinity_hit_rate']} vs random "
+        f"{out['fleet_random_hit_rate']} (single-engine "
+        f"{out['fleet_single_engine_hit_rate']}); exact={exact}"
+    )
+    out.update(_fleet_kill_leg(progress))
+    return out
+
+
+def _fleet_kill_leg(progress):
+    """Kill-one-replica chaos leg: a 3-replica stub-model ServeFleet,
+    one replica hard-killed mid-decode (step-triggered off its own
+    lease), death confirmed by the real detector, drained requests
+    requeued onto the survivors — requests lost MUST be 0, recovery
+    token-identical, every engine teardown leak-free. Stub model
+    (next = token+1 mod v): the fleet machinery is model-agnostic, so
+    the leg runs in seconds (the llama exactness tiers live in
+    tests/test_fleet.py)."""
+    import threading
+    import time as _time
+    from types import SimpleNamespace
+
+    try:
+        import jax
+
+        from nexus_tpu.api.types import ConfigMap
+        from nexus_tpu.cluster.store import ClusterStore, NotFoundError
+        from nexus_tpu.fleet import PrefixAffinityRouter, ServeFleet
+        from nexus_tpu.ha.lease import heartbeat_name
+        from nexus_tpu.ha.serve_failover import serve_replica_template
+        from nexus_tpu.runtime.serving import ServeRequest, ServingEngine
+
+        import jax.numpy as jnp
+
+        v = 13
+        cfg = SimpleNamespace(
+            n_layers=1, n_kv_heads=1, head_dim=8, dtype=jnp.float32,
+            max_seq_len=256, vocab_size=v,
+        )
+
+        def fwd(params, cfg_, tokens, cache):
+            logits = jax.nn.one_hot((tokens + 1) % v, v) * 10.0
+            new = {k: x for k, x in cache.items() if k != "n_valid"}
+            nv = cache.get("n_valid")
+            adv = tokens.shape[1] if nv is None else nv
+            new["length"] = cache["length"] + adv
+            return logits.astype(jnp.float32), new
+
+        def make_engine(rid):
+            return ServingEngine(
+                fwd, {}, cfg, batch_size=2, max_len=128, chunk=4,
+                kv_block_size=8, gauge_tags=[f"engine:{rid}"],
+            )
+
+        store = ClusterStore("bench-fleet-kill")
+        router = PrefixAffinityRouter([], block_size=8, affinity_depth=2)
+        fleet = ServeFleet(
+            make_engine, store, "bench", "fleet", replicas=3,
+            router=router, ttl_seconds=0.3, pace_s=0.012,
+        )
+        reqs = []
+        for f in range(6):
+            preamble = [(f * 2 + 1) % v] * 16
+            for i in range(3):
+                reqs.append(ServeRequest(
+                    prompt=preamble + [(i + 1) % v], max_new_tokens=100,
+                ))
+        fired = threading.Lock()
+
+        def kill_once(rid):
+            # kill the first replica whose OWN lease is born, ~0.1s
+            # into its serving: provably mid-decode with a live lease,
+            # so the death is confirmed by the real detector and the
+            # drain carries in-flight same-family rows
+            if fired.acquire(blocking=False):
+                fleet.kill_replica(rid, hard=True)
+
+        def watch(rid):
+            name = heartbeat_name(serve_replica_template("fleet", rid))
+            deadline = _time.monotonic() + 60.0
+            while _time.monotonic() < deadline:
+                try:
+                    store.get(ConfigMap.KIND, "bench", name)
+                except NotFoundError:
+                    _time.sleep(0.005)
+                    continue
+                _time.sleep(0.1)
+                kill_once(rid)
+                return
+
+        for rid in ("r0", "r1", "r2"):
+            threading.Thread(target=watch, args=(rid,),
+                             daemon=True).start()
+        results, report = fleet.run(reqs, timeout_s=120)
+        exact = True
+        for req, res in zip(reqs, results):
+            expect = [int(t) for t in req.prompt]
+            cur = expect[-1]
+            for _ in range(req.max_new_tokens):
+                cur = (cur + 1) % v
+                expect.append(cur)
+            if res is None or res.tokens != expect:
+                exact = False
+        leaked = 0
+        for metrics_log in report["replica_metrics"].values():
+            for m in metrics_log:
+                if (m.get("kv_allocated_blocks_final") or
+                        m.get("kv_reserved_blocks_final")):
+                    leaked += 1
+        rec = {
+            "fleet_kill_requests_lost": report["requests_lost"],
+            "fleet_kill_deaths": report["deaths"],
+            "fleet_kill_migrations": report["migrations"],
+            "fleet_kill_exact": exact,
+            "fleet_kill_leaky_teardowns": leaked,
+        }
+        if report["detections_s"]:
+            rec["fleet_kill_detection_s"] = round(
+                report["detections_s"][0], 4
+            )
+        progress(
+            f"fleet kill leg: lost={rec['fleet_kill_requests_lost']} "
+            f"deaths={rec['fleet_kill_deaths']} "
+            f"migrations={rec['fleet_kill_migrations']} exact={exact} "
+            f"detection={rec.get('fleet_kill_detection_s')}s"
+        )
+        return rec
+    except Exception as e:  # noqa: BLE001 — hermetic leg must not kill bench
+        progress(f"fleet kill leg failed: {type(e).__name__}: "
+                 f"{str(e)[:160]}")
+        return {}
+
+
 def _serve_only_stage(progress):
     """Serve-only stage (`make bench-serve`, NEXUS_BENCH_SERVE=only):
     the paged-KV ledger and the row-scaling point, CPU-runnable — the
@@ -1692,6 +1997,12 @@ def _serve_only_stage(progress):
     obs_env = os.environ.get("NEXUS_BENCH_SERVE_OBS", "1")
     if obs_env == "only":
         out.update(_serve_obs_scenarios(preset, progress, block, chunk))
+        return out
+    # NEXUS_BENCH_SERVE_FLEET=only: just the round-14 fleet scaling +
+    # routing A/B + kill-one-replica legs (`make bench-serve-fleet`)
+    fleet_env = os.environ.get("NEXUS_BENCH_SERVE_FLEET", "1")
+    if fleet_env == "only":
+        out.update(_serve_fleet_scenarios(preset, progress, block, chunk))
         return out
     legs = {}
     for rows in (4, 16):
@@ -1832,6 +2143,11 @@ def _serve_only_stage(progress):
     # artifact — the tentpole's acceptance ledger
     if obs_env not in ("0", "false"):
         out.update(_serve_obs_scenarios(preset, progress, block, chunk))
+    # ---- fleet scenarios (round 14): replicas 1/2/4 aggregate tok/s +
+    # goodput-under-SLO, affinity-vs-random routing A/B, and the
+    # kill-one-replica chaos leg — the tentpole's acceptance ledger
+    if fleet_env not in ("0", "false"):
+        out.update(_serve_fleet_scenarios(preset, progress, block, chunk))
     # ---- outage leg (round 7): kill-mid-decode → detector → requeue →
     # token-identical recovery, plus bounded-queue shed honesty — its
     # time-to-recover / requests-lost keys ride the per-round artifact
@@ -1920,6 +2236,18 @@ def _write_serve_artifact(sv):
             "value": round(value, 3),
             "unit": unit,
             "vs_baseline": round((2.0 - value) / 2.0, 4),
+        }
+    elif "fleet_agg_scaling_r4" in sv:
+        # focused round-14 runs (NEXUS_BENCH_SERVE_FLEET=only):
+        # headline the fleet's aggregate-throughput scaling at 4
+        # replicas (replicas-1 = 1.0; vs_baseline = value/4, the
+        # perfect-scaling share the fleet realizes)
+        val = float(sv.get("fleet_agg_scaling_r4") or 0.0)
+        rec = {
+            "metric": "serve_fleet_aggregate_scaling_r4",
+            "value": round(val, 3),
+            "unit": "x_agg_tok_s_vs_replicas_1",
+            "vs_baseline": round(val / 4.0, 3),
         }
     else:
         # focused runs (e.g. NEXUS_BENCH_SERVE_SPEC=only) carry no

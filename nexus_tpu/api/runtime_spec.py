@@ -610,6 +610,53 @@ class ServeSpec:
     # (seconds from engine start; 0 = none): expired rows cancel at the
     # next wave boundary with status `deadline_exceeded`
     request_deadline_s: float = 0.0
+    # ---- fleet serving (round 14, nexus_tpu/fleet/; docs/fleet.md) ----
+    # engine replica count: > 1 serves the queue through a FLEET of
+    # engines — the controller places one replica per healthy shard
+    # (sticky top-N rendezvous, controller/placement.py), a
+    # prefix-affinity router single-homes same-prefix traffic so cache
+    # locality survives load balancing, and replica death/scale-down
+    # drain-and-requeue onto survivors (ha/serve_failover.py). 1 = the
+    # single-engine path, bit-for-bit the pre-round-14 behavior.
+    replicas: int = 1
+    # request → replica assignment: "affinity" (default) rendezvous-
+    # hashes each prompt's radix chain-key prefix so same-preamble
+    # traffic lands on one replica's warm cache, with power-of-two-
+    # choices spill-over among the top candidates bounding hot-key
+    # imbalance; "random" is the cache-blind A/B baseline the fleet
+    # bench measures against
+    router_policy: str = "affinity"
+    # FULL prompt blocks hashed into the affinity key (the chain digest
+    # at this depth commits to every token through it): keep at or
+    # below the workload's shared-preamble depth in blocks — deeper
+    # keys fold request-specific tails into the hash and scatter a
+    # family across replicas
+    affinity_depth: int = 2
+    # power-of-two-choices width: the router reads live queue-depth
+    # gauges for this many top-affinity candidates and spills to a
+    # less-loaded one only when the affinity home is busier by at
+    # least spillThreshold requests (1 = pure affinity, no spill)
+    spill_candidates: int = 2
+    spill_threshold: int = 4
+    # SLO-driven autoscaling bounds (0/0 = fixed fleet, no autoscaler):
+    # the autoscaler reads each replica's live serve_ttft_p95_s /
+    # serve_queue_depth gauges (tagged engine:<id>) from the telemetry
+    # registry and steps the replica count within [min, max]. Acts in
+    # the SUPERVISED live harness (nexus_tpu/fleet/ServeFleet) — the
+    # one-shot template drive serves a fixed `replicas` fleet and
+    # reports `fleet_autoscale_active: false` when bounds are declared
+    autoscale_min: int = 0
+    autoscale_max: int = 0
+    # scale-up triggers: live ttft p95 above this (seconds; 0 = ignore
+    # ttft) or mean queue depth above queueDepthHigh (0 = ignore depth)
+    ttft_slo_s: float = 0.0
+    queue_depth_high: int = 0
+    # hysteresis, in autoscaler observation polls: this many CONSECUTIVE
+    # breached polls before a scale-up, and this many consecutive
+    # clear polls (every signal under half its threshold) before a
+    # scale-down — a one-poll spike or dip never moves the fleet
+    scale_breach_polls: int = 3
+    scale_clear_polls: int = 6
 
     def kv_request_cap(self, max_seq_len: int) -> int:
         """Worst-case cache positions ONE synthetic-queue request can
@@ -735,6 +782,27 @@ class ServeSpec:
             d["maxQueueDelaySeconds"] = self.max_queue_delay_s
         if self.request_deadline_s:
             d["requestDeadlineSeconds"] = self.request_deadline_s
+        if self.replicas != 1:
+            d["replicas"] = self.replicas
+        if self.router_policy != "affinity":
+            d["routerPolicy"] = self.router_policy
+        if self.affinity_depth != 2:
+            d["affinityDepth"] = self.affinity_depth
+        if self.spill_candidates != 2:
+            d["spillCandidates"] = self.spill_candidates
+        if self.spill_threshold != 4:
+            d["spillThreshold"] = self.spill_threshold
+        if self.autoscale_min or self.autoscale_max:
+            d["autoscaleMin"] = self.autoscale_min
+            d["autoscaleMax"] = self.autoscale_max
+        if self.ttft_slo_s:
+            d["ttftSloSeconds"] = self.ttft_slo_s
+        if self.queue_depth_high:
+            d["queueDepthHigh"] = self.queue_depth_high
+        if self.scale_breach_polls != 3:
+            d["scaleBreachPolls"] = self.scale_breach_polls
+        if self.scale_clear_polls != 6:
+            d["scaleClearPolls"] = self.scale_clear_polls
         return d
 
     @classmethod
@@ -767,6 +835,31 @@ class ServeSpec:
             max_queue_delay_s=float(d.get("maxQueueDelaySeconds", 0) or 0),
             request_deadline_s=float(
                 d.get("requestDeadlineSeconds", 0) or 0
+            ),
+            replicas=int(d.get("replicas", 1) or 1),
+            router_policy=str(d.get("routerPolicy") or "affinity"),
+            affinity_depth=int(
+                2 if d.get("affinityDepth") is None else d["affinityDepth"]
+            ),
+            spill_candidates=int(
+                2 if d.get("spillCandidates") is None
+                else d["spillCandidates"]
+            ),
+            spill_threshold=int(
+                4 if d.get("spillThreshold") is None
+                else d["spillThreshold"]
+            ),
+            autoscale_min=int(d.get("autoscaleMin", 0) or 0),
+            autoscale_max=int(d.get("autoscaleMax", 0) or 0),
+            ttft_slo_s=float(d.get("ttftSloSeconds", 0) or 0),
+            queue_depth_high=int(d.get("queueDepthHigh", 0) or 0),
+            scale_breach_polls=int(
+                3 if d.get("scaleBreachPolls") is None
+                else d["scaleBreachPolls"]
+            ),
+            scale_clear_polls=int(
+                6 if d.get("scaleClearPolls") is None
+                else d["scaleClearPolls"]
             ),
             num_requests=int(d.get("numRequests", 32) or 32),
             prompt_length_min=int(d.get("promptLengthMin", 16) or 16),
@@ -1330,6 +1423,72 @@ class JaxXlaRuntime:
             if sv.temperature < 0:
                 errs.append(
                     f"serve.temperature must be >= 0, got {sv.temperature}"
+                )
+            # ---- fleet serving (round 14; docs/fleet.md) ----
+            if sv.replicas < 1:
+                errs.append(
+                    f"serve.replicas must be >= 1, got {sv.replicas}"
+                )
+            if sv.router_policy not in ("affinity", "random"):
+                errs.append(
+                    "serve.routerPolicy must be 'affinity' or 'random' "
+                    f"(docs/fleet.md), got {sv.router_policy!r}"
+                )
+            if sv.affinity_depth < 1:
+                errs.append(
+                    "serve.affinityDepth must be >= 1, got "
+                    f"{sv.affinity_depth}"
+                )
+            if sv.spill_candidates < 1:
+                errs.append(
+                    "serve.spillCandidates must be >= 1 (1 = pure "
+                    f"affinity, no spill-over), got {sv.spill_candidates}"
+                )
+            if sv.spill_threshold < 1:
+                errs.append(
+                    "serve.spillThreshold must be >= 1, got "
+                    f"{sv.spill_threshold}"
+                )
+            if (sv.autoscale_min < 0 or sv.autoscale_max < 0
+                    or (sv.autoscale_max and not sv.autoscale_min)):
+                errs.append(
+                    "serve.autoscaleMin/autoscaleMax must be set "
+                    "together and >= 0 (0/0 = fixed fleet), got "
+                    f"{sv.autoscale_min}/{sv.autoscale_max}"
+                )
+            elif sv.autoscale_min:
+                if sv.autoscale_max < sv.autoscale_min:
+                    errs.append(
+                        f"serve.autoscaleMax ({sv.autoscale_max}) below "
+                        f"autoscaleMin ({sv.autoscale_min})"
+                    )
+                if not (sv.autoscale_min <= sv.replicas
+                        <= max(sv.autoscale_max, sv.autoscale_min)):
+                    errs.append(
+                        f"serve.replicas ({sv.replicas}) outside the "
+                        f"autoscale bounds [{sv.autoscale_min}, "
+                        f"{sv.autoscale_max}]"
+                    )
+                if sv.ttft_slo_s <= 0 and sv.queue_depth_high <= 0:
+                    errs.append(
+                        "autoscaling enabled but no scale signal: set "
+                        "serve.ttftSloSeconds and/or queueDepthHigh"
+                    )
+            if sv.ttft_slo_s < 0:
+                errs.append(
+                    f"serve.ttftSloSeconds must be >= 0, got "
+                    f"{sv.ttft_slo_s}"
+                )
+            if sv.queue_depth_high < 0:
+                errs.append(
+                    "serve.queueDepthHigh must be >= 0, got "
+                    f"{sv.queue_depth_high}"
+                )
+            if sv.scale_breach_polls < 1 or sv.scale_clear_polls < 1:
+                errs.append(
+                    "serve.scaleBreachPolls/scaleClearPolls must be "
+                    ">= 1 (hysteresis is counted in autoscaler polls), "
+                    f"got {sv.scale_breach_polls}/{sv.scale_clear_polls}"
                 )
             if sv.prompt_lookup_ngram > 0 and sv.draft is not None:
                 errs.append(

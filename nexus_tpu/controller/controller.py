@@ -207,6 +207,12 @@ class Controller:
         self.shard_health: dict = {s.name: True for s in self.shards}
         self._home: dict = {}
         self._home_avoid: dict = {}
+        # fleet serve placement (ServeSpec.replicas > 1 under workgroup
+        # scheduling="any"): the sticky ORDERED tuple of shard names the
+        # template's engine replicas are homed on — the N-home analogue
+        # of _home, kept separate so single-home semantics (home_of and
+        # the failover planner's lookups) stay byte-for-byte unchanged
+        self._replica_homes: dict = {}  # guarded-by: _health_lock
         self.failover_manager: Optional[FailoverManager] = (
             FailoverManager(self, failover) if failover is not None else None
         )
@@ -437,18 +443,33 @@ class Controller:
         with self._health_lock:
             return self._home.get((namespace, name))
 
+    def replica_homes_of(self, namespace: str, name: str) -> List[str]:
+        """Sticky N-home assignment of a fleet serve template
+        (ServeSpec.replicas > 1 under workgroup scheduling="any") — the
+        ordered shard names its engine replicas are placed on."""
+        with self._health_lock:
+            return list(self._replica_homes.get((namespace, name), ()))
+
     def evict_home(self, namespace: str, name: str, shard_name: str) -> None:
         """Failover hook: forget the sticky assignment and avoid the shard
-        the workload just died on when the next placement runs."""
+        the workload just died on when the next placement runs. For a
+        fleet serve template only the replica homed on the dead shard is
+        forgotten — the survivors keep their (warm-cache) assignments."""
         with self._health_lock:
             key = (namespace, name)
             if self._home.get(key) == shard_name:
                 del self._home[key]
+            homes = self._replica_homes.get(key)
+            if homes and shard_name in homes:
+                self._replica_homes[key] = tuple(
+                    h for h in homes if h != shard_name
+                )
             self._home_avoid[key] = shard_name
 
     def _drop_home(self, namespace: str, name: str) -> None:
         with self._health_lock:
             self._home.pop((namespace, name), None)
+            self._replica_homes.pop((namespace, name), None)
             self._home_avoid.pop((namespace, name), None)
 
     @staticmethod
@@ -899,6 +920,7 @@ class Controller:
         from nexus_tpu.controller.placement import (
             PlacementError,
             select_home,
+            select_replica_homes,
             select_shards,
         )
 
@@ -932,6 +954,26 @@ class Controller:
                 )
             if workgroup is not None and sched == "any":
                 key = (template.namespace, template.name)
+                replicas = self._serve_replicas(template)
+                if replicas > 1:
+                    # fleet serve workload (ServeSpec.replicas): N engine
+                    # replicas across distinct healthy shards — sticky
+                    # per replica (a healthy engine's warm prefix cache
+                    # is never migrated by a recomputation), dead shard
+                    # avoided, remainder by rendezvous rank so churn
+                    # moves only the replicas that lost their home
+                    with self._health_lock:
+                        current_homes = self._replica_homes.get(key, ())
+                        avoid = self._home_avoid.get(key)
+                    homes = select_replica_homes(
+                        template, workgroup, candidates, replicas,
+                        current=current_homes, avoid=avoid,
+                    )
+                    with self._health_lock:
+                        self._replica_homes[key] = tuple(
+                            h.name for h in homes
+                        )
+                    return homes
                 with self._health_lock:
                     current = self._home.get(key)
                     avoid = self._home_avoid.get(key)
@@ -952,6 +994,16 @@ class Controller:
                 str(e),
             )
             raise SyncError(str(e)) from e
+
+    @staticmethod
+    def _serve_replicas(template: NexusAlgorithmTemplate) -> int:
+        """The template's requested serve-engine replica count: >1 only
+        for a ``mode: serve`` runtime that declares ``replicas`` — every
+        other workload keeps the single-home path bit-for-bit."""
+        rt = template.spec.runtime
+        if rt is None or getattr(rt, "mode", "") != "serve":
+            return 1
+        return max(1, int(getattr(rt.serve, "replicas", 1) or 1))
 
     def _report_template_placement_error(
         self, template: NexusAlgorithmTemplate, msg: str

@@ -84,8 +84,20 @@ def rendezvous_pick(key: str, shards: Sequence[Shard]) -> Shard:
     max-weight shard. Removing a shard (failure) only moves the templates
     that were homed on it; every other assignment is unchanged — the
     placement-under-churn property the failover planner relies on so one
-    shard outage doesn't reshuffle the whole fleet.
+    shard outage doesn't reshuffle the whole fleet. Exactly
+    ``rendezvous_rank(key, shards)[0]`` — single-home and N-home
+    placement share ONE weight formula by construction.
     """
+    return rendezvous_rank(key, shards)[0]
+
+
+def rendezvous_rank(key: str, shards: Sequence[Shard]) -> List[Shard]:
+    """All shards ordered by descending rendezvous weight for ``key`` —
+    the multi-home generalization of :func:`rendezvous_pick` (rank[0]
+    is exactly its answer). Taking the top N gives the churn-minimal
+    N-replica placement: removing one shard promotes the former rank
+    N+1 into the set and moves ONLY the replica that was homed on the
+    removed shard; every other assignment is unchanged."""
     if not shards:
         raise PlacementError("rendezvous placement over zero shards")
 
@@ -94,7 +106,60 @@ def rendezvous_pick(key: str, shards: Sequence[Shard]) -> Shard:
             f"{key}\x00{shard.name}".encode(), digest_size=8
         ).digest()
 
-    return max(shards, key=weight)
+    return sorted(shards, key=weight, reverse=True)
+
+
+def select_replica_homes(
+    template: NexusAlgorithmTemplate,
+    workgroup: Optional[NexusAlgorithmWorkgroup],
+    shards: Sequence[Shard],
+    replicas: int,
+    current: Optional[Sequence[str]] = None,
+    avoid: Optional[str] = None,
+) -> List[Shard]:
+    """N-replica placement for a fleet serve workload (``ServeSpec
+    .replicas``): constraint-filter via :func:`select_shards`, then pick
+    ``replicas`` DISTINCT shards with the same three rules
+    :func:`select_home` applies per replica:
+
+      1. stickiness — shards in ``current`` that are still eligible (and
+         not ``avoid``) keep their replicas, in their existing order: a
+         healthy running engine is never migrated by a placement
+         recomputation (its HBM pool + host tier hold the warm prefix
+         cache the router's affinity hashing points traffic at);
+      2. ``avoid`` — the shard a replica just died on is skipped when
+         any alternative exists;
+      3. remaining slots fill from the rendezvous rank over the
+         survivors, so churn moves only the replicas that lost their
+         home.
+
+    Fewer eligible shards than ``replicas`` degrades to one replica per
+    eligible shard (the sync model places at most one engine per shard)
+    — the caller observes the shortfall through the returned length;
+    zero eligible shards is a PlacementError like every placement."""
+    if replicas < 1:
+        raise PlacementError(f"replicas must be >= 1, got {replicas}")
+    eligible = select_shards(template, workgroup, shards)
+    if not eligible:
+        raise PlacementError("replica placement over zero eligible shards")
+    by_name = {s.name: s for s in eligible}
+    homes: List[Shard] = []
+    for name in current or ():
+        # avoid beats stickiness (the select_home rule): a racing
+        # reconcile must not write a replica back onto its corpse
+        if name != avoid and name in by_name and len(homes) < replicas:
+            if all(h.name != name for h in homes):
+                homes.append(by_name[name])
+    ranked = rendezvous_rank(
+        template.metadata.uid or template.key(), eligible
+    )
+    pool = [s for s in ranked if s.name != avoid] or ranked
+    for s in pool:
+        if len(homes) >= replicas:
+            break
+        if all(h.name != s.name for h in homes):
+            homes.append(s)
+    return homes
 
 
 def select_home(

@@ -1,0 +1,32 @@
+"""Fleet-scale serving (round 14): replicated engines, prefix-affinity
+routing, SLO-driven autoscaling — the serve plane's analogue of the
+controller's multi-shard fan-out (PAPER.md's NCC pattern applied to
+engines instead of templates; docs/fleet.md).
+
+  * :mod:`~nexus_tpu.fleet.router` — :class:`PrefixAffinityRouter`:
+    rendezvous-hash each prompt's radix chain-key prefix to a replica
+    so same-prefix traffic single-homes (cache locality survives load
+    balancing), with power-of-two-choices spill-over on live
+    queue-depth gauges bounding hot-key imbalance.
+  * :mod:`~nexus_tpu.fleet.autoscaler` — :class:`SloAutoscaler`:
+    poll-driven replica-count control on the live ``serve_ttft_p95_s``
+    / ``serve_queue_depth`` gauges with breach/clear hysteresis and a
+    frozen-gauge staleness guard.
+  * :mod:`~nexus_tpu.fleet.fleet` — :class:`ServeFleet` (live threaded
+    harness: per-replica leases, detector-confirmed deaths,
+    drain-and-requeue onto survivors) and :func:`serve_fleet_local`
+    (the deterministic thread-free drive the entrypoint and bench use).
+"""
+
+from nexus_tpu.fleet.autoscaler import (  # noqa: F401
+    ReplicaSample,
+    ScaleDecision,
+    SloAutoscaler,
+    read_replica_sample,
+)
+from nexus_tpu.fleet.fleet import ServeFleet, serve_fleet_local  # noqa: F401
+from nexus_tpu.fleet.router import (  # noqa: F401
+    PrefixAffinityRouter,
+    affinity_key,
+    rendezvous_weight,
+)

@@ -1,0 +1,246 @@
+"""SLO-driven autoscaling for the serve fleet.
+
+The autoscaler is a pure poll-driven state machine in the failure
+detector's mold: no clocks, no threads, no stores — it consumes one
+:class:`ReplicaSample` per live replica per poll and answers "how many
+replicas should exist". Time enters only as POLL COUNTS (the fleet
+monitor polls on its own cadence), so every path unit-tests in
+microseconds and replays exactly.
+
+Signals (the PR 12 wave-boundary live gauges, read from the telemetry
+registry through the typed ``get_tagged``/``tagged_series`` path — no
+Prometheus text parsing):
+
+  * ``serve_ttft_p95_s`` tagged ``engine:<id>`` — the user-facing SLO:
+    scale up when any fresh replica's rolling p95 breaches
+    ``ttft_high_s``;
+  * ``serve_queue_depth`` tagged ``engine:<id>`` — the backlog signal:
+    scale up when the mean depth across fresh replicas breaches
+    ``queue_high``.
+
+Hysteresis: a breach must hold for ``breach_polls`` CONSECUTIVE polls
+before a scale-up, and every signal must sit below HALF its threshold
+for ``clear_polls`` consecutive polls before a scale-down — one spiky
+wave or one idle gap never moves the fleet, and the asymmetric bounds
+(clear is stricter than breach by default) bias toward serving the SLO
+over saving a replica.
+
+Staleness: a gauge registry keeps a frozen emitter's LAST values
+forever, so a wedged engine that stopped publishing would otherwise
+look permanently healthy (its last-known ttft was fine). Every
+emission carries the registry's global sequence number
+(``GaugeSample.seq``); a BUSY replica whose sequence hasn't advanced
+for ``stale_polls`` polls is STALE — excluded from every aggregate,
+reported in the decision so the fleet can cross-check the failure
+detector, and a blocker for scale-down (shrinking the fleet on signals
+we can't trust is the one unsafe direction). Idle replicas legitimately
+stop publishing between serve calls, so only busy ones accrue
+staleness.
+
+Scaling moves one replica per decision: scale-up placement/engine
+builds are expensive and the hysteresis window re-evaluates before the
+next step — ramping is polls × one, never a thundering herd.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+from nexus_tpu.utils.telemetry import (
+    METRIC_SERVE_QUEUE_DEPTH,
+    METRIC_SERVE_TTFT_P95,
+    StatsdClient,
+)
+
+
+class ReplicaSample(NamedTuple):
+    """One replica's vitals at one autoscaler poll. ``seq`` is the
+    newest registry emission sequence across the replica's gauge series
+    (0 = never published); ``busy`` is the fleet's local knowledge that
+    the replica is mid-serve (only busy replicas can be stale — an idle
+    engine publishing nothing is resting, not wedged). NaN signals mean
+    "never published" and are excluded from aggregates."""
+
+    replica_id: str
+    busy: bool
+    ttft_p95_s: float
+    queue_depth: float
+    seq: int
+
+
+def read_replica_sample(client: StatsdClient, replica_id: str,
+                        busy: bool) -> ReplicaSample:
+    """Build one replica's sample from the telemetry registry via the
+    typed per-engine read path (``tagged_series("engine:<id>")``)."""
+    series = client.tagged_series(f"engine:{replica_id}")
+    ttft = series.get(METRIC_SERVE_TTFT_P95)
+    depth = series.get(METRIC_SERVE_QUEUE_DEPTH)
+    seq = max((s.seq for s in series.values()), default=0)
+    return ReplicaSample(
+        replica_id=replica_id,
+        busy=bool(busy),
+        ttft_p95_s=float(ttft.value) if ttft is not None else float("nan"),
+        queue_depth=(
+            float(depth.value) if depth is not None else float("nan")
+        ),
+        seq=int(seq),
+    )
+
+
+class ScaleDecision(NamedTuple):
+    target: int  # desired replica count after this poll
+    current: int
+    reason: str  # human-readable cause ("" = hold)
+    stale: Tuple[str, ...]  # busy replicas with frozen gauges this poll
+    breach_streak: int
+    clear_streak: int
+
+
+class SloAutoscaler:
+    """Poll-driven replica-count controller (see module docstring).
+
+    Thread-safety: ``observe`` is called from the fleet monitor; the
+    per-replica staleness ledger and the hysteresis streaks are guarded
+    so introspection from other threads (tests, exposition) is safe."""
+
+    def __init__(
+        self,
+        min_replicas: int,
+        max_replicas: int,
+        ttft_high_s: float = 0.0,
+        queue_high: float = 0.0,
+        breach_polls: int = 3,
+        clear_polls: int = 6,
+        stale_polls: int = 3,
+    ) -> None:
+        if min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {min_replicas}"
+            )
+        if max_replicas < min_replicas:
+            raise ValueError(
+                f"max_replicas ({max_replicas}) below min_replicas "
+                f"({min_replicas})"
+            )
+        if ttft_high_s <= 0 and queue_high <= 0:
+            raise ValueError(
+                "autoscaler needs at least one scale signal: "
+                "ttft_high_s and/or queue_high"
+            )
+        if breach_polls < 1 or clear_polls < 1 or stale_polls < 1:
+            raise ValueError(
+                "breach_polls, clear_polls and stale_polls must be >= 1"
+            )
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.ttft_high_s = float(ttft_high_s)
+        self.queue_high = float(queue_high)
+        self.breach_polls = int(breach_polls)
+        self.clear_polls = int(clear_polls)
+        self.stale_polls = int(stale_polls)
+        self._lock = threading.Lock()
+        self._last_seq: Dict[str, int] = {}  # guarded-by: _lock
+        self._frozen_polls: Dict[str, int] = {}  # guarded-by: _lock
+        self._breach_streak = 0  # guarded-by: _lock
+        self._clear_streak = 0  # guarded-by: _lock
+        self.decisions = 0  # guarded-by: _lock
+
+    # ------------------------------------------------------------- staleness
+    def _update_staleness(self, samples) -> List[str]:  # guarded-by: _lock
+        """Per-poll staleness bookkeeping (caller holds ``_lock``):
+        a busy replica whose newest emission sequence did not advance
+        since the previous poll accrues one frozen poll; ``stale_polls``
+        of them make it stale. Any advance — or going idle — resets."""
+        stale: List[str] = []
+        seen = set()
+        for s in samples:
+            seen.add(s.replica_id)
+            prev = self._last_seq.get(s.replica_id)
+            # seq == 0 means the replica has NEVER published — a fresh
+            # scale-up busy with its first-serve compile, not a wedged
+            # emitter (the same silence window the lease birth rule
+            # exempts); staleness accrues only once gauges existed
+            if s.busy and prev is not None and 0 < s.seq <= prev:
+                n = self._frozen_polls.get(s.replica_id, 0) + 1
+                self._frozen_polls[s.replica_id] = n
+                if n >= self.stale_polls:
+                    stale.append(s.replica_id)
+            else:
+                self._frozen_polls[s.replica_id] = 0
+            self._last_seq[s.replica_id] = s.seq
+        for rid in list(self._last_seq):
+            if rid not in seen:  # replica left the fleet
+                del self._last_seq[rid]
+                self._frozen_polls.pop(rid, None)
+        return stale
+
+    # -------------------------------------------------------------- decision
+    def observe(self, samples: Sequence[ReplicaSample],
+                current: Optional[int] = None) -> ScaleDecision:
+        """One autoscaler poll → the desired replica count."""
+        cur = int(current if current is not None else len(samples))
+        with self._lock:
+            self.decisions += 1
+            stale = self._update_staleness(samples)
+            stale_set = set(stale)
+            fresh = [s for s in samples if s.replica_id not in stale_set]
+            ttfts = [s.ttft_p95_s for s in fresh
+                     if not math.isnan(s.ttft_p95_s)]
+            depths = [s.queue_depth for s in fresh
+                      if not math.isnan(s.queue_depth)]
+            breach_causes: List[str] = []
+            if self.ttft_high_s > 0 and ttfts:
+                worst = max(ttfts)
+                if worst > self.ttft_high_s:
+                    breach_causes.append(
+                        f"ttft_p95 {worst:.4f}s > slo {self.ttft_high_s}s"
+                    )
+            if self.queue_high > 0 and depths:
+                mean_depth = sum(depths) / len(depths)
+                if mean_depth > self.queue_high:
+                    breach_causes.append(
+                        f"mean queue depth {mean_depth:.1f} > "
+                        f"{self.queue_high:g}"
+                    )
+            breached = bool(breach_causes)
+            # "clear" is stricter than "not breached": every fresh
+            # signal under HALF its threshold — the hysteresis band
+            # between scale-up and scale-down where the fleet holds
+            clear = bool(fresh) and not stale and (
+                (self.ttft_high_s <= 0
+                 or all(t <= self.ttft_high_s / 2 for t in ttfts))
+                and (self.queue_high <= 0
+                     or all(d <= self.queue_high / 2 for d in depths))
+            )
+            self._breach_streak = self._breach_streak + 1 if breached else 0
+            self._clear_streak = self._clear_streak + 1 if clear else 0
+            target, reason = cur, ""
+            if (self._breach_streak >= self.breach_polls
+                    and cur < self.max_replicas):
+                target = cur + 1
+                reason = (
+                    f"scale up: {'; '.join(breach_causes)} for "
+                    f"{self._breach_streak} polls"
+                )
+                self._breach_streak = 0
+                self._clear_streak = 0
+            elif (self._clear_streak >= self.clear_polls
+                    and cur > self.min_replicas):
+                # scale-down additionally requires ZERO stale busy
+                # replicas this poll (enforced by `clear`): shrinking on
+                # signals we can't trust is the one unsafe direction
+                target = cur - 1
+                reason = (
+                    "scale down: all signals under half thresholds for "
+                    f"{self._clear_streak} polls"
+                )
+                self._clear_streak = 0
+                self._breach_streak = 0
+            return ScaleDecision(
+                target=target, current=cur, reason=reason,
+                stale=tuple(stale),
+                breach_streak=self._breach_streak,
+                clear_streak=self._clear_streak,
+            )

@@ -1,0 +1,761 @@
+"""The serve fleet: N engine replicas behind one router, with
+SLO-driven autoscaling and drain-and-requeue failover.
+
+Two drive modes share the router and the failover planner:
+
+  * :func:`serve_fleet_local` — the DETERMINISTIC, thread-free drive:
+    route the whole queue (priority-ordered), serve each replica's
+    partition on its own engine, merge results back into request order.
+    This is the entrypoint path (``ServeSpec.replicas > 1``) and the
+    bench's measurement harness: replicas model independent engines on
+    disjoint shards, so the CPU lane time-multiplexes them and reports
+    ``fleet_busy_max_s`` (the slowest replica's serve seconds — the
+    wall a real fleet would realize) next to the raw sum.
+  * :class:`ServeFleet` — the LIVE harness: each replica serves from an
+    inbox in its own worker thread while renewing a per-replica
+    ``hb-serve-<template>--<id>`` lease; one monitor thread probes the
+    shared :class:`~nexus_tpu.ha.detector.FailureDetector`, harvests
+    results, polls the :class:`~nexus_tpu.fleet.autoscaler
+    .SloAutoscaler`, and — on a confirmed replica death OR a
+    scale-down — drains the replica and requeues its work onto the
+    SURVIVORS through the PR 6 :class:`~nexus_tpu.ha.serve_failover
+    .ServeFailoverPlanner`: committed tokens fold into the merged
+    prompt, and because the router re-routes the requeued entries by
+    the SAME affinity hash (minus the dead replica — rendezvous moves
+    only its keys), a recovered cohort's shared prefixes re-match on
+    their new home exactly as PR 9 proved per-engine.
+
+Retries semantics: ``ServeResult.retries`` counts MIGRATIONS of any
+cause — replica death and graceful scale-down both requeue through the
+planner, so a request that completed on its second home reports
+``failed_over``/``retries >= 1`` either way (the honest record that
+more than one engine served it; docs/fleet.md).
+
+Engine caches are per-``serve()``-call (the allocator and radix index
+are built inside ``serve``), so affinity pays off WITHIN each routed
+batch — same-prefix requests single-home and dedupe in one admission
+stream. The cross-call warm-cache story (a persistent per-replica
+radix tree + host tier) is the disaggregated-serving ROADMAP item;
+the router is built for it (keys are stable across calls).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from nexus_tpu.fleet.autoscaler import SloAutoscaler, read_replica_sample
+from nexus_tpu.fleet.router import PrefixAffinityRouter
+from nexus_tpu.ha.detector import EVENT_LEASE_EXPIRED, FailureDetector
+from nexus_tpu.ha.lease import LeaseRenewer, heartbeat_name, list_heartbeats
+from nexus_tpu.ha.serve_failover import (
+    RequeueEntry,
+    ServeFailoverPlanner,
+    replica_of_serve_lease,
+    serve_replica_template,
+)
+from nexus_tpu.utils.telemetry import StatsdClient, get_client
+
+logger = logging.getLogger("nexus_tpu.fleet")
+
+
+# --------------------------------------------------------------- local drive
+
+def serve_fleet_local(
+    engines: Dict[str, Any],
+    router: PrefixAffinityRouter,
+    requests: Sequence[Any],
+    cancel: Any = None,
+    heartbeat: Optional[Callable[[int], None]] = None,
+    planner: Optional[ServeFailoverPlanner] = None,
+    clock: Callable[[], float] = time.monotonic,
+) -> Tuple[List[Optional[Any]], Dict[str, Any]]:
+    """Deterministic fleet drive (no threads, no store): route the
+    queue through ``router`` (priority-ordered), serve each replica's
+    partition on its engine, return ``(results, metrics)`` with
+    ``results[i]`` answering ``requests[i]``.
+
+    ``heartbeat`` is called at every wave boundary of every replica
+    with the FLEET-cumulative committed-token count (the entrypoint
+    wires it to the template's serve lease exactly as the single-engine
+    path does). A fired ``cancel`` drains the replica currently serving
+    at its next boundary and skips the rest; per-replica drains land in
+    ``metrics['interrupted']`` + each engine's own ``last_drain``.
+
+    Per-replica serve seconds ride the metrics: ``fleet_busy_max_s`` is
+    the slowest replica — the wall N independent shards would realize —
+    next to ``fleet_busy_sum_s`` (the time-multiplexed CPU-lane total).
+    """
+    planner = planner or ServeFailoverPlanner()
+    if router._load_fn is None:
+        # no injected load signal: the registry default reads live
+        # gauges, which are all unpublished during an upfront routing
+        # pass — spill-over would silently never fire. Pending routed
+        # counts are the real load here (see enable_pending_load).
+        router.enable_pending_load()
+    entries = planner.fresh(requests)
+    assignments = router.route_batch(entries)
+    partitions: Dict[str, List[RequeueEntry]] = {
+        rid: [] for rid in engines
+    }
+    for entry, rid, _spilled in assignments:
+        partitions[rid].append(entry)
+    results: List[Optional[Any]] = [None] * len(requests)
+    committed_total = [0]
+    per_replica: Dict[str, Dict[str, Any]] = {}
+    interrupted = False
+    busy: List[float] = []
+    walls: List[float] = []
+    for rid, engine in engines.items():
+        part = partitions.get(rid) or []
+        if not part:
+            per_replica[rid] = {"requests": 0, "busy_s": 0.0}
+            busy.append(0.0)
+            walls.append(0.0)
+            continue
+        base = committed_total[0]
+
+        def hb(step, _base=base):
+            committed_total[0] = _base + int(step)
+            if heartbeat is not None:
+                heartbeat(committed_total[0])
+
+        t0 = clock()
+        r_results, r_metrics = engine.serve(
+            [e.request for e in part], cancel=cancel, heartbeat=hb,
+        )
+        busy_s = clock() - t0
+        busy.append(busy_s)
+        # the engine's own wall excludes its program compiles (serve()
+        # warms up before starting its clock) — the honest per-replica
+        # serve time for throughput arithmetic
+        walls.append(float(r_metrics.get("wall_s", busy_s) or 0.0))
+        committed_total[0] = base + int(
+            r_metrics.get("committed_tokens", 0) or 0
+        )
+        for entry, res in zip(part, r_results):
+            if res is not None:
+                results[entry.request_idx] = planner.stitch(entry, res)
+        per_replica[rid] = {
+            **r_metrics, "requests": len(part),
+            "busy_s": round(busy_s, 6),
+        }
+        if r_metrics.get("interrupted"):
+            interrupted = True
+            break  # the cancel is fleet-wide: stop starting replicas
+    busy_max = max(busy) if busy else 0.0
+    wall_max = max(walls) if walls else 0.0
+    metrics: Dict[str, Any] = {
+        "fleet_replicas": len(engines),
+        "fleet_committed_tokens": committed_total[0],
+        "fleet_busy_max_s": round(busy_max, 6),
+        "fleet_busy_sum_s": round(sum(busy), 6),
+        "fleet_wall_max_s": round(wall_max, 6),
+        "fleet_prefix_hit_tokens": sum(
+            int(m.get("prefix_hit_tokens", 0) or 0)
+            for m in per_replica.values()
+        ),
+        "fleet_per_replica": per_replica,
+        "interrupted": interrupted,
+        # the single-engine ledger names, at fleet scope: committed
+        # total, and aggregate tok/s against the SLOWEST replica's
+        # compile-free serve wall — the wall N independent shards would
+        # realize (the CPU lane time-multiplexes replicas;
+        # fleet_busy_sum_s is the honest single-box total, compiles
+        # included)
+        "committed_tokens": committed_total[0],
+        "tokens_per_sec": round(
+            committed_total[0] / max(wall_max, 1e-9), 2
+        ),
+        **router.ledger(),
+    }
+    return results, metrics
+
+
+# ---------------------------------------------------------------- live fleet
+
+class _Replica:
+    """One live fleet member's shared state. Every mutable field below
+    the thread handle is guarded by the OWNING FLEET's ``_lock`` — a
+    cross-object guard NX-LOCK's per-class annotations can't express,
+    so the discipline here is structural: only ``ServeFleet`` methods
+    and ``_worker`` touch these, always inside ``with self._lock`` on
+    the fleet."""
+
+    def __init__(self, rid: str, engine: Any) -> None:
+        self.id = rid
+        self.engine = engine
+        self.thread: Optional[threading.Thread] = None
+        self.inbox: List[RequeueEntry] = []
+        self.busy = False
+        self.killed = False  # chaos/fence: renewals stop immediately
+        self.draining = False  # graceful scale-down: finish, don't take more
+        self.stopped = False  # worker thread exited
+        self.collected = False  # drain harvested by the monitor
+        self.cancel: Any = None
+        self.current_batch: Optional[List[RequeueEntry]] = None
+        self.pending_drain: Optional[Tuple[List[RequeueEntry], List[Any]]] = None
+        self.error: Optional[BaseException] = None
+        self.committed = 0
+        self.busy_s = 0.0
+        self.serve_calls = 0
+        self.metrics_log: List[dict] = []
+        self.flight_dumps: List[dict] = []
+
+
+class ServeFleet:
+    """Drive one serve queue to completion across N replicas, replica
+    deaths, and scale events (see module docstring).
+
+    ``make_engine(replica_id)`` builds one replica's engine — it SHOULD
+    pass ``gauge_tags=["engine:<replica_id>"]`` so the router's
+    spill-over and the autoscaler read that replica's live gauges.
+    ``concurrency`` bounds how many replicas serve simultaneously
+    (0 = all — the chaos/HA mode; 1 = time-multiplexed, the
+    deterministic CPU measurement mode)."""
+
+    def __init__(
+        self,
+        make_engine: Callable[[str], Any],
+        store: Any,
+        namespace: str,
+        template: str,
+        replicas: int = 2,
+        router: Optional[PrefixAffinityRouter] = None,
+        block_size: int = 32,
+        autoscaler: Optional[SloAutoscaler] = None,
+        planner: Optional[ServeFailoverPlanner] = None,
+        ttl_seconds: float = 0.25,
+        poll_s: Optional[float] = None,
+        pace_s: float = 0.0,
+        concurrency: int = 0,
+        max_failures: int = 3,
+        shard: str = "serve-fleet",
+        detector: Optional[FailureDetector] = None,
+        client: Optional[StatsdClient] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> None:
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.make_engine = make_engine
+        self.store = store
+        self.namespace = namespace
+        self.template = template
+        self.initial_replicas = int(replicas)
+        self.ttl = float(ttl_seconds)
+        self.poll_s = float(poll_s) if poll_s else max(0.01, self.ttl / 5.0)
+        self.pace_s = float(pace_s)
+        self.max_failures = int(max_failures)
+        self.shard = shard
+        self.planner = planner or ServeFailoverPlanner()
+        self.autoscaler = autoscaler
+        self.detector = detector or FailureDetector(
+            ttl_seconds=self.ttl, suspect_misses=2,
+            probe_interval=self.poll_s,
+        )
+        self.router = router or PrefixAffinityRouter(
+            [], block_size=block_size
+        )
+        # the router's default load signal is the live queue-depth
+        # gauge alone — 0 before any wave and frozen between serve
+        # calls; stack the fleet's not-yet-served inbox counts on top
+        # so routing sees work the engines haven't admitted yet.
+        # Applied whenever the caller injected no explicit signal
+        # (injected router included), mirroring serve_fleet_local
+        if self.router._load_fn is None:
+            self.router._load_fn = self._route_load
+        self._client = client or get_client()
+        self._clock = clock
+        self._sleep = sleep
+        self._sema = (
+            threading.BoundedSemaphore(int(concurrency))
+            if concurrency and concurrency > 0 else None
+        )
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, _Replica] = {}  # guarded-by: _lock
+        self._spawn_counter = 0  # guarded-by: _lock
+        self._finished: List[Tuple[RequeueEntry, Any]] = []  # guarded-by: _lock
+        self._shutdown = False  # guarded-by: _lock
+
+    # ------------------------------------------------------------------ load
+    def _route_load(self, rid: str) -> float:
+        from nexus_tpu.utils.telemetry import METRIC_SERVE_QUEUE_DEPTH
+
+        sample = self._client.get_tagged(
+            METRIC_SERVE_QUEUE_DEPTH, [f"engine:{rid}"]
+        )
+        live = float(sample.value) if sample is not None else 0.0
+        with self._lock:
+            rep = self._replicas.get(rid)
+            local = len(rep.inbox) if rep is not None else 0
+        return live + local
+
+    # ------------------------------------------------------------ membership
+    def alive_ids(self) -> List[str]:
+        with self._lock:
+            return [
+                rid for rid, r in self._replicas.items()
+                if not (r.killed or r.draining or r.stopped)
+            ]
+
+    def _spawn_replica(self) -> str:
+        with self._lock:
+            rid = f"r{self._spawn_counter}"
+            self._spawn_counter += 1
+        engine = self.make_engine(rid)
+        rep = _Replica(rid, engine)
+        with self._lock:
+            self._replicas[rid] = rep
+        t = threading.Thread(
+            target=self._worker, args=(rep,), daemon=True,
+            name=f"serve-fleet-{self.template}-{rid}",
+        )
+        rep.thread = t
+        t.start()
+        self.router.add_replica(rid)
+        return rid
+
+    # ------------------------------------------------------------------ chaos
+    def kill_replica(self, rid: str, hard: bool = True) -> bool:
+        """Launcher-style kill of one replica: its renewer falls silent
+        (the detector must confirm by lease expiry) and its current
+        serve call drains at the next wave boundary. Returns True if
+        the replica existed and was alive."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None or rep.killed or rep.stopped:
+                return False
+            rep.killed = True
+            cancel = rep.cancel
+        self.router.remove_replica(rid)
+        if cancel is not None:
+            cancel.cancel(hard=hard)
+        return True
+
+    # ----------------------------------------------------------------- worker
+    def _worker(self, rep: _Replica) -> None:
+        from nexus_tpu.utils.signals import CancelToken
+
+        renewer = LeaseRenewer(
+            self.store, self.namespace,
+            serve_replica_template(self.template, rep.id),
+            holder=rep.id, ttl_seconds=self.ttl,
+        )
+        idle_wait = max(0.005, self.ttl / 4.0)
+        # the lease is BORN at the replica's first served wave, not at
+        # spawn: an engine's first serve() call compiles its programs
+        # in silence, and a lease created before that gap would expire
+        # mid-compile and read as a death (the single-engine supervisor
+        # has the same property — its renewer first writes at the first
+        # wave boundary). No lease, nothing to confirm.
+        lease_live = [False]
+
+        def hb(step: int) -> None:
+            with self._lock:
+                silenced = rep.killed
+            if not silenced:
+                renewer.renew(int(step))
+                lease_live[0] = True
+            if self.pace_s > 0:
+                self._sleep(self.pace_s)
+
+        graceful = False
+        while True:
+            with self._lock:
+                if self._shutdown or rep.killed or rep.draining:
+                    graceful = rep.draining and not rep.killed
+                    break
+                has_work = bool(rep.inbox)
+            if not has_work:
+                if lease_live[0]:  # idle AFTER first serve: stay alive
+                    renewer.renew(rep.committed)
+                self._sleep(idle_wait)
+                continue
+            if self._sema is not None:
+                self._sema.acquire()
+            try:
+                with self._lock:
+                    if self._shutdown or rep.killed or rep.draining:
+                        graceful = rep.draining and not rep.killed
+                        break
+                    batch = rep.inbox
+                    rep.inbox = []
+                    if not batch:
+                        continue
+                    cancel = CancelToken()
+                    rep.cancel = cancel
+                    rep.current_batch = batch
+                    rep.busy = True
+                t0 = self._clock()
+                try:
+                    r_results, r_metrics = rep.engine.serve(
+                        [e.request for e in batch],
+                        cancel=cancel, heartbeat=hb,
+                    )
+                except BaseException as e:  # noqa: BLE001 — surfaced by run()
+                    with self._lock:
+                        rep.error = e
+                        rep.busy = False
+                        rep.stopped = True
+                    return
+                elapsed = self._clock() - t0
+            finally:
+                if self._sema is not None:
+                    self._sema.release()
+            drained = (
+                list(rep.engine.last_drain or [])
+                if r_metrics.get("interrupted") else []
+            )
+            dump = getattr(rep.engine, "last_flight_dump", None)
+            # fleet-side batch annotation: which serve calls carried
+            # MIGRATED entries (death/scale-down requeues) — the chaos
+            # tests and bench read re-match evidence off exactly these
+            r_metrics = dict(r_metrics)
+            r_metrics["fleet_batch_requests"] = len(batch)
+            r_metrics["fleet_batch_migrated"] = any(
+                int(getattr(e.request, "retries", 0) or 0) > 0
+                for e in batch
+            )
+            with self._lock:
+                rep.busy = False
+                rep.cancel = None
+                rep.current_batch = None
+                rep.serve_calls += 1
+                rep.busy_s += elapsed
+                rep.committed += int(
+                    r_metrics.get("committed_tokens", 0) or 0
+                )
+                rep.metrics_log.append(r_metrics)
+                if drained and dump is not None:
+                    rep.flight_dumps.append(dump)
+                for entry, res in zip(batch, r_results):
+                    if res is not None:
+                        self._finished.append((entry, res))
+                if drained:
+                    rep.pending_drain = (batch, drained)
+        if graceful and lease_live[0]:
+            # scale-down: mark the lease done so the detector reads the
+            # silence that follows as completion, never as a death
+            renewer.complete(rep.committed)
+        with self._lock:
+            rep.stopped = True
+
+    # ---------------------------------------------------------------- monitor
+    def _probe(self) -> List:
+        try:
+            heartbeats = list_heartbeats(self.store)
+        except Exception as e:  # noqa: BLE001 — outage is an observation
+            return self.detector.observe_api_error(self.shard, e)
+        return self.detector.observe(self.shard, heartbeats)
+
+    def _confirmed_replicas(self, events) -> List[Tuple[str, float]]:
+        out = []
+        for ev in events:
+            if ev.kind != EVENT_LEASE_EXPIRED or ev.lease is None:
+                continue
+            rid = replica_of_serve_lease(ev.lease.template, self.template)
+            if rid is not None:
+                out.append((rid, float(ev.detection_seconds)))
+        return out
+
+    def _reap_lease(self, rid: str) -> None:
+        from nexus_tpu.api.types import ConfigMap
+        from nexus_tpu.cluster.store import NotFoundError
+
+        try:
+            self.store.delete(
+                ConfigMap.KIND, self.namespace,
+                heartbeat_name(serve_replica_template(self.template, rid)),
+            )
+        except NotFoundError:
+            pass
+        except Exception:  # noqa: BLE001 — cleanup is advisory
+            logger.debug("fleet lease reap incomplete", exc_info=True)
+
+    def _dispatch(self, entries: Sequence[RequeueEntry],
+                  report: Dict[str, Any]) -> None:
+        """Route entries (priority-ordered) into replica inboxes. The
+        workers pick assigned batches up as soon as they land, so later
+        decisions of one dispatch already read live gauges."""
+        if not entries:
+            return
+        for entry, rid, _spilled in self.router.route_batch(entries):
+            with self._lock:
+                rep = self._replicas.get(rid)
+                if rep is not None and not (
+                    rep.killed or rep.draining or rep.stopped
+                ):
+                    rep.inbox.append(entry)
+                    continue
+            # raced a death/scale between rank and append: the router
+            # may still list the stale member (its removal runs after
+            # the killed flag lands), so rendezvous could hand the SAME
+            # dead replica back — drop stale members as we find them
+            # and retry until a live one answers or none remain
+            placed = False
+            for _ in range(8):
+                self.router.unroute(rid)  # the abandoned assignment
+                self.router.remove_replica(rid)
+                if not self.router.replicas():
+                    break
+                rid, _ = self.router.route(entry.request)
+                with self._lock:
+                    rep = self._replicas.get(rid)
+                    if rep is not None and not (
+                        rep.killed or rep.draining or rep.stopped
+                    ):
+                        rep.inbox.append(entry)
+                        placed = True
+                        break
+            if not placed:
+                raise RuntimeError(
+                    "no live replica to route to (all routed "
+                    "candidates dead or draining)"
+                )
+        report["dispatches"] = report.get("dispatches", 0) + len(entries)
+
+    def _collect_retired(self, rep: _Replica,
+                         report: Dict[str, Any]) -> List[RequeueEntry]:
+        """Harvest a dead/draining replica's unfinished work: drained
+        in-flight entries re-enter through the planner (committed
+        tokens folded into the merged prompt), never-admitted inbox
+        entries requeue verbatim — in that order, preserving the dying
+        engine's serving order ahead of its backlog."""
+        with self._lock:
+            pending = rep.pending_drain
+            rep.pending_drain = None
+            inbox = rep.inbox
+            rep.inbox = []
+            rep.collected = True
+            dumps = list(rep.flight_dumps)
+        requeued: List[RequeueEntry] = []
+        if pending is not None:
+            batch, drained = pending
+            requeued.extend(self.planner.requeue(batch, drained))
+        requeued.extend(inbox)
+        report["flight_dumps"].extend(dumps)
+        report["migrations"] += len(requeued)
+        return requeued
+
+    def _handle_death(self, rid: str, detection_s: Optional[float],
+                      report: Dict[str, Any]) -> None:
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None or rep.collected:
+                return
+            was_killed = rep.killed
+            rep.killed = True
+            cancel = rep.cancel
+        self.router.remove_replica(rid)
+        if not was_killed:
+            # confirmed dead with the process still serving: a WEDGED
+            # engine — fence it before its requests re-enter the queue
+            report["fenced_alive"] = True
+            if cancel is not None:
+                cancel.cancel(hard=True)
+        if rep.thread is not None:
+            rep.thread.join(timeout=30.0)
+        if rep.thread is not None and rep.thread.is_alive():
+            raise RuntimeError(
+                f"fleet replica {rid!r} did not stop within 30s of "
+                "fencing; its requests cannot be drained in-process"
+            )
+        report["deaths"] += 1
+        if detection_s is not None:
+            report["detections_s"].append(detection_s)
+        if report["deaths"] > self.max_failures:
+            raise RuntimeError(
+                f"serve fleet gave up after {self.max_failures} replica "
+                "deaths"
+            )
+        requeued = self._collect_retired(rep, report)
+        self._reap_lease(rid)
+        if not self.alive_ids():
+            # last replica died: spawn a replacement or the queue
+            # strands (the single-engine supervisor's restart, at
+            # fleet scope)
+            new_rid = self._spawn_replica()
+            report["scale_events"].append(
+                {"kind": "respawn", "replica": new_rid}
+            )
+        self._dispatch(requeued, report)
+
+    def _lease_exists(self, rid: str) -> bool:
+        from nexus_tpu.api.types import ConfigMap
+        from nexus_tpu.cluster.store import NotFoundError
+
+        try:
+            self.store.get(
+                ConfigMap.KIND, self.namespace,
+                heartbeat_name(serve_replica_template(self.template, rid)),
+            )
+            return True
+        except NotFoundError:
+            return False
+        except Exception:  # noqa: BLE001 — outage: let the detector decide
+            return True
+
+    def _harvest_leaseless_kills(self, report: Dict[str, Any]) -> None:
+        """A replica killed DURING ITS FIRST serve's program compile
+        never renewed, so its lease was never born and the detector has
+        nothing to confirm — but its worker has exited and its drain
+        snapshot is final. Requeue directly; every killed replica whose
+        lease DOES exist still waits for detector confirmation (the
+        PR 6 discipline: never requeue work an unconfirmed engine might
+        still be committing)."""
+        with self._lock:
+            candidates = [
+                r for r in self._replicas.values()
+                if r.killed and r.stopped and not r.collected
+            ]
+        for rep in candidates:
+            if not self._lease_exists(rep.id):
+                self._handle_death(rep.id, None, report)
+
+    def _scale_down(self, report: Dict[str, Any], reason: str) -> None:
+        # LIFO victim: the newest replica has the coldest cache and the
+        # fewest affinity keys homed on it
+        alive = self.alive_ids()
+        if len(alive) <= 1:
+            return
+        rid = alive[-1]
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None:
+                return
+            rep.draining = True
+            cancel = rep.cancel
+        self.router.remove_replica(rid)
+        if cancel is not None:
+            cancel.cancel(hard=False)
+        report["scale_events"].append(
+            {"kind": "down", "replica": rid, "reason": reason}
+        )
+
+    def _scale_up(self, report: Dict[str, Any], reason: str) -> None:
+        rid = self._spawn_replica()
+        report["scale_events"].append(
+            {"kind": "up", "replica": rid, "reason": reason}
+        )
+
+    def _autoscale_poll(self, report: Dict[str, Any]) -> None:
+        if self.autoscaler is None:
+            return
+        alive = self.alive_ids()
+        if not alive:
+            return
+        samples = []
+        with self._lock:
+            busy = {
+                rid: self._replicas[rid].busy
+                for rid in alive if rid in self._replicas
+            }
+        for rid in alive:
+            samples.append(read_replica_sample(
+                self._client, rid, busy=busy.get(rid, False)
+            ))
+        decision = self.autoscaler.observe(samples, current=len(alive))
+        if decision.stale:
+            report["stale_observations"] += 1
+        if decision.target > decision.current:
+            self._scale_up(report, decision.reason)
+        elif decision.target < decision.current:
+            self._scale_down(report, decision.reason)
+
+    # -------------------------------------------------------------------- run
+    def run(self, requests: Sequence[Any], timeout_s: float = 180.0
+            ) -> Tuple[List[Optional[Any]], Dict[str, Any]]:
+        """Serve ``requests`` to terminal results across the fleet →
+        ``(results, report)``. ``results[i]`` answers ``requests[i]``
+        (None only for requests genuinely lost — the acceptance gate
+        requires zero). The report carries deaths/detections, scale
+        events, migrations, the router ledger, per-replica serve
+        metrics (``replica_metrics`` — every engine teardown's pool
+        partition rides here for the leak audit), and flight dumps of
+        every drained generation."""
+        results: List[Optional[Any]] = [None] * len(requests)
+        report: Dict[str, Any] = {
+            "deaths": 0,
+            "detections_s": [],
+            "migrations": 0,
+            "fenced_alive": False,
+            "scale_events": [],
+            "stale_observations": 0,
+            "flight_dumps": [],
+        }
+        for _ in range(self.initial_replicas):
+            self._spawn_replica()
+        try:
+            entries = self.planner.fresh(requests)
+            self._dispatch(entries, report)
+            deadline = self._clock() + float(timeout_s)
+            while True:
+                with self._lock:
+                    finished = self._finished
+                    self._finished = []
+                    errors = [
+                        r.error for r in self._replicas.values()
+                        if r.error is not None
+                    ]
+                if errors:
+                    raise errors[0]
+                for entry, res in finished:
+                    results[entry.request_idx] = self.planner.stitch(
+                        entry, res
+                    )
+                if all(r is not None for r in results):
+                    break
+                if self._clock() > deadline:
+                    raise TimeoutError(
+                        f"fleet serve of {self.template!r} exceeded "
+                        f"{timeout_s}s with "
+                        f"{sum(1 for r in results if r is None)} requests "
+                        "outstanding"
+                    )
+                for rid, detection in self._confirmed_replicas(
+                    self._probe()
+                ):
+                    self._handle_death(rid, detection, report)
+                self._harvest_leaseless_kills(report)
+                # graceful scale-down drains complete asynchronously:
+                # harvest any retired replica whose worker has exited
+                with self._lock:
+                    retired = [
+                        r for r in self._replicas.values()
+                        if r.draining and r.stopped and not r.collected
+                        and not r.killed
+                    ]
+                for rep in retired:
+                    self._dispatch(
+                        self._collect_retired(rep, report), report
+                    )
+                self._autoscale_poll(report)
+                self._sleep(self.poll_s)
+        finally:
+            with self._lock:
+                self._shutdown = True
+                threads = [
+                    r.thread for r in self._replicas.values()
+                    if r.thread is not None
+                ]
+            for t in threads:
+                t.join(timeout=30.0)
+        with self._lock:
+            report["replica_metrics"] = {
+                rid: list(r.metrics_log)
+                for rid, r in self._replicas.items()
+            }
+            report["replica_committed"] = {
+                rid: r.committed for rid, r in self._replicas.items()
+            }
+            report["replica_busy_s"] = {
+                rid: round(r.busy_s, 6)
+                for rid, r in self._replicas.items()
+            }
+            report["replicas_started"] = self._spawn_counter
+        report.update(self.router.ledger())
+        report["requests_lost"] = sum(1 for r in results if r is None)
+        return results, report

@@ -1,0 +1,287 @@
+"""Prefix-affinity request routing for the serve fleet.
+
+The single-engine serve plane wins most of its throughput from KV
+reuse: the radix prefix cache (PR 9), the host spill tier under it
+(PR 10), and Hydragen's shared-prefix decomposition (PR 8) all feed on
+same-prefix requests LANDING ON THE SAME ENGINE. A cache-blind load
+balancer destroys exactly that: scatter a 24-request family with one
+system preamble across 4 replicas and the preamble prefills four times
+— four cold leaders instead of one — and every replica's radix tree
+holds a quarter of the family's warmth (SGLang's cache-aware routing
+observation, PAPERS.md).
+
+:class:`PrefixAffinityRouter` keeps locality through load balancing:
+
+  * **Affinity key** — each prompt's radix chain keys (the PR 9 digest
+    chain, ``runtime/prefix_cache.py::chain_keys``, reused not
+    reimplemented) hashed to depth ``affinity_depth`` FULL blocks. A
+    chain digest commits to every token through its block, so two
+    prompts share the key iff they agree on the whole prefix through
+    that depth — the same collision-safety argument the prefix cache
+    itself rests on. Prompts without a full block hash their raw
+    leading tokens instead (identical short prompts still single-home).
+  * **Rendezvous choice** — the key rendezvous-hashes over the live
+    replica set (the ``controller/placement.py`` rule at the request
+    level): replica death or scale-down re-homes ONLY the keys that
+    lived on the removed replica; every other family stays put on its
+    warm cache.
+  * **Load-aware spill-over** — pure affinity piles a hot key's whole
+    family on one replica no matter how deep its queue grows. The
+    router ranks the top ``spill_candidates`` replicas by affinity
+    weight and applies power-of-two-choices among them, reading each
+    candidate's live load (``serve_queue_depth`` tagged
+    ``engine:<id>``, published by the PR 12 wave-boundary gauges, read
+    through the registry's typed ``get_tagged`` path — plus whatever
+    the fleet already assigned locally); it spills off the affinity
+    home only when the home is busier by at least ``spill_threshold``
+    requests, so locality is the default and imbalance is bounded, not
+    chased per request.
+
+Priority contract (docs/fleet.md is the one normative home):
+``ServeRequest.priority`` orders FLEET DISPATCH — :meth:`route_batch`
+routes higher-priority requests first, so when load forces spill-over
+it is the low-priority tail that migrates off warm caches — exactly as
+it orders shedding inside an engine (lowest sheds first). Within one
+engine, admission ordering remains the engine's ``admission_policy``.
+
+Routing is scheduling, never semantics: whatever the assignment,
+results are token-for-token identical (the fleet bench re-proves it
+in-run via ``fleet_exact``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from nexus_tpu.runtime.prefix_cache import chain_keys
+from nexus_tpu.utils.telemetry import (
+    METRIC_SERVE_QUEUE_DEPTH,
+    StatsdClient,
+    get_client,
+)
+
+ROUTER_POLICIES = ("affinity", "random")
+
+
+def affinity_key(prompt: Sequence[int], block_size: int,
+                 depth: int = 2) -> bytes:
+    """The routing digest of a prompt: its radix chain key at
+    ``min(full blocks, depth)`` — commits to every token of the prefix
+    through that block. Sub-block prompts (no full block to key) hash
+    their raw leading tokens so identical short prompts still share a
+    home. ``depth`` should not exceed the workload's shared-preamble
+    depth in blocks: a deeper key folds request-specific tail tokens
+    into the digest and scatters the family."""
+    if depth < 1:
+        raise ValueError(f"affinity depth must be >= 1, got {depth}")
+    keys = chain_keys(prompt, block_size, limit=depth)
+    if keys:
+        return keys[-1]
+    head = np.asarray(list(prompt)[:block_size], dtype=np.int32)
+    return hashlib.sha256(b"sub-block:" + head.tobytes()).digest()
+
+
+def rendezvous_weight(key: bytes, replica_id: str) -> bytes:
+    """Stable pseudo-random weight of (affinity key, replica) — the
+    highest-random-weight rule ``controller/placement.py`` uses for
+    shard homes, applied per request key."""
+    return hashlib.blake2b(
+        key + b"\x00" + replica_id.encode(), digest_size=8
+    ).digest()
+
+
+class PrefixAffinityRouter:
+    """Assign requests to replicas, preserving prefix locality.
+
+    ``load_fn(replica_id) -> float`` injects the spill-over load signal;
+    the default reads the replica's live ``serve_queue_depth`` gauge
+    (tagged ``engine:<id>``) from the telemetry registry — the fleet
+    adds its locally-known pending counts on top. ``policy="random"``
+    is the cache-blind baseline (seeded, deterministic) the fleet bench
+    A/Bs against.
+
+    Thread-safety: the replica set shrinks on confirmed deaths and
+    grows on scale-up from the fleet monitor while workers run —
+    membership reads/writes hold ``_lock``."""
+
+    def __init__(
+        self,
+        replica_ids: Sequence[str],
+        block_size: int,
+        affinity_depth: int = 2,
+        spill_candidates: int = 2,
+        spill_threshold: int = 4,
+        policy: str = "affinity",
+        load_fn: Optional[Callable[[str], float]] = None,
+        client: Optional[StatsdClient] = None,
+        seed: int = 0,
+    ) -> None:
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if policy not in ROUTER_POLICIES:
+            raise ValueError(
+                f"router policy must be one of {ROUTER_POLICIES}, "
+                f"got {policy!r}"
+            )
+        if spill_candidates < 1:
+            raise ValueError(
+                f"spill_candidates must be >= 1, got {spill_candidates}"
+            )
+        if spill_threshold < 1:
+            raise ValueError(
+                f"spill_threshold must be >= 1, got {spill_threshold}"
+            )
+        self.block_size = int(block_size)
+        self.affinity_depth = int(affinity_depth)
+        self.spill_candidates = int(spill_candidates)
+        self.spill_threshold = int(spill_threshold)
+        self.policy = policy
+        self._load_fn = load_fn
+        self._client = client
+        self._rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
+        self._replicas: List[str] = list(replica_ids)  # guarded-by: _lock
+        # ---- routing ledger (monitor-thread writes) ----
+        self.routed: Dict[str, int] = {}  # guarded-by: _lock
+        self.spills = 0  # guarded-by: _lock — non-affinity-home placements
+        self.decisions = 0  # guarded-by: _lock
+
+    def _pending_load(self, rid: str) -> float:
+        with self._lock:
+            return float(self.routed.get(rid, 0))
+
+    def enable_pending_load(self) -> None:
+        """Switch the spill-over load signal to the router's OWN routed
+        counts — the offline routing pass's analogue of live queue
+        depth. An upfront pass (``serve_fleet_local``, the bench legs)
+        routes the whole queue before any engine has published a gauge,
+        so the registry default would read 0.0 everywhere and silently
+        disable spill-over; pending-assigned counts are the load that
+        actually exists at that point."""
+        self._load_fn = self._pending_load
+
+    # ------------------------------------------------------------ membership
+    def replicas(self) -> List[str]:
+        with self._lock:
+            return list(self._replicas)
+
+    def set_replicas(self, replica_ids: Sequence[str]) -> None:
+        with self._lock:
+            self._replicas = list(replica_ids)
+
+    def add_replica(self, replica_id: str) -> None:
+        with self._lock:
+            if replica_id not in self._replicas:
+                self._replicas.append(replica_id)
+
+    def remove_replica(self, replica_id: str) -> None:
+        with self._lock:
+            self._replicas = [r for r in self._replicas if r != replica_id]
+
+    def unroute(self, replica_id: str) -> None:
+        """Roll back one routed count for an ABANDONED assignment (the
+        replica died between routing and delivery and the entry is
+        being re-routed) — keeps the per-replica ledger, and with
+        pending-load enabled the spill-over signal, honest through
+        re-route races. The decision count stands: a re-route is a
+        second decision."""
+        with self._lock:
+            n = self.routed.get(replica_id, 0)
+            if n > 1:
+                self.routed[replica_id] = n - 1
+            elif n:
+                del self.routed[replica_id]
+
+    # --------------------------------------------------------------- routing
+    def _load(self, replica_id: str) -> float:
+        if self._load_fn is not None:
+            return float(self._load_fn(replica_id))
+        client = self._client or get_client()
+        sample = client.get_tagged(
+            METRIC_SERVE_QUEUE_DEPTH, [f"engine:{replica_id}"]
+        )
+        return float(sample.value) if sample is not None else 0.0
+
+    def rank(self, key: bytes) -> List[str]:
+        """The live replica set by DESCENDING affinity weight for
+        ``key`` — rank[0] is the affinity home; churn in the set moves
+        only the keys homed on the changed replica (rendezvous)."""
+        with self._lock:
+            reps = list(self._replicas)
+        if not reps:
+            raise RuntimeError("router has zero live replicas")
+        return sorted(
+            reps, key=lambda r: rendezvous_weight(key, r), reverse=True
+        )
+
+    def route(self, request) -> Tuple[str, bool]:
+        """One request → ``(replica_id, spilled)``: the affinity home
+        unless power-of-two-choices found a top candidate less loaded
+        by at least ``spill_threshold`` (``spilled=True`` then). The
+        ``random`` policy draws uniformly over live replicas — the
+        cache-blind baseline."""
+        if self.policy == "random":
+            with self._lock:
+                reps = list(self._replicas)
+                if not reps:
+                    raise RuntimeError("router has zero live replicas")
+                chosen = reps[int(self._rng.randint(len(reps)))]
+                self.decisions += 1
+                self.routed[chosen] = self.routed.get(chosen, 0) + 1
+            return chosen, False
+        key = affinity_key(
+            request.prompt, self.block_size, self.affinity_depth
+        )
+        ranked = self.rank(key)
+        candidates = ranked[: self.spill_candidates]
+        chosen = candidates[0]
+        spilled = False
+        if len(candidates) > 1:
+            loads = [self._load(r) for r in candidates]
+            best = min(range(len(candidates)), key=lambda i: loads[i])
+            # affinity wins ties AND small imbalances: spill only when
+            # the home is busier by the full threshold — bounded hot-key
+            # imbalance without chasing per-request noise off warm caches
+            if best != 0 and loads[0] - loads[best] >= self.spill_threshold:
+                chosen = candidates[best]
+                spilled = True
+        with self._lock:
+            self.decisions += 1
+            self.spills += int(spilled)
+            self.routed[chosen] = self.routed.get(chosen, 0) + 1
+        return chosen, spilled
+
+    def route_batch(self, entries: Sequence) -> List[Tuple[object, str, bool]]:
+        """Route a batch of queue entries (anything carrying a
+        ``.request``) → ``[(entry, replica_id, spilled), ...]``.
+
+        The batch is routed in PRIORITY order — higher
+        ``ServeRequest.priority`` first, FIFO within a priority tier
+        (the fleet half of the priority contract, docs/fleet.md): when
+        load forces spill-over it is the low-priority tail, routed
+        last into the fullest queues, that migrates off the warm
+        affinity homes. The returned list is in routing order, so
+        replica inboxes inherit it."""
+        order = sorted(
+            range(len(entries)),
+            key=lambda i: (-int(getattr(
+                entries[i].request, "priority", 0) or 0), i),
+        )
+        out: List[Tuple[object, str, bool]] = []
+        for i in order:
+            rid, spilled = self.route(entries[i].request)
+            out.append((entries[i], rid, spilled))
+        return out
+
+    def ledger(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "router_policy": self.policy,
+                "router_decisions": self.decisions,
+                "router_spills": self.spills,
+                "router_routed": dict(self.routed),
+            }

@@ -87,6 +87,27 @@ def strip_serve_prefix(lease_template: str) -> str:
     return lease_template
 
 
+def serve_replica_template(template_name: str, replica_id: str) -> str:
+    """Lease template of ONE fleet engine replica: each replica of a
+    fleet serve workload (nexus_tpu/fleet/) renews its own
+    ``hb-serve-<template>--<replica>`` lease, so the one detector the
+    fleet monitor runs confirms deaths per REPLICA — the double dash
+    keeps the replica id parseable out of the lease name even when the
+    template name itself contains dashes."""
+    return serve_heartbeat_template(f"{template_name}--{replica_id}")
+
+
+def replica_of_serve_lease(lease_template: str,
+                           template_name: str) -> Optional[str]:
+    """The replica id a fleet serve lease belongs to, or None when the
+    lease is not a replica lease of ``template_name`` (the inverse of
+    :func:`serve_replica_template`)."""
+    prefix = SERVE_HB_PREFIX + template_name + "--"
+    if lease_template.startswith(prefix):
+        return lease_template[len(prefix):]
+    return None
+
+
 def freeze_engine(store, namespace: str, template_name: str) -> None:
     """Chaos hook ("wedge engine"): freeze a serving engine's heartbeat
     lease so its renewer stops touching it while the engine process

@@ -114,12 +114,20 @@ class LiveGauges:
                 committed_tokens: int, waves: int) -> None:
         c = self.client
         tags = self.tags or None
-        c.gauge(METRIC_SERVE_QUEUE_DEPTH, queue_depth, tags=tags)
-        c.gauge(METRIC_SERVE_RUNNING_ROWS, running_rows, tags=tags)
-        c.gauge(METRIC_SERVE_FREE_BLOCKS, free_pool_blocks, tags=tags)
-        c.gauge(METRIC_SERVE_HOST_BYTES, host_cache_bytes, tags=tags)
-        c.gauge(METRIC_SERVE_COMMITTED, committed_tokens, tags=tags)
-        c.gauge(METRIC_SERVE_WAVES, waves, tags=tags)
+        # every gauge of this boundary is stamped with the engine's wave
+        # count — the per-series freshness record (GaugeSample.stamp)
+        # the fleet autoscaler compares across polls: a wedged engine's
+        # stamp (and the registry seq) stops advancing, so its frozen
+        # last-known-good values can't pass for live health
+        w = float(waves)
+        c.gauge(METRIC_SERVE_QUEUE_DEPTH, queue_depth, tags=tags, stamp=w)
+        c.gauge(METRIC_SERVE_RUNNING_ROWS, running_rows, tags=tags, stamp=w)
+        c.gauge(METRIC_SERVE_FREE_BLOCKS, free_pool_blocks, tags=tags,
+                stamp=w)
+        c.gauge(METRIC_SERVE_HOST_BYTES, host_cache_bytes, tags=tags,
+                stamp=w)
+        c.gauge(METRIC_SERVE_COMMITTED, committed_tokens, tags=tags, stamp=w)
+        c.gauge(METRIC_SERVE_WAVES, waves, tags=tags, stamp=w)
         for (name50, name95), win in (
             ((METRIC_SERVE_TTFT_P50, METRIC_SERVE_TTFT_P95), self.ttft),
             ((METRIC_SERVE_QUEUE_P50, METRIC_SERVE_QUEUE_P95),
@@ -128,5 +136,5 @@ class LiveGauges:
             p50, p95 = win.percentiles((0.50, 0.95))
             for name, v in ((name50, p50), (name95, p95)):
                 if not math.isnan(v):  # empty window: omit, never 0.0
-                    c.gauge(name, round(v, 6), tags=tags)
+                    c.gauge(name, round(v, 6), tags=tags, stamp=w)
         self.publishes += 1

@@ -991,48 +991,117 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None):
             from nexus_tpu.obs import ServeTracer
 
             tracer = ServeTracer()
-        engine = ServingEngine(
-            family.forward_decode, params, cfg,
-            tracer=tracer,
-            batch_size=tr.batch_size,
-            max_len=cfg.max_seq_len,
-            stop_token_id=sv.stop_token_id,
-            chunk=sv.chunk,
-            cache_sharding=cache_sharding,
-            lookup_ngram=sv.prompt_lookup_ngram,
-            num_speculative=sv.num_speculative,
-            **draft_kw,
-            prefill_chunk=sv.prefill_chunk,
-            kv_block_size=sv.kv_block_size,
-            # the ONE sizing formula validate()'s HBM gate also uses —
-            # pool capacity and admission can't drift from the spec
-            kv_num_blocks=sv.kv_pool_blocks(
-                tr.batch_size, cfg.max_seq_len
-            ),
-            prefix_cache=sv.prefix_cache,
-            max_queue_depth=sv.max_queue_depth,
-            max_queue_delay_s=sv.max_queue_delay_s,
-            attention_path=sv.attention_path,
-            admission_policy=sv.admission_policy,
-            admission_aging_waves=sv.admission_aging_waves,
-            # tiered KV cache (round 10): the quantized block pool and
-            # the host-RAM spill tier under it
-            kv_pool_dtype=sv.kv_pool_dtype,
-            host_cache_bytes=sv.host_cache_bytes,
-            host_cache_dtype=sv.host_cache_dtype,
-        )
-        results, metrics = engine.serve(
-            requests, cancel=cancel, heartbeat=heartbeat,
-        )
+        def make_engine(gauge_tags=None, engine_tracer=None):
+            return ServingEngine(
+                family.forward_decode, params, cfg,
+                tracer=engine_tracer,
+                batch_size=tr.batch_size,
+                max_len=cfg.max_seq_len,
+                stop_token_id=sv.stop_token_id,
+                chunk=sv.chunk,
+                cache_sharding=cache_sharding,
+                lookup_ngram=sv.prompt_lookup_ngram,
+                num_speculative=sv.num_speculative,
+                **draft_kw,
+                prefill_chunk=sv.prefill_chunk,
+                kv_block_size=sv.kv_block_size,
+                # the ONE sizing formula validate()'s HBM gate also
+                # uses — pool capacity and admission can't drift from
+                # the spec
+                kv_num_blocks=sv.kv_pool_blocks(
+                    tr.batch_size, cfg.max_seq_len
+                ),
+                prefix_cache=sv.prefix_cache,
+                max_queue_depth=sv.max_queue_depth,
+                max_queue_delay_s=sv.max_queue_delay_s,
+                attention_path=sv.attention_path,
+                admission_policy=sv.admission_policy,
+                admission_aging_waves=sv.admission_aging_waves,
+                # tiered KV cache (round 10): the quantized block pool
+                # and the host-RAM spill tier under it
+                kv_pool_dtype=sv.kv_pool_dtype,
+                host_cache_bytes=sv.host_cache_bytes,
+                host_cache_dtype=sv.host_cache_dtype,
+                gauge_tags=gauge_tags,
+            )
+
+        if sv.replicas > 1:
+            # fleet serving (round 14, docs/fleet.md): N engine
+            # replicas — each its own rows + pool, the in-template
+            # stand-in for N placed shards — behind the prefix-affinity
+            # router; served deterministically (thread-free), with the
+            # template's heartbeat renewed at every replica's wave
+            # boundaries and the fleet-aggregate ledger returned
+            from nexus_tpu.fleet import (
+                PrefixAffinityRouter,
+                serve_fleet_local,
+            )
+
+            # one tracer PER replica: each engine numbers requests by
+            # its own partition indices, so a shared tracer would merge
+            # unrelated requests' spans under colliding request ids
+            replica_tracers = {
+                f"r{i}": ServeTracer() for i in range(sv.replicas)
+            } if tracer is not None else {}
+            engines = {
+                f"r{i}": make_engine(
+                    gauge_tags=[f"engine:r{i}"],
+                    engine_tracer=replica_tracers.get(f"r{i}"),
+                )
+                for i in range(sv.replicas)
+            }
+            fleet_router = PrefixAffinityRouter(
+                list(engines),
+                # affinity hashes radix chain keys; the dense layout
+                # has no blocks, so hash at the default paged width
+                block_size=sv.kv_block_size or 32,
+                affinity_depth=sv.affinity_depth,
+                spill_candidates=sv.spill_candidates,
+                spill_threshold=sv.spill_threshold,
+                policy=sv.router_policy,
+                seed=tr.seed,
+            )
+            results, metrics = serve_fleet_local(
+                engines, fleet_router, requests,
+                cancel=cancel, heartbeat=heartbeat,
+            )
+            if sv.autoscale_min:
+                # the in-template drive serves one fixed batch queue to
+                # completion, so declared autoscale bounds cannot act
+                # here — they drive the supervised live harness
+                # (nexus_tpu/fleet/ServeFleet; docs/fleet.md). Label
+                # it loudly so capacity config is never silently
+                # ignored.
+                logger.warning(
+                    "serve.autoscaleMin/Max declared but the template "
+                    "drive runs a fixed fleet of %d replicas; "
+                    "autoscaling acts in the ServeFleet harness "
+                    "(docs/fleet.md)", sv.replicas,
+                )
+                metrics["fleet_autoscale_active"] = False
+        else:
+            engine = make_engine(engine_tracer=tracer)
+            results, metrics = engine.serve(
+                requests, cancel=cancel, heartbeat=heartbeat,
+            )
         if tracer is not None:
             import json as _json
 
-            try:
-                with open(trace_path, "w") as f:
-                    _json.dump(tracer.to_dict(), f, indent=1)
-                    f.write("\n")
-            except OSError:  # telemetry is best-effort
-                pass
+            # fleet runs dump one timeline file per replica
+            # (<path>.<rid>): request ids are per-partition, so a
+            # merged file would alias unrelated requests' spans
+            dumps = (
+                [(f"{trace_path}.{rid}", t)
+                 for rid, t in replica_tracers.items()]
+                if sv.replicas > 1 else [(trace_path, tracer)]
+            )
+            for path_, tracer_ in dumps:
+                try:
+                    with open(path_, "w") as f:
+                        _json.dump(tracer_.to_dict(), f, indent=1)
+                        f.write("\n")
+                except OSError:  # telemetry is best-effort
+                    pass
     finished = sum(1 for r in results if r is not None)
     # the latency rollups describe SERVED requests only — shed and
     # deadline-missed terminals would flatter the p50 with their

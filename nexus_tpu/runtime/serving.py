@@ -511,15 +511,21 @@ class ServeRequest:
     request's total time from enqueue (engine start) — the engine checks
     it at every wave boundary, cancelling the row (or dropping the
     queued request) with a terminal ``deadline_exceeded`` status instead
-    of serving a result nobody is waiting for. ``priority`` orders LOAD
-    SHEDDING only — when the bounded queue overflows, the LOWEST
-    priority queued request is shed first. It does NOT order admission:
-    that is the engine's ``admission_policy`` (round 9 — the default
-    ``cache-aware`` may admit a request with a resident prefix match
-    ahead of older cold arrivals, bounded by ``admission_aging_waves``;
-    ``fifo`` keeps strict arrival order).
-    ``retries`` counts engine-death requeues (stamped by the
-    ServeFailoverPlanner, echoed into the result)."""
+    of serving a result nobody is waiting for. ``priority`` orders two
+    things, consistently HIGH-IS-FAVORED (the fleet-level contract,
+    normative in docs/fleet.md): (1) LOAD SHEDDING — when the bounded
+    queue overflows, the LOWEST-priority queued request is shed first;
+    (2) FLEET DISPATCH — the round-14 router
+    (nexus_tpu/fleet/router.py) routes higher-priority requests first,
+    so when load forces spill-over it is the low-priority tail that
+    migrates off warm affinity homes. It does NOT order admission
+    within one engine: that is the engine's ``admission_policy``
+    (round 9 — the default ``cache-aware`` may admit a request with a
+    resident prefix match ahead of older cold arrivals, bounded by
+    ``admission_aging_waves``; ``fifo`` keeps strict arrival order).
+    ``retries`` counts requeue migrations — engine-death failovers AND
+    fleet scale-down drains (stamped by the ServeFailoverPlanner,
+    echoed into the result)."""
 
     prompt: Sequence[int]
     max_new_tokens: int = 128

@@ -19,7 +19,27 @@ import socket
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional, Sequence, Tuple, Union
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple, Union
+
+
+class GaugeSample(NamedTuple):
+    """One gauge series' last emission, with its freshness record — the
+    TYPED read path consumers (the fleet router/autoscaler) use instead
+    of parsing Prometheus text.
+
+    ``seq`` is the registry's global emission counter at publish time
+    (strictly monotone across ALL series — two reads of one series with
+    equal ``seq`` mean NOTHING was published in between). ``stamp`` is
+    the emitter's own publish clock when it provided one (the serving
+    engine stamps its wave count via LiveGauges), 0.0 otherwise. A
+    consumer that polls and sees seq/stamp frozen across its polls is
+    looking at a WEDGED emitter — the staleness signal that keeps a
+    frozen engine's last-known-good gauges from masquerading as live
+    health (the fleet autoscaler's trust gate)."""
+
+    value: float
+    seq: int
+    stamp: float
 
 METRIC_RECONCILE_LATENCY = "reconcile_latency"
 METRIC_WORKQUEUE_LENGTH = "workqueue_length"
@@ -270,6 +290,11 @@ class StatsdClient:
         # emissions of one metric into a single cell, which is fine for
         # tests but loses the per-series values Prometheus text needs
         self.tagged: Dict[Tuple[str, Tuple[str, ...]], float] = {}  # guarded-by: _lock
+        # per-series freshness record behind the typed read path
+        # (get_tagged / tagged_series): same keys as ``tagged``, values
+        # carry (value, global emission seq, emitter stamp)
+        self._tagged_meta: Dict[Tuple[str, Tuple[str, ...]], GaugeSample] = {}  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock — global emission counter
         self.history: deque = deque(maxlen=self.HISTORY_CAP)  # guarded-by: _lock
         address = address or os.environ.get("NEXUS__STATSD_ADDRESS", "")
         if address.startswith("unix://"):
@@ -283,13 +308,22 @@ class StatsdClient:
             self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
 
     def gauge(
-        self, name: str, value: float, tags: Optional[List[str]] = None, rate: float = 1.0
+        self, name: str, value: float, tags: Optional[List[str]] = None,
+        rate: float = 1.0, stamp: Optional[float] = None,
     ) -> None:
+        """``stamp`` is an OPTIONAL emitter-side publish clock (e.g. the
+        serving engine's wave count) recorded per series for the typed
+        read path's staleness signal; it never reaches the wire."""
         full = f"{self.app_name}.{name}"
         tag_tuple = tuple(tags or [])
         with self._lock:
+            self._seq += 1
             self.gauges[full] = value
             self.tagged[(full, tag_tuple)] = value
+            self._tagged_meta[(full, tag_tuple)] = GaugeSample(
+                float(value), self._seq,
+                float(stamp) if stamp is not None else 0.0,
+            )
             self.history.append((full, value, tag_tuple))
         if self._sock and self._addr:
             tag_str = f"|#{','.join(tags)}" if tags else ""
@@ -309,6 +343,40 @@ class StatsdClient:
         """Gauge of elapsed seconds since a ``time.monotonic()`` stamp
         (GaugeDuration equivalent, reference controller.go:389)."""
         self.gauge(name, time.monotonic() - since, tags=tags, rate=rate)
+
+    def get_tagged(
+        self, name: str, tags: Optional[Sequence[str]] = None
+    ) -> Optional[GaugeSample]:
+        """Typed last-emission read of ONE series: the gauge ``name``
+        (bare, without the app prefix) as published with exactly
+        ``tags`` — None when that series never emitted. The fleet
+        router reads per-engine load this way
+        (``get_tagged("serve_queue_depth", ["engine:r0"])``) instead of
+        parsing exposition text; compare two polls' ``seq`` to detect a
+        frozen emitter."""
+        full = f"{self.app_name}.{name}"
+        with self._lock:
+            return self._tagged_meta.get((full, tuple(tags or [])))
+
+    def tagged_series(self, tag: str) -> Dict[str, GaugeSample]:
+        """Every series carrying ``tag`` (exact tag-member match), as
+        ``{bare metric name: GaugeSample}`` — one engine replica's whole
+        live-gauge snapshot in one lock hold
+        (``tagged_series("engine:r0")``). Series published under several
+        tags are keyed by bare name; when one metric name was emitted
+        with DIFFERENT tag sets that both contain ``tag``, the
+        highest-seq (latest) emission wins."""
+        prefix = f"{self.app_name}."
+        out: Dict[str, GaugeSample] = {}
+        with self._lock:
+            for (full, tag_tuple), sample in self._tagged_meta.items():
+                if tag not in tag_tuple:
+                    continue
+                bare = full[len(prefix):] if full.startswith(prefix) else full
+                prior = out.get(bare)
+                if prior is None or sample.seq > prior.seq:
+                    out[bare] = sample
+        return out
 
     def snapshot(self) -> Dict[str, object]:
         """One CONSISTENT copy of the registry (single lock hold): the

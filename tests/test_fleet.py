@@ -1,0 +1,756 @@
+"""Fleet-scale serving (round 14): replicated engines behind the
+prefix-affinity router, SLO-driven autoscaling, and fleet failover.
+
+The load-bearing properties:
+
+  * routing is scheduling, never semantics — whatever the assignment
+    (affinity, random, spill-over, migration), results are
+    token-for-token identical to the single-engine decode;
+  * same-prefix traffic SINGLE-HOMES: one family's requests share an
+    affinity key and land on one replica (replica churn moves only the
+    keys homed on the changed replica — rendezvous);
+  * a replica killed mid-decode drains, its requests requeue onto the
+    SURVIVING replicas with committed tokens folded into the merged
+    prompt, the recovered cohort's shared prefixes re-match on the new
+    home, zero requests are lost, and every engine teardown's pool
+    partition stays leak-free;
+  * the autoscaler trusts only LIVE gauges: hysteresis on breach/clear
+    streaks, and a busy replica whose registry emissions froze is
+    stale — excluded from aggregates and a blocker for scale-down.
+"""
+
+import threading
+import time
+
+import pytest
+
+from nexus_tpu.api.runtime_spec import JaxXlaRuntime, ServeSpec
+from nexus_tpu.api.template import (
+    Container,
+    NexusAlgorithmSpec,
+    NexusAlgorithmTemplate,
+    WorkgroupRef,
+)
+from nexus_tpu.api.types import ConfigMap, ObjectMeta
+from nexus_tpu.api.workgroup import (
+    NexusAlgorithmWorkgroup,
+    NexusAlgorithmWorkgroupSpec,
+)
+from nexus_tpu.cluster.store import ClusterStore, NotFoundError
+from nexus_tpu.controller.placement import (
+    PlacementError,
+    rendezvous_rank,
+    select_replica_homes,
+)
+from nexus_tpu.fleet import (
+    PrefixAffinityRouter,
+    ReplicaSample,
+    ServeFleet,
+    SloAutoscaler,
+    affinity_key,
+    read_replica_sample,
+    serve_fleet_local,
+)
+from nexus_tpu.ha.lease import heartbeat_name
+from nexus_tpu.ha.serve_failover import (
+    replica_of_serve_lease,
+    serve_replica_template,
+)
+from nexus_tpu.runtime.serving import (
+    STATUS_FAILED_OVER,
+    STATUS_OK,
+    ServeRequest,
+    ServingEngine,
+)
+from nexus_tpu.shards.shard import Shard
+from nexus_tpu.utils.telemetry import StatsdClient
+from tests.test_serving import _cyclic_model
+
+NS = "nexus-fleet"
+V = 13  # cyclic stub vocabulary
+
+
+# ------------------------------------------------------------ helpers
+
+def _cyclic_expected(req):
+    out = [int(t) for t in req.prompt]
+    cur = out[-1]
+    for _ in range(req.max_new_tokens):
+        cur = (cur + 1) % V
+        out.append(cur)
+    return out
+
+
+def _assert_pool_clean(metrics):
+    assert metrics["kv_allocated_blocks_final"] == 0, metrics
+    assert metrics["kv_reserved_blocks_final"] == 0, metrics
+    assert (
+        metrics["kv_free_blocks_final"]
+        + metrics["kv_parked_blocks_final"]
+    ) == metrics["kv_num_blocks"], metrics
+
+
+def _stub_engine_factory(batch=2, block=8, **kw):
+    cfg, fwd = _cyclic_model(V, -1)
+
+    def make_engine(rid):
+        return ServingEngine(
+            fwd, {}, cfg, batch_size=batch, max_len=128, chunk=4,
+            kv_block_size=block, gauge_tags=[f"engine:{rid}"], **kw,
+        )
+
+    return make_engine
+
+
+class _Req:
+    """Router-facing request stub (prompt + priority only)."""
+
+    def __init__(self, prompt, priority=0):
+        self.prompt = list(prompt)
+        self.priority = priority
+
+
+class _Entry:
+    def __init__(self, request):
+        self.request = request
+
+
+# ----------------------------------------- satellite: typed registry reads
+
+def test_registry_get_tagged_and_series_with_staleness_record():
+    """The typed read path: per-series last value + the global emission
+    sequence + the emitter stamp — and per-engine snapshots filtered by
+    tag, latest emission winning."""
+    c = StatsdClient("t")
+    assert c.get_tagged("serve_queue_depth", ["engine:r0"]) is None
+    c.gauge("serve_queue_depth", 7, tags=["engine:r0"], stamp=3.0)
+    c.gauge("serve_queue_depth", 2, tags=["engine:r1"], stamp=5.0)
+    c.gauge("serve_ttft_p95_s", 0.25, tags=["engine:r0"], stamp=3.0)
+    s = c.get_tagged("serve_queue_depth", ["engine:r0"])
+    assert (s.value, s.stamp) == (7.0, 3.0)
+    s1 = c.get_tagged("serve_queue_depth", ["engine:r1"])
+    assert s1.seq > s.seq  # global sequence is strictly monotone
+    # untagged emission is a DIFFERENT series — never aliases
+    c.gauge("serve_queue_depth", 99)
+    assert c.get_tagged("serve_queue_depth", ["engine:r0"]).value == 7.0
+    series = c.tagged_series("engine:r0")
+    assert set(series) == {"serve_queue_depth", "serve_ttft_p95_s"}
+    # a re-emission advances seq and replaces the sample
+    before = series["serve_queue_depth"].seq
+    c.gauge("serve_queue_depth", 9, tags=["engine:r0"], stamp=4.0)
+    after = c.get_tagged("serve_queue_depth", ["engine:r0"])
+    assert after.seq > before and after.value == 9.0 and after.stamp == 4.0
+
+
+def test_read_replica_sample_missing_gauges_are_nan_not_zero():
+    c = StatsdClient("t2")
+    s = read_replica_sample(c, "r9", busy=True)
+    assert s.ttft_p95_s != s.ttft_p95_s  # NaN
+    assert s.queue_depth != s.queue_depth
+    assert s.seq == 0
+    c.gauge("serve_queue_depth", 4, tags=["engine:r9"], stamp=1.0)
+    s = read_replica_sample(c, "r9", busy=True)
+    assert s.queue_depth == 4.0 and s.seq > 0
+
+
+# ------------------------------------------------------- router: affinity
+
+def test_affinity_key_commits_to_prefix_through_depth():
+    common = list(range(32))  # 2 full blocks at 16
+    a = affinity_key(common + [7, 8, 9], 16, depth=2)
+    b = affinity_key(common + [1, 2, 3, 4, 5], 16, depth=2)
+    assert a == b  # tails beyond depth never enter the key
+    c = affinity_key(list(range(31)) + [99, 7], 16, depth=2)
+    assert c != a  # any token change inside the depth changes the key
+    # sub-block prompts key on their raw leading tokens
+    assert affinity_key([1, 2, 3], 16) == affinity_key([1, 2, 3], 16)
+    assert affinity_key([1, 2, 3], 16) != affinity_key([1, 2, 4], 16)
+    with pytest.raises(ValueError):
+        affinity_key([1], 16, depth=0)
+
+
+def test_router_family_single_homes_and_churn_moves_only_dead_keys():
+    r = PrefixAffinityRouter(
+        ["r0", "r1", "r2", "r3"], block_size=16, load_fn=lambda _: 0.0
+    )
+    fams = {}
+    for f in range(12):
+        preamble = [f * 3 + 1] * 40
+        homes = {
+            r.route(_Req(preamble + [f, i, i + 1]))[0] for i in range(6)
+        }
+        assert len(homes) == 1, f"family {f} scattered: {homes}"
+        fams[f] = homes.pop()
+    assert len(set(fams.values())) > 1  # families spread across replicas
+    dead = fams[0]
+    r.remove_replica(dead)
+    for f, home in fams.items():
+        new_home, _ = r.route(_Req([f * 3 + 1] * 40 + [f, 99, 100]))
+        if home == dead:
+            assert new_home != dead
+        else:
+            assert new_home == home  # survivors' keys never move
+
+
+def test_router_spill_over_bounded_by_threshold_and_ledgered():
+    loads = {"r0": 0.0, "r1": 0.0}
+    r = PrefixAffinityRouter(
+        ["r0", "r1"], block_size=8, spill_candidates=2,
+        spill_threshold=3, load_fn=lambda rid: loads[rid],
+    )
+    req = _Req([5] * 16)
+    home, spilled = r.route(req)
+    assert not spilled
+    alt = "r1" if home == "r0" else "r0"
+    loads[home] = 2.0  # under threshold: affinity wins
+    assert r.route(req) == (home, False)
+    loads[home] = 3.0  # at threshold: spill to the less-loaded candidate
+    assert r.route(req) == (alt, True)
+    led = r.ledger()
+    assert led["router_spills"] == 1 and led["router_decisions"] == 3
+    # spill_candidates=1 disables spill-over entirely
+    r1 = PrefixAffinityRouter(
+        ["r0", "r1"], block_size=8, spill_candidates=1,
+        load_fn=lambda rid: loads[rid],
+    )
+    loads[home] = 1000.0
+    assert r1.route(req) == (home, False)
+
+
+def test_router_default_load_reads_live_gauges_from_registry():
+    c = StatsdClient("t3")
+    r = PrefixAffinityRouter(
+        ["r0", "r1"], block_size=8, spill_threshold=2, client=c
+    )
+    req = _Req([9] * 16)
+    home, _ = r.route(req)
+    alt = "r1" if home == "r0" else "r0"
+    c.gauge("serve_queue_depth", 10, tags=[f"engine:{home}"], stamp=1.0)
+    c.gauge("serve_queue_depth", 1, tags=[f"engine:{alt}"], stamp=1.0)
+    assert r.route(req) == (alt, True)
+
+
+def test_route_batch_orders_by_priority_then_arrival():
+    r = PrefixAffinityRouter(
+        ["r0", "r1"], block_size=8, load_fn=lambda _: 0.0
+    )
+    entries = [
+        _Entry(_Req([i] * 16, priority=p))
+        for i, p in enumerate([0, 5, 1, 5, 0])
+    ]
+    routed = [e.request.priority for e, _rid, _s in r.route_batch(entries)]
+    assert routed == [5, 5, 1, 0, 0]
+    # FIFO within a tier: the two priority-5 entries keep arrival order
+    fives = [e.request.prompt[0] for e, _r, _s in r.route_batch(entries)
+             if e.request.priority == 5]
+    assert fives == [1, 3]
+
+
+def test_router_random_policy_is_seeded_and_uniformish():
+    r = PrefixAffinityRouter(
+        ["r0", "r1", "r2", "r3"], block_size=8, policy="random", seed=7
+    )
+    picks = [r.route(_Req([1] * 16))[0] for _ in range(40)]
+    r2 = PrefixAffinityRouter(
+        ["r0", "r1", "r2", "r3"], block_size=8, policy="random", seed=7
+    )
+    assert picks == [r2.route(_Req([1] * 16))[0] for _ in range(40)]
+    assert len(set(picks)) > 1  # an identical prompt scatters (the A/B)
+    with pytest.raises(ValueError):
+        PrefixAffinityRouter(["r0"], block_size=8, policy="round-robin")
+
+
+# ----------------------------------------------------------- autoscaler
+
+def test_autoscaler_breach_hysteresis_steps_one_replica():
+    a = SloAutoscaler(1, 4, ttft_high_s=0.1, queue_high=0,
+                      breach_polls=3, clear_polls=3)
+    mk = lambda seq: [ReplicaSample("r0", True, 0.5, 1.0, seq)]
+    assert a.observe(mk(1), current=1).target == 1
+    assert a.observe(mk(2), current=1).target == 1
+    d = a.observe(mk(3), current=1)
+    assert d.target == 2 and "ttft" in d.reason
+    # streaks reset after a move: the next poll starts a fresh window
+    assert a.observe(mk(4), current=2).target == 2
+
+
+def test_autoscaler_scale_down_needs_clear_streak_and_respects_min():
+    a = SloAutoscaler(1, 4, ttft_high_s=1.0, queue_high=10,
+                      breach_polls=2, clear_polls=2)
+    calm = lambda seq: [
+        ReplicaSample("r0", True, 0.1, 1.0, seq),
+        ReplicaSample("r1", True, 0.1, 1.0, seq),
+    ]
+    assert a.observe(calm(1), current=2).target == 2
+    assert a.observe(calm(2), current=2).target == 1
+    # at min: never below
+    one = lambda seq: [ReplicaSample("r0", True, 0.1, 1.0, seq)]
+    assert a.observe(one(3), current=1).target == 1
+    assert a.observe(one(4), current=1).target == 1
+
+
+def test_autoscaler_stale_busy_replica_excluded_and_blocks_scale_down():
+    """A busy replica whose emission sequence froze is stale after
+    stale_polls: its (healthy-looking) frozen gauges leave every
+    aggregate, and the fleet never scales DOWN while it exists."""
+    a = SloAutoscaler(1, 4, ttft_high_s=1.0, queue_high=0,
+                      breach_polls=2, clear_polls=2, stale_polls=2)
+    live = lambda seq: ReplicaSample("r0", True, 0.1, 1.0, seq)
+    frozen = ReplicaSample("r1", True, 0.1, 0.0, 7)  # seq never advances
+    d1 = a.observe([live(1), frozen], current=2)
+    assert d1.stale == ()  # baseline poll: nothing to compare yet
+    d2 = a.observe([live(2), frozen], current=2)
+    assert d2.stale == ()  # one frozen interval: not yet stale
+    d3 = a.observe([live(3), frozen], current=2)
+    assert d3.stale == ("r1",)
+    d4 = a.observe([live(4), frozen], current=2)
+    assert d4.stale == ("r1",) and d4.target == 2  # clear never accrues
+    # an IDLE replica that stops publishing is resting, not stale
+    b = SloAutoscaler(1, 4, ttft_high_s=1.0, breach_polls=2,
+                      clear_polls=2, stale_polls=2)
+    idle = ReplicaSample("r1", False, 0.1, 0.0, 7)
+    targets = []
+    for seq in (1, 2, 3):
+        d = b.observe([live(seq), idle], current=2)
+        assert d.stale == ()
+        targets.append(d.target)
+    assert 1 in targets  # and clear CAN accrue through an idle replica
+
+
+def test_autoscaler_validates_config():
+    with pytest.raises(ValueError):
+        SloAutoscaler(0, 4, ttft_high_s=1.0)
+    with pytest.raises(ValueError):
+        SloAutoscaler(2, 1, ttft_high_s=1.0)
+    with pytest.raises(ValueError):
+        SloAutoscaler(1, 4)  # no signal at all
+
+
+# ------------------------------------------------- controller placement
+
+_SHARDS = [Shard("alias", f"pool-{i}", None) for i in range(5)]
+
+
+def _template(name="srv", uid="uid-1", replicas=2):
+    t = NexusAlgorithmTemplate(
+        metadata=ObjectMeta(name=name, namespace=NS, uid=uid),
+        spec=NexusAlgorithmSpec(
+            container=Container(image="a", registry="r", version_tag="v"),
+            workgroup_ref=WorkgroupRef(name="wg"),
+        ),
+    )
+    t.spec.runtime = JaxXlaRuntime(
+        mode="serve", serve=ServeSpec(replicas=replicas)
+    )
+    return t
+
+
+def test_select_replica_homes_distinct_sticky_and_minimal_churn():
+    t = _template()
+    homes = select_replica_homes(t, None, _SHARDS, 3)
+    assert len(homes) == 3
+    assert len({h.name for h in homes}) == 3
+    # deterministic: same inputs, same homes
+    again = select_replica_homes(t, None, _SHARDS, 3)
+    assert [h.name for h in again] == [h.name for h in homes]
+    # top-N rendezvous: the homes are exactly the rank's first 3
+    rank = [s.name for s in rendezvous_rank(t.metadata.uid, _SHARDS)]
+    assert [h.name for h in homes] == rank[:3]
+    # removing a non-home shard changes nothing
+    survivors = [s for s in _SHARDS if s.name not in rank[:3]]
+    kept = [s for s in _SHARDS if s.name != survivors[0].name]
+    assert [
+        h.name
+        for h in select_replica_homes(
+            t, None, kept, 3, current=[h.name for h in homes]
+        )
+    ] == [h.name for h in homes]
+    # removing a HOME shard moves only that replica (stickiness keeps
+    # the survivors in place, rendezvous fills the gap)
+    dead = homes[1].name
+    remaining = [s for s in _SHARDS if s.name != dead]
+    moved = select_replica_homes(
+        t, None, remaining, 3,
+        current=[h.name for h in homes], avoid=dead,
+    )
+    names = [h.name for h in moved]
+    assert dead not in names
+    assert names[0] == homes[0].name and names[1] == homes[2].name
+    assert len(set(names)) == 3
+
+
+def test_select_replica_homes_avoid_beats_stickiness_and_clamps():
+    t = _template()
+    homes = select_replica_homes(t, None, _SHARDS, 2)
+    # avoid evicts a sticky current even while it is still connected
+    moved = select_replica_homes(
+        t, None, _SHARDS, 2,
+        current=[h.name for h in homes], avoid=homes[0].name,
+    )
+    assert homes[0].name not in [h.name for h in moved]
+    # fewer eligible shards than replicas: one per shard, no doubling
+    two = _SHARDS[:2]
+    assert len(select_replica_homes(t, None, two, 4)) == 2
+    with pytest.raises(PlacementError):
+        select_replica_homes(t, None, [], 2)
+    with pytest.raises(PlacementError):
+        select_replica_homes(t, None, _SHARDS, 0)
+
+
+def test_controller_places_serve_replicas_and_evicts_only_dead_home():
+    """Controller-level: a serve template with replicas=N under
+    workgroup scheduling=any lands on N distinct shards; a failover
+    eviction moves only the dead shard's replica."""
+    from nexus_tpu.controller.controller import Controller
+
+    stores = {f"pool-{i}": ClusterStore(f"pool-{i}") for i in range(4)}
+    shards = [Shard("alias", n, s) for n, s in stores.items()]
+    ctl = Controller(
+        ClusterStore("controller"), shards,
+        statsd=StatsdClient("test-fleet"),
+    )
+    tpl = _template(replicas=3)
+    wg = NexusAlgorithmWorkgroup(
+        metadata=ObjectMeta(name="wg", namespace=NS),
+        spec=NexusAlgorithmWorkgroupSpec(scheduling="any"),
+    )
+    ctl.workgroup_lister.add(wg)
+    placed = ctl._resolve_placement(tpl)
+    assert len(placed) == 3
+    assert len({s.name for s in placed}) == 3
+    assert ctl.replica_homes_of(NS, "srv") == [s.name for s in placed]
+    # re-resolve is sticky
+    assert [s.name for s in ctl._resolve_placement(tpl)] == [
+        s.name for s in placed
+    ]
+    dead = placed[1].name
+    ctl.evict_home(NS, "srv", dead)
+    ctl.set_shard_health(dead, False)
+    moved = ctl._resolve_placement(tpl)
+    names = [s.name for s in moved]
+    assert dead not in names and len(names) == 3
+    # the two survivors kept their assignments
+    assert placed[0].name in names and placed[2].name in names
+    # single-home templates are untouched by the fleet path
+    solo = _template(name="solo", uid="uid-2", replicas=1)
+    assert len(ctl._resolve_placement(solo)) == 1
+    assert ctl.home_of(NS, "solo") is not None
+
+
+# ------------------------------------------------------ lease helpers
+
+def test_replica_lease_template_roundtrip():
+    lt = serve_replica_template("my-tpl", "r2")
+    assert lt == "serve-my-tpl--r2"
+    assert heartbeat_name(lt) == "hb-serve-my-tpl--r2"
+    assert replica_of_serve_lease(lt, "my-tpl") == "r2"
+    assert replica_of_serve_lease(lt, "other") is None
+    assert replica_of_serve_lease("serve-my-tpl", "my-tpl") is None
+
+
+# ------------------------------------------------- spec knobs + validation
+
+def test_serve_spec_fleet_knobs_roundtrip_and_validate():
+    sv = ServeSpec(
+        replicas=4, router_policy="random", affinity_depth=3,
+        spill_candidates=3, spill_threshold=2, autoscale_min=2,
+        autoscale_max=6, ttft_slo_s=0.5, queue_depth_high=32,
+        scale_breach_polls=4, scale_clear_polls=8,
+    )
+    rt = ServeSpec.from_dict(sv.to_dict())
+    assert rt == sv
+    assert ServeSpec.from_dict(ServeSpec().to_dict()) == ServeSpec()
+
+    def errs(**kw):
+        rt = JaxXlaRuntime(mode="serve", serve=ServeSpec(**kw))
+        return [e for e in rt.validate() if "serve." in e or "autoscal" in e]
+
+    assert not errs(replicas=4)
+    assert errs(replicas=0)
+    assert errs(router_policy="round-robin")
+    assert errs(affinity_depth=0)
+    assert errs(spill_candidates=0)
+    assert errs(spill_threshold=0)
+    assert errs(autoscale_min=0, autoscale_max=4)  # max without min
+    assert errs(autoscale_min=4, autoscale_max=2, ttft_slo_s=1.0)
+    assert errs(replicas=1, autoscale_min=2, autoscale_max=4,
+                ttft_slo_s=1.0)  # replicas outside bounds
+    assert errs(replicas=2, autoscale_min=2, autoscale_max=4)  # no signal
+    assert not errs(replicas=2, autoscale_min=2, autoscale_max=4,
+                    queue_depth_high=16)
+    assert errs(scale_breach_polls=0)
+    assert errs(ttft_slo_s=-1.0)
+
+
+# ------------------------------------------------------ local fleet drive
+
+def test_serve_fleet_local_exact_and_affinity_preserves_hits():
+    """The deterministic drive: 4 families × 6 requests over 1/2/4
+    replicas — results identical to the isolated decode everywhere, and
+    affinity routing keeps every family's prefix hits intact (one cold
+    leader per family fleet-wide) while random routing measurably
+    multiplies cold leaders."""
+    make = _stub_engine_factory(batch=2, block=8)
+    reqs = []
+    for f in range(4):
+        preamble = [(f * 2 + 1) % V] * 16  # 2 full blocks at block 8
+        for i in range(6):
+            reqs.append(ServeRequest(
+                prompt=preamble + [(i + 1) % V], max_new_tokens=12,
+            ))
+    expected = [_cyclic_expected(q) for q in reqs]
+    hits = {}
+    for n, policy in ((1, "affinity"), (2, "affinity"), (4, "affinity"),
+                      (4, "random")):
+        engines = {f"r{i}": make(f"r{i}") for i in range(n)}
+        router = PrefixAffinityRouter(
+            list(engines), block_size=8, affinity_depth=2,
+            policy=policy, load_fn=lambda _: 0.0, seed=3,
+        )
+        results, metrics = serve_fleet_local(engines, router, reqs)
+        assert metrics["fleet_replicas"] == n
+        assert [r.tokens for r in results] == expected
+        assert all(r.status == STATUS_OK for r in results)
+        hits[(n, policy)] = metrics["fleet_prefix_hit_tokens"]
+        for m in metrics["fleet_per_replica"].values():
+            if m.get("kv_num_blocks"):
+                _assert_pool_clean(m)
+    # affinity at any width preserves the single-engine hit volume
+    assert hits[(2, "affinity")] == hits[(1, "affinity")]
+    assert hits[(4, "affinity")] == hits[(1, "affinity")]
+    # random scatters families: strictly fewer hit tokens
+    assert hits[(4, "random")] < hits[(4, "affinity")]
+
+
+def test_serve_fleet_local_default_load_spills_hot_family():
+    """With no injected load signal, the local drive uses PENDING
+    routed counts for spill-over (live gauges don't exist during an
+    upfront routing pass): one hot family over two replicas spills its
+    tail off the affinity home past the threshold — bounded imbalance,
+    still token-exact, and the spill is ledgered."""
+    make = _stub_engine_factory(batch=2, block=8)
+    preamble = [3] * 16
+    reqs = [ServeRequest(prompt=preamble + [(i % 5) + 1],
+                         max_new_tokens=10) for i in range(10)]
+    engines = {f"r{i}": make(f"r{i}") for i in range(2)}
+    router = PrefixAffinityRouter(
+        list(engines), block_size=8, affinity_depth=2,
+        spill_candidates=2, spill_threshold=3,
+    )
+    results, metrics = serve_fleet_local(engines, router, reqs)
+    assert [r.tokens for r in results] == [
+        _cyclic_expected(q) for q in reqs
+    ]
+    assert metrics["router_spills"] > 0
+    routed = metrics["router_routed"]
+    assert len(routed) == 2 and min(routed.values()) > 0
+    # imbalance stays within threshold granularity of the hot key
+    assert abs(routed["r0"] - routed["r1"]) <= 3
+
+
+def test_serve_fleet_local_heartbeat_carries_fleet_committed_total():
+    make = _stub_engine_factory(batch=2, block=8)
+    engines = {f"r{i}": make(f"r{i}") for i in range(2)}
+    router = PrefixAffinityRouter(
+        list(engines), block_size=8, load_fn=lambda _: 0.0
+    )
+    beats = []
+    reqs = [ServeRequest(prompt=[(i % 5) + 1] * 8, max_new_tokens=10)
+            for i in range(6)]
+    results, metrics = serve_fleet_local(
+        engines, router, reqs, heartbeat=beats.append,
+    )
+    assert all(r is not None for r in results)
+    # beats are FLEET-cumulative: monotone across replica boundaries
+    # (the second replica's first beat rides on the first's total), and
+    # never ahead of the final committed count (the engine beats at
+    # wave boundaries, so the last commits land after the last beat)
+    assert beats and all(b2 >= b1 for b1, b2 in zip(beats, beats[1:]))
+    assert beats[-1] <= metrics["fleet_committed_tokens"]
+    per = list(metrics["fleet_per_replica"].values())
+    assert len([m for m in per if m["requests"]]) == 2
+    assert max(beats) > per[0].get("committed_tokens", 0) / 2
+
+
+# ---------------------------------------------- fleet chaos tier (satellite)
+
+def _chaos_after_replica_lease(store, template, rid, delay, action,
+                               timeout=60.0):
+    """Fire ``action`` a fixed ``delay`` after replica ``rid``'s lease
+    is BORN (first served wave) — the deterministic mid-decode trigger.
+    (The lease's step counter advances in whole-request quanta — the
+    engine counts committed tokens at request COMPLETION — so a
+    step-threshold trigger would always land at a completion boundary,
+    where a family may have a lone unfinished member; a short delay
+    past lease birth lands mid-flight of the first admitted rows.)"""
+    name = heartbeat_name(serve_replica_template(template, rid))
+
+    def run():
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                store.get(ConfigMap.KIND, NS, name)
+            except NotFoundError:
+                time.sleep(0.005)
+                continue
+            time.sleep(delay)
+            action()
+            return
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_fleet_kill_one_replica_mid_decode_requeues_onto_survivors():
+    """The acceptance drill: kill one of three replicas mid-decode.
+    The detector confirms by lease expiry, the dead replica's drained
+    requests requeue onto the SURVIVORS with committed tokens folded
+    into the merged prompt, the recovered cohort's shared preamble
+    re-matches on the new home, results are token-identical to the
+    isolated decode, zero requests are lost, and every serve call of
+    every engine tears down with a leak-free pool partition."""
+    store = ClusterStore("fleet-chaos")
+    router = PrefixAffinityRouter([], block_size=8, affinity_depth=2)
+    fleet = ServeFleet(
+        _stub_engine_factory(batch=2, block=8), store, NS, "chaos",
+        replicas=3, router=router, ttl_seconds=0.3, pace_s=0.012,
+    )
+    preambles = {f: [(f * 2 + 1) % V] * 16 for f in range(6)}
+    reqs = []
+    for f, preamble in preambles.items():
+        for i in range(3):
+            # budgets LONG relative to the kill threshold: the lease
+            # renewer throttles writes to TTL/3, so the step trigger
+            # can fire ~an extra throttle window late — the victim's
+            # rows must still be mid-flight then, or the drain shrinks
+            # to a lone tail request with nothing to re-match against
+            reqs.append(ServeRequest(
+                prompt=preamble + [(i + 1) % V], max_new_tokens=100,
+            ))
+    victim = [None]
+    # arm one trigger per initial replica; the FIRST whose own lease is
+    # born (first served wave) is the victim, killed ~0.1s in —
+    # guaranteed mid-decode with a live lease (budgets are ~0.3s+ of
+    # waves), so detection exercises the real detector and the drain
+    # carries several same-family in-flight rows
+    fired = threading.Lock()
+
+    def kill_once(rid):
+        if fired.acquire(blocking=False):
+            victim[0] = rid
+            fleet.kill_replica(rid, hard=True)
+
+    for rid in ("r0", "r1", "r2"):
+        _chaos_after_replica_lease(
+            store, "chaos", rid, 0.1,
+            lambda _rid=rid: kill_once(_rid),
+        )
+    results, report = fleet.run(reqs, timeout_s=120)
+    assert report["requests_lost"] == 0
+    assert report["deaths"] == 1
+    assert victim[0] is not None
+    assert victim[0] not in fleet.alive_ids()
+    assert report["migrations"] > 0
+    # detection came from the real detector (the lease existed: the
+    # kill was step-triggered, so the victim had served waves)
+    assert report["detections_s"] and report["detections_s"][0] >= 0.0
+    recovered = [r for r in results if r.status == STATUS_FAILED_OVER]
+    assert recovered and all(r.retries >= 1 for r in recovered)
+    for req, res in zip(reqs, results):
+        assert res.tokens == _cyclic_expected(req)
+        assert res.new_tokens == req.max_new_tokens
+    # zero leaked blocks on EVERY engine's pool partition — the dead
+    # replica's drained generation included
+    calls = 0
+    for rid, metrics_log in report["replica_metrics"].items():
+        for m in metrics_log:
+            _assert_pool_clean(m)
+            calls += 1
+    assert calls >= 4  # three initial serves + at least one migration
+    # the recovered cohort's merged prompts re-matched their family
+    # preamble on the surviving homes: affinity keeps same-family
+    # entries together through re-routing, so a migrated serve call
+    # carrying >= 2 requests must report prefix hits (a lone drained
+    # tail has nothing in-batch to match — the long budgets above make
+    # that case unreachable)
+    migrated_calls = [
+        m
+        for metrics_log in report["replica_metrics"].values()
+        for m in metrics_log if m.get("fleet_batch_migrated")
+    ]
+    assert migrated_calls
+    multi = [m for m in migrated_calls
+             if int(m.get("fleet_batch_requests") or 0) >= 2]
+    assert multi, migrated_calls
+    assert sum(
+        int(m.get("prefix_hit_tokens", 0) or 0) for m in multi
+    ) > 0
+    # the dead generation left its flight-recorder dump in the report
+    assert report["flight_dumps"]
+
+
+def test_fleet_graceful_scale_down_migrates_without_failure():
+    """Autoscaler-driven scale-down: with every signal far below
+    threshold the fleet drains its newest replica — lease marked done
+    (no detector event), inbox + drained work requeued onto survivors,
+    all requests exact, zero lost."""
+    store = ClusterStore("fleet-scale")
+    router = PrefixAffinityRouter([], block_size=8, affinity_depth=2)
+    scaler = SloAutoscaler(
+        1, 2, ttft_high_s=1000.0, queue_high=10000,
+        breach_polls=2, clear_polls=2,
+    )
+    fleet = ServeFleet(
+        _stub_engine_factory(batch=2, block=8), store, NS, "scl",
+        replicas=2, router=router, autoscaler=scaler,
+        ttl_seconds=0.3, pace_s=0.03, poll_s=0.05,
+    )
+    reqs = [ServeRequest(prompt=[(i % 5) + 1] * 8, max_new_tokens=60)
+            for i in range(8)]
+    results, report = fleet.run(reqs, timeout_s=120)
+    assert report["requests_lost"] == 0
+    assert report["deaths"] == 0
+    downs = [e for e in report["scale_events"] if e["kind"] == "down"]
+    assert downs, report["scale_events"]
+    for req, res in zip(reqs, results):
+        assert res.tokens == _cyclic_expected(req)
+    for metrics_log in report["replica_metrics"].values():
+        for m in metrics_log:
+            _assert_pool_clean(m)
+
+
+# --------------------------------------------------- entrypoint integration
+
+def test_run_template_runtime_serve_replicas_fleet_metrics():
+    from nexus_tpu.api.runtime_spec import (
+        ModelRef,
+        ParallelismSpec,
+        TpuSliceSpec,
+        TrainSpec,
+    )
+    from nexus_tpu.runtime.entrypoints import run_template_runtime
+
+    rt = JaxXlaRuntime(
+        mode="serve",
+        model=ModelRef(
+            family="llama", preset="tiny",
+            overrides={"max_seq_len": 256, "dtype": "float32"},
+        ),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1", slice_count=1),
+        parallelism=ParallelismSpec(),
+        train=TrainSpec(batch_size=4, seq_len=64),
+        serve=ServeSpec(
+            num_requests=10, prompt_length_min=24, prompt_length_max=48,
+            max_new_min=8, max_new_max=16, chunk=4, prefill_chunk=4,
+            kv_block_size=16, shared_prefix_length=16, replicas=2,
+        ),
+    )
+    assert rt.validate() == []
+    m = run_template_runtime(rt)
+    assert m["fleet_replicas"] == 2
+    assert m["finished_requests"] == 10
+    assert m["router_decisions"] == 10
+    assert m["committed_tokens"] == m["fleet_committed_tokens"] > 0
+    assert set(m["fleet_per_replica"]) == {"r0", "r1"}
+    assert m["fleet_busy_max_s"] <= m["fleet_busy_sum_s"]

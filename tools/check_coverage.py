@@ -57,6 +57,12 @@ FORCE_INCLUDE = [
     # trace/recorder/gauges/exposition modules are gated per-file
     # already — nothing excludes them)
     r"nexus_tpu/obs/__init__\.py$",
+    # the round-14 fleet package: routing decides WHICH replica serves
+    # a request (a silent bug scatters warm caches, exactness tests
+    # can't see it), the autoscaler moves real capacity, and the fleet
+    # failover path is where requests get lost — every module gated
+    # per-file, the __init__ re-export shim included
+    r"nexus_tpu/fleet/.*\.py$",
     # the round-8 enforcement layer itself: a rule or audit whose own
     # coverage rots is a gate that silently stops gating — nexuslint's
     # package __init__ (rule registration) and every rule module, plus
