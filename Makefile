@@ -3,7 +3,7 @@ SHELL := /bin/bash
 NATIVE_SRC := nexus_tpu/native/src/nexus_core.cpp nexus_tpu/native/src/nexus_data.cpp
 NATIVE_LIB := nexus_tpu/native/libnexus_core.so
 
-.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-serve-spec bench-serve-obs bench-serve-fleet bench-failover bench-serve-outage chaos-smoke serve-smoke serve-chaos-smoke serve-sanitize-smoke radix-smoke spill-smoke spec-serve-smoke fleet-smoke obs-smoke race-smoke race-smoke-telemetry clean lint nexuslint analyze
+.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-serve-spec bench-serve-obs bench-serve-fleet bench-failover bench-serve-outage chaos-smoke serve-smoke serve-chaos-smoke serve-sanitize-smoke radix-smoke spill-smoke spec-serve-smoke fleet-smoke obs-smoke fleet-obs-smoke race-smoke race-smoke-telemetry clean lint nexuslint analyze
 
 all: native
 
@@ -179,6 +179,16 @@ bench-serve-fleet:
 # (dumps land in /tmp/nexus_obs_smoke for trace_summary.py to render).
 obs-smoke:
 	JAX_PLATFORMS=cpu python tools/obs_smoke.py
+
+# Fleet-plane observability smoke (fast lane, round 15, stub-model,
+# seconds on CPU): the local-drive journey/decision-log validators, a
+# kill-one-replica drill whose stitched cross-replica journeys must
+# validate seam-conserving with the death/drain/route audit trail, the
+# federated fleet_* gauge rollups through the Prometheus exposition,
+# and the trace_summary renderers over every dump kind (dumps land in
+# /tmp/nexus_fleet_obs_smoke). Wired into the CI fast job.
+fleet-obs-smoke:
+	JAX_PLATFORMS=cpu python tools/fleet_obs_smoke.py
 
 # Thread-safety smoke for the store/informer/lister under parallel fan-out.
 race-smoke:

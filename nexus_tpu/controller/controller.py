@@ -213,6 +213,15 @@ class Controller:
         # of _home, kept separate so single-home semantics (home_of and
         # the failover planner's lookups) stay byte-for-byte unchanged
         self._replica_homes: dict = {}  # guarded-by: _health_lock
+        # sticky replica IDENTITY per (template, shard): ids must not be
+        # positional over _replica_homes or a death would shift every
+        # later survivor's id (restarting healthy engines — deep-equal
+        # sees a new NEXUS_SERVE_REPLICA_ID — and churning their
+        # leases). A survivor keeps its id for as long as it stays a
+        # home; a replacement takes the smallest id no current home
+        # holds (usually the dead replica's — its reaped lease name is
+        # reused exactly like a single-engine replacement's).
+        self._replica_ids: dict = {}  # guarded-by: _health_lock
         self.failover_manager: Optional[FailoverManager] = (
             FailoverManager(self, failover) if failover is not None else None
         )
@@ -450,6 +459,31 @@ class Controller:
         with self._health_lock:
             return list(self._replica_homes.get((namespace, name), ()))
 
+    def _resolve_replica_ids(self, key, homes: List[str]) -> dict:
+        """Sticky replica identity for a fleet template's current homes
+        → ``{shard_name: "r<i>"}``. A shard that is still a home keeps
+        the id it already held (its engine's lease name, gauge tags,
+        and Job spec stay bit-identical — no churn on unrelated
+        reconciles, no restart of healthy survivors after another
+        replica's death); a NEW home takes the smallest id no current
+        home holds, which after a failover is the dead replica's freed
+        id (its lease was reaped, exactly the single-engine replacement
+        contract)."""
+        with self._health_lock:
+            assigned = dict(self._replica_ids.get(key, {}))
+            ids = {s: assigned[s] for s in homes if s in assigned}
+            used = set(ids.values())
+            next_i = 0
+            for s in homes:
+                if s in ids:
+                    continue
+                while f"r{next_i}" in used:
+                    next_i += 1
+                ids[s] = f"r{next_i}"
+                used.add(f"r{next_i}")
+            self._replica_ids[key] = ids
+            return dict(ids)
+
     def evict_home(self, namespace: str, name: str, shard_name: str) -> None:
         """Failover hook: forget the sticky assignment and avoid the shard
         the workload just died on when the next placement runs. For a
@@ -470,6 +504,7 @@ class Controller:
         with self._health_lock:
             self._home.pop((namespace, name), None)
             self._replica_homes.pop((namespace, name), None)
+            self._replica_ids.pop((namespace, name), None)
             self._home_avoid.pop((namespace, name), None)
 
     @staticmethod
@@ -1075,6 +1110,21 @@ class Controller:
 
         spec_hash = stable_hash(template.spec) if self._write_skip else ""
 
+        # fleet serve placement (round 15 materializer wiring): each
+        # placed shard's engine launches knowing WHICH replica it is,
+        # so it renews its own per-replica lease and tags its gauges
+        # engine:<id> (the signals the fleet router/autoscaler read).
+        # Identity is sticky PER SHARD (_replica_ids), never positional
+        # over the homes tuple — see the field's comment.
+        replica_ids: dict = {}
+        if self._serve_replicas(template) > 1:
+            replica_ids = self._resolve_replica_ids(
+                (template.namespace, template.name),
+                self.replica_homes_of(
+                    template.namespace, template.name
+                ),
+            )
+
         def sync_one_shard(shard: Shard):
             shard_template = self._sync_template_spec_to_shard(
                 template, shard, spec_hash
@@ -1095,7 +1145,8 @@ class Controller:
             )
             if template.spec.runtime is not None:
                 return self._sync_workload_to_shard(
-                    template, shard_template, shard, workgroup
+                    template, shard_template, shard, workgroup,
+                    replica_id=replica_ids.get(shard.name, ""),
                 )
             # runtime block removed: stop + clean up previously
             # materialized workloads (they'd otherwise burn TPU until the
@@ -1143,6 +1194,7 @@ class Controller:
         shard_template: NexusAlgorithmTemplate,
         shard: Shard,
         workgroup,
+        replica_id: str = "",
     ) -> str:
         """Materialize the template's jax_xla runtime as Jobs + headless
         Services on the shard and return the shard's workload phase.
@@ -1166,7 +1218,9 @@ class Controller:
         )
 
         try:
-            job_manifests = materialize_job(template, workgroup, shard.name)
+            job_manifests = materialize_job(
+                template, workgroup, shard.name, replica_id=replica_id,
+            )
             svc_manifests = materialize_headless_service(template)
         except ValueError as e:
             self.recorder.event(

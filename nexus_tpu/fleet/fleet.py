@@ -42,6 +42,8 @@ the router is built for it (keys are stable across calls).
 from __future__ import annotations
 
 import logging
+import math
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -56,7 +58,19 @@ from nexus_tpu.ha.serve_failover import (
     replica_of_serve_lease,
     serve_replica_template,
 )
-from nexus_tpu.utils.telemetry import StatsdClient, get_client
+from nexus_tpu.obs.federation import FleetGauges
+from nexus_tpu.obs.fleet_log import FleetDecisionLog
+from nexus_tpu.obs.journey import (
+    JourneyBook,
+    goodput_under_slo,
+    slo_verdicts,
+)
+from nexus_tpu.obs.trace import ServeTracer
+from nexus_tpu.utils.telemetry import (
+    METRIC_SERVE_AFFINITY_HIT_RATE,
+    StatsdClient,
+    get_client,
+)
 
 logger = logging.getLogger("nexus_tpu.fleet")
 
@@ -71,6 +85,9 @@ def serve_fleet_local(
     heartbeat: Optional[Callable[[int], None]] = None,
     planner: Optional[ServeFailoverPlanner] = None,
     clock: Callable[[], float] = time.monotonic,
+    journeys: bool = True,
+    decision_log: Any = None,
+    slo_s: float = 0.0,
 ) -> Tuple[List[Optional[Any]], Dict[str, Any]]:
     """Deterministic fleet drive (no threads, no store): route the
     queue through ``router`` (priority-ordered), serve each replica's
@@ -87,8 +104,23 @@ def serve_fleet_local(
     Per-replica serve seconds ride the metrics: ``fleet_busy_max_s`` is
     the slowest replica — the wall N independent shards would realize —
     next to ``fleet_busy_sum_s`` (the time-multiplexed CPU-lane total).
+
+    Fleet observability (round 15, default ON): ``journeys`` attaches
+    a fresh per-call tracer to every replica serve and stitches the
+    cross-replica journey dump into ``metrics['journeys']``;
+    ``decision_log`` (None → a fresh :class:`FleetDecisionLog`;
+    ``False`` disables) records every route decision with its evidence
+    into ``metrics['fleet_decision_log']``; ``slo_s > 0`` adds the
+    goodput-under-SLO rollup (``fleet_slo_attainment`` /
+    ``fleet_goodput_tok_s`` against the slowest-replica wall).
     """
     planner = planner or ServeFailoverPlanner()
+    t_run0 = clock()
+    if decision_log is False:
+        log = None
+    else:
+        log = decision_log or FleetDecisionLog(clock=clock)
+    book = JourneyBook() if journeys else None
     if router._load_fn is None:
         # no injected load signal: the registry default reads live
         # gauges, which are all unpublished during an upfront routing
@@ -96,7 +128,18 @@ def serve_fleet_local(
         # counts are the real load here (see enable_pending_load).
         router.enable_pending_load()
     entries = planner.fresh(requests)
-    assignments = router.route_batch(entries)
+    # attach OUR log to the router ONLY around this drive's single
+    # routing pass (the router may outlive this call — a permanently
+    # attached first-run log would swallow later runs' route events
+    # onto a stale time base); a caller-attached log stays untouched
+    attached_log = log is not None and router.decision_log is None
+    if attached_log:
+        router.decision_log = log
+    try:
+        assignments = router.route_batch(entries)
+    finally:
+        if attached_log:
+            router.decision_log = None
     partitions: Dict[str, List[RequeueEntry]] = {
         rid: [] for rid in engines
     }
@@ -122,11 +165,19 @@ def serve_fleet_local(
             if heartbeat is not None:
                 heartbeat(committed_total[0])
 
+        call_tracer = ServeTracer() if book is not None else None
         t0 = clock()
         r_results, r_metrics = engine.serve(
             [e.request for e in part], cancel=cancel, heartbeat=hb,
+            tracer=call_tracer,
         )
         busy_s = clock() - t0
+        if book is not None:
+            book.absorb_trace(
+                call_tracer.to_dict(), replica=rid,
+                t_start=t0 - t_run0,
+                request_idxs=[e.request_idx for e in part],
+            )
         busy.append(busy_s)
         # the engine's own wall excludes its program compiles (serve()
         # warms up before starting its clock) — the honest per-replica
@@ -171,6 +222,17 @@ def serve_fleet_local(
         ),
         **router.ledger(),
     }
+    if book is not None:
+        metrics["journeys"] = book.to_dict()
+    if log is not None:
+        metrics["fleet_decision_log"] = log.to_dict()
+    if slo_s > 0:
+        g = goodput_under_slo(
+            [r for r in results if r is not None], slo_s, wall_max,
+        )
+        metrics["fleet_slo_s"] = g["slo_s"]
+        metrics["fleet_slo_attainment"] = g["slo_attainment"]
+        metrics["fleet_goodput_tok_s"] = g["goodput_tok_s"]
     return results, metrics
 
 
@@ -237,6 +299,12 @@ class ServeFleet:
         client: Optional[StatsdClient] = None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
+        journeys: bool = True,
+        decision_log: Any = None,
+        fleet_gauges: bool = True,
+        slo_s: float = 0.0,
+        death_storm_threshold: int = 2,
+        flap_window: int = 6,
     ) -> None:
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
@@ -270,6 +338,29 @@ class ServeFleet:
         self._client = client or get_client()
         self._clock = clock
         self._sleep = sleep
+        # ---- fleet observability (round 15, nexus_tpu/obs/) ----
+        # journey stitching, the decision audit log (also attached to
+        # the router so routes self-record), federated gauges, and the
+        # goodput SLO — all default ON, each independently disableable
+        self._t_base = clock()
+        if decision_log is False:
+            self.decision_log: Optional[FleetDecisionLog] = None
+        else:
+            self.decision_log = decision_log or FleetDecisionLog(clock=clock)
+        # the router gets this log attached for the DURATION OF run()
+        # only (see run's try/finally): an injected router may be
+        # shared or reused, and must not keep recording into a retired
+        # fleet's log
+        self._book = JourneyBook() if journeys else None
+        self.slo_s = float(slo_s)
+        self.fleet_gauges = (
+            FleetGauges(
+                client=self._client, tags=[f"fleet:{template}"],
+                slo_s=self.slo_s,
+            ) if fleet_gauges else None
+        )
+        self.death_storm_threshold = int(death_storm_threshold)
+        self.flap_window = int(flap_window)
         self._sema = (
             threading.BoundedSemaphore(int(concurrency))
             if concurrency and concurrency > 0 else None
@@ -279,6 +370,13 @@ class ServeFleet:
         self._spawn_counter = 0  # guarded-by: _lock
         self._finished: List[Tuple[RequeueEntry, Any]] = []  # guarded-by: _lock
         self._shutdown = False  # guarded-by: _lock
+        self._obs_dumps: List[dict] = []  # monitor-thread only
+        self._tripped: set = set()  # monitor-thread only
+        self._death_journeys: List[str] = []  # monitor-thread only
+        self._monitor_polls = 0  # monitor-thread only
+        # (autoscaler poll index, +1 up / -1 down) of the last scale
+        # move — the flap detector's memory (monitor-thread only)
+        self._last_scale: Optional[Tuple[int, int]] = None
 
     # ------------------------------------------------------------------ load
     def _route_load(self, rid: str) -> float:
@@ -292,6 +390,37 @@ class ServeFleet:
             rep = self._replicas.get(rid)
             local = len(rep.inbox) if rep is not None else 0
         return live + local
+
+    # -------------------------------------------------------- observability
+    def _log(self, kind: str, **fields) -> None:
+        if self.decision_log is not None:
+            self.decision_log.record(kind, **fields)
+
+    def _trip_fleet(self, reason: str, detail: dict,
+                    journey_ids: Optional[Sequence[str]]) -> None:
+        """Freeze the decision ring + the affected cohort's stitched
+        journeys into a fleet postmortem dump — once per reason per
+        run (the engine flight recorder's discipline), persisted to
+        NEXUS_FLIGHT_DUMP_DIR when set."""
+        if self.decision_log is None or reason in self._tripped:
+            return
+        self._tripped.add(reason)
+        cohort = None
+        if self._book is not None:
+            with self._lock:
+                cohort = self._book.to_dict(only=journey_ids)
+        dump = self.decision_log.trip(reason, detail, journeys=cohort)
+        self._obs_dumps.append(dump)
+        dump_dir = os.environ.get("NEXUS_FLIGHT_DUMP_DIR", "")
+        if dump_dir:
+            try:
+                from nexus_tpu.obs.recorder import write_dump
+
+                write_dump(dump, os.path.join(
+                    dump_dir, f"fleet-{self.template}-{reason}.json",
+                ))
+            except Exception:  # noqa: BLE001 — telemetry never blocks recovery
+                logger.debug("fleet obs dump not persisted", exc_info=True)
 
     # ------------------------------------------------------------ membership
     def alive_ids(self) -> List[str]:
@@ -316,6 +445,7 @@ class ServeFleet:
         rep.thread = t
         t.start()
         self.router.add_replica(rid)
+        self._log("spawn", replica=rid)
         return rid
 
     # ------------------------------------------------------------------ chaos
@@ -331,6 +461,7 @@ class ServeFleet:
             rep.killed = True
             cancel = rep.cancel
         self.router.remove_replica(rid)
+        self._log("kill", replica=rid, hard=bool(hard))
         if cancel is not None:
             cancel.cancel(hard=hard)
         return True
@@ -389,11 +520,19 @@ class ServeFleet:
                     rep.cancel = cancel
                     rep.current_batch = batch
                     rep.busy = True
+                # one FRESH tracer per serve call (round 15): this
+                # call's span timelines become the batch's journey
+                # legs, without touching the engine-attached
+                # observability surface (gauges keep publishing, the
+                # engine's own flight recorder keeps recording)
+                call_tracer = (
+                    ServeTracer() if self._book is not None else None
+                )
                 t0 = self._clock()
                 try:
                     r_results, r_metrics = rep.engine.serve(
                         [e.request for e in batch],
-                        cancel=cancel, heartbeat=hb,
+                        cancel=cancel, heartbeat=hb, tracer=call_tracer,
                     )
                 except BaseException as e:  # noqa: BLE001 — surfaced by run()
                     with self._lock:
@@ -419,6 +558,23 @@ class ServeFleet:
                 int(getattr(e.request, "retries", 0) or 0) > 0
                 for e in batch
             )
+            if self.fleet_gauges is not None:
+                # per-replica affinity yield: radix-matched tokens over
+                # prompt tokens this call served — the router's
+                # locality, measured where it pays (tagged engine:<id>,
+                # stamped with the replica's serve-call count)
+                prompt_toks = sum(
+                    len(e.request.prompt) for e in batch
+                )
+                self._client.gauge(
+                    METRIC_SERVE_AFFINITY_HIT_RATE,
+                    round(
+                        int(r_metrics.get("prefix_hit_tokens", 0) or 0)
+                        / max(1, prompt_toks), 4,
+                    ),
+                    tags=[f"engine:{rep.id}"],
+                    stamp=float(rep.serve_calls + 1),
+                )
             with self._lock:
                 rep.busy = False
                 rep.cancel = None
@@ -429,6 +585,15 @@ class ServeFleet:
                     r_metrics.get("committed_tokens", 0) or 0
                 )
                 rep.metrics_log.append(r_metrics)
+                if call_tracer is not None:
+                    # stitch this call's timelines in as journey legs
+                    # (t_start on the fleet clock orders legs globally;
+                    # span t stays engine-local per the schema)
+                    self._book.absorb_trace(
+                        call_tracer.to_dict(), replica=rep.id,
+                        t_start=t0 - self._t_base,
+                        request_idxs=[e.request_idx for e in batch],
+                    )
                 if drained and dump is not None:
                     rep.flight_dumps.append(dump)
                 for entry, res in zip(batch, r_results):
@@ -517,13 +682,16 @@ class ServeFleet:
                 )
         report["dispatches"] = report.get("dispatches", 0) + len(entries)
 
-    def _collect_retired(self, rep: _Replica,
-                         report: Dict[str, Any]) -> List[RequeueEntry]:
+    def _collect_retired(self, rep: _Replica, report: Dict[str, Any],
+                         reason: str = "death") -> List[RequeueEntry]:
         """Harvest a dead/draining replica's unfinished work: drained
         in-flight entries re-enter through the planner (committed
         tokens folded into the merged prompt), never-admitted inbox
         entries requeue verbatim — in that order, preserving the dying
-        engine's serving order ahead of its backlog."""
+        engine's serving order ahead of its backlog. The audit log
+        records the drain→requeue mapping (which journeys left this
+        replica, and why); their subsequent ``route`` events are the
+        requeue side."""
         with self._lock:
             pending = rep.pending_drain
             rep.pending_drain = None
@@ -536,6 +704,13 @@ class ServeFleet:
             batch, drained = pending
             requeued.extend(self.planner.requeue(batch, drained))
         requeued.extend(inbox)
+        jids = [
+            str(getattr(e.request, "journey", "") or "")
+            for e in requeued
+        ]
+        self._log("drain", replica=rep.id, reason=reason, journeys=jids)
+        if reason == "death":
+            self._death_journeys.extend(j for j in jids if j)
         report["flight_dumps"].extend(dumps)
         report["migrations"] += len(requeued)
         return requeued
@@ -566,13 +741,34 @@ class ServeFleet:
         report["deaths"] += 1
         if detection_s is not None:
             report["detections_s"].append(detection_s)
+        self._log(
+            "death_confirmed", replica=rid,
+            detection_s=(
+                round(float(detection_s), 6)
+                if detection_s is not None else None
+            ),
+            fenced_alive=not was_killed,
+        )
         if report["deaths"] > self.max_failures:
             raise RuntimeError(
                 f"serve fleet gave up after {self.max_failures} replica "
                 "deaths"
             )
-        requeued = self._collect_retired(rep, report)
+        requeued = self._collect_retired(rep, report, reason="death")
         self._reap_lease(rid)
+        if report["deaths"] >= self.death_storm_threshold:
+            # a DEATH STORM: several replicas confirmed dead in one run
+            # — freeze the decision ring with the drained cohort's
+            # journeys (each engine's own recorder shows ONE drain;
+            # only the fleet view shows the storm)
+            self._trip_fleet(
+                "death_storm",
+                {"deaths": report["deaths"],
+                 "detections_s": [
+                     round(float(d), 6) for d in report["detections_s"]
+                 ]},
+                journey_ids=list(dict.fromkeys(self._death_journeys)),
+            )
         if not self.alive_ids():
             # last replica died: spawn a replacement or the queue
             # strands (the single-engine supervisor's restart, at
@@ -658,8 +854,50 @@ class ServeFleet:
                 self._client, rid, busy=busy.get(rid, False)
             ))
         decision = self.autoscaler.observe(samples, current=len(alive))
+        # the audit record: the decision WITH the per-replica vitals it
+        # was computed from (NaN = never published → None, JSON-safe)
+        self._log(
+            "scale_decision",
+            current=decision.current, target=decision.target,
+            reason=decision.reason,
+            breach_streak=decision.breach_streak,
+            clear_streak=decision.clear_streak,
+            stale=list(decision.stale),
+            samples=[
+                {
+                    "replica": s.replica_id, "busy": s.busy,
+                    "ttft_p95_s": (
+                        None if math.isnan(s.ttft_p95_s)
+                        else round(s.ttft_p95_s, 6)
+                    ),
+                    "queue_depth": (
+                        None if math.isnan(s.queue_depth)
+                        else round(s.queue_depth, 3)
+                    ),
+                    "seq": s.seq,
+                }
+                for s in samples
+            ],
+        )
         if decision.stale:
             report["stale_observations"] += 1
+        if decision.target != decision.current:
+            direction = 1 if decision.target > decision.current else -1
+            last = self._last_scale
+            if (last is not None and last[1] == -direction
+                    and self._monitor_polls - last[0] <= self.flap_window):
+                # AUTOSCALE FLAPPING: a reversal inside the flap window
+                # — hysteresis should make this rare, so when it
+                # happens the decisions (and their gauge evidence)
+                # leading up to it are exactly the postmortem
+                self._trip_fleet(
+                    "autoscale_flap",
+                    {"window_polls": self.flap_window,
+                     "reversal": f"{last[1]:+d} -> {direction:+d}",
+                     "reason": decision.reason},
+                    journey_ids=None,  # the whole in-flight cohort
+                )
+            self._last_scale = (self._monitor_polls, direction)
         if decision.target > decision.current:
             self._scale_up(report, decision.reason)
         elif decision.target < decision.current:
@@ -677,6 +915,7 @@ class ServeFleet:
         partition rides here for the leak audit), and flight dumps of
         every drained generation."""
         results: List[Optional[Any]] = [None] * len(requests)
+        run_t0 = self._clock()
         report: Dict[str, Any] = {
             "deaths": 0,
             "detections_s": [],
@@ -686,6 +925,12 @@ class ServeFleet:
             "stale_observations": 0,
             "flight_dumps": [],
         }
+        attached_log = (
+            self.decision_log is not None
+            and self.router.decision_log is None
+        )
+        if attached_log:
+            self.router.decision_log = self.decision_log
         for _ in range(self.initial_replicas):
             self._spawn_replica()
         try:
@@ -703,9 +948,18 @@ class ServeFleet:
                 if errors:
                     raise errors[0]
                 for entry, res in finished:
-                    results[entry.request_idx] = self.planner.stitch(
-                        entry, res
-                    )
+                    stitched = self.planner.stitch(entry, res)
+                    results[entry.request_idx] = stitched
+                    if (self.fleet_gauges is not None
+                            and stitched is not None):
+                        # merged-sample fleet percentiles + the SLO
+                        # counter feed on every stitched finish —
+                        # "ok"/"failed_over" = completed (the planner's
+                        # terminal-status contract)
+                        self.fleet_gauges.observe_result(
+                            stitched.ttft_s, stitched.latency_s,
+                            ok=stitched.status in ("ok", "failed_over"),
+                        )
                 if all(r is not None for r in results):
                     break
                 if self._clock() > deadline:
@@ -730,9 +984,18 @@ class ServeFleet:
                     ]
                 for rep in retired:
                     self._dispatch(
-                        self._collect_retired(rep, report), report
+                        self._collect_retired(
+                            rep, report, reason="scale_down",
+                        ),
+                        report,
                     )
                 self._autoscale_poll(report)
+                self._monitor_polls += 1
+                if self.fleet_gauges is not None:
+                    self.fleet_gauges.publish(
+                        self.alive_ids(),
+                        stamp=float(self._monitor_polls),
+                    )
                 self._sleep(self.poll_s)
         finally:
             with self._lock:
@@ -743,6 +1006,8 @@ class ServeFleet:
                 ]
             for t in threads:
                 t.join(timeout=30.0)
+            if attached_log:
+                self.router.decision_log = None
         with self._lock:
             report["replica_metrics"] = {
                 rid: list(r.metrics_log)
@@ -758,4 +1023,30 @@ class ServeFleet:
             report["replicas_started"] = self._spawn_counter
         report.update(self.router.ledger())
         report["requests_lost"] = sum(1 for r in results if r is None)
+        # ---- fleet observability (round 15) ----
+        if self._book is not None:
+            with self._lock:
+                report["journeys"] = self._book.to_dict()
+        if self.decision_log is not None:
+            report["fleet_decision_log"] = self.decision_log.to_dict()
+        report["fleet_obs_dumps"] = list(self._obs_dumps)
+        if self.fleet_gauges is not None:
+            # one final federated publication so post-run scrapes see
+            # the end state (and the percentiles of the whole run)
+            self.fleet_gauges.publish(
+                self.alive_ids(), stamp=float(self._monitor_polls + 1),
+            )
+        if self.slo_s > 0:
+            wall = max(1e-9, self._clock() - run_t0)
+            report["slo"] = {
+                **goodput_under_slo(
+                    [r for r in results if r is not None],
+                    self.slo_s, wall,
+                ),
+                "wall_s": round(wall, 6),
+                "verdicts": (
+                    slo_verdicts(report["journeys"], self.slo_s)
+                    if self._book is not None else []
+                ),
+            }
         return results, report

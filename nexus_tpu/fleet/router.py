@@ -53,7 +53,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -119,6 +119,7 @@ class PrefixAffinityRouter:
         load_fn: Optional[Callable[[str], float]] = None,
         client: Optional[StatsdClient] = None,
         seed: int = 0,
+        decision_log: Any = None,
     ) -> None:
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
@@ -142,6 +143,12 @@ class PrefixAffinityRouter:
         self.policy = policy
         self._load_fn = load_fn
         self._client = client
+        # round-15 audit surface (obs/fleet_log.py): when attached,
+        # every route records its evidence — the affinity key, the
+        # rendezvous ranking, and the candidate loads that justified
+        # (or vetoed) a spill. The fleet wires its own log in; None
+        # keeps routing record-free.
+        self.decision_log = decision_log
         self._rng = np.random.RandomState(seed)
         self._lock = threading.Lock()
         self._replicas: List[str] = list(replica_ids)  # guarded-by: _lock
@@ -224,6 +231,7 @@ class PrefixAffinityRouter:
         by at least ``spill_threshold`` (``spilled=True`` then). The
         ``random`` policy draws uniformly over live replicas — the
         cache-blind baseline."""
+        log = self.decision_log
         if self.policy == "random":
             with self._lock:
                 reps = list(self._replicas)
@@ -232,6 +240,14 @@ class PrefixAffinityRouter:
                 chosen = reps[int(self._rng.randint(len(reps)))]
                 self.decisions += 1
                 self.routed[chosen] = self.routed.get(chosen, 0) + 1
+            if log is not None:
+                log.record(
+                    "route",
+                    journey=str(getattr(request, "journey", "") or ""),
+                    key="", policy="random", ranked=[], loads=[],
+                    chosen=chosen, spilled=False,
+                    spill_threshold=self.spill_threshold,
+                )
             return chosen, False
         key = affinity_key(
             request.prompt, self.block_size, self.affinity_depth
@@ -240,6 +256,7 @@ class PrefixAffinityRouter:
         candidates = ranked[: self.spill_candidates]
         chosen = candidates[0]
         spilled = False
+        loads: List[float] = []
         if len(candidates) > 1:
             loads = [self._load(r) for r in candidates]
             best = min(range(len(candidates)), key=lambda i: loads[i])
@@ -253,6 +270,20 @@ class PrefixAffinityRouter:
             self.decisions += 1
             self.spills += int(spilled)
             self.routed[chosen] = self.routed.get(chosen, 0) + 1
+        if log is not None:
+            # the decision WITH its evidence: candidates in affinity
+            # order and the loads actually read (the live queue-depth
+            # gauges + pending counts power-of-two-choices compared) —
+            # an auditor can recompute spill-or-stay from this line
+            log.record(
+                "route",
+                journey=str(getattr(request, "journey", "") or ""),
+                key=key.hex()[:16], policy=self.policy,
+                ranked=list(candidates),
+                loads=[round(float(x), 3) for x in loads],
+                chosen=chosen, spilled=spilled,
+                spill_threshold=self.spill_threshold,
+            )
         return chosen, spilled
 
     def route_batch(self, entries: Sequence) -> List[Tuple[object, str, bool]]:

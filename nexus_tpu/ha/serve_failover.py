@@ -141,11 +141,24 @@ class ServeFailoverPlanner:
     supervisor (below) and the controller own orchestration."""
 
     def fresh(self, requests: Sequence[Any]) -> List[RequeueEntry]:
-        """The generation-0 queue: every request verbatim."""
-        return [
-            RequeueEntry(request_idx=i, request=req)
-            for i, req in enumerate(requests)
-        ]
+        """The generation-0 queue: every request verbatim — except that
+        a request without a ``journey`` id gets one stamped here
+        (``j<queue index>``, on a COPY; caller objects are never
+        mutated). The journey id is the fleet-stable identity the obs
+        layer stitches cross-replica span timelines by
+        (nexus_tpu/obs/journey.py); ``requeue`` carries it through
+        every migration, so one id names the request on every engine
+        that ever served it."""
+        import dataclasses
+
+        out: List[RequeueEntry] = []
+        for i, req in enumerate(requests):
+            if (dataclasses.is_dataclass(req)
+                    and hasattr(req, "journey")
+                    and not getattr(req, "journey")):
+                req = dataclasses.replace(req, journey=f"j{i}")
+            out.append(RequeueEntry(request_idx=i, request=req))
+        return out
 
     def requeue(self, entries: Sequence[RequeueEntry],
                 drained: Sequence[Any]) -> List[RequeueEntry]:
@@ -194,6 +207,7 @@ class ServeFailoverPlanner:
                 deadline_s=deadline,
                 priority=req.priority,
                 retries=int(req.retries) + 1,
+                journey=str(getattr(req, "journey", "") or ""),
             )
             out.append(RequeueEntry(
                 request_idx=base.request_idx,

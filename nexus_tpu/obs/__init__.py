@@ -30,6 +30,23 @@ timelines the same way — PAPERS.md):
     named-trace annotations around the engine's dispatch sites
     (CPU-safe; ``NEXUS_OBS_JAX_TRACE=1``).
 
+Round 15 extends the substrate to the FLEET plane (docs/fleet.md):
+
+  * :mod:`~nexus_tpu.obs.journey` — :class:`JourneyBook`: one stitched
+    cross-replica timeline per request (the journey id threads from
+    the fleet dispatch through the router into each engine's tracer
+    and back through drain/requeue), with a token-conserving seam
+    invariant across engine deaths and the SLO delay attribution
+    (queue vs decode vs requeue-induced) behind goodput-under-SLO;
+  * :mod:`~nexus_tpu.obs.fleet_log` — :class:`FleetDecisionLog`: the
+    audit ring of every routing/scaling/failover decision WITH its
+    gauge evidence, doubling as the fleet-wide flight recorder (death
+    storms, autoscale flapping);
+  * :mod:`~nexus_tpu.obs.federation` — :class:`FleetGauges`:
+    fleet-level rollups (aggregate depth/blocks/committed,
+    merged-sample ttft/latency percentiles, SLO attainment) over the
+    per-replica tagged gauges, through the same exposition path.
+
 Cost discipline: everything here must be cheap enough to leave on — the
 serve bench's tracing A/B budgets <= 2% tok/s overhead
 (docs/bench_serve_r12.json). Clock discipline: monotonic clocks only
@@ -42,7 +59,27 @@ from nexus_tpu.obs.exposition import (  # noqa: F401
     registry_snapshot,
     render_prometheus,
 )
+from nexus_tpu.obs.federation import (  # noqa: F401
+    FleetGauges,
+    fleet_rollup,
+)
+from nexus_tpu.obs.fleet_log import (  # noqa: F401
+    FLEET_EVENT_FIELDS,
+    FLEET_LOG_SCHEMA_VERSION,
+    FleetDecisionLog,
+    validate_fleet_log,
+)
 from nexus_tpu.obs.gauges import LiveGauges, RollingPercentiles  # noqa: F401
+from nexus_tpu.obs.journey import (  # noqa: F401
+    JOURNEY_ENTRY_FIELDS,
+    JOURNEY_LEG_FIELDS,
+    JOURNEY_SCHEMA_VERSION,
+    JourneyBook,
+    goodput_under_slo,
+    journey_attribution,
+    slo_verdicts,
+    validate_journey,
+)
 from nexus_tpu.obs.recorder import (  # noqa: F401
     FlightRecorder,
     validate_flight_dump,

@@ -1,0 +1,165 @@
+"""Federated fleet gauges: per-replica serve gauges rolled up to one
+fleet-level view.
+
+The PR 12 live gauges made each ENGINE observable (``serve_*`` gauges
+tagged ``engine:<id>``); the PR 14 fleet reads them per replica for
+routing and autoscaling, but nothing answered fleet-level questions —
+total backlog, aggregate committed tokens, the ttft a user of the
+WHOLE fleet experiences. :class:`FleetGauges` publishes exactly that,
+through the same registry and exposition path (`render_prometheus` /
+`registry_snapshot` pick the ``fleet_*`` families up with no new
+plumbing):
+
+  * **sum rollups** over the live replicas' tagged gauges — queue
+    depth, free pool blocks, committed tokens — read through the typed
+    ``get_tagged`` path (a replica that never published is skipped,
+    not counted as zero);
+  * **merged-sample percentiles**: fleet ttft/latency p50/p95 come
+    from ONE rolling window fed with every replica's finished requests
+    (the fleet observes each stitched result). Averaging per-replica
+    p95s would not be a percentile of anything; pooling the samples
+    and ranking once is — the same nearest-rank estimator as every
+    other percentile in the repo;
+  * **goodput-under-SLO**: when the fleet is given an SLO, the rolling
+    fraction of finished requests served ``ok`` within it.
+
+Same discipline as the rest of the package: registry writes only, no
+JAX, no clock reads (the publisher stamps with its poll sequence).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+from nexus_tpu.obs.gauges import RollingPercentiles
+from nexus_tpu.utils.telemetry import (
+    METRIC_FLEET_COMMITTED,
+    METRIC_FLEET_FREE_BLOCKS,
+    METRIC_FLEET_LATENCY_P50,
+    METRIC_FLEET_LATENCY_P95,
+    METRIC_FLEET_QUEUE_DEPTH,
+    METRIC_FLEET_REPLICAS,
+    METRIC_FLEET_SLO_ATTAINMENT,
+    METRIC_FLEET_TTFT_P50,
+    METRIC_FLEET_TTFT_P95,
+    METRIC_SERVE_COMMITTED,
+    METRIC_SERVE_FREE_BLOCKS,
+    METRIC_SERVE_QUEUE_DEPTH,
+    StatsdClient,
+    get_client,
+)
+
+#: the per-replica gauges the sum rollups federate (name → fleet name)
+_SUM_ROLLUPS = (
+    (METRIC_SERVE_QUEUE_DEPTH, METRIC_FLEET_QUEUE_DEPTH),
+    (METRIC_SERVE_FREE_BLOCKS, METRIC_FLEET_FREE_BLOCKS),
+    (METRIC_SERVE_COMMITTED, METRIC_FLEET_COMMITTED),
+)
+
+
+def _sum_rollups(client: StatsdClient,
+                 replica_ids: Sequence[str]) -> dict:
+    """THE one sum-rollup loop (``{fleet name: total}``): a family
+    appears only when at least one replica published it (a replica
+    that never published is skipped, never counted as zero).
+    ``FleetGauges.publish`` and :func:`fleet_rollup` both read through
+    this, so the published gauges and the read-side rollup can never
+    disagree about skip-vs-zero semantics or the tag shape."""
+    out = {}
+    for per_replica, fleet_name in _SUM_ROLLUPS:
+        total, seen = 0.0, 0
+        for rid in replica_ids:
+            sample = client.get_tagged(per_replica, [f"engine:{rid}"])
+            if sample is not None:
+                total += float(sample.value)
+                seen += 1
+        if seen:
+            out[fleet_name] = total
+    return out
+
+
+class FleetGauges:
+    """Publish fleet-level rollups into the telemetry registry.
+
+    The fleet monitor drives it: :meth:`observe_result` per stitched
+    finished request (feeds the merged percentile windows and the SLO
+    counter), :meth:`publish` once per monitor poll (reads the live
+    replicas' tagged gauges, publishes the ``fleet_*`` family).
+    ``tags`` (e.g. ``["fleet:<template>"]``) distinguish fleets sharing
+    one process registry."""
+
+    def __init__(self, client: Optional[StatsdClient] = None,
+                 tags: Optional[List[str]] = None,
+                 slo_s: float = 0.0,
+                 ttft_window: int = 512,
+                 latency_window: int = 512) -> None:
+        self._client = client  # None → resolve the process default lazily
+        self.tags = list(tags or [])
+        self.slo_s = float(slo_s)
+        self.ttft = RollingPercentiles(ttft_window)
+        self.latency = RollingPercentiles(latency_window)
+        self.finished = 0
+        self.attained = 0
+        self.publishes = 0
+
+    @property
+    def client(self) -> StatsdClient:
+        if self._client is None:
+            self._client = get_client()
+        return self._client
+
+    def observe_result(self, ttft_s: float, latency_s: float,
+                       ok: bool) -> None:
+        """Feed one stitched finished request. ``latency_s`` is the
+        stitched end-to-end latency (dead generations included) and
+        ``ok`` means the request completed (``ok``/``failed_over``) —
+        shed/deadline terminals count as finished but never attained."""
+        self.finished += 1
+        if ok:
+            self.ttft.add(float(ttft_s))
+            self.latency.add(float(latency_s))
+            if self.slo_s > 0 and float(latency_s) <= self.slo_s:
+                self.attained += 1
+
+    def publish(self, replica_ids: Sequence[str], stamp: float) -> None:
+        """One poll's federated publication. ``stamp`` is the
+        publisher's own freshness record (the fleet stamps its poll
+        count — the same frozen-emitter story as the engine's wave
+        stamp)."""
+        c = self.client
+        tags = self.tags or None
+        s = float(stamp)
+        for fleet_name, total in _sum_rollups(c, replica_ids).items():
+            c.gauge(fleet_name, total, tags=tags, stamp=s)
+        c.gauge(METRIC_FLEET_REPLICAS, len(replica_ids), tags=tags,
+                stamp=s)
+        for (name50, name95), win in (
+            ((METRIC_FLEET_TTFT_P50, METRIC_FLEET_TTFT_P95), self.ttft),
+            ((METRIC_FLEET_LATENCY_P50, METRIC_FLEET_LATENCY_P95),
+             self.latency),
+        ):
+            p50, p95 = win.percentiles((0.50, 0.95))
+            for name, v in ((name50, p50), (name95, p95)):
+                if not math.isnan(v):
+                    c.gauge(name, round(v, 6), tags=tags, stamp=s)
+        if self.slo_s > 0 and self.finished:
+            c.gauge(
+                METRIC_FLEET_SLO_ATTAINMENT,
+                round(self.attained / self.finished, 4),
+                tags=tags, stamp=s,
+            )
+        self.publishes += 1
+
+
+def fleet_rollup(replica_ids: Sequence[str],
+                 client: Optional[StatsdClient] = None) -> dict:
+    """One-shot read-side rollup over the per-replica tagged gauges —
+    for tooling (`make fleet-obs-smoke`, dashboards) that wants the
+    fleet totals WITHOUT owning a publisher: ``{fleet_name: total}``
+    for every sum-rollup family at least one replica published, plus
+    ``fleet_replicas_alive``."""
+    c = client or get_client()
+    out = {METRIC_FLEET_REPLICAS: len(replica_ids)}
+    out.update(_sum_rollups(c, replica_ids))
+    return out

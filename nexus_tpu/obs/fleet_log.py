@@ -1,0 +1,209 @@
+"""The fleet decision audit log: why the router, autoscaler, and
+failover machinery did what they did.
+
+PR 14's fleet plane makes decisions that move real traffic — which
+replica a request lands on, when capacity grows or shrinks, which
+engine gets fenced and drained — and until this log those decisions
+left no record beyond their side effects. The ledger counted spills;
+nothing said WHICH request spilled, off which home, justified by which
+queue-depth reading. :class:`FleetDecisionLog` records every decision
+WITH its evidence:
+
+  * ``route``   — the affinity key, the rendezvous ranking, the
+    power-of-two-choices candidate loads (the live queue-depth gauges
+    + pending counts that justified a spill), the chosen replica;
+  * ``scale_decision`` — the full :class:`~nexus_tpu.fleet.autoscaler
+    .ScaleDecision` (target/current/reason, breach/clear streaks,
+    stale set) plus the per-replica :class:`ReplicaSample` vitals it
+    was computed from;
+  * ``spawn`` / ``kill`` / ``death_confirmed`` — replica lifecycle,
+    with detection seconds and whether a live engine had to be fenced;
+  * ``drain``  — the failover drain→requeue mapping: which journeys
+    left which replica, and why (death vs graceful scale-down). The
+    journeys' subsequent ``route`` events ARE the requeue side of the
+    mapping — the audit reads end to end.
+
+Same discipline as every obs module (docs/observability.md): host-side
+dict appends into a bounded ring, schema (field names AND order) frozen
+by :data:`FLEET_EVENT_FIELDS` and pinned by the golden file, monotonic
+clock only — the log stamps ``t`` from the clock its owner injects (the
+fleet's own), never a wall clock, so audit timelines subtract cleanly
+against the same run's journey ``t_start``s.
+
+The log doubles as the FLEET-WIDE flight recorder: :meth:`trip`
+freezes the ring — plus the affected cohort's stitched journeys — on
+death storms and autoscale flapping, the two failure shapes a
+single-engine recorder cannot see (each engine's own ring shows one
+drain; only the fleet view shows three in a row, or a scale-up
+chasing a scale-down).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+FLEET_LOG_SCHEMA_VERSION = 1
+
+#: Event kinds and their REQUIRED fields, in emission order. Every
+#: event is ``{"seq": ..., "t": ..., "kind": ...}`` followed by exactly
+#: these fields in this order — the golden file pins the table, and
+#: :func:`validate_fleet_log` enforces it (the ServeTracer pattern).
+FLEET_EVENT_FIELDS: Dict[str, tuple] = {
+    # one routing decision: key is the affinity digest (hex prefix),
+    # ranked the rendezvous candidate order, loads the per-candidate
+    # spill-over signal actually read (empty when no choice existed)
+    "route": ("journey", "key", "policy", "ranked", "loads", "chosen",
+              "spilled", "spill_threshold"),
+    # one autoscaler poll: the ScaleDecision + the ReplicaSample
+    # evidence (one dict per replica: replica/busy/ttft_p95_s/
+    # queue_depth/seq, NaN signals recorded as None)
+    "scale_decision": ("current", "target", "reason", "breach_streak",
+                       "clear_streak", "stale", "samples"),
+    # replica lifecycle
+    "spawn": ("replica",),
+    "kill": ("replica", "hard"),
+    "death_confirmed": ("replica", "detection_s", "fenced_alive"),
+    # the drain→requeue mapping: journeys that left `replica`; their
+    # re-routing shows up as subsequent `route` events
+    "drain": ("replica", "reason", "journeys"),
+}
+
+
+class FleetDecisionLog:
+    """Bounded audit ring of fleet-plane decisions (see module
+    docstring). ``clock`` is injectable (the fleet passes its own);
+    ``t`` is seconds since the log's construction — the fleet run's
+    time base, shared with journey ``t_start``s.
+
+    Thread-safety: routed from the monitor thread and workers race on
+    the ring — every append/read holds ``_lock``."""
+
+    def __init__(self, capacity: int = 4096, max_dumps: int = 8,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._clock = clock
+        self._t0 = clock()
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.capacity)  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+        self.dumps: deque = deque(maxlen=int(max_dumps))
+        self.last_dump: Optional[dict] = None
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one decision. ``fields`` must be exactly
+        ``FLEET_EVENT_FIELDS[kind]`` — enforced by construction order
+        here (the dict literal walks the schema; a missing field is a
+        loud KeyError at the call site, not a drifted dump)."""
+        # the clock is read INSIDE the lock: racing recorders (monitor
+        # thread + a chaos kill) must append in the same order they
+        # stamp, or the ring's time axis could run backwards against
+        # its seq order
+        with self._lock:
+            t = round(self._clock() - self._t0, 6)
+            ev = {"seq": self._seq, "t": t, "kind": kind}
+            for f in FLEET_EVENT_FIELDS[kind]:
+                ev[f] = fields[f]
+            self._seq += 1
+            self._ring.append(ev)
+
+    @property
+    def events_recorded(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def events(self, kind: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            evs = list(self._ring)
+        if kind is None:
+            return evs
+        return [e for e in evs if e["kind"] == kind]
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "schema_version": FLEET_LOG_SCHEMA_VERSION,
+                "events_recorded": self._seq,
+                "events": [dict(e) for e in self._ring],
+            }
+
+    def trip(self, reason: str, detail: Optional[dict] = None,
+             journeys: Optional[dict] = None) -> dict:
+        """Freeze the ring into a fleet postmortem dump — the fleet-wide
+        flight-recorder trip. ``journeys`` is the affected cohort's
+        stitched journey dump (:meth:`JourneyBook.to_dict`), embedded so
+        the postmortem shows both WHAT the fleet decided and what each
+        affected request lived through."""
+        with self._lock:
+            t = round(self._clock() - self._t0, 6)
+            dump = {
+                "schema_version": FLEET_LOG_SCHEMA_VERSION,
+                "reason": reason,
+                "tripped_t": t,
+                "detail": dict(detail or {}),
+                "events": [dict(e) for e in self._ring],
+                "journeys": dict(journeys or {"journeys": []}),
+            }
+        self.dumps.append(dump)
+        self.last_dump = dump
+        return dump
+
+
+def validate_fleet_log(dump: dict) -> List[str]:
+    """Schema check of a :meth:`FleetDecisionLog.to_dict` (or
+    :meth:`trip`) dump → problem list (empty = valid): version, every
+    event a known kind with keys exactly ``("seq", "t", "kind") +
+    FLEET_EVENT_FIELDS[kind]`` in order, ``seq`` strictly increasing,
+    ``t`` numeric and non-decreasing. Trip dumps additionally need a
+    reason. The golden-file test and ``make fleet-obs-smoke`` gate on
+    this."""
+    problems: List[str] = []
+    if dump.get("schema_version") != FLEET_LOG_SCHEMA_VERSION:
+        problems.append(
+            f"schema_version {dump.get('schema_version')!r} != "
+            f"{FLEET_LOG_SCHEMA_VERSION}"
+        )
+    if "tripped_t" in dump and not dump.get("reason"):
+        problems.append("trip dump missing reason")
+    events = dump.get("events")
+    if not isinstance(events, list):
+        problems.append("events is not a list")
+        return problems
+    last_seq = -1
+    last_t: Optional[float] = None
+    for ev in events:
+        kind = ev.get("kind")
+        if kind not in FLEET_EVENT_FIELDS:
+            problems.append(
+                f"event seq={ev.get('seq')}: unknown kind {kind!r}"
+            )
+            continue
+        expect = ("seq", "t", "kind") + FLEET_EVENT_FIELDS[kind]
+        got = tuple(ev.keys())
+        if got != expect:
+            problems.append(
+                f"event seq={ev.get('seq')} ({kind}): fields {got} != "
+                f"schema {expect}"
+            )
+        seq = ev.get("seq")
+        if not isinstance(seq, int) or seq <= last_seq:
+            problems.append(
+                f"event seq {seq!r} not strictly increasing after "
+                f"{last_seq}"
+            )
+        else:
+            last_seq = seq
+        t = ev.get("t")
+        if not isinstance(t, (int, float)):
+            problems.append(f"event seq={seq}: t is not a number")
+        elif last_t is not None and t < last_t:
+            problems.append(
+                f"event seq={seq}: t went backwards ({last_t} -> {t})"
+            )
+        else:
+            last_t = t
+    return problems

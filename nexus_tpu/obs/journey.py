@@ -1,0 +1,366 @@
+"""Cross-replica request journeys for the serve fleet.
+
+PR 12 made a SINGLE engine's run observable: one :class:`~nexus_tpu
+.obs.trace.ServeTracer` timeline per request, ending ``terminal`` — or
+``drained`` when the engine died under it. PR 14 made serving a fleet,
+and with it the per-engine view stopped being the per-REQUEST view: a
+request routed to replica A, drained on A's death, and finished on
+replica B leaves two disconnected traces whose request indices don't
+even agree (each serve call numbers its own batch). The journey layer
+stitches them back together:
+
+  * a **journey id** — stable for the request's whole life, stamped by
+    the :class:`~nexus_tpu.ha.serve_failover.ServeFailoverPlanner` at
+    generation 0 (``j<queue index>``) and carried through every
+    requeue on ``ServeRequest.journey`` — threads from the fleet's
+    dispatch through the router into each engine's tracer;
+  * a **leg** — one engine generation's span timeline for the journey
+    (the ServeTracer spans, verbatim — same schema, same golden file),
+    tagged with the replica that served it and the serve call's start
+    on the FLEET's clock (span ``t`` stays engine-local: each engine's
+    t0 is its own serve start, so legs subtract cleanly within
+    themselves and order globally by ``t_start``);
+  * the **seam invariant** — a requeued generation's prompt is the
+    prior generation's prompt plus its drained committed tokens (the
+    planner folds them in), so consecutive legs must satisfy
+    ``enqueued[k+1].prompt_tokens == enqueued[k].prompt_tokens +
+    drained[k].committed_tokens``. :func:`validate_journey` checks it
+    structurally — "no gap, no token lost or re-decoded across the
+    seam" is a schema property, not a test-only assertion.
+
+Like every obs module: host-side dict bookkeeping only, no JAX, no
+clock reads of its own (callers stamp ``t_start`` from their injectable
+clocks), schema pinned by a golden file
+(``tests/golden/fleet_obs_schema.json``).
+
+SLO accounting rides the same stitched view. A journey's end-to-end
+latency decomposes into three delay buckets (the attribution the
+ROADMAP's goodput-under-SLO yardstick needs):
+
+  * ``queue_s``   — admission waits, summed over every leg (a leg that
+    drained before admitting contributes its whole duration here: the
+    request only ever waited);
+  * ``requeue_s`` — serve time spent on generations that DIED, net of
+    their queue waits (committed tokens were preserved, but the wall
+    the request lived through on dead engines is failover-induced);
+  * ``decode_s``  — the final generation's serve time past admission
+    (prefill + decode, the work the user actually paid for once).
+
+``slo_attained`` is then ``status == ok and latency <= slo_s`` with
+``latency = queue_s + requeue_s + decode_s`` — identical to the
+stitched ``ServeResult.latency_s`` the planner reports (it adds dead
+generations' elapsed time back in), so the journey view and the result
+view can never disagree about whether an SLO was met.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from nexus_tpu.obs.trace import SPAN_FIELDS
+
+JOURNEY_SCHEMA_VERSION = 1
+
+#: Key order of one journey entry in a dump — pinned by the golden
+#: file, like the span fields. ``legs`` holds the per-generation
+#: timelines in serve order.
+JOURNEY_ENTRY_FIELDS = ("journey", "request", "legs")
+
+#: Key order of one leg. ``timeline`` is a list of ServeTracer spans
+#: (SPAN_FIELDS schema, engine-local ``t``); ``t_start`` is the serve
+#: call's start on the stitching fleet's clock.
+JOURNEY_LEG_FIELDS = ("replica", "t_start", "timeline")
+
+
+class JourneyBook:
+    """Stitch per-serve-call tracer dumps into cross-replica journeys.
+
+    The fleet drives it: after every engine ``serve()`` call it absorbs
+    that call's :meth:`ServeTracer.to_dict` dump, tagged with the
+    replica id, the call's start time on the fleet clock, and the
+    ORIGINAL queue index each batch entry answers (engine request
+    indices are per-call). Entries whose tracer timeline carries a
+    journey id join that journey as its next leg; ``to_dict()`` renders
+    the golden-pinned dump.
+
+    Thread-safety: the fleet absorbs under its own lock (one worker's
+    serve call completes at a time per replica; the book itself is
+    plain dicts)."""
+
+    def __init__(self) -> None:
+        self._journeys: Dict[str, dict] = {}
+        self.legs_absorbed = 0
+
+    def absorb_trace(self, trace_dump: dict, replica: str, t_start: float,
+                     request_idxs: Sequence[int]) -> int:
+        """Fold one serve call's tracer dump in as legs → legs added.
+
+        ``request_idxs[i]`` is the original queue index of the call's
+        i-th request (the fleet's ``RequeueEntry.request_idx``).
+        Entries without a journey id are skipped — a journey-less trace
+        is a single-engine run, which needs no stitching."""
+        added = 0
+        for entry in trace_dump.get("spans", []):
+            jid = str(entry.get("journey", "") or "")
+            if not jid:
+                continue
+            i = int(entry.get("request", 0))
+            idx = int(request_idxs[i]) if i < len(request_idxs) else i
+            rec = self._journeys.get(jid)
+            if rec is None:
+                rec = {"journey": jid, "request": idx, "legs": []}
+                self._journeys[jid] = rec
+            rec["legs"].append({
+                "replica": str(replica),
+                "t_start": round(float(t_start), 6),
+                "timeline": list(entry.get("timeline", [])),
+            })
+            added += 1
+        self.legs_absorbed += added
+        return added
+
+    def journey_ids(self) -> List[str]:
+        return list(self._journeys)
+
+    def to_dict(self, only: Optional[Sequence[str]] = None) -> dict:
+        """The golden-pinned journey dump (``only`` restricts to a
+        cohort of journey ids — the flight-trip path)."""
+        keep = None if only is None else set(only)
+        return {
+            "schema_version": JOURNEY_SCHEMA_VERSION,
+            "journeys": [
+                {
+                    "journey": rec["journey"],
+                    "request": rec["request"],
+                    "legs": [dict(leg) for leg in rec["legs"]],
+                }
+                for rec in self._journeys.values()
+                if keep is None or rec["journey"] in keep
+            ],
+        }
+
+
+def _leg_problems(jid: str, k: int, leg: dict, final: bool,
+                  problems: List[str]) -> None:
+    got = tuple(leg.keys())
+    if got != JOURNEY_LEG_FIELDS:
+        problems.append(
+            f"journey {jid} leg {k}: keys {got} != {JOURNEY_LEG_FIELDS}"
+        )
+        return
+    tl = leg.get("timeline") or []
+    if not tl:
+        problems.append(f"journey {jid} leg {k}: empty timeline")
+        return
+    last_t: Optional[float] = None
+    for j, span in enumerate(tl):
+        kind = span.get("kind")
+        if kind not in SPAN_FIELDS:
+            problems.append(
+                f"journey {jid} leg {k} span {j}: unknown kind {kind!r}"
+            )
+            continue
+        expect = ("kind",) + SPAN_FIELDS[kind]
+        if tuple(span.keys()) != expect:
+            problems.append(
+                f"journey {jid} leg {k} span {j} ({kind}): fields "
+                f"{tuple(span.keys())} != schema {expect}"
+            )
+        t = span.get("t")
+        if not isinstance(t, (int, float)):
+            problems.append(
+                f"journey {jid} leg {k} span {j} ({kind}): t not a number"
+            )
+        elif last_t is not None and t < last_t:
+            problems.append(
+                f"journey {jid} leg {k} span {j} ({kind}): t went "
+                f"backwards ({last_t} -> {t})"
+            )
+        else:
+            last_t = t
+    if tl[0].get("kind") != "enqueued":
+        problems.append(
+            f"journey {jid} leg {k}: timeline does not start 'enqueued'"
+        )
+    end = tl[-1].get("kind")
+    if final:
+        if end not in ("terminal", "drained"):
+            problems.append(
+                f"journey {jid} final leg {k} ends {end!r}, not "
+                "terminal/drained"
+            )
+    elif end != "drained":
+        problems.append(
+            f"journey {jid} non-final leg {k} ends {end!r}, not "
+            "'drained' (only a drain hands a journey to the next leg)"
+        )
+
+
+def validate_journey(dump: dict) -> List[str]:
+    """Schema + stitching check of a :meth:`JourneyBook.to_dict` dump →
+    problem list (empty = valid). Beyond the golden-pinned key orders
+    and per-leg span validity, this enforces the CROSS-REPLICA
+    invariants stitching exists to witness: every non-final leg ends
+    ``drained`` (the only handoff), leg ``t_start`` never decreases
+    (generations serve in order on the fleet clock), and the SEAM is
+    token-conserving — the successor leg's prompt is exactly the prior
+    leg's prompt plus its drained committed tokens, so no committed
+    token is lost or re-decoded across an engine death."""
+    problems: List[str] = []
+    if dump.get("schema_version") != JOURNEY_SCHEMA_VERSION:
+        problems.append(
+            f"schema_version {dump.get('schema_version')!r} != "
+            f"{JOURNEY_SCHEMA_VERSION}"
+        )
+    journeys = dump.get("journeys")
+    if not isinstance(journeys, list):
+        problems.append("journeys is not a list")
+        return problems
+    for rec in journeys:
+        got = tuple(rec.keys())
+        if got != JOURNEY_ENTRY_FIELDS:
+            problems.append(
+                f"journey entry keys {got} != {JOURNEY_ENTRY_FIELDS}"
+            )
+            continue
+        jid = rec.get("journey")
+        legs = rec.get("legs") or []
+        if not legs:
+            problems.append(f"journey {jid}: no legs")
+            continue
+        last_start: Optional[float] = None
+        for k, leg in enumerate(legs):
+            _leg_problems(jid, k, leg, final=(k == len(legs) - 1),
+                          problems=problems)
+            ts = leg.get("t_start")
+            if isinstance(ts, (int, float)):
+                if last_start is not None and ts < last_start:
+                    problems.append(
+                        f"journey {jid} leg {k}: t_start went backwards "
+                        f"({last_start} -> {ts})"
+                    )
+                else:
+                    last_start = ts
+        # the seam: committed tokens conserved across every handoff
+        for k in range(len(legs) - 1):
+            a = (legs[k].get("timeline") or [{}])
+            b = (legs[k + 1].get("timeline") or [{}])
+            if (a[0].get("kind") != "enqueued"
+                    or b[0].get("kind") != "enqueued"
+                    or a[-1].get("kind") != "drained"):
+                continue  # already reported above
+            expect = (int(a[0].get("prompt_tokens", 0))
+                      + int(a[-1].get("committed_tokens", 0)))
+            got_p = int(b[0].get("prompt_tokens", 0))
+            if got_p != expect:
+                problems.append(
+                    f"journey {jid} seam {k}->{k + 1}: prompt_tokens "
+                    f"{got_p} != prior prompt + drained committed "
+                    f"({expect}) — tokens lost or re-decoded across "
+                    "the failover"
+                )
+    return problems
+
+
+# --------------------------------------------------------- SLO accounting
+
+def _leg_queue_s(tl: List[dict]) -> Optional[float]:
+    for span in tl:
+        if span.get("kind") == "admitted":
+            return float(span.get("queue_s", 0.0))
+    return None  # never admitted on this leg
+
+
+def journey_attribution(rec: dict) -> Dict[str, float]:
+    """One journey entry → its delay decomposition (module docstring):
+    ``{"queue_s", "requeue_s", "decode_s", "latency_s",
+    "committed_tokens", "status"}``. ``latency_s`` is the bucket sum —
+    the stitched end-to-end serve latency (detection/restart wall
+    between generations is excluded, exactly as the planner excludes
+    it from ``ServeResult.latency_s``)."""
+    queue = requeue = decode = 0.0
+    committed = 0
+    status = ""
+    legs = rec.get("legs") or []
+    for k, leg in enumerate(legs):
+        tl = leg.get("timeline") or []
+        if not tl:
+            continue
+        final = k == len(legs) - 1
+        end = tl[-1]
+        leg_total = float(end.get("t", 0.0))
+        q = _leg_queue_s(tl)
+        if end.get("kind") == "drained":
+            committed += int(end.get("committed_tokens", 0))
+            if q is None:
+                queue += leg_total  # drained out of the wait queue
+            else:
+                queue += q
+                requeue += max(0.0, leg_total - q)
+        elif end.get("kind") == "terminal":
+            status = str(end.get("status", ""))
+            committed += int(end.get("new_tokens", 0))
+            leg_total = float(end.get("latency_s", leg_total))
+            if q is None:
+                queue += leg_total  # shed / queued-deadline: all wait
+            else:
+                queue += q
+                decode += max(0.0, leg_total - q)
+        if final and end.get("kind") == "drained":
+            status = "drained"  # interrupted dump: journey still open
+    return {
+        "queue_s": round(queue, 6),
+        "requeue_s": round(requeue, 6),
+        "decode_s": round(decode, 6),
+        "latency_s": round(queue + requeue + decode, 6),
+        "committed_tokens": committed,
+        "status": status,
+    }
+
+
+def slo_verdicts(dump: dict, slo_s: float) -> List[dict]:
+    """Per-journey ``slo_attained`` verdicts with delay attribution —
+    one dict per journey: the attribution buckets plus ``journey``,
+    ``request``, ``replicas`` (every replica the journey touched),
+    ``migrations`` and ``slo_attained``."""
+    out: List[dict] = []
+    for rec in dump.get("journeys", []):
+        att = journey_attribution(rec)
+        legs = rec.get("legs") or []
+        out.append({
+            "journey": rec.get("journey"),
+            "request": rec.get("request"),
+            "replicas": [leg.get("replica") for leg in legs],
+            "migrations": max(0, len(legs) - 1),
+            **att,
+            "slo_attained": bool(
+                att["status"] == "ok" and att["latency_s"] <= float(slo_s)
+            ),
+        })
+    return out
+
+
+def goodput_under_slo(results: Sequence[Any], slo_s: float,
+                      wall_s: float) -> Dict[str, float]:
+    """The fleet-level goodput rollup off stitched ``ServeResult``s:
+    tokens of requests that finished ``ok``/``failed_over``-to-ok
+    WITHIN the SLO, over the serve wall — the ROADMAP's
+    goodput-under-SLO yardstick (raw tok/s counts tokens nobody was
+    still waiting for). ``failed_over`` results count when under the
+    SLO: the request completed; its migration already shows up as
+    requeue-attributed latency."""
+    finished = [r for r in results if r is not None]
+    ok = [r for r in finished
+          if getattr(r, "status", "") in ("ok", "failed_over")]
+    attained = [r for r in ok if float(r.latency_s) <= float(slo_s)]
+    return {
+        "slo_s": round(float(slo_s), 6),
+        "slo_attainment": round(
+            len(attained) / max(1, len(finished)), 4
+        ),
+        "goodput_tok_s": round(
+            sum(int(r.new_tokens) for r in attained)
+            / max(1e-9, float(wall_s)), 2
+        ),
+        "ok_under_slo": len(attained),
+    }

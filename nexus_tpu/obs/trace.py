@@ -90,12 +90,24 @@ class ServeTracer:
 
     def __init__(self) -> None:
         self._timelines: List[List[dict]] = []
+        self._journeys: List[str] = []
         self.runs = 0
 
-    def begin(self, n_requests: int) -> None:
+    def begin(self, n_requests: int,
+              journeys: Optional[List[str]] = None) -> None:
         """Reset for a run of ``n_requests`` (the engine calls this
-        right after its warm-up, before enqueuing spans)."""
-        self._timelines = [[] for _ in range(int(n_requests))]
+        right after its warm-up, before enqueuing spans). ``journeys``
+        optionally names each request's fleet-level journey id (the
+        engine reads ``ServeRequest.journey``) — the dump then carries
+        it per request so the fleet's :class:`~nexus_tpu.obs.journey
+        .JourneyBook` can stitch this run's timelines into
+        cross-replica journeys."""
+        n = int(n_requests)
+        self._timelines = [[] for _ in range(n)]
+        self._journeys = (
+            [str(j or "") for j in journeys] if journeys is not None
+            else [""] * n
+        )
         self.runs += 1
 
     def event(self, request_idx: int, kind: str, **fields: Any) -> None:
@@ -112,11 +124,21 @@ class ServeTracer:
         return self._timelines[request_idx]
 
     def to_dict(self) -> dict:
+        # "journey" rides per request entry only when ``begin`` was
+        # given journey ids — single-engine dumps keep their exact
+        # pre-round-15 shape (the golden test pins span fields either
+        # way; entry keys gain nothing silently)
+        journeys = any(self._journeys)
         return {
             "schema_version": TRACE_SCHEMA_VERSION,
             "requests": len(self._timelines),
             "spans": [
-                {"request": i, "timeline": list(tl)}
+                (
+                    {"request": i, "journey": self._journeys[i],
+                     "timeline": list(tl)}
+                    if journeys else
+                    {"request": i, "timeline": list(tl)}
+                )
                 for i, tl in enumerate(self._timelines)
             ],
         }

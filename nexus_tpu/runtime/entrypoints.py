@@ -72,6 +72,7 @@ def run_template_runtime(
     cancel=None,
     heartbeat=None,
     restore_step: Optional[int] = None,
+    serve_replica_id: str = "",
 ) -> Dict[str, Any]:
     """Execute a runtime block; returns a JSON-serializable metrics dict.
 
@@ -85,7 +86,12 @@ def run_template_runtime(
 
     ``restore_step``: pin the resume point to an exact durable checkpoint
     step (the failover planner's restore-step annotation → the
-    materializer's ``NEXUS_RESTORE_STEP`` env) instead of latest."""
+    materializer's ``NEXUS_RESTORE_STEP`` env) instead of latest.
+
+    ``serve_replica_id``: this engine's fleet replica identity (the
+    controller's replica-homes placement → the materializer's
+    ``NEXUS_SERVE_REPLICA_ID`` env) — tags the serve engine's live
+    gauges ``engine:<id>``. Empty for single-home serving."""
     family = get_family(runtime.model.family)
     overrides = dict(runtime.model.overrides)
     # train.remat is the spec-level knob; model.overrides.remat (with
@@ -127,6 +133,7 @@ def run_template_runtime(
         # lease), cancel → drain at the next boundary (failover requeue)
         return _run_serve(
             runtime, family, cfg, mesh, cancel=cancel, heartbeat=heartbeat,
+            replica_id=serve_replica_id,
         )
     return _run_train(
         runtime, family, cfg, mesh, n_devices, max_steps, cancel,
@@ -806,7 +813,8 @@ def _decode_completion(tokenizer, new_ids, stop_token_id: int) -> str:
     return tokenizer.decode(new_ids)
 
 
-def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None):
+def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None,
+               replica_id=""):
     """Continuous-batching serving (mode='serve'): a synthetic request
     queue — deterministic from train.seed — decodes through
     runtime/serving.py's fixed-row engine; finished rows are refilled
@@ -1037,17 +1045,8 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None):
                 serve_fleet_local,
             )
 
-            # one tracer PER replica: each engine numbers requests by
-            # its own partition indices, so a shared tracer would merge
-            # unrelated requests' spans under colliding request ids
-            replica_tracers = {
-                f"r{i}": ServeTracer() for i in range(sv.replicas)
-            } if tracer is not None else {}
             engines = {
-                f"r{i}": make_engine(
-                    gauge_tags=[f"engine:r{i}"],
-                    engine_tracer=replica_tracers.get(f"r{i}"),
-                )
+                f"r{i}": make_engine(gauge_tags=[f"engine:r{i}"])
                 for i in range(sv.replicas)
             }
             fleet_router = PrefixAffinityRouter(
@@ -1061,6 +1060,13 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None):
                 policy=sv.router_policy,
                 seed=tr.seed,
             )
+            # fleet observability (round 15): the local drive stitches
+            # cross-replica journeys (one per request, journey ids
+            # stamped by the planner) and records the route decision
+            # log — the per-replica span files of round 12 are
+            # superseded by ONE journey dump per run (request spans
+            # from every replica, stitched; tools/trace_summary.py
+            # renders it)
             results, metrics = serve_fleet_local(
                 engines, fleet_router, requests,
                 cancel=cancel, heartbeat=heartbeat,
@@ -1080,25 +1086,54 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None):
                 )
                 metrics["fleet_autoscale_active"] = False
         else:
-            engine = make_engine(engine_tracer=tracer)
+            # a controller-materialized fleet replica runs ONE engine
+            # per shard (replicas > 1 only multiplexes in-template):
+            # its identity arrives via the caller (worker/launcher) or
+            # NEXUS_SERVE_REPLICA_ID and tags the live gauges
+            # engine:<id> — the per-replica signal the fleet
+            # router/autoscaler read across the fleet
+            replica_id = (replica_id or os.environ.get(
+                "NEXUS_SERVE_REPLICA_ID", ""
+            )).strip()
+            engine = make_engine(
+                gauge_tags=[f"engine:{replica_id}"] if replica_id
+                else None,
+                engine_tracer=tracer,
+            )
             results, metrics = engine.serve(
                 requests, cancel=cancel, heartbeat=heartbeat,
             )
-        if tracer is not None:
+            if replica_id:
+                metrics = dict(metrics)
+                metrics["serve_replica_id"] = replica_id
+        # fleet obs dumps ride the metrics as FULL structures — summarize
+        # them in the returned dict (the worker prints it as JSON) and
+        # persist the structures themselves next to NEXUS_SERVE_TRACE
+        journey_dump = metrics.pop("journeys", None)
+        fleet_log_dump = metrics.pop("fleet_decision_log", None)
+        if journey_dump is not None:
+            metrics["fleet_journeys"] = len(journey_dump["journeys"])
+        if fleet_log_dump is not None:
+            metrics["fleet_decision_events"] = (
+                fleet_log_dump["events_recorded"]
+            )
+        if trace_path:
             import json as _json
 
-            # fleet runs dump one timeline file per replica
-            # (<path>.<rid>): request ids are per-partition, so a
-            # merged file would alias unrelated requests' spans
-            dumps = (
-                [(f"{trace_path}.{rid}", t)
-                 for rid, t in replica_tracers.items()]
-                if sv.replicas > 1 else [(trace_path, tracer)]
-            )
-            for path_, tracer_ in dumps:
+            # single-engine runs dump the span timeline; fleet runs
+            # dump the stitched journey file (+ <path>.fleetlog.json,
+            # the decision audit) — trace_summary auto-detects all
+            dumps = [(trace_path, tracer.to_dict())] if tracer else []
+            if journey_dump is not None:
+                dumps = [(trace_path, journey_dump)]
+            if fleet_log_dump is not None:
+                dumps.append(
+                    (f"{trace_path}.fleetlog.json", fleet_log_dump)
+                )
+            for path_, dump_ in dumps:
                 try:
                     with open(path_, "w") as f:
-                        _json.dump(tracer_.to_dict(), f, indent=1)
+                        _json.dump(dump_, f, indent=1)
                         f.write("\n")
                 except OSError:  # telemetry is best-effort
                     pass

@@ -200,6 +200,55 @@ class LocalLauncher:
             self._threads[key] = t
         t.start()
 
+    def _synced_replica_id(self, tmpl: NexusAlgorithmTemplate,
+                           wait_s: float = 2.0) -> str:
+        """The fleet replica id the controller stamped into this
+        shard's synced Job env (``NEXUS_SERVE_REPLICA_ID``), or "".
+        The launcher materializes its own manifests from the template
+        (which is shard-agnostic), so the replica identity — a property
+        of the PLACEMENT, not the template — must be read off what the
+        controller actually synced here.
+
+        FLEET templates (``serve.replicas > 1``) poll up to ``wait_s``
+        for the Job to appear: the launcher wakes on the TEMPLATE sync,
+        which lands BEFORE the workload sync applies the Job (the same
+        ordering race ``_set_job_statuses`` already waits out) — read
+        too early and the engine would renew the SHARED serve lease and
+        publish untagged gauges, so the fleet monitor would confirm a
+        healthy replica dead. Single-home templates return "" at once
+        (there is no identity to wait for)."""
+        import time
+
+        rt = tmpl.spec.runtime
+        if rt is None or getattr(rt, "mode", "") != "serve":
+            return ""
+        replicas = max(1, int(getattr(
+            getattr(rt, "serve", None), "replicas", 1) or 1))
+        if replicas <= 1:
+            return ""
+        from nexus_tpu.api.workload import Job
+        from nexus_tpu.runtime.materializer import LABEL_TEMPLATE
+
+        deadline = time.monotonic() + max(0.0, float(wait_s))
+        while True:
+            try:
+                jobs = self.store.list(
+                    Job.KIND, tmpl.metadata.namespace,
+                    label_selector={LABEL_TEMPLATE: tmpl.metadata.name},
+                )
+            except Exception:  # noqa: BLE001 — identity is best-effort
+                jobs = []
+            for job in jobs:
+                spec = getattr(job, "spec", None) or {}
+                pod = (spec.get("template") or {}).get("spec") or {}
+                for container in pod.get("containers") or []:
+                    for env in container.get("env") or []:
+                        if env.get("name") == "NEXUS_SERVE_REPLICA_ID":
+                            return str(env.get("value") or "")
+            if time.monotonic() >= deadline or self._stop.is_set():
+                return ""
+            time.sleep(0.02)
+
     # -------------------------------------------------------------- execution
     def _execute(self, tmpl: NexusAlgorithmTemplate) -> None:
         try:
@@ -220,6 +269,14 @@ class LocalLauncher:
         name = tmpl.metadata.name
         with self._lock:
             cancel = self._cancels.get(tmpl.key())
+        # fleet replica identity, read ONCE off this shard's synced Job
+        # env and used for both the lease name and the engine's gauge
+        # tags below — two reads could diverge if the store changed
+        # between them (lease under one id, gauges under another)
+        serve_rid = (
+            self._synced_replica_id(tmpl)
+            if tmpl.spec.runtime.mode == "serve" else ""
+        )
         renewer = None
         if self.heartbeat_ttl > 0:
             from nexus_tpu.ha.lease import LeaseRenewer
@@ -229,12 +286,20 @@ class LocalLauncher:
                 # serving engines renew ``hb-serve-<template>`` (the
                 # detector confirms their death exactly as for trainers;
                 # the failover planners strip the infix back to the
-                # workload template — ha/serve_failover.py)
+                # workload template — ha/serve_failover.py). A FLEET
+                # replica — the controller synced a Job carrying
+                # NEXUS_SERVE_REPLICA_ID for this shard — renews its own
+                # ``hb-serve-<template>--<id>`` lease, the pod path's
+                # exact behavior (runtime/worker.py)
                 from nexus_tpu.ha.serve_failover import (
                     serve_heartbeat_template,
+                    serve_replica_template,
                 )
 
-                hb_template = serve_heartbeat_template(name)
+                hb_template = (
+                    serve_replica_template(name, serve_rid) if serve_rid
+                    else serve_heartbeat_template(name)
+                )
             renewer = LeaseRenewer(
                 self.store,
                 namespace=tmpl.metadata.namespace,
@@ -271,6 +336,7 @@ class LocalLauncher:
                 max_steps=self.max_steps, cancel=cancel,
                 heartbeat=on_step if (renewer or self.step_pace_s) else None,
                 restore_step=int(raw_restore) if raw_restore else None,
+                serve_replica_id=serve_rid,
             )
             if metrics.get("interrupted"):
                 # killed / preempted mid-run: the job did NOT complete — no

@@ -45,8 +45,20 @@ def materialize_job(
     template: NexusAlgorithmTemplate,
     workgroup: Optional[NexusAlgorithmWorkgroup] = None,
     shard_name: str = "",
+    replica_id: str = "",
 ) -> List[Dict[str, Any]]:
     """Build one Job manifest per TPU slice for a template's runtime block.
+
+    ``replica_id`` (fleet serve placement, round 15): when the
+    controller placed this template on N shards as a serve FLEET
+    (``ServeSpec.replicas > 1``), each shard's copy carries its replica
+    identity — the launched engine renews the per-replica
+    ``hb-serve-<template>--<id>`` lease and tags its live gauges
+    ``engine:<id>`` (the signals the fleet router/autoscaler consume),
+    instead of N untagged engines all claiming the template's one
+    lease. Emitted as ``NEXUS_SERVE_REPLICA_ID``; empty for single-home
+    and training workloads (env omitted, manifests bit-identical to
+    round 14's).
 
     Raises ValueError if the template has no runtime or the runtime is
     invalid (axes don't tile the slice, unknown accelerator, ...)."""
@@ -108,6 +120,10 @@ def materialize_job(
             {"name": "NEXUS_HB_TEMPLATE", "value": template.metadata.name},
             {"name": "NEXUS_HB_NAMESPACE", "value": template.metadata.namespace},
         ]
+        if replica_id:
+            runtime_env.append(
+                {"name": "NEXUS_SERVE_REPLICA_ID", "value": replica_id}
+            )
         restore_step = (template.metadata.annotations or {}).get(
             ANNOTATION_RESTORE_STEP, ""
         )

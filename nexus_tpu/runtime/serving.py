@@ -525,7 +525,15 @@ class ServeRequest:
     ``admission_aging_waves``; ``fifo`` keeps strict arrival order).
     ``retries`` counts requeue migrations — engine-death failovers AND
     fleet scale-down drains (stamped by the ServeFailoverPlanner,
-    echoed into the result)."""
+    echoed into the result).
+
+    ``journey`` (round 15, nexus_tpu/obs/journey.py) is the request's
+    FLEET-stable identity: stamped once by the failover planner at
+    generation 0 (``j<queue index>``), carried verbatim through every
+    drain/requeue, and threaded into each engine's ServeTracer — the
+    key that stitches a request's per-engine span timelines across
+    every replica it touched into one cross-replica journey. Empty on
+    single-engine runs (nothing to stitch)."""
 
     prompt: Sequence[int]
     max_new_tokens: int = 128
@@ -534,6 +542,7 @@ class ServeRequest:
     deadline_s: float = 0.0
     priority: int = 0
     retries: int = 0
+    journey: str = ""
 
 
 @dataclass
@@ -1610,7 +1619,7 @@ class ServingEngine:
         return cache, buf, ptr, plen, temp_vec, seed_vec, out
 
     def serve(self, requests: Sequence[ServeRequest], cancel=None,
-              heartbeat=None):
+              heartbeat=None, tracer=None):
         """Run the queue to completion → (results, metrics).
 
         results[i] corresponds to requests[i]. Metrics: committed vs
@@ -1630,6 +1639,14 @@ class ServingEngine:
         entrypoint wires it to a ``hb-serve-<template>`` lease renewer
         (ha/lease.py) so the failover detector confirms engine death
         exactly as for trainers.
+
+        ``tracer``: a per-CALL ServeTracer override (round 15). The
+        fleet attaches a FRESH tracer to every serve call so each
+        call's span timelines can be stitched into cross-replica
+        journeys without resetting the engine-attached tracer or the
+        rest of the observability surface (set_observability swaps
+        everything; this swaps one run's tracer only). None keeps the
+        engine-attached tracer.
 
         ``cancel``: a utils.signals.CancelToken. When it fires, serve()
         stops at the next wave boundary, releases every KV lease (the
@@ -1841,7 +1858,7 @@ class ServingEngine:
         # flight recorder are dict appends, the gauges a handful of
         # registry writes per wave — each site guards on None so the
         # disabled path costs one branch
-        tracer = self._tracer
+        tracer = tracer if tracer is not None else self._tracer
         flight = self.flight_recorder
         gauges = (
             LiveGauges(tags=self._gauge_tags) if self._live_gauges
@@ -1861,7 +1878,13 @@ class ServingEngine:
             )
 
         if tracer is not None:
-            tracer.begin(len(requests))
+            # journey ids (round 15): the fleet-stable identity each
+            # request carries — the tracer dump echoes it per request
+            # so the fleet's JourneyBook can stitch this call's
+            # timelines into cross-replica journeys
+            tracer.begin(len(requests), journeys=[
+                str(getattr(r, "journey", "") or "") for r in requests
+            ])
             for i, req_ in enumerate(requests):
                 tracer.event(
                     i, "enqueued", t=0.0,

@@ -159,12 +159,24 @@ def run_from_env(environ: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
             # serving engines renew ``hb-serve-<template>`` on the pod
             # path too — the same name LocalLauncher uses, so the
             # freeze_engine chaos hook and the failover planners' serve
-            # lease detection hold for real pods (ha/serve_failover.py)
+            # lease detection hold for real pods (ha/serve_failover.py).
+            # A FLEET replica (NEXUS_SERVE_REPLICA_ID, stamped by the
+            # controller's replica-homes placement) renews its own
+            # ``hb-serve-<template>--<id>`` lease instead, so the fleet
+            # monitor confirms deaths per replica — N engines on one
+            # shared lease would mask any single replica's death
             from nexus_tpu.ha.serve_failover import (
                 serve_heartbeat_template,
+                serve_replica_template,
             )
 
-            hb_template = serve_heartbeat_template(hb_template)
+            replica_id = env.get("NEXUS_SERVE_REPLICA_ID", "").strip()
+            if replica_id:
+                hb_template = serve_replica_template(
+                    hb_template, replica_id
+                )
+            else:
+                hb_template = serve_heartbeat_template(hb_template)
         renewer = LeaseRenewer(
             KubeClusterStore("hb", env["NEXUS_HB_KUBECONFIG"]),
             namespace=env.get("NEXUS_HB_NAMESPACE", "default"),
@@ -176,7 +188,9 @@ def run_from_env(environ: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
         heartbeat = renewer.renew
 
     metrics = run_template_runtime(
-        runtime, cancel=cancel, heartbeat=heartbeat, restore_step=restore_step
+        runtime, cancel=cancel, heartbeat=heartbeat,
+        restore_step=restore_step,
+        serve_replica_id=env.get("NEXUS_SERVE_REPLICA_ID", "").strip(),
     )
     if renewer is not None and not metrics.get("interrupted"):
         renewer.complete(int(metrics.get("steps", -1) or -1))

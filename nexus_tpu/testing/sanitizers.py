@@ -357,9 +357,11 @@ def install(engine_cls: Optional[type] = None) -> bool:
         return True
     original: Callable = engine_cls.serve
 
-    def serve_with_audits(self, requests, cancel=None, heartbeat=None):
+    def serve_with_audits(self, requests, cancel=None, heartbeat=None,
+                          tracer=None):
         results, metrics = original(
-            self, requests, cancel=cancel, heartbeat=heartbeat
+            self, requests, cancel=cancel, heartbeat=heartbeat,
+            tracer=tracer,
         )
         audit_pool_partition(metrics, context="sanitizer[pool]")
         audit_prefix_tree(self, context="sanitizer[radix]")

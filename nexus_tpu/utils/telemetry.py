@@ -82,6 +82,28 @@ METRIC_SERVE_TTFT_P50 = "serve_ttft_p50_s"
 METRIC_SERVE_TTFT_P95 = "serve_ttft_p95_s"
 METRIC_SERVE_QUEUE_P50 = "serve_queue_p50_s"
 METRIC_SERVE_QUEUE_P95 = "serve_queue_p95_s"
+# Per-replica affinity economics, published by the fleet after each
+# replica serve call (tagged ``engine:<id>``): radix-matched prompt
+# tokens over prompt tokens served — the router's locality yield.
+METRIC_SERVE_AFFINITY_HIT_RATE = "serve_affinity_hit_rate"
+# Fleet-level federated gauges (nexus_tpu/obs/federation.py rolls the
+# per-replica ``engine:<id>``-tagged serve gauges up at every fleet
+# monitor poll; docs/observability.md): aggregate backlog/pool headroom/
+# committed totals across live replicas, the live replica count, and
+# MERGED-SAMPLE nearest-rank percentiles over every replica's finished
+# requests (fed per stitched result — not an average of per-replica
+# percentiles, which would not be a percentile of anything).
+METRIC_FLEET_QUEUE_DEPTH = "fleet_queue_depth_total"
+METRIC_FLEET_FREE_BLOCKS = "fleet_free_pool_blocks_total"
+METRIC_FLEET_COMMITTED = "fleet_committed_tokens_total"
+METRIC_FLEET_REPLICAS = "fleet_replicas_alive"
+METRIC_FLEET_TTFT_P50 = "fleet_ttft_p50_s"
+METRIC_FLEET_TTFT_P95 = "fleet_ttft_p95_s"
+METRIC_FLEET_LATENCY_P50 = "fleet_latency_p50_s"
+METRIC_FLEET_LATENCY_P95 = "fleet_latency_p95_s"
+# goodput-under-SLO: fraction of finished requests served ok within the
+# configured SLO (published only when the fleet was given an SLO)
+METRIC_FLEET_SLO_ATTAINMENT = "fleet_slo_attainment"
 
 
 def percentile_nearest_rank(xs: Sequence[float], q: float) -> float:

@@ -688,6 +688,50 @@ def test_fleet_kill_one_replica_mid_decode_requeues_onto_survivors():
     ) > 0
     # the dead generation left its flight-recorder dump in the report
     assert report["flight_dumps"]
+    # ---- round 15: journeys stitch the death, the audit log shows it
+    from nexus_tpu.obs import validate_fleet_log, validate_journey
+
+    jd = report["journeys"]
+    assert validate_journey(jd) == []  # seam conservation included
+    assert len(jd["journeys"]) == len(reqs)
+    log = report["fleet_decision_log"]
+    assert validate_fleet_log(log) == []
+    drains = [e for e in log["events"]
+              if e["kind"] == "drain" and e["replica"] == victim[0]]
+    assert len(drains) == 1 and drains[0]["reason"] == "death"
+    drained_jids = set(drains[0]["journeys"])
+    assert drained_jids  # the victim was mid-decode: work drained
+    deaths = [e for e in log["events"] if e["kind"] == "death_confirmed"]
+    assert len(deaths) == 1 and deaths[0]["replica"] == victim[0]
+    assert deaths[0]["detection_s"] is not None
+    by_jid = {rec["journey"]: rec for rec in jd["journeys"]}
+    for jid in drained_jids:
+        legs = by_jid[jid]["legs"]
+        # dead-replica spans stitch to survivor spans with no gap:
+        # victim leg(s) end drained, the final leg (a survivor's) ends
+        # terminal, and the validator already proved the seam conserves
+        # committed tokens — re-assert the replica topology explicitly
+        assert len(legs) >= 2
+        assert legs[0]["replica"] == victim[0]
+        assert legs[0]["timeline"][-1]["kind"] == "drained"
+        assert legs[-1]["replica"] != victim[0]
+        assert legs[-1]["timeline"][-1]["kind"] == "terminal"
+        # committed-token conservation across the seam, end to end:
+        # drained + fresh tokens == the request's full budget
+        total = sum(
+            int(leg["timeline"][-1].get("committed_tokens", 0))
+            for leg in legs[:-1]
+        ) + int(legs[-1]["timeline"][-1].get("new_tokens", 0))
+        assert total == reqs[by_jid[jid]["request"]].max_new_tokens
+        # the requeue side of the drain mapping: a post-drain route
+        # decision moved this journey to a survivor, with its evidence
+        routes = [e for e in log["events"] if e["kind"] == "route"
+                  and e["journey"] == jid and e["t"] >= drains[0]["t"]]
+        assert routes and all(
+            ev["chosen"] != victim[0] for ev in routes
+        )
+    # journeys that never touched the victim are single-leg
+    assert any(len(rec["legs"]) == 1 for rec in jd["journeys"])
 
 
 def test_fleet_graceful_scale_down_migrates_without_failure():
@@ -718,6 +762,25 @@ def test_fleet_graceful_scale_down_migrates_without_failure():
     for metrics_log in report["replica_metrics"].values():
         for m in metrics_log:
             _assert_pool_clean(m)
+    # round 15: the graceful drain is audited with its reason, and the
+    # migrated journeys stitch validator-clean across the scale-down
+    from nexus_tpu.obs import validate_fleet_log, validate_journey
+
+    assert validate_journey(report["journeys"]) == []
+    assert validate_fleet_log(report["fleet_decision_log"]) == []
+    drain_reasons = {
+        e["reason"] for e in report["fleet_decision_log"]["events"]
+        if e["kind"] == "drain"
+    }
+    assert drain_reasons <= {"scale_down"} and (
+        not downs or "scale_down" in drain_reasons
+    )
+    scale_evs = [
+        e for e in report["fleet_decision_log"]["events"]
+        if e["kind"] == "scale_decision" and e["target"] < e["current"]
+    ]
+    assert scale_evs, "the scale-down decision must be in the audit log"
+    assert all(s["samples"] for s in scale_evs)  # gauge evidence rides
 
 
 # --------------------------------------------------- entrypoint integration
@@ -754,3 +817,8 @@ def test_run_template_runtime_serve_replicas_fleet_metrics():
     assert m["committed_tokens"] == m["fleet_committed_tokens"] > 0
     assert set(m["fleet_per_replica"]) == {"r0", "r1"}
     assert m["fleet_busy_max_s"] <= m["fleet_busy_sum_s"]
+    # round 15: the entrypoint summarizes the fleet-obs dumps (full
+    # structures are file artifacts, not worker-JSON payload)
+    assert m["fleet_journeys"] == 10
+    assert m["fleet_decision_events"] >= 10
+    assert "journeys" not in m and "fleet_decision_log" not in m

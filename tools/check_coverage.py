@@ -57,6 +57,14 @@ FORCE_INCLUDE = [
     # trace/recorder/gauges/exposition modules are gated per-file
     # already — nothing excludes them)
     r"nexus_tpu/obs/__init__\.py$",
+    # the round-15 fleet-obs modules: journey stitching is where a
+    # silently-dropped leg hides (validators can only flag dumps that
+    # exist), the decision log is the audit record itself, and the
+    # federation rollups feed dashboards — force-gated per-file,
+    # whatever future exclusions appear
+    r"nexus_tpu/obs/journey\.py$",
+    r"nexus_tpu/obs/fleet_log\.py$",
+    r"nexus_tpu/obs/federation\.py$",
     # the round-14 fleet package: routing decides WHICH replica serves
     # a request (a silent bug scatters warm caches, exactness tests
     # can't see it), the autoscaler moves real capacity, and the fleet

@@ -1,0 +1,265 @@
+"""Fleet-plane observability smoke (round 15, seconds on CPU).
+
+Three lanes over stub-model engines (the fleet machinery is
+model-agnostic — tests/test_fleet.py owns the llama exactness tiers):
+
+  1. **local drive** — ``serve_fleet_local`` with journeys + the
+     decision log + an SLO: the journey dump and the audit log must
+     validate against the golden-pinned schemas, every journey's delay
+     attribution must reconcile with its result's latency, and the
+     route decisions must carry their load evidence;
+  2. **kill drill** — a 3-replica live ``ServeFleet``, one replica
+     hard-killed mid-decode: zero requests lost, and every drained
+     request's journey stitches dead-replica spans to survivor spans
+     validator-clean (seam conservation included), with the
+     death/drain/re-route audit trail present;
+  3. **federation** — the fleet_* rollups land in the registry and the
+     Prometheus exposition renders them.
+
+Dumps land in /tmp/nexus_fleet_obs_smoke for
+``tools/trace_summary.py`` to render (both renderers are exercised
+here so a schema change that breaks the tooling fails the smoke, not a
+user).
+
+Run: ``make fleet-obs-smoke`` (CI fast job) or
+``JAX_PLATFORMS=cpu python tools/fleet_obs_smoke.py``.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT_DIR = "/tmp/nexus_fleet_obs_smoke"
+
+
+def _stub_cfg_fwd(v=13):
+    import jax
+    import jax.numpy as jnp
+
+    cfg = SimpleNamespace(
+        n_layers=1, n_kv_heads=1, head_dim=8, dtype=jnp.float32,
+        max_seq_len=256, vocab_size=v,
+    )
+
+    def fwd(params, cfg_, tokens, cache):
+        logits = jax.nn.one_hot((tokens + 1) % v, v) * 10.0
+        new = {k: x for k, x in cache.items() if k != "n_valid"}
+        nv = cache.get("n_valid")
+        adv = tokens.shape[1] if nv is None else nv
+        new["length"] = cache["length"] + adv
+        return logits.astype(jnp.float32), new
+
+    return cfg, fwd
+
+
+def _queue(v=13, families=5, per_family=3, budget=24):
+    from nexus_tpu.runtime.serving import ServeRequest
+
+    reqs = []
+    for f in range(families):
+        preamble = [(f * 2 + 1) % v] * 16
+        for i in range(per_family):
+            reqs.append(ServeRequest(
+                prompt=preamble + [(i + 1) % v], max_new_tokens=budget,
+            ))
+    return reqs
+
+
+def _expected(req, v=13):
+    out = [int(t) for t in req.prompt]
+    cur = out[-1]
+    for _ in range(req.max_new_tokens):
+        cur = (cur + 1) % v
+        out.append(cur)
+    return out
+
+
+def check(ok, msg):
+    if not ok:
+        print(f"FAIL: {msg}")
+        sys.exit(1)
+    print(f"ok: {msg}")
+
+
+def lane_local_drive():
+    from nexus_tpu.fleet import PrefixAffinityRouter, serve_fleet_local
+    from nexus_tpu.obs import (
+        journey_attribution,
+        validate_fleet_log,
+        validate_journey,
+    )
+    from nexus_tpu.runtime.serving import ServingEngine
+
+    cfg, fwd = _stub_cfg_fwd()
+    engines = {
+        f"r{i}": ServingEngine(
+            fwd, {}, cfg, batch_size=2, max_len=128, chunk=4,
+            kv_block_size=8, gauge_tags=[f"engine:r{i}"],
+        )
+        for i in range(3)
+    }
+    router = PrefixAffinityRouter(
+        list(engines), block_size=8, affinity_depth=2,
+    )
+    reqs = _queue()
+    results, m = serve_fleet_local(engines, router, reqs, slo_s=60.0)
+    check(all(r is not None for r in results), "local drive served all")
+    check(
+        all(res.tokens == _expected(req)
+            for req, res in zip(reqs, results)),
+        "local drive exact (journeys never perturb tokens)",
+    )
+    jd, fl = m["journeys"], m["fleet_decision_log"]
+    check(validate_journey(jd) == [], "journey dump validates")
+    check(validate_fleet_log(fl) == [], "decision log validates")
+    routes = [e for e in fl["events"] if e["kind"] == "route"]
+    check(len(routes) == len(reqs), "one route decision per request")
+    check(
+        all(len(e["loads"]) == len(e["ranked"]) for e in routes),
+        "route decisions carry per-candidate load evidence",
+    )
+    by_req = {rec["request"]: rec for rec in jd["journeys"]}
+    drift = [
+        abs(journey_attribution(by_req[i])["latency_s"] - r.latency_s)
+        for i, r in enumerate(results)
+    ]
+    check(max(drift) < 1e-3,
+          "journey delay attribution reconciles with result latency")
+    check(m["fleet_slo_attainment"] == 1.0, "SLO rollup present")
+    os.makedirs(OUT_DIR, exist_ok=True)
+    with open(os.path.join(OUT_DIR, "journeys.json"), "w") as f:
+        json.dump(jd, f, indent=1)
+    with open(os.path.join(OUT_DIR, "fleet_log.json"), "w") as f:
+        json.dump(fl, f, indent=1)
+    return jd
+
+
+def lane_kill_drill():
+    from nexus_tpu.cluster.store import ClusterStore
+    from nexus_tpu.api.types import ConfigMap
+    from nexus_tpu.cluster.store import NotFoundError
+    from nexus_tpu.fleet import PrefixAffinityRouter, ServeFleet
+    from nexus_tpu.ha.lease import heartbeat_name
+    from nexus_tpu.ha.serve_failover import serve_replica_template
+    from nexus_tpu.obs import validate_fleet_log, validate_journey
+    from nexus_tpu.runtime.serving import ServingEngine
+
+    cfg, fwd = _stub_cfg_fwd()
+
+    def make_engine(rid):
+        return ServingEngine(
+            fwd, {}, cfg, batch_size=2, max_len=128, chunk=4,
+            kv_block_size=8, gauge_tags=[f"engine:{rid}"],
+        )
+
+    store = ClusterStore("fleet-obs-smoke")
+    router = PrefixAffinityRouter([], block_size=8, affinity_depth=2)
+    fleet = ServeFleet(
+        make_engine, store, "smoke", "fo", replicas=3, router=router,
+        ttl_seconds=0.3, pace_s=0.012, slo_s=60.0,
+    )
+    reqs = _queue(families=6, per_family=3, budget=100)
+    fired = threading.Lock()
+    victim = [None]
+
+    def kill_once(rid):
+        if fired.acquire(blocking=False):
+            victim[0] = rid
+            fleet.kill_replica(rid, hard=True)
+
+    def watch(rid):
+        name = heartbeat_name(serve_replica_template("fo", rid))
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            try:
+                store.get(ConfigMap.KIND, "smoke", name)
+            except NotFoundError:
+                time.sleep(0.005)
+                continue
+            time.sleep(0.1)
+            kill_once(rid)
+            return
+
+    for rid in ("r0", "r1", "r2"):
+        threading.Thread(target=watch, args=(rid,), daemon=True).start()
+    results, report = fleet.run(reqs, timeout_s=120)
+    check(report["requests_lost"] == 0, "kill drill: zero requests lost")
+    check(report["deaths"] == 1, "kill drill: one confirmed death")
+    jd, fl = report["journeys"], report["fleet_decision_log"]
+    check(validate_journey(jd) == [],
+          "kill drill: stitched journeys validate (seams conserve "
+          "committed tokens)")
+    check(validate_fleet_log(fl) == [], "kill drill: audit log validates")
+    stitched = [rec for rec in jd["journeys"] if len(rec["legs"]) > 1]
+    check(bool(stitched), "kill drill: cross-replica journeys present")
+    check(
+        all(rec["legs"][0]["replica"] == victim[0]
+            and rec["legs"][-1]["replica"] != victim[0]
+            for rec in stitched),
+        "kill drill: dead-replica legs hand off to survivors",
+    )
+    kinds = {e["kind"] for e in fl["events"]}
+    check({"death_confirmed", "drain", "route", "spawn"} <= kinds,
+          "kill drill: death/drain/route audit trail present")
+    check("slo" in report and report["slo"]["slo_attainment"] > 0,
+          "kill drill: goodput-under-SLO reported")
+    with open(os.path.join(OUT_DIR, "kill_journeys.json"), "w") as f:
+        json.dump(jd, f, indent=1)
+    with open(os.path.join(OUT_DIR, "kill_fleet_log.json"), "w") as f:
+        json.dump(fl, f, indent=1)
+
+
+def lane_federation():
+    from nexus_tpu.obs import render_prometheus
+    from nexus_tpu.obs.federation import fleet_rollup
+    from nexus_tpu.utils.telemetry import (
+        METRIC_FLEET_QUEUE_DEPTH,
+        METRIC_SERVE_QUEUE_DEPTH,
+        get_client,
+    )
+
+    client = get_client()
+    # the engines of the earlier lanes published tagged gauges into the
+    # process registry; roll them up and render
+    rollup = fleet_rollup(["r0", "r1", "r2"], client=client)
+    check("fleet_replicas_alive" in rollup, "fleet rollup computes")
+    check(METRIC_FLEET_QUEUE_DEPTH in rollup
+          or client.get_tagged(METRIC_SERVE_QUEUE_DEPTH,
+                               ["engine:r0"]) is None,
+          "rollup sums published per-replica gauges")
+    text = render_prometheus(client)
+    check("serve_queue_depth" in text, "exposition renders serve gauges")
+    check("fleet_" in text or "fleet_queue_depth_total" not in rollup,
+          "exposition renders fleet gauges when published")
+
+
+def lane_render():
+    import subprocess
+
+    for name in ("journeys.json", "kill_journeys.json",
+                 "kill_fleet_log.json"):
+        path = os.path.join(OUT_DIR, name)
+        out = subprocess.run(
+            [sys.executable, "tools/trace_summary.py", path],
+            capture_output=True, text=True, timeout=60,
+        )
+        check(out.returncode == 0 and out.stdout.strip(),
+              f"trace_summary renders {name}")
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    lane_local_drive()
+    lane_kill_drill()
+    lane_federation()
+    lane_render()
+    print(f"fleet-obs smoke PASSED (dumps in {OUT_DIR})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,19 +1,26 @@
 """Trace summaries: jax.profiler device traces AND serve-plane dumps.
 
-Two input kinds, auto-detected:
+Input kinds, auto-detected:
 
   * a directory of jax.profiler TensorBoard traces (the original mode):
     top device ops by self time;
   * a ``.json`` file holding a serve-plane observability dump
-    (nexus_tpu/obs/): a ``ServeTracer.to_dict()`` span timeline or a
-    flight-recorder trip dump — rendered as a human-readable
-    per-request timeline / event tail.
+    (nexus_tpu/obs/): a ``ServeTracer.to_dict()`` span timeline, a
+    flight-recorder trip dump, a CROSS-REPLICA journey dump
+    (``JourneyBook.to_dict()`` — one stitched timeline per request,
+    legs per replica), a fleet DECISION LOG
+    (``FleetDecisionLog.to_dict()`` — routes with their rendezvous/load
+    evidence, scale decisions with their samples, drains), or a fleet
+    obs trip dump (decision ring + affected journeys) — each rendered
+    human-readable.
 
 Usage::
 
     python tools/trace_summary.py /tmp/nexus_prof          # profiler
     python tools/trace_summary.py serve_trace.json         # span dump
     python tools/trace_summary.py flight-tmpl-gen0.json    # flight dump
+    python tools/trace_summary.py journeys.json            # journeys
+    python tools/trace_summary.py journeys.json.fleetlog.json  # audit
 """
 import collections
 import glob
@@ -106,6 +113,48 @@ def summarize_flight_dump(dump: dict) -> None:
               f"{ev.get('kind', '?'):<14s} {rest}")
 
 
+def summarize_journeys(dump: dict) -> None:
+    """Per-request cross-replica journey timelines (one indented block
+    per leg; span ``t`` is engine-local, ``t_start`` fleet-local)."""
+    journeys = dump.get("journeys", [])
+    stitched = [j for j in journeys if len(j.get("legs", [])) > 1]
+    print(f"journeys: schema v{dump.get('schema_version')}, "
+          f"{len(journeys)} journey(s), {len(stitched)} cross-replica")
+    for rec in journeys:
+        legs = rec.get("legs", [])
+        path = " -> ".join(leg.get("replica", "?") for leg in legs)
+        tl_last = (legs[-1].get("timeline") or [{}])[-1] if legs else {}
+        final = tl_last.get("status", tl_last.get("kind", "?"))
+        print(f"journey {rec.get('journey')} (request "
+              f"{rec.get('request')}): {path}  final={final}")
+        for leg in legs:
+            print(f"  leg on {leg.get('replica')} "
+                  f"(t_start {leg.get('t_start', 0.0):.4f}s):")
+            for span in leg.get("timeline", []):
+                print("  " + _span_line(span))
+
+
+def summarize_fleet_log(dump: dict) -> None:
+    """The fleet decision audit: one line per event, evidence inline."""
+    if dump.get("reason"):
+        print(f"fleet obs trip: reason={dump.get('reason')!r} "
+              f"tripped_t={dump.get('tripped_t')}s "
+              f"detail={json.dumps(dump.get('detail') or {}, sort_keys=True)}")
+    print(f"fleet decision log: schema v{dump.get('schema_version')}, "
+          f"{len(dump.get('events', []))} event(s) in ring "
+          f"({dump.get('events_recorded', '?')} recorded)")
+    for ev in dump.get("events", []):
+        rest = ", ".join(
+            f"{k}={v}" for k, v in ev.items()
+            if k not in ("seq", "t", "kind")
+        )
+        print(f"  #{ev.get('seq', '?'):>5} {ev.get('t', 0.0):9.4f}s  "
+              f"{ev.get('kind', '?'):<16s} {rest}")
+    if dump.get("reason") and dump.get("journeys", {}).get("journeys"):
+        print("--- affected cohort ---")
+        summarize_journeys(dump["journeys"])
+
+
 def main(argv) -> None:
     target = argv[1] if len(argv) > 1 else "/tmp/nexus_prof"
     if os.path.isfile(target) and target.endswith(".json"):
@@ -113,11 +162,18 @@ def main(argv) -> None:
             dump = json.load(f)
         if "spans" in dump:
             summarize_serve_trace(dump)
-        elif "events" in dump:
+        elif "journeys" in dump and "events" in dump:
+            summarize_fleet_log(dump)  # fleet obs trip (ring + cohort)
+        elif "journeys" in dump:
+            summarize_journeys(dump)
+        elif "events" in dump and "reason" in dump:
             summarize_flight_dump(dump)
+        elif "events" in dump:
+            summarize_fleet_log(dump)
         else:
-            sys.exit(f"{target}: neither a serve trace (spans) nor a "
-                     "flight dump (events)")
+            sys.exit(f"{target}: not a serve trace (spans), journey "
+                     "dump (journeys), flight dump, or fleet log "
+                     "(events)")
         return
     summarize_profiler(target)
 
