@@ -3,7 +3,7 @@ SHELL := /bin/bash
 NATIVE_SRC := nexus_tpu/native/src/nexus_core.cpp nexus_tpu/native/src/nexus_data.cpp
 NATIVE_LIB := nexus_tpu/native/libnexus_core.so
 
-.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-serve-spec bench-serve-obs bench-serve-fleet bench-failover bench-serve-outage chaos-smoke serve-smoke serve-chaos-smoke serve-sanitize-smoke radix-smoke spill-smoke spec-serve-smoke fleet-smoke obs-smoke fleet-obs-smoke race-smoke race-smoke-telemetry clean lint nexuslint analyze
+.PHONY: all native test test-all tier1 coverage bench bench-cp bench-serve bench-serve-spec bench-serve-obs bench-serve-fleet bench-serve-traffic bench-failover bench-serve-outage chaos-smoke serve-smoke serve-chaos-smoke serve-sanitize-smoke radix-smoke spill-smoke spec-serve-smoke fleet-smoke obs-smoke fleet-obs-smoke traffic-smoke race-smoke race-smoke-telemetry clean lint nexuslint analyze
 
 all: native
 
@@ -189,6 +189,30 @@ obs-smoke:
 # /tmp/nexus_fleet_obs_smoke). Wired into the CI fast job.
 fleet-obs-smoke:
 	JAX_PLATFORMS=cpu python tools/fleet_obs_smoke.py
+
+# Open-loop traffic smoke (fast lane, round 16, stub-model, seconds on
+# CPU, sanitizers ARMED): pure-seeded trace synthesis (Poisson/bursty
+# arrivals, Zipf prefixes, multi-turn sessions, branching fan-outs) +
+# versioned round-trip, the source protocol on a fake clock, streamed
+# engine admission token-identical to the closed-loop replay with
+# arrival-anchored queue attribution, the external-backlog queue-depth
+# gauge, and a mini live-fleet stream drained to zero lost requests.
+# Wired into the CI fast job.
+traffic-smoke:
+	NEXUS_SANITIZE=1 JAX_PLATFORMS=cpu python -m pytest \
+	  tests/test_traffic.py -q
+
+# Round-16 traffic legs only (minutes, CPU): the warm-vs-cold A/B (one
+# persistent engine serving the same trace twice vs two fresh engines —
+# cross-call hit rate, prefill steps saved, goodput delta) and the
+# open-loop fleet leg (a versioned Poisson + bursty trace streamed into
+# a multi-replica ServeFleet with the autoscaler live, scored by
+# arrival-anchored goodput-under-SLO) — writing docs/bench_serve_r16
+# .json via the merge-not-clobber artifact writer.
+bench-serve-traffic:
+	NEXUS_BENCH_SERVE=only NEXUS_BENCH_SERVE_TRAFFIC=only \
+	  NEXUS_BENCH_ROUND=16 \
+	  NEXUS_BENCH_INIT_PROBE=0 JAX_PLATFORMS=cpu python bench.py
 
 # Thread-safety smoke for the store/informer/lister under parallel fan-out.
 race-smoke:

@@ -2110,6 +2110,200 @@ def _fleet_kill_leg(progress):
         return {}
 
 
+def _serve_traffic_scenarios(progress):
+    """Round-16 traffic legs (`make bench-serve-traffic`): the
+    engine-lifetime KV tentpole measured in the regime it exists for.
+
+    * WARM-VS-COLD A/B (`warm_*`): one versioned Zipf/multi-turn/
+      branching trace served TWICE through a single persistent engine
+      (the warm path — call 2 inherits call 1's radix tree and parked
+      pool blocks) vs twice through two fresh engines (the cold path —
+      every call rebuilds from nothing). Records the cross-call hit
+      rate (hit tokens against blocks a PRIOR call registered, over
+      prompt tokens), prefill steps saved, and the goodput delta; the
+      exactness gate (`warm_exact`) asserts warm call 2 token-identical
+      to cold call 2.
+
+    * OPEN-LOOP FLEET (`traffic_poisson_*` / `traffic_bursty_*`): the
+      same trace family STREAMED into a live multi-replica ServeFleet
+      while engines run — the SLO autoscaler polls mid-stream (the
+      bursty leg is sized to breach its queue signal so a scale-up is
+      observable in `scale_events`), the router spills against live
+      backlog, and the score is PR 15's goodput-under-SLO where queue
+      time starts at TRACE ARRIVAL, not serve() entry.
+
+    Stub model (next = token+1 mod v): the lifecycle/streaming
+    machinery is model-agnostic, so the legs run in seconds on CPU
+    (the llama exactness tiers live in tests/)."""
+    from types import SimpleNamespace
+
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from nexus_tpu.cluster.store import ClusterStore
+        from nexus_tpu.fleet import PrefixAffinityRouter, ServeFleet
+        from nexus_tpu.fleet.autoscaler import SloAutoscaler
+        from nexus_tpu.obs.journey import goodput_under_slo
+        from nexus_tpu.runtime.serving import ServingEngine
+        from nexus_tpu.runtime.traffic import TraceSource, synthesize_trace
+
+        v = 64
+        cfg = SimpleNamespace(
+            n_layers=1, n_kv_heads=1, head_dim=8, dtype=jnp.float32,
+            max_seq_len=512, vocab_size=v,
+        )
+
+        def fwd(params, cfg_, tokens, cache):
+            logits = jax.nn.one_hot((tokens + 1) % v, v) * 10.0
+            new = {k: x for k, x in cache.items() if k != "n_valid"}
+            nv = cache.get("n_valid")
+            adv = tokens.shape[1] if nv is None else nv
+            new["length"] = cache["length"] + adv
+            return logits.astype(jnp.float32), new
+
+        def cyclic_completion(prompt, budget):
+            out, cur = [], int(prompt[-1])
+            for _ in range(int(budget)):
+                cur = (cur + 1) % v
+                out.append(cur)
+            return out
+
+        def trace_for(arrival, seed, n):
+            return synthesize_trace(
+                name=f"r16-{arrival}", seed=seed, vocab_size=v,
+                requests=n, duration_s=1.2, arrival=arrival,
+                burst_duty=0.2, n_prefixes=4, zipf_a=1.3,
+                prefix_tokens=32, tail_tokens=8, max_new_tokens=16,
+                multi_turn_frac=0.25, turns=2, think_s=0.25,
+                branch_frac=0.25, fanout=3,
+                completion_fn=cyclic_completion,
+            )
+
+        out = {}
+
+        # ---- leg A: warm-vs-cold A/B on one persistent engine ----
+        trace = trace_for("poisson", seed=161, n=20)
+        queue = trace.to_requests()
+        prompt_tokens = sum(len(r.prompt) for r in queue)
+
+        def mk_engine():
+            return ServingEngine(
+                fwd, {}, cfg, batch_size=4, max_len=256, chunk=4,
+                kv_block_size=16,
+            )
+
+        cold_a, cold_b = mk_engine(), mk_engine()
+        cold1, mc1 = cold_a.serve(queue)
+        cold2, mc2 = cold_b.serve(queue)
+        warm_eng = mk_engine()
+        warm1, mw1 = warm_eng.serve(queue)
+        warm2, mw2 = warm_eng.serve(queue)
+        exact = all(
+            c is not None and w is not None and c.tokens == w.tokens
+            for c, w in zip(cold2, warm2)
+        )
+        slo = 2.0
+        g_cold = goodput_under_slo(cold2, slo, mc2["wall_s"])
+        g_warm = goodput_under_slo(warm2, slo, mw2["wall_s"])
+        out.update({
+            "warm_exact": exact,
+            "warm_trace_version": trace.version,
+            "warm_trace_events": len(trace),
+            "warm_prompt_tokens": prompt_tokens,
+            "warm_cross_call_hit_tokens":
+                mw2["prefix_hit_tokens_cross_call"],
+            "warm_cross_call_hit_requests":
+                mw2["prefix_hit_requests_cross_call"],
+            "warm_cross_call_hit_rate": round(
+                mw2["prefix_hit_tokens_cross_call"]
+                / max(1, prompt_tokens), 4,
+            ),
+            "cold_cross_call_hit_tokens":
+                mc2["prefix_hit_tokens_cross_call"],
+            "warm_second_prefill_steps": mw2["prefill_steps"],
+            "cold_second_prefill_steps": mc2["prefill_steps"],
+            "warm_prefill_steps_saved_vs_cold":
+                mc2["prefill_steps"] - mw2["prefill_steps"],
+            "warm_second_step_slots": mw2["scheduled_step_slots"],
+            "cold_second_step_slots": mc2["scheduled_step_slots"],
+            "warm_step_slots_saved_vs_cold":
+                mc2["scheduled_step_slots"] - mw2["scheduled_step_slots"],
+            "warm_second_cow_copies": mw2.get("prefix_cow_copies", 0),
+            "warm_goodput_tok_s": g_warm["goodput_tok_s"],
+            "cold_goodput_tok_s": g_cold["goodput_tok_s"],
+            "warm_goodput_gain": round(
+                g_warm["goodput_tok_s"]
+                / max(1e-9, g_cold["goodput_tok_s"]), 3,
+            ),
+        })
+        progress(
+            f"warm-vs-cold: exact={exact} cross_hit_rate="
+            f"{out['warm_cross_call_hit_rate']} prefill_saved="
+            f"{out['warm_prefill_steps_saved_vs_cold']} step_slots_saved="
+            f"{out['warm_step_slots_saved_vs_cold']} goodput_gain="
+            f"{out['warm_goodput_gain']}x"
+        )
+
+        # ---- leg B: open-loop streamed fleet, poisson + bursty ----
+        for arrival in ("poisson", "bursty"):
+            tr = trace_for(arrival, seed=162, n=24)
+
+            def make_engine(rid):
+                return ServingEngine(
+                    fwd, {}, cfg, batch_size=2, max_len=256, chunk=4,
+                    kv_block_size=16, gauge_tags=[f"engine:{rid}"],
+                )
+
+            auto = SloAutoscaler(
+                min_replicas=2, max_replicas=4, queue_high=1.5,
+                breach_polls=2, clear_polls=8,
+            )
+            fleet = ServeFleet(
+                make_engine, ClusterStore(f"bench-traffic-{arrival}"),
+                "bench", f"traffic-{arrival}", replicas=2,
+                router=PrefixAffinityRouter(
+                    [], block_size=16, affinity_depth=2,
+                ),
+                autoscaler=auto, ttl_seconds=0.4, pace_s=0.01,
+                slo_s=slo,
+            )
+            results, report = fleet.run_stream(
+                TraceSource(tr), timeout_s=120.0,
+            )
+            ups = sum(1 for e in report["scale_events"]
+                      if e["kind"] == "up")
+            key = f"traffic_{arrival}"
+            out.update({
+                f"{key}_events": len(tr),
+                f"{key}_streamed": report.get("streamed", 0),
+                f"{key}_requests_lost": report["requests_lost"],
+                f"{key}_replicas_started": report["replicas_started"],
+                f"{key}_scale_ups": ups,
+                f"{key}_scale_events": len(report["scale_events"]),
+                f"{key}_migrations": report["migrations"],
+                f"{key}_slo_attainment":
+                    report["slo"]["slo_attainment"],
+                f"{key}_goodput_tok_s": report["slo"]["goodput_tok_s"],
+                f"{key}_queue_p95_s": round(float(np.percentile(
+                    [r.queue_s for r in results if r is not None], 95,
+                )), 4) if any(r is not None for r in results) else None,
+            })
+            progress(
+                f"traffic {arrival}: streamed="
+                f"{out[f'{key}_streamed']} lost="
+                f"{out[f'{key}_requests_lost']} scale_ups={ups} "
+                f"attainment={out[f'{key}_slo_attainment']} "
+                f"goodput={out[f'{key}_goodput_tok_s']} tok/s"
+            )
+        return out
+    except Exception as e:  # noqa: BLE001 — hermetic leg must not kill bench
+        progress(f"traffic scenarios failed: {type(e).__name__}: "
+                 f"{str(e)[:160]}")
+        return {}
+
+
 def _serve_only_stage(progress):
     """Serve-only stage (`make bench-serve`, NEXUS_BENCH_SERVE=only):
     the paged-KV ledger and the row-scaling point, CPU-runnable — the
@@ -2154,6 +2348,12 @@ def _serve_only_stage(progress):
     fleet_env = os.environ.get("NEXUS_BENCH_SERVE_FLEET", "1")
     if fleet_env == "only":
         out.update(_serve_fleet_scenarios(preset, progress, block, chunk))
+        return out
+    # NEXUS_BENCH_SERVE_TRAFFIC=only: just the round-16 warm-vs-cold
+    # A/B + open-loop streamed fleet legs (`make bench-serve-traffic`)
+    traffic_env = os.environ.get("NEXUS_BENCH_SERVE_TRAFFIC", "1")
+    if traffic_env == "only":
+        out.update(_serve_traffic_scenarios(progress))
         return out
     legs = {}
     for rows in (4, 16):
@@ -2387,6 +2587,19 @@ def _write_serve_artifact(sv):
             "value": round(value, 3),
             "unit": unit,
             "vs_baseline": round((2.0 - value) / 2.0, 4),
+        }
+    elif "warm_cross_call_hit_rate" in sv:
+        # focused round-16 runs (NEXUS_BENCH_SERVE_TRAFFIC=only):
+        # headline the warm engine's cross-call prefix hit rate (hit
+        # tokens against prior-call blocks over prompt tokens on the
+        # trace's second pass; cold baseline is exactly 0, so the rate
+        # itself is the gain — vs_baseline restates it)
+        val = float(sv.get("warm_cross_call_hit_rate") or 0.0)
+        rec = {
+            "metric": "serve_warm_cross_call_hit_rate",
+            "value": round(val, 4),
+            "unit": "hit_tokens_per_prompt_token_cold_0",
+            "vs_baseline": round(val, 4),
         }
     elif "fleet_agg_scaling_r4" in sv:
         # focused round-14 runs (NEXUS_BENCH_SERVE_FLEET=only):

@@ -657,6 +657,36 @@ class ServeSpec:
     # scale-down — a one-poll spike or dip never moves the fleet
     scale_breach_polls: int = 3
     scale_clear_polls: int = 6
+    # ---- open-loop trace-driven load (round 16, runtime/traffic.py) ----
+    # request ARRIVAL process: "closed" (default) hands the whole queue
+    # to the engine at t=0 — the pre-round-16 closed loop, bit-for-bit.
+    # "poisson" / "bursty" synthesize a versioned arrival trace from the
+    # template seed (Zipf-shared prefixes, optional multi-turn chats and
+    # agent fan-outs below) and STREAM it into the running engine/fleet
+    # through a TraceSource — queue time and the goodput ledger anchor
+    # at trace arrival, not serve() entry (docs/fleet.md).
+    arrival: str = "closed"
+    # span (seconds) the synthesized arrivals cover: poisson spreads
+    # exponential gaps across it, bursty packs the same request count
+    # into on-phases covering arrivalBurstDuty of it
+    arrival_duration_s: float = 4.0
+    arrival_burst_duty: float = 0.25
+    # shared-prefix pool the trace draws roots from: tracePrefixPool
+    # distinct preambles, rank-probability ~ 1/rank^traceZipfA — the
+    # skew that makes cross-request (and warm cross-CALL) prefix hits
+    # the common case
+    trace_prefix_pool: int = 4
+    trace_zipf_a: float = 1.1
+    # fraction of roots that become traceTurns-turn chat sessions, each
+    # follow-up arriving ~traceThinkSeconds after the prior turn with
+    # the full history (prior prompt + completion) as its prompt
+    trace_multi_turn_frac: float = 0.0
+    trace_turns: int = 2
+    trace_think_s: float = 0.4
+    # fraction of roots that become agent-style fan-outs: traceFanout
+    # children sharing the root's history and diverging in their tails
+    trace_branch_frac: float = 0.0
+    trace_fanout: int = 3
 
     def kv_request_cap(self, max_seq_len: int) -> int:
         """Worst-case cache positions ONE synthetic-queue request can
@@ -803,6 +833,26 @@ class ServeSpec:
             d["scaleBreachPolls"] = self.scale_breach_polls
         if self.scale_clear_polls != 6:
             d["scaleClearPolls"] = self.scale_clear_polls
+        if self.arrival != "closed":
+            d["arrival"] = self.arrival
+        if self.arrival_duration_s != 4.0:
+            d["arrivalDurationSeconds"] = self.arrival_duration_s
+        if self.arrival_burst_duty != 0.25:
+            d["arrivalBurstDuty"] = self.arrival_burst_duty
+        if self.trace_prefix_pool != 4:
+            d["tracePrefixPool"] = self.trace_prefix_pool
+        if self.trace_zipf_a != 1.1:
+            d["traceZipfA"] = self.trace_zipf_a
+        if self.trace_multi_turn_frac:
+            d["traceMultiTurnFrac"] = self.trace_multi_turn_frac
+        if self.trace_turns != 2:
+            d["traceTurns"] = self.trace_turns
+        if self.trace_think_s != 0.4:
+            d["traceThinkSeconds"] = self.trace_think_s
+        if self.trace_branch_frac:
+            d["traceBranchFrac"] = self.trace_branch_frac
+        if self.trace_fanout != 3:
+            d["traceFanout"] = self.trace_fanout
         return d
 
     @classmethod
@@ -860,6 +910,36 @@ class ServeSpec:
             scale_clear_polls=int(
                 6 if d.get("scaleClearPolls") is None
                 else d["scaleClearPolls"]
+            ),
+            arrival=str(d.get("arrival") or "closed"),
+            arrival_duration_s=float(
+                4.0 if d.get("arrivalDurationSeconds") is None
+                else d["arrivalDurationSeconds"]
+            ),
+            arrival_burst_duty=float(
+                0.25 if d.get("arrivalBurstDuty") is None
+                else d["arrivalBurstDuty"]
+            ),
+            trace_prefix_pool=int(
+                4 if d.get("tracePrefixPool") is None
+                else d["tracePrefixPool"]
+            ),
+            trace_zipf_a=float(
+                1.1 if d.get("traceZipfA") is None else d["traceZipfA"]
+            ),
+            trace_multi_turn_frac=float(
+                d.get("traceMultiTurnFrac", 0) or 0
+            ),
+            trace_turns=int(
+                2 if d.get("traceTurns") is None else d["traceTurns"]
+            ),
+            trace_think_s=float(
+                0.4 if d.get("traceThinkSeconds") is None
+                else d["traceThinkSeconds"]
+            ),
+            trace_branch_frac=float(d.get("traceBranchFrac", 0) or 0),
+            trace_fanout=int(
+                3 if d.get("traceFanout") is None else d["traceFanout"]
             ),
             num_requests=int(d.get("numRequests", 32) or 32),
             prompt_length_min=int(d.get("promptLengthMin", 16) or 16),
@@ -1490,6 +1570,60 @@ class JaxXlaRuntime:
                     ">= 1 (hysteresis is counted in autoscaler polls), "
                     f"got {sv.scale_breach_polls}/{sv.scale_clear_polls}"
                 )
+            if sv.arrival not in ("closed", "poisson", "bursty"):
+                errs.append(
+                    "serve.arrival must be one of closed/poisson/"
+                    f"bursty, got {sv.arrival!r}"
+                )
+            elif sv.arrival != "closed":
+                if sv.arrival_duration_s <= 0:
+                    errs.append(
+                        "serve.arrivalDurationSeconds must be > 0 under "
+                        f"open-loop arrivals, got {sv.arrival_duration_s}"
+                    )
+                if not (0 < sv.arrival_burst_duty <= 1):
+                    errs.append(
+                        "serve.arrivalBurstDuty must be in (0, 1], got "
+                        f"{sv.arrival_burst_duty}"
+                    )
+                if sv.trace_prefix_pool < 1 or sv.trace_zipf_a <= 0:
+                    errs.append(
+                        "serve.tracePrefixPool must be >= 1 and "
+                        "traceZipfA > 0, got "
+                        f"{sv.trace_prefix_pool}/{sv.trace_zipf_a}"
+                    )
+                for frac_name, frac in (
+                    ("traceMultiTurnFrac", sv.trace_multi_turn_frac),
+                    ("traceBranchFrac", sv.trace_branch_frac),
+                ):
+                    if not (0 <= frac <= 1):
+                        errs.append(
+                            f"serve.{frac_name} must be in [0, 1], "
+                            f"got {frac}"
+                        )
+                if sv.trace_multi_turn_frac > 0 and sv.trace_turns < 2:
+                    errs.append(
+                        "serve.traceTurns must be >= 2 when "
+                        "traceMultiTurnFrac > 0, got "
+                        f"{sv.trace_turns}"
+                    )
+                if sv.trace_branch_frac > 0 and sv.trace_fanout < 1:
+                    errs.append(
+                        "serve.traceFanout must be >= 1 when "
+                        "traceBranchFrac > 0, got "
+                        f"{sv.trace_fanout}"
+                    )
+                if sv.trace_think_s < 0:
+                    errs.append(
+                        "serve.traceThinkSeconds must be >= 0, got "
+                        f"{sv.trace_think_s}"
+                    )
+                if sv.prompts:
+                    errs.append(
+                        "serve.arrival trace synthesis and "
+                        "serve.prompts (a literal closed-loop queue) "
+                        "are mutually exclusive"
+                    )
             if sv.prompt_lookup_ngram > 0 and sv.draft is not None:
                 errs.append(
                     "serve.promptLookupNgram and serve.draft are "

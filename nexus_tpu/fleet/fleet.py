@@ -31,12 +31,18 @@ planner, so a request that completed on its second home reports
 ``failed_over``/``retries >= 1`` either way (the honest record that
 more than one engine served it; docs/fleet.md).
 
-Engine caches are per-``serve()``-call (the allocator and radix index
-are built inside ``serve``), so affinity pays off WITHIN each routed
-batch — same-prefix requests single-home and dedupe in one admission
-stream. The cross-call warm-cache story (a persistent per-replica
-radix tree + host tier) is the disaggregated-serving ROADMAP item;
-the router is built for it (keys are stable across calls).
+Engine caches are ENGINE-LIFETIME (round 16): each replica's block
+pool, radix tree, and host tier are built at engine init and survive
+across its serve calls, so affinity pays off across the whole run —
+the router's stable keys home repeat prefixes onto replicas whose
+warm trees already hold them, and every call boundary passes the
+NEXUS_SANITIZE warm-boundary audits. ``run(..., source=)`` is the
+matching OPEN-LOOP drive: a trace source (``nexus_tpu/runtime/
+traffic.py``) streams arrivals into the monitor loop while engines
+run, so the autoscaler scales, the router spills, and failover drains
+against live load; per-entry arrival stamps rebase onto each engine
+call's clock so ``ServeResult.queue_s`` and the goodput rollup anchor
+at TRUE arrival, not ``serve()`` entry.
 """
 
 from __future__ import annotations
@@ -374,11 +380,25 @@ class ServeFleet:
         self._tripped: set = set()  # monitor-thread only
         self._death_journeys: List[str] = []  # monitor-thread only
         self._monitor_polls = 0  # monitor-thread only
+        # streaming run clock base: set by run(source=) BEFORE replicas
+        # spawn, cleared in its finally — workers read it to rebase
+        # entry arrivals onto their engine call's clock (write-once per
+        # run, so no lock needed on the read side)
+        self._stream_t0: Optional[float] = None
         # (autoscaler poll index, +1 up / -1 down) of the last scale
         # move — the flap detector's memory (monitor-thread only)
         self._last_scale: Optional[Tuple[int, int]] = None
 
     # ------------------------------------------------------------------ load
+    def _inbox_depth(self, rep: "_Replica") -> int:
+        """Routed-but-unserved entries waiting on ``rep`` — the
+        engine's ``ext_backlog`` hook, so its live ``serve_queue_depth``
+        gauge counts work the fleet has committed to this replica that
+        the engine hasn't admitted yet (the autoscaler and p2c spill
+        read real backlog, not just the in-call queue)."""
+        with self._lock:
+            return len(rep.inbox)
+
     def _route_load(self, rid: str) -> float:
         from nexus_tpu.utils.telemetry import METRIC_SERVE_QUEUE_DEPTH
 
@@ -529,10 +549,35 @@ class ServeFleet:
                     ServeTracer() if self._book is not None else None
                 )
                 t0 = self._clock()
+                # arrival rebase (round 16 streaming): an entry's
+                # arrival is stamped on the FLEET's streaming clock;
+                # the engine anchors queue/latency on ITS OWN call
+                # clock, so shift each arrival by this call's start
+                # (negative = the request waited in the inbox before
+                # this engine ever saw it — exactly the wait the
+                # arrival-anchored queue_s must charge)
+                stream_t0 = self._stream_t0
+                if stream_t0 is not None:
+                    import dataclasses
+
+                    rel = t0 - stream_t0
+                    serve_reqs = [
+                        dataclasses.replace(
+                            e.request,
+                            arrival_s=(
+                                float(e.arrival_s) - rel
+                                if e.arrival_s is not None else 0.0
+                            ),
+                        )
+                        for e in batch
+                    ]
+                else:
+                    serve_reqs = [e.request for e in batch]
                 try:
                     r_results, r_metrics = rep.engine.serve(
-                        [e.request for e in batch],
+                        serve_reqs,
                         cancel=cancel, heartbeat=hb, tracer=call_tracer,
+                        ext_backlog=lambda: self._inbox_depth(rep),
                     )
                 except BaseException as e:  # noqa: BLE001 — surfaced by run()
                     with self._lock:
@@ -704,6 +749,15 @@ class ServeFleet:
             batch, drained = pending
             requeued.extend(self.planner.requeue(batch, drained))
         requeued.extend(inbox)
+        if self._stream_t0 is not None:
+            # streaming: a migrated entry RE-ARRIVES now — restamp so
+            # its next engine charges the post-requeue wait as queue
+            # time (prior serve time rides elapsed_s into the stitched
+            # latency; the detection gap stays uncharged, the planner's
+            # documented engine-clock-pauses discipline)
+            now_rel = self._clock() - self._stream_t0
+            for e in requeued:
+                e.arrival_s = now_rel
         jids = [
             str(getattr(e.request, "journey", "") or "")
             for e in requeued
@@ -904,7 +958,35 @@ class ServeFleet:
             self._scale_down(report, decision.reason)
 
     # -------------------------------------------------------------------- run
-    def run(self, requests: Sequence[Any], timeout_s: float = 180.0
+    def _fresh_streamed(self, reqs: Sequence[Any],
+                        base: int) -> List[RequeueEntry]:
+        """Planner-``fresh`` semantics for a MID-RUN delivery: indices
+        and journey ids continue from ``base`` (the queue length before
+        this delivery), and each entry keeps its source-stamped arrival
+        on the fleet streaming clock."""
+        import dataclasses
+
+        out: List[RequeueEntry] = []
+        for k, req in enumerate(reqs):
+            i = base + k
+            if (dataclasses.is_dataclass(req)
+                    and hasattr(req, "journey")
+                    and not getattr(req, "journey")):
+                req = dataclasses.replace(req, journey=f"j{i}")
+            out.append(RequeueEntry(
+                request_idx=i, request=req,
+                arrival_s=float(getattr(req, "arrival_s", 0.0) or 0.0),
+            ))
+        return out
+
+    def run_stream(self, source: Any, timeout_s: float = 180.0
+                   ) -> Tuple[List[Optional[Any]], Dict[str, Any]]:
+        """Open-loop drive: serve everything ``source`` delivers (see
+        ``run``'s ``source=``) starting from an empty queue."""
+        return self.run([], timeout_s=timeout_s, source=source)
+
+    def run(self, requests: Sequence[Any], timeout_s: float = 180.0,
+            source: Any = None,
             ) -> Tuple[List[Optional[Any]], Dict[str, Any]]:
         """Serve ``requests`` to terminal results across the fleet →
         ``(results, report)``. ``results[i]`` answers ``requests[i]``
@@ -913,9 +995,21 @@ class ServeFleet:
         events, migrations, the router ledger, per-replica serve
         metrics (``replica_metrics`` — every engine teardown's pool
         partition rides here for the leak audit), and flight dumps of
-        every drained generation."""
+        every drained generation.
+
+        ``source`` (round 16) streams arrivals INTO the running fleet:
+        every monitor poll delivers ``source.poll(now_s)`` (``now_s``
+        seconds since run start), routes the new entries while engines
+        serve, and the run completes only when the source is exhausted
+        AND every delivered request has a result — ``results`` then
+        answers ``requests`` + deliveries in arrival order. Entry
+        arrivals anchor queue/latency attribution (see ``_worker``'s
+        rebase) and ``report['streamed']`` counts deliveries."""
+        requests = list(requests)
         results: List[Optional[Any]] = [None] * len(requests)
         run_t0 = self._clock()
+        if source is not None:
+            self._stream_t0 = run_t0
         report: Dict[str, Any] = {
             "deaths": 0,
             "detections_s": [],
@@ -935,9 +1029,26 @@ class ServeFleet:
             self._spawn_replica()
         try:
             entries = self.planner.fresh(requests)
+            if source is not None:
+                for e in entries:
+                    e.arrival_s = float(
+                        getattr(e.request, "arrival_s", 0.0) or 0.0
+                    )
             self._dispatch(entries, report)
             deadline = self._clock() + float(timeout_s)
             while True:
+                if source is not None:
+                    fresh = source.poll(self._clock() - run_t0)
+                    if fresh:
+                        new_entries = self._fresh_streamed(
+                            fresh, base=len(requests)
+                        )
+                        requests.extend(fresh)
+                        results.extend([None] * len(fresh))
+                        report["streamed"] = (
+                            report.get("streamed", 0) + len(fresh)
+                        )
+                        self._dispatch(new_entries, report)
                 with self._lock:
                     finished = self._finished
                     self._finished = []
@@ -960,7 +1071,9 @@ class ServeFleet:
                             stitched.ttft_s, stitched.latency_s,
                             ok=stitched.status in ("ok", "failed_over"),
                         )
-                if all(r is not None for r in results):
+                if all(r is not None for r in results) and (
+                    source is None or source.exhausted()
+                ):
                     break
                 if self._clock() > deadline:
                     raise TimeoutError(
@@ -1008,6 +1121,7 @@ class ServeFleet:
                 t.join(timeout=30.0)
             if attached_log:
                 self.router.decision_log = None
+            self._stream_t0 = None
         with self._lock:
             report["replica_metrics"] = {
                 rid: list(r.metrics_log)

@@ -131,6 +131,13 @@ class RequeueEntry:
     request: Any  # ServeRequest (imported lazily — keep jax out of ha/)
     committed: List[int] = field(default_factory=list)
     elapsed_s: float = 0.0
+    # when the entry ARRIVED, seconds on the fleet's streaming clock
+    # (round 16 open-loop admission; None = closed-loop entry, queue
+    # time anchors at serve() entry as before). The fleet rebases this
+    # onto each engine call's own clock so ServeResult.queue_s measures
+    # from true arrival; a requeued entry is restamped at requeue time
+    # (the engine clock pauses while nothing serves — docs/failover.md)
+    arrival_s: Optional[float] = None
 
 
 class ServeFailoverPlanner:

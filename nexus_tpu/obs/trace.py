@@ -110,6 +110,17 @@ class ServeTracer:
         )
         self.runs += 1
 
+    def extend(self, journey: str = "") -> int:
+        """Open a timeline for ONE request that arrived MID-RUN
+        (round 16 streamed admission: the engine polls its arrival
+        source at wave boundaries and each delivery needs a timeline of
+        its own) → the new request index. The dump's shape is identical
+        to a begin()-sized run — a streamed request's timeline simply
+        starts at its arrival ``t`` instead of 0."""
+        self._timelines.append([])
+        self._journeys.append(str(journey or ""))
+        return len(self._timelines) - 1
+
     def event(self, request_idx: int, kind: str, **fields: Any) -> None:
         """Append one span. ``fields`` must be exactly
         ``SPAN_FIELDS[kind]`` — enforced cheaply by construction order

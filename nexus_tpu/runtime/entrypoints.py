@@ -904,6 +904,7 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None,
         )
         rng = _np.random.RandomState(tr.seed)
         requests = []
+        trace = None
         if literal_ids:
             for i, ids in enumerate(literal_ids):
                 requests.append(ServeRequest(
@@ -913,6 +914,58 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None,
                     seed=i,
                     deadline_s=sv.request_deadline_s,
                 ))
+        elif sv.arrival != "closed":
+            # open-loop trace-driven load (round 16): synthesize the
+            # versioned arrival trace from the template seed and STREAM
+            # it into the running engine — queue time and the goodput
+            # ledger anchor at trace arrival, not serve() entry
+            from nexus_tpu.runtime.traffic import synthesize_trace
+
+            prefix_tokens = (
+                min(sv.shared_prefix_length, max(1, pmax - 2))
+                if sv.shared_prefix_length > 0
+                else min(32, max(1, pmin))
+            )
+            tail_tokens = max(4, min(16, pmax - prefix_tokens))
+            # feasibility at the spec boundary, mirroring the literal-
+            # prompt check: multi-turn histories accrete the prior
+            # turns' completions, so the WORST trace prompt must still
+            # leave decode budget
+            worst = prefix_tokens + tail_tokens
+            if sv.trace_multi_turn_frac > 0:
+                worst += (sv.trace_turns - 1) * (
+                    sv.max_new_max + tail_tokens
+                )
+            elif sv.trace_branch_frac > 0:
+                worst += sv.max_new_max + tail_tokens
+            if worst > cfg.max_seq_len - 2 - sv.serve_slack():
+                raise ValueError(
+                    f"serve.arrival trace's worst prompt ({worst} "
+                    f"tokens across {sv.trace_turns} turns) leaves no "
+                    f"decode budget within max_seq_len "
+                    f"{cfg.max_seq_len}; shrink sharedPrefixLength / "
+                    "maxNewMax / traceTurns"
+                )
+            trace = synthesize_trace(
+                name=f"serve-{sv.arrival}-{tr.seed}",
+                seed=tr.seed,
+                vocab_size=cfg.vocab_size,
+                requests=sv.num_requests,
+                duration_s=sv.arrival_duration_s,
+                arrival=sv.arrival,
+                burst_duty=sv.arrival_burst_duty,
+                n_prefixes=sv.trace_prefix_pool,
+                zipf_a=sv.trace_zipf_a,
+                prefix_tokens=prefix_tokens,
+                tail_tokens=tail_tokens,
+                max_new_tokens=sv.max_new_max,
+                multi_turn_frac=sv.trace_multi_turn_frac,
+                turns=sv.trace_turns,
+                think_s=sv.trace_think_s,
+                branch_frac=sv.trace_branch_frac,
+                fanout=sv.trace_fanout,
+                temperature=sv.temperature,
+            )
         else:
             # sharedPrefixLength: one common preamble (system-prompt
             # shape), drawn once, heads every synthetic prompt — the
@@ -1045,6 +1098,23 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None,
                 serve_fleet_local,
             )
 
+            if trace is not None:
+                # the in-template fleet drive is one-shot and
+                # deterministic (thread-free); it cannot pace a live
+                # stream, so the trace replays as a closed queue in
+                # arrival order with the ARRIVAL stamps kept — queue
+                # time still anchors at trace arrival. True open-loop
+                # streaming acts on the single-engine template path
+                # and the ServeFleet live harness (docs/fleet.md).
+                logger.warning(
+                    "serve.arrival=%s with replicas=%d: the template "
+                    "fleet drive replays the trace as a closed queue "
+                    "(arrival-stamped); live streaming needs the "
+                    "ServeFleet harness", sv.arrival, sv.replicas,
+                )
+                requests = trace.to_requests(
+                    deadline_s=sv.request_deadline_s, arrivals=True,
+                )
             engines = {
                 f"r{i}": make_engine(gauge_tags=[f"engine:r{i}"])
                 for i in range(sv.replicas)
@@ -1100,9 +1170,27 @@ def _run_serve(runtime, family, cfg, mesh, cancel=None, heartbeat=None,
                 else None,
                 engine_tracer=tracer,
             )
-            results, metrics = engine.serve(
-                requests, cancel=cancel, heartbeat=heartbeat,
-            )
+            if trace is not None:
+                # stream the trace into the RUNNING engine: requests
+                # admit as their wall-clock arrivals come due, and the
+                # queue/ttft/goodput ledger anchors at trace arrival
+                from nexus_tpu.runtime.traffic import TraceSource
+
+                source = TraceSource(
+                    trace, deadline_s=sv.request_deadline_s,
+                )
+                results, metrics = engine.serve(
+                    [], cancel=cancel, heartbeat=heartbeat,
+                    source=source,
+                )
+                metrics = dict(metrics)
+                metrics["arrival"] = sv.arrival
+                metrics["trace_version"] = trace.version
+                metrics["trace_events"] = len(trace)
+            else:
+                results, metrics = engine.serve(
+                    requests, cancel=cancel, heartbeat=heartbeat,
+                )
             if replica_id:
                 metrics = dict(metrics)
                 metrics["serve_replica_id"] = replica_id

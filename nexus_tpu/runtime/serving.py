@@ -533,7 +533,21 @@ class ServeRequest:
     drain/requeue, and threaded into each engine's ServeTracer — the
     key that stitches a request's per-engine span timelines across
     every replica it touched into one cross-replica journey. Empty on
-    single-engine runs (nothing to stitch)."""
+    single-engine runs (nothing to stitch).
+
+    ``arrival_s`` (round 16, open-loop serving) is WHEN the request
+    actually arrived, in seconds relative to the serve() call's clock
+    start: 0 (the default) means "existed when serve() began" — the
+    closed-loop behavior, bit-identical to before. Streamed admission
+    (``serve(source=...)``) stamps each request's trace arrival here;
+    the fleet stamps the instant a request entered the fleet (so a
+    request that waited in a replica inbox carries a NEGATIVE offset
+    into the engine call that finally serves it). Latency attribution
+    (``ServeResult.queue_s``/``latency_s``, the ttft rollup, and
+    ``goodput_under_slo``) anchors at arrival, never at serve() entry;
+    ``deadline_s``/``max_queue_delay_s`` count from arrival too (from
+    engine start when the request predates the call) so an open-loop
+    deadline budgets the request's OWN wait, not the stream's."""
 
     prompt: Sequence[int]
     max_new_tokens: int = 128
@@ -543,17 +557,23 @@ class ServeRequest:
     priority: int = 0
     retries: int = 0
     journey: str = ""
+    arrival_s: float = 0.0
 
 
 @dataclass
 class ServeResult:
     """Completed request: prompt + generated ids (stop token included when
-    one was hit), plus per-request timing from serve() start —
-    ``latency_s`` (enqueue → finished), ``queue_s`` (enqueue →
-    admission: the wait the HBM-aware gate and prefix-aware deferral
+    one was hit), plus per-request timing anchored at request ARRIVAL
+    (``ServeRequest.arrival_s``; serve() start for closed-loop queues,
+    where every request arrives at t0 and nothing changes) —
+    ``latency_s`` (arrival → finished), ``queue_s`` (arrival →
+    admission: the wait the HBM-aware gate, prefix-aware deferral, and
+    — under streamed admission — the request's own late arrival
     impose), and ``ttft_s`` (admission → first committed token: the
     prefill cost the user actually feels, observed at chunk granularity
-    — the number prefix caching attacks directly).
+    — the number prefix caching attacks directly; the METRICS rollup
+    ``ttft_p50/p95_s`` is arrival-anchored instead, so open-loop
+    first-token latency includes the queue wait honestly).
 
     ``status`` is the request's TERMINAL disposition — ``ok`` (served to
     completion), ``deadline_exceeded`` (cancelled at a wave boundary;
@@ -1490,6 +1510,96 @@ class ServingEngine:
                 _make_spec_chunk(False),
                 donate_argnums=(1, 5) if donate else (),
             )
+        # int8 KV serving rides the same scaffold as static decode: the
+        # chunk program quantizes on write and the insert path never
+        # touches K/V (chunked prefill streams the prompt in-band), so
+        # the scale planes need no admission-time handling at all.
+        # kv_pool_dtype='int8' (round 10) selects the same quantized
+        # layout at the serve level — one pool, two switches.
+        self._quantized = (
+            bool(getattr(cfg, "kv_cache_quantized", False))
+            or self._kv_pool_int8
+        )
+        # per-position cache bytes across layers and k+v (+ the int8
+        # scale planes) — the currency of the KV metrics
+        if self._quantized:
+            self._pos_bytes = cfg.n_layers * cfg.n_kv_heads * (
+                cfg.head_dim * 1 + 4
+            ) * 2
+        else:
+            self._pos_bytes = (
+                cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
+                * int(np.dtype(cfg.dtype).itemsize) * 2
+            )
+        # ---- engine-LIFETIME KV state (round 16) ----
+        # the device pool, radix tree, and host tier survive across
+        # serve() calls — cross-call prefix reuse is the whole point of
+        # a persistent engine; reset_cache() is the escape hatch
+        self._warmed = False
+        self._serve_calls = 0
+        self.cache_resets = 0
+        self._build_kv_state()
+
+    def _build_kv_state(self) -> None:
+        """(Re)build the engine-lifetime KV bookkeeping from scratch:
+        host spill store, block allocator + radix prefix index, the
+        host-side block-table mirror, and the persisted device cache
+        slot (None = mint fresh on the next serve). Called once at
+        construction and again by :meth:`reset_cache`."""
+        self._host_store = (
+            HostBlockStore(
+                self._host_cache_bytes, dtype=self._host_cache_dtype
+            )
+            if self._paged and self._host_tier else None
+        )
+        self._alloc = (
+            BlockAllocator(
+                self._num_blocks, self._block_size,
+                prefix_index=(
+                    PrefixCacheIndex() if self._prefix else None
+                ),
+                host_cache=self._host_store,
+            )
+            if self._paged else None
+        )
+        # the sanitizer's radix-tree audit hook (and the bench's
+        # introspection point): the content index — engine-lifetime
+        # since round 16, so "last" now means "current"
+        self.last_prefix_index = (
+            self._alloc.index if self._alloc is not None else None
+        )
+        # the sanitizer's host-tier audit hook: spilled tree entries and
+        # store keys must agree bit for bit
+        self.last_host_store = self._host_store
+        self._table_np = np.full(
+            (self._b, self._blocks_per_row or 1), self._num_blocks,
+            dtype=np.int32,
+        )
+        # the persisted device cache between serve() calls; ownership
+        # transfers INTO serve() (donated dispatches consume it), so a
+        # call that raises mid-run leaves this None and the next call
+        # rebuilds from a clean slate via reset_cache()
+        self._kv_cache = None
+        # distinguishes "just (re)built, cache legitimately unminted"
+        # from "a prior call crashed mid-run" at serve() entry — an
+        # explicit reset_cache() must not be re-counted as a crash
+        # recovery there
+        self._kv_fresh = True
+        # digests already indexed when the current serve() call began —
+        # the committed-publication audit treats them as prior calls'
+        # committed text (re-proven when they were published), and the
+        # cross-call hit ledger counts matches against them
+        self.last_preexisting_keys: frozenset = frozenset()
+
+    def reset_cache(self) -> None:
+        """Escape hatch: discard ALL engine-lifetime KV state — the
+        device pool content, the radix prefix tree, and the host spill
+        tier — as if the engine were freshly built. The next serve()
+        call starts cache-cold (its first dispatch re-mints the pool;
+        the compiled programs are untouched, so no re-warm-up). Never
+        call mid-serve."""
+        self._build_kv_state()
+        self.cache_resets += 1
 
     def set_observability(self, tracer: Any = None,
                           flight_recorder: Any = False,
@@ -1519,6 +1629,191 @@ class ServingEngine:
         if self._host_sharding is not None:
             arr = jax.device_put(arr, self._host_sharding)
         return arr
+
+    def _fresh_cache(self):
+        """The serve cache at its REAL layout (paged pool + scratch
+        block, or the legacy dense rows) with the caller's sharding
+        constraint pinned — used for warm-up AND the serving runs so
+        both compile the same program."""
+        b, max_len, cfg = self._b, self._max_len, self._cfg
+        if self._paged:
+            c = init_paged_kv_cache(
+                cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
+                b, self._num_blocks + 1, self._block_size,
+                self._blocks_per_row, quantized=self._quantized,
+            )
+        else:
+            c = init_kv_cache(
+                cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
+                b, max_len, quantized=self._quantized,
+            )
+            c["length"] = jnp.zeros((b,), jnp.int32)
+        c = constrain_kv_sharding(c, self._cache_sharding)
+        if self._host_sharding is not None:
+            # k/v (+ scales) already carry the cache sharding; commit
+            # the host-side leaves (tables, lengths) replicated so the
+            # first dispatch's cache signature equals the steady
+            # state's
+            c = {
+                k: (v if k in ("k", "v", "k_scale", "v_scale")
+                    else jax.device_put(v, self._host_sharding))
+                for k, v in c.items()
+            }
+        return c
+
+    def _fresh_draft_cache(self):
+        """The draft proposer's own KV cache: DENSE rows at the
+        draft's shapes (a draft is small by design, so a worst-case
+        ``batch × max_len`` stripe is cheap next to the target's
+        pool) with vector lengths — rollback is the same
+        pointer-rewind the dense speculative loops use. No block
+        table, no prefix sharing: the draft teacher-forces every
+        admitted prompt from position 0 (see _draft_propose)."""
+        b, max_len = self._b, self._max_len
+        d_cfg = self._draft_cfg
+        dc = init_kv_cache(
+            d_cfg.n_layers, d_cfg.n_kv_heads, d_cfg.head_dim,
+            d_cfg.dtype, b, max_len,
+            quantized=getattr(d_cfg, "kv_cache_quantized", False),
+        )
+        dc["length"] = jnp.zeros((b,), jnp.int32)
+        dc = constrain_kv_sharding(dc, self._draft_cache_sharding)
+        if self._host_sharding is not None:
+            # commit EVERY leaf on the mesh (k/v replicated when no
+            # explicit draft sharding was given): a fresh cache
+            # whose commitment differs from the steady-state jit
+            # outputs is a second compile key for the verify and
+            # draft-reset programs — the PR 7 recompile class
+            kv = ("k", "v", "k_scale", "v_scale")
+            keep = kv if self._draft_cache_sharding is not None else ()
+            dc = {
+                k: (v if k in keep
+                    else jax.device_put(v, self._host_sharding))
+                for k, v in dc.items()
+            }
+        return dc
+
+    @staticmethod
+    def _restore_plane_zeros(c, n):
+        """(L, n, Bs, ...) zero stacks matching every K/V plane of
+        cache ``c`` — the restore wave's padding template (and its
+        warm-up payload)."""
+        planes = {}
+        for key in ("k", "v", "k_scale", "v_scale"):
+            if key in c:
+                shp = c[key].shape
+                planes[key] = np.zeros(
+                    (shp[0], n) + tuple(shp[2:]),
+                    dtype=np.dtype(c[key].dtype),
+                )
+        return planes
+
+    def warmup(self) -> None:
+        """Compile every program the serve loop can dispatch (idempotent
+        — ONCE per engine lifetime, not per call). serve() calls this
+        before starting its clock, so tokens/sec and the per-request
+        latencies measure serving, not XLA compilation; a long-lived
+        replica may call it eagerly at construction time instead so its
+        FIRST streamed arrival doesn't pay the compile either."""
+        if self._warmed:
+            return
+        b, max_len = self._b, self._max_len
+        # warm with the REAL layout or jit compiles a second program for
+        # the constrained cache on the first timed chunk (scale planes
+        # included — unconstrained they replicate on a sharded mesh)
+        warm_cache = self._fresh_cache()
+        warm_buf = self._mint(np.zeros((b, max_len), np.int32))
+
+        def zi():
+            # donation demands DISTINCT buffers per donated argnum (a
+            # shared array would be both donated twice in one call and
+            # dead for the next one) — mint a fresh array per use
+            return self._mint(np.zeros((b,), np.int32))
+
+        def zf():
+            return self._mint(np.zeros((b,), np.float32))
+
+        m_slots = self._blocks_per_row or 1
+        zero_shared = (
+            self._mint(np.int32(0)),
+            self._mint(np.full((m_slots,), self._num_blocks, np.int32)),
+        )
+        # the insert consumes its donated inputs; thread its RETURNS
+        # into the chunk warm-up instead of reusing dead arrays
+        (warm_cache, warm_buf, warm_ptr, warm_plen, warm_temp,
+         warm_seed) = self._insert_fn(
+            warm_cache, warm_buf, zi(), zi(), zf(), zi(),
+            self._mint(np.full((b,), b, np.int32)),
+            self._mint(np.zeros((b, max_len), np.int32)), zi(), zi(),
+            zf(), zi(),
+        )
+        if self._draft:
+            # warm in SERVE order — reset on the eager fresh cache,
+            # then the verify chunk on the reset's jit output — so both
+            # commitment flavors the timed run produces are the ones
+            # already compiled (mirrors the insert→chunk threading
+            # above; the reset first fires at the first admission wave,
+            # inside the timed window)
+            warm_d = self._draft_reset_fn(
+                self._fresh_draft_cache(),
+                self._mint(np.full((b,), b, np.int32)),
+            )
+            out = self._spec_chunk(
+                self._params, self._draft_params, warm_cache, warm_d,
+                zi(), warm_ptr, self._mint(np.ones((b,), np.bool_)),
+                warm_buf, warm_plen, *zero_shared,
+            )
+            np.asarray(out[5])  # host fetch: the warm-up really completed
+            del warm_d
+        elif self._lookup:
+            out = self._spec_chunk(
+                self._params, warm_cache, zi(), warm_ptr,
+                self._mint(np.ones((b,), np.bool_)), warm_buf, warm_plen,
+                *zero_shared,
+            )
+            np.asarray(out[4])  # host fetch: the warm-up really completed
+        else:
+            out = self._decode_chunk(
+                self._params, warm_cache, zi(), warm_ptr,
+                self._mint(np.ones((b,), np.bool_)), warm_buf, warm_plen,
+                warm_temp, warm_seed, *zero_shared,
+            )
+            np.asarray(out[3])  # host fetch: the warm-up really completed
+            if self._decode_chunk_narrow is not self._decode_chunk:
+                # the wide warm-up donated its state; mint fresh buffers
+                # for the pure-decode program's compile
+                warm2 = self._fresh_cache()
+                out = self._decode_chunk_narrow(
+                    self._params, warm2, zi(), zi(),
+                    self._mint(np.ones((b,), np.bool_)),
+                    self._mint(np.zeros((b, max_len), np.int32)), zi(),
+                    zf(), zi(), *zero_shared,
+                )
+                np.asarray(out[3])
+        if self._paged and self._host_tier:
+            # compile the host-tier programs outside the timed window
+            # (they first fire mid-run, under pool pressure): the spill
+            # download with a traced block id, and the restore upload
+            # at its fixed wave width with all-OOB (dropped) padding
+            wc = self._fresh_cache()
+            jax.device_get(
+                self._spill_gather_fn(wc, self._mint(np.int32(0)))
+            )
+            wc = self._restore_write_fn(
+                wc,
+                self._mint(np.full(
+                    (self._restore_wave,), self._num_blocks + 1,
+                    np.int32,
+                )),
+                {k: self._mint(v) for k, v in
+                 self._restore_plane_zeros(
+                     wc, self._restore_wave
+                 ).items()},
+            )
+            np.asarray(wc["length"])
+            del wc
+        del warm_cache, warm_buf, out
+        self._warmed = True
 
     def _validate_request(self, req: ServeRequest, req_idx: int):
         """Per-request admission checks → (prompt, p, budget)."""
@@ -1619,8 +1914,10 @@ class ServingEngine:
         return cache, buf, ptr, plen, temp_vec, seed_vec, out
 
     def serve(self, requests: Sequence[ServeRequest], cancel=None,
-              heartbeat=None, tracer=None):
-        """Run the queue to completion → (results, metrics).
+              heartbeat=None, tracer=None, source=None,
+              ext_backlog=None):
+        """Run the queue (plus any streamed arrivals) to completion →
+        (results, metrics).
 
         results[i] corresponds to requests[i]. Metrics: committed vs
         scheduled step-slots (the continuous-batching win is this
@@ -1657,198 +1954,68 @@ class ServingEngine:
         returns with ``metrics['interrupted'] = True``; unfinished
         entries of ``results`` stay None.
 
-        The two programs (decode chunk + insert) are compiled BEFORE the
-        clock starts — tokens/sec and the per-request latencies measure
-        serving, not XLA compilation (the infer bench warms the same
-        way)."""
+        ``source`` (round 16, open-loop serving): an arrival stream —
+        any object with the :class:`~nexus_tpu.runtime.traffic
+        .TraceSource` protocol (``poll(now_s) -> [ServeRequest]``,
+        ``exhausted()``, ``wait(now_s)``, ``due(now_s)``; times are
+        seconds since THIS call's clock start). The engine polls it at
+        every wave boundary and admits arrivals into the SAME
+        continuous-batching loop the pre-queued requests run in; when
+        every row is idle and the stream has more to deliver, the
+        engine blocks in ``source.wait`` (which sleeps real time or
+        advances an injected clock) instead of returning. ``results``
+        grows to cover streamed requests, in arrival order after the
+        pre-queued ones. ``ext_backlog``: a callable returning how many
+        requests are pending OUTSIDE this call (a fleet replica's
+        inbox) — folded into the ``serve_queue_depth`` live gauge so
+        the autoscaler and p2c spill read real backlog, never engine
+        math.
+
+        The engine's KV state is ENGINE-LIFETIME (round 16): the block
+        pool, radix prefix tree, and host spill tier persist across
+        serve() calls, so a warm engine's admissions match prefixes
+        cached by EARLIER calls (``prefix_hit_tokens_cross_call``
+        ledgers the cross-call share). ``reset_cache()`` drops all of
+        it. Under NEXUS_SANITIZE a warm entry re-audits the boundary
+        state (pool partition, tree closure, store coherence) before
+        serving — state dirtied between calls trips the sanitizer here,
+        not mid-wave.
+
+        Every program the loop can dispatch is compiled BEFORE the
+        clock starts (once per engine lifetime — warmup()) — tokens/sec
+        and the per-request latencies measure serving, not XLA
+        compilation (the infer bench warms the same way)."""
         b, max_len = self._b, self._max_len
-        cfg = self._cfg
-        # int8 KV serving rides the same scaffold as static decode: the
-        # chunk program quantizes on write and the insert path never
-        # touches K/V (chunked prefill streams the prompt in-band), so
-        # the scale planes need no admission-time handling at all.
-        # kv_pool_dtype='int8' (round 10) selects the same quantized
-        # layout at the serve level — one pool, two switches.
-        quantized = (
-            bool(getattr(cfg, "kv_cache_quantized", False))
-            or self._kv_pool_int8
+        requests = list(requests)
+
+        # ---- engine-lifetime KV state pickup (round 16) ----
+        alloc = self._alloc
+        host_store = self._host_store
+        if self._kv_cache is None and not self._kv_fresh:
+            # the prior call raised mid-run: its donated device cache
+            # is gone, so the tree/store bookkeeping points at payloads
+            # that no longer exist — rebuild everything cache-cold
+            # rather than serve stale-block hits (_kv_fresh excludes a
+            # deliberate reset_cache() or a never-served engine, both
+            # already clean)
+            self.reset_cache()
+            alloc = self._alloc
+            host_store = self._host_store
+        if self._sanitize and self._serve_calls > 0 and self._kv_cache is not None:
+            # warm-entry audit: the boundary state a previous call left
+            # behind must still be clean BEFORE new admissions build on
+            # it (the same partition/closure/coherence invariants the
+            # post-serve audits prove, re-checked against between-call
+            # mutation)
+            from nexus_tpu.testing.sanitizers import audit_warm_boundary
+
+            audit_warm_boundary(self, context="serve[warm-entry]")
+        self.last_preexisting_keys = (
+            frozenset(alloc.index.indexed_keys())
+            if alloc is not None and alloc.index is not None
+            else frozenset()
         )
-
-        def fresh_cache():
-            """The serve cache at its REAL layout (paged pool + scratch
-            block, or the legacy dense rows) with the caller's sharding
-            constraint pinned — used for warm-up AND the timed run so
-            both compile the same program."""
-            if self._paged:
-                c = init_paged_kv_cache(
-                    cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
-                    b, self._num_blocks + 1, self._block_size,
-                    self._blocks_per_row, quantized=quantized,
-                )
-            else:
-                c = init_kv_cache(
-                    cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, cfg.dtype,
-                    b, max_len, quantized=quantized,
-                )
-                c["length"] = jnp.zeros((b,), jnp.int32)
-            c = constrain_kv_sharding(c, self._cache_sharding)
-            if self._host_sharding is not None:
-                # k/v (+ scales) already carry the cache sharding; commit
-                # the host-side leaves (tables, lengths) replicated so the
-                # first dispatch's cache signature equals the steady
-                # state's
-                c = {
-                    k: (v if k in ("k", "v", "k_scale", "v_scale")
-                        else jax.device_put(v, self._host_sharding))
-                    for k, v in c.items()
-                }
-            return c
-
-        def fresh_draft_cache():
-            """The draft proposer's own KV cache: DENSE rows at the
-            draft's shapes (a draft is small by design, so a worst-case
-            ``batch × max_len`` stripe is cheap next to the target's
-            pool) with vector lengths — rollback is the same
-            pointer-rewind the dense speculative loops use. No block
-            table, no prefix sharing: the draft teacher-forces every
-            admitted prompt from position 0 (see _draft_propose)."""
-            d_cfg = self._draft_cfg
-            dc = init_kv_cache(
-                d_cfg.n_layers, d_cfg.n_kv_heads, d_cfg.head_dim,
-                d_cfg.dtype, b, max_len,
-                quantized=getattr(d_cfg, "kv_cache_quantized", False),
-            )
-            dc["length"] = jnp.zeros((b,), jnp.int32)
-            dc = constrain_kv_sharding(dc, self._draft_cache_sharding)
-            if self._host_sharding is not None:
-                # commit EVERY leaf on the mesh (k/v replicated when no
-                # explicit draft sharding was given): a fresh cache
-                # whose commitment differs from the steady-state jit
-                # outputs is a second compile key for the verify and
-                # draft-reset programs — the PR 7 recompile class
-                kv = ("k", "v", "k_scale", "v_scale")
-                keep = kv if self._draft_cache_sharding is not None else ()
-                dc = {
-                    k: (v if k in keep
-                        else jax.device_put(v, self._host_sharding))
-                    for k, v in dc.items()
-                }
-            return dc
-
-        # ---- warm-up (outside the timed window) ----
-        # warm with the REAL layout or jit compiles a second program for
-        # the constrained cache on the first timed chunk (scale planes
-        # included — unconstrained they replicate on a sharded mesh)
-        warm_cache = fresh_cache()
-        warm_buf = self._mint(np.zeros((b, max_len), np.int32))
-
-        def zi():
-            # donation demands DISTINCT buffers per donated argnum (a
-            # shared array would be both donated twice in one call and
-            # dead for the next one) — mint a fresh array per use
-            return self._mint(np.zeros((b,), np.int32))
-
-        def zf():
-            return self._mint(np.zeros((b,), np.float32))
-
-        # fused-path operands (traced VALUES — one program whatever the
-        # wave's shared run is): the Hydragen shared-run length and the
-        # aliased leading block ids; an all-scratch table + length 0 is
-        # the no-shared-run neutral element, reused whenever detection
-        # finds nothing (gather/dense engines pass it uninspected)
-        m_slots = self._blocks_per_row or 1
-        zero_shared = (
-            self._mint(np.int32(0)),
-            self._mint(np.full((m_slots,), self._num_blocks, np.int32)),
-        )
-
-        # the insert consumes its donated inputs; thread its RETURNS
-        # into the chunk warm-up instead of reusing dead arrays
-        (warm_cache, warm_buf, warm_ptr, warm_plen, warm_temp,
-         warm_seed) = self._insert_fn(
-            warm_cache, warm_buf, zi(), zi(), zf(), zi(),
-            self._mint(np.full((b,), b, np.int32)),
-            self._mint(np.zeros((b, max_len), np.int32)), zi(), zi(), zf(), zi(),
-        )
-        if self._draft:
-            # warm in SERVE order — reset on the eager fresh cache,
-            # then the verify chunk on the reset's jit output — so both
-            # commitment flavors the timed run produces are the ones
-            # already compiled (mirrors the insert→chunk threading
-            # above; the reset first fires at the first admission wave,
-            # inside the timed window)
-            warm_d = self._draft_reset_fn(
-                fresh_draft_cache(),
-                self._mint(np.full((b,), b, np.int32)),
-            )
-            out = self._spec_chunk(
-                self._params, self._draft_params, warm_cache, warm_d,
-                zi(), warm_ptr, self._mint(np.ones((b,), np.bool_)),
-                warm_buf, warm_plen, *zero_shared,
-            )
-            np.asarray(out[5])  # host fetch: the warm-up really completed
-            del warm_d
-        elif self._lookup:
-            out = self._spec_chunk(
-                self._params, warm_cache, zi(), warm_ptr,
-                self._mint(np.ones((b,), np.bool_)), warm_buf, warm_plen,
-                *zero_shared,
-            )
-            np.asarray(out[4])  # host fetch: the warm-up really completed
-        else:
-            out = self._decode_chunk(
-                self._params, warm_cache, zi(), warm_ptr,
-                self._mint(np.ones((b,), np.bool_)), warm_buf, warm_plen,
-                warm_temp, warm_seed, *zero_shared,
-            )
-            np.asarray(out[3])  # host fetch: the warm-up really completed
-            if self._decode_chunk_narrow is not self._decode_chunk:
-                # the wide warm-up donated its state; mint fresh buffers
-                # for the pure-decode program's compile
-                warm2 = fresh_cache()
-                out = self._decode_chunk_narrow(
-                    self._params, warm2, zi(), zi(),
-                    self._mint(np.ones((b,), np.bool_)),
-                    self._mint(np.zeros((b, max_len), np.int32)), zi(), zf(), zi(),
-                    *zero_shared,
-                )
-                np.asarray(out[3])
-
-        def restore_plane_zeros(c, n):
-            """(L, n, Bs, ...) zero stacks matching every K/V plane of
-            cache ``c`` — the restore wave's padding template (and its
-            warm-up payload)."""
-            planes = {}
-            for key in ("k", "v", "k_scale", "v_scale"):
-                if key in c:
-                    shp = c[key].shape
-                    planes[key] = np.zeros(
-                        (shp[0], n) + tuple(shp[2:]),
-                        dtype=np.dtype(c[key].dtype),
-                    )
-            return planes
-
-        if self._paged and self._host_tier:
-            # compile the host-tier programs outside the timed window
-            # (they first fire mid-run, under pool pressure): the spill
-            # download with a traced block id, and the restore upload
-            # at its fixed wave width with all-OOB (dropped) padding
-            wc = fresh_cache()
-            jax.device_get(
-                self._spill_gather_fn(wc, self._mint(np.int32(0)))
-            )
-            wc = self._restore_write_fn(
-                wc,
-                self._mint(np.full(
-                    (self._restore_wave,), self._num_blocks + 1,
-                    np.int32,
-                )),
-                {k: self._mint(v) for k, v in
-                 restore_plane_zeros(wc, self._restore_wave).items()},
-            )
-            np.asarray(wc["length"])
-            del wc
-        del warm_cache, warm_buf, out
+        self.warmup()  # idempotent: compiles once per engine lifetime
 
         t0 = self._clock()
         self.last_drain = None
@@ -1894,8 +2061,42 @@ class ServingEngine:
         if flight is not None:
             flight.record("run_start", t=0.0, requests=len(requests))
         interrupted = False
-        cache = fresh_cache()  # vector length from step 0
-        d_cache = fresh_draft_cache() if self._draft else None
+
+        def zi():
+            # donation demands DISTINCT buffers per donated argnum (a
+            # shared array would be both donated twice in one call and
+            # dead for the next one) — mint a fresh array per use
+            return self._mint(np.zeros((b,), np.int32))
+
+        def zf():
+            return self._mint(np.zeros((b,), np.float32))
+
+        # fused-path operands (traced VALUES — one program whatever the
+        # wave's shared run is): the Hydragen shared-run length and the
+        # aliased leading block ids; an all-scratch table + length 0 is
+        # the no-shared-run neutral element, reused whenever detection
+        # finds nothing (gather/dense engines pass it uninspected)
+        m_slots = self._blocks_per_row or 1
+        zero_shared = (
+            self._mint(np.int32(0)),
+            self._mint(np.full((m_slots,), self._num_blocks, np.int32)),
+        )
+        # the device cache's OWNERSHIP transfers into this call: a warm
+        # engine resumes the pool its last call left behind (parked
+        # prefix payloads intact — the cross-call hit surface); a fresh
+        # engine, or one reset_cache() just wiped, mints cold. Donated
+        # dispatches consume the array, so the slot is cleared here and
+        # re-stashed only when the call completes.
+        if self._kv_cache is not None:
+            cache = self._kv_cache
+            self._kv_cache = None
+        else:
+            cache = self._fresh_cache()  # vector length from step 0
+        # the freshness token is consumed HERE: from this point on, a
+        # None _kv_cache means this call died mid-run and the next call
+        # must rebuild (see the entry check above)
+        self._kv_fresh = False
+        d_cache = self._fresh_draft_cache() if self._draft else None
         buf = self._mint(np.zeros((b, max_len), np.int32))
         tok_vec = zi()
         ptr_vec = zi()
@@ -1915,6 +2116,24 @@ class ServingEngine:
         # cache saves) and re-queue it at the front; a pool-full refusal
         # still blocks the head (refund-wait, never overtaken).
         pending = deque(range(len(requests)))
+        # arrival anchoring (round 16): the absolute arrival stamp per
+        # request — t0 + arrival_s. Latency/queue attribution and the
+        # ttft rollup measure from here; deadlines and the queue-delay
+        # shed anchor at max(arrival, t0) so a request whose arrival
+        # predates this call (fleet inbox wait: arrival_s < 0) can
+        # never be charged engine time it spent elsewhere twice, and a
+        # streamed arrival's deadline budgets ITS wait, not the
+        # stream's.
+        arrive_t = [
+            t0 + float(getattr(r, "arrival_s", 0.0) or 0.0)
+            for r in requests
+        ]
+
+        def dl_anchor(req_idx: int) -> float:
+            a = arrive_t[req_idx]
+            return a if a > t0 else t0
+
+        streamed = 0
         committed = 0
         scheduled_slots = 0
         chunks = 0
@@ -1936,29 +2155,7 @@ class ServingEngine:
         # ---- paged-pool bookkeeping (all host-side) ----
         # per-position cache bytes across layers and k+v (+ the int8
         # scale planes) — the currency of the KV metrics
-        if quantized:
-            pos_bytes = cfg.n_layers * cfg.n_kv_heads * (
-                cfg.head_dim * 1 + 4
-            ) * 2
-        else:
-            pos_bytes = (
-                cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
-                * int(np.dtype(cfg.dtype).itemsize) * 2
-            )
-        host_store = (
-            HostBlockStore(
-                self._host_cache_bytes, dtype=self._host_cache_dtype
-            )
-            if self._paged and self._host_tier else None
-        )
-        alloc = (
-            BlockAllocator(
-                self._num_blocks, self._block_size,
-                prefix_index=PrefixCacheIndex() if self._prefix else None,
-                host_cache=host_store,
-            )
-            if self._paged else None
-        )
+        pos_bytes = self._pos_bytes
         if host_store is not None:
             def spill_download(blk: int, _key: bytes) -> dict:
                 """The device half of a demotion: gather the victim's
@@ -1971,20 +2168,19 @@ class ServingEngine:
                 ))
                 return {k: np.asarray(v) for k, v in planes.items()}
 
+            # re-bound every call: the closure reads THIS call's live
+            # ``cache`` local (the engine-lifetime allocator outlives
+            # any one call's device array)
             alloc.spill_fn = spill_download
-        # the sanitizer's radix-tree audit hook (and the bench's
-        # introspection point): the content index of the LAST serve run
-        self.last_prefix_index = alloc.index if alloc is not None else None
-        # the sanitizer's host-tier audit hook: spilled tree entries and
-        # store keys must agree bit for bit
-        self.last_host_store = host_store
         leases: List[Optional[_BlockLease]] = [None] * b
         caps = [0] * b  # _row_cap per active row
         plen_host = [0] * b  # prompt length per active row
         scratch = self._num_blocks  # the one block the allocator never owns
-        table_np = np.full(
-            (b, self._blocks_per_row or 1), scratch, dtype=np.int32
-        )
+        # engine-lifetime table mirror: at every call boundary all rows
+        # point at scratch (release_row resets them), so persisting the
+        # array is free — and the first wave always re-pushes it
+        # (table_dirty starts True)
+        table_np = self._table_np
         reserved_blocks_total = 0  # Σ per-admission PRIVATE reservations
         alloc_block_steps = 0  # Σ per-chunk allocated blocks (residency)
         table_dirty = [True]  # admission/finish/growth since last push
@@ -1995,6 +2191,12 @@ class ServingEngine:
         keys_cache: dict = {}  # request idx → chain keys (deferral re-scan)
         hit_tokens = 0
         hit_requests = 0
+        # cross-call share of the hits (round 16): matched tokens whose
+        # digests were already indexed when THIS call began — prefix
+        # reuse paid for by a PREVIOUS call's prefill/decode work
+        pre_keys = self.last_preexisting_keys
+        cross_hit_tokens = 0
+        cross_hit_requests = 0
         cow_copies = 0
         # host-tier ledger (round 10): prompt tokens served by swapping
         # spilled blocks back in (a subset of hit_tokens), and the
@@ -2100,26 +2302,34 @@ class ServingEngine:
         def finish(state: _RowState, status: str = STATUS_OK) -> None:
             nonlocal committed
             committed += len(state.emitted)
+            arr = arrive_t[state.request_idx]
             ttft = max(0.0, state.first_tok_t - state.admitted_t)
-            queue_s = max(0.0, state.admitted_t - t0)
+            # the ROLLUP ttft anchors at ARRIVAL (round 16): under
+            # streamed admission "time to first token" the user felt
+            # includes the queue wait, or an open-loop p95 would look
+            # flat while the backlog exploded
+            ttft_arr = max(0.0, state.first_tok_t - arr)
+            queue_s = max(0.0, state.admitted_t - arr)
             if status == STATUS_OK:
                 # the latency rollups describe SERVED requests only — a
                 # cancelled row's ttft must not flatter (or poison) the
                 # p95 of the work that actually completed
-                ttfts.append(ttft)
+                ttfts.append(ttft_arr)
                 queues.append(queue_s)
                 if gauges is not None:
                     # same population as the end-of-run rollup, so the
                     # rolling p95 and the final p95 agree on the data
-                    gauges.observe_finish(ttft, queue_s)
-            done_t = self._clock() - t0
+                    gauges.observe_finish(ttft_arr, queue_s)
+            done = self._clock()
+            done_t = done - t0
+            latency = max(0.0, done - arr)
             results[state.request_idx] = ServeResult(
                 tokens=list(np.asarray(
                     requests[state.request_idx].prompt, dtype=np.int32
                 )) + state.emitted,
                 new_tokens=len(state.emitted),
                 finished_by_stop=state.stopped,
-                latency_s=done_t,
+                latency_s=latency,
                 ttft_s=round(ttft, 6),
                 queue_s=round(queue_s, 6),
                 status=status,
@@ -2132,7 +2342,7 @@ class ServingEngine:
                     state.request_idx, "terminal",
                     t=round(done_t, 6), status=status,
                     new_tokens=len(state.emitted),
-                    latency_s=round(done_t, 6),
+                    latency_s=round(latency, 6),
                     finished_by_stop=state.stopped,
                 )
             if flight is not None and status == STATUS_DEADLINE_EXCEEDED:
@@ -2145,14 +2355,15 @@ class ServingEngine:
             """Terminal result for a request REFUSED before admission
             (shed / queued-deadline-miss): prompt only, zero compute."""
             req = requests[req_idx]
-            done_t = self._clock() - t0
+            done = self._clock()
+            done_t = done - t0
             results[req_idx] = ServeResult(
                 tokens=[int(t) for t in np.asarray(
                     req.prompt, dtype=np.int32
                 )],
                 new_tokens=0,
                 finished_by_stop=False,
-                latency_s=done_t,
+                latency_s=max(0.0, done - arrive_t[req_idx]),
                 status=status,
                 retries=int(getattr(req, "retries", 0)),
             )
@@ -2179,12 +2390,13 @@ class ServingEngine:
             for req_idx in list(pending):
                 req = requests[req_idx]
                 dl = float(getattr(req, "deadline_s", 0.0) or 0.0)
-                if dl > 0 and now - t0 >= dl:
+                anchor = dl_anchor(req_idx)
+                if dl > 0 and now - anchor >= dl:
                     pending.remove(req_idx)
                     finish_queued(req_idx, STATUS_DEADLINE_EXCEEDED)
                     deadline_miss_count += 1
                 elif (self._max_queue_delay > 0
-                        and now - t0 > self._max_queue_delay):
+                        and now - anchor > self._max_queue_delay):
                     pending.remove(req_idx)
                     finish_queued(req_idx, STATUS_SHED)
                     shed_count += 1
@@ -2353,6 +2565,7 @@ class ServingEngine:
             nonlocal cache, d_cache, buf, ptr_vec, plen_vec, temp_vec
             nonlocal seed_vec
             nonlocal reserved_blocks_total, hit_tokens, hit_requests
+            nonlocal cross_hit_tokens, cross_hit_requests
             nonlocal cow_copies, admission_overtakes
             nonlocal restore_hit_tokens, restore_hit_requests
             if not free_rows or not pending:
@@ -2442,6 +2655,22 @@ class ServingEngine:
                     hit_depth_hist[depth] = (
                         hit_depth_hist.get(depth, 0) + 1
                     )
+                    if pre_keys:
+                        # the contiguous leading run of matched digests
+                        # that predate this call — tokens a PREVIOUS
+                        # serve() call's work served (the radix prefix
+                        # property makes the pre-existing run a prefix
+                        # of the match)
+                        pre_depth = 0
+                        for kk in keys[:depth]:
+                            if kk not in pre_keys:
+                                break
+                            pre_depth += 1
+                        if pre_depth:
+                            cross_hit_tokens += min(
+                                matched, pre_depth * self._block_size
+                            )
+                            cross_hit_requests += 1
                 row = free_rows.pop(0)
                 admitted_idx.append(req_idx)
                 wave.append((row, req, req_idx, prompt, p, budget, matched))
@@ -2574,7 +2803,7 @@ class ServingEngine:
                 for j0 in range(0, len(restore_jobs), W):
                     batch = restore_jobs[j0:j0 + W]
                     ids = np.full((W,), self._num_blocks + 1, np.int32)
-                    planes = restore_plane_zeros(cache, W)
+                    planes = self._restore_plane_zeros(cache, W)
                     for i, (blk, payload) in enumerate(batch):
                         ids[i] = blk
                         for k_ in planes:
@@ -2598,6 +2827,55 @@ class ServingEngine:
                     restores=len(restore_jobs), cow=len(cow_pairs),
                 )
 
+        src = source
+
+        def poll_source() -> int:
+            """Drain due arrivals from the stream into the wait queue —
+            requests, results, arrival stamps, and (when tracing) a
+            fresh per-request timeline all grow in lock-step. Returns
+            how many arrived; they admit at this wave's boundary like
+            any other queued request."""
+            nonlocal streamed
+            if src is None:
+                return 0
+            new = src.poll(self._clock() - t0)
+            for req in new:
+                idx = len(requests)
+                requests.append(req)
+                results.append(None)
+                arrive_t.append(
+                    t0 + float(getattr(req, "arrival_s", 0.0) or 0.0)
+                )
+                pending.append(idx)
+                streamed += 1
+                if tracer is not None:
+                    tracer.extend(
+                        journey=str(getattr(req, "journey", "") or "")
+                    )
+                    tracer.event(
+                        idx, "enqueued",
+                        t=round(max(0.0, arrive_t[idx] - t0), 6),
+                        prompt_tokens=len(req.prompt),
+                        max_new_tokens=int(req.max_new_tokens),
+                    )
+            return len(new)
+
+        def ext_pending() -> int:
+            """Backlog OUTSIDE the in-call wait queue: arrived-but-
+            unpolled stream events plus whatever the caller's own queue
+            (a fleet replica's inbox) reports. The serve_queue_depth
+            live gauge folds this in so the autoscaler and p2c spill
+            read the real stream, not just this wave's snapshot."""
+            n = 0
+            if src is not None:
+                n += int(src.due(self._clock() - t0))
+            if ext_backlog is not None:
+                n += int(ext_backlog())
+            return n
+
+        def source_live() -> bool:
+            return src is not None and not src.exhausted()
+
         police_deadlines()
         admit_into([r for r in range(b) if rows[r] is None])
         police_depth()
@@ -2611,7 +2889,8 @@ class ServingEngine:
                  "deadline": deadline_miss_count},
             )
 
-        while any(r is not None for r in rows):
+        while (any(r is not None for r in rows) or pending
+                or source_live()):
             if cancel is not None and cancel.cancelled():
                 # engine death / fencing: stop at the wave boundary,
                 # snapshot every unfinished request (committed tokens
@@ -2669,6 +2948,38 @@ class ServingEngine:
                 })
                 interrupted = True
                 break
+            if src is not None:
+                poll_source()
+            if not any(r is not None for r in rows):
+                # every row idle: admit whatever just arrived; when the
+                # stream still has deliveries coming, WAIT for the next
+                # one (real sleep, or an injected clock's advance)
+                # instead of returning with the trace half-served
+                police_deadlines()
+                admit_into([r for r in range(b) if rows[r] is None])
+                police_depth()
+                if not any(r is not None for r in rows):
+                    if not source_live():
+                        break
+                    if heartbeat is not None:
+                        heartbeat(committed)
+                    if gauges is not None:
+                        # idle gaps still publish: the autoscaler must
+                        # see an empty engine with a building backlog
+                        gauges.publish(
+                            queue_depth=len(pending) + ext_pending(),
+                            running_rows=0,
+                            free_pool_blocks=(
+                                alloc.free_blocks if alloc else 0
+                            ),
+                            host_cache_bytes=(
+                                host_store.bytes
+                                if host_store is not None else 0
+                            ),
+                            committed_tokens=committed, waves=chunks,
+                        )
+                    src.wait(self._clock() - t0)
+                    continue
             if self._paged:
                 # map the blocks this dispatch can touch, then sample the
                 # pool's residency for the bytes-per-token metric
@@ -2844,7 +3155,7 @@ class ServingEngine:
                 dl = float(getattr(
                     requests[state.request_idx], "deadline_s", 0.0
                 ) or 0.0)
-                if dl > 0 and now - t0 >= dl:
+                if dl > 0 and now - dl_anchor(state.request_idx) >= dl:
                     # deadline cancellation at the wave boundary: report
                     # the partial completion honestly, free the lease
                     # (shareable prefix blocks PARK for future hits —
@@ -2895,12 +3206,21 @@ class ServingEngine:
                 )
             if gauges is not None:
                 gauges.publish(
-                    queue_depth=len(pending), running_rows=live_rows,
+                    queue_depth=len(pending) + ext_pending(),
+                    running_rows=live_rows,
                     free_pool_blocks=free_blocks,
                     host_cache_bytes=host_bytes,
                     committed_tokens=committed, waves=chunks,
                 )
         wall = self._clock() - t0
+        # ownership back to the engine: the pool (parked prefix
+        # payloads included) survives for the next call's cross-call
+        # hits — on interrupt too, since the drain released every lease
+        # and the partition is clean. The request list (streamed
+        # arrivals included) is what the post-serve audits iterate.
+        self._kv_cache = cache
+        self._serve_calls += 1
+        self.last_requests = requests
         if flight is not None and not interrupted:
             flight.record("run_end", t=wall, committed=committed)
         _pctl = percentile_nearest_rank
@@ -2952,6 +3272,14 @@ class ServingEngine:
             "live_gauge_publishes": (
                 gauges.publishes if gauges is not None else 0
             ),
+            # ---- engine-lifetime / open-loop ledger (round 16) ----
+            # serve calls this ENGINE has completed (this one included)
+            # — cross-call reuse is only possible past 1; cache_resets
+            # counts reset_cache() wipes; streamed_requests arrived via
+            # the source mid-run (0 = pure closed-loop)
+            "engine_serve_calls": self._serve_calls,
+            "cache_resets": self.cache_resets,
+            "streamed_requests": streamed,
         }
         # admission → first committed token (chunk-granular) and
         # enqueue → admission waits, per request — OMITTED when no
@@ -3015,6 +3343,15 @@ class ServingEngine:
                 # eviction traffic behind it
                 metrics["prefix_hit_tokens"] = hit_tokens
                 metrics["prefix_hit_requests"] = hit_requests
+                # cross-call share (round 16): hits served by digests a
+                # PREVIOUS serve() call indexed — 0 on a cold engine by
+                # construction, the warm-vs-cold A/B's headline number
+                metrics["prefix_hit_tokens_cross_call"] = (
+                    cross_hit_tokens
+                )
+                metrics["prefix_hit_requests_cross_call"] = (
+                    cross_hit_requests
+                )
                 metrics["prefix_prefill_steps_saved"] = (
                     self._prefill_steps_saved
                 )

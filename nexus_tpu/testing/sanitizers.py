@@ -245,11 +245,21 @@ def audit_committed_publication(
 
     Drained rows (engine death) are covered through ``last_drain`` —
     their committed snapshots publish at release exactly like finished
-    rows."""
+    rows.
+
+    Engine-lifetime trees (round 16): digests indexed by PREVIOUS
+    serve() calls persist by design — they were proven against their
+    own call's committed text when published, and the engine snapshots
+    them (``last_preexisting_keys``) at each call boundary, so only
+    THIS call's publications are checked here. Streamed arrivals are
+    covered through ``last_requests`` (the call's full request list,
+    source deliveries included)."""
     index = getattr(engine, "last_prefix_index", None)
     bs = int(getattr(engine, "_block_size", 0) or 0)
     if index is None or bs <= 0:
         return
+    requests = list(getattr(engine, "last_requests", None) or requests)
+    preexisting = getattr(engine, "last_preexisting_keys", None) or frozenset()
     from nexus_tpu.runtime.prefix_cache import chain_keys
 
     allowed = set()
@@ -278,7 +288,10 @@ def audit_committed_publication(
             admit_text((prompt + committed)[:-1])
         else:
             admit_text(prompt)
-    stray = [k for k in index.indexed_keys() if k not in allowed]
+    stray = [
+        k for k in index.indexed_keys()
+        if k not in allowed and k not in preexisting
+    ]
     if stray:
         raise SanitizerError(
             f"{context}: {len(stray)} indexed radix digest(s) match no "
@@ -286,6 +299,51 @@ def audit_committed_publication(
             "committed (e.g. a partially-rejected speculation window) "
             "was published to the prefix tree"
         )
+
+
+# ---------------------------------------------------------------------------
+# audit 2d: engine-lifetime call-boundary state (round 16)
+
+
+def audit_warm_boundary(engine: Any, context: str = "warm-entry") -> None:
+    """Assert a WARM engine's persisted KV state is clean at a serve()
+    call boundary — the engine-lifetime analogue of the post-serve
+    audits, run against whatever happened BETWEEN calls: with every
+    lease released, the pool must partition into free + parked exactly
+    (nothing allocated or reserved), the radix tree must satisfy its
+    structural invariant, and the host spill tier must agree with the
+    tree bit for bit. ``ServingEngine.serve`` calls this under
+    NEXUS_SANITIZE before building on inherited state, so a dirty tree
+    or pool trips HERE with a boundary-named error instead of
+    corrupting a mid-wave admission. Dense-layout engines carry no pool
+    and are skipped."""
+    alloc = getattr(engine, "_alloc", None)
+    if alloc is None:
+        return
+    part = alloc.pool_partition()
+    partition = (
+        f"free={part['free']} parked={part['parked']} "
+        f"allocated={part['allocated']} reserved={part['reserved']} "
+        f"total={alloc.num_blocks}"
+    )
+    if part["allocated"] != 0:
+        raise SanitizerError(
+            f"{context}: {part['allocated']} KV block(s) still "
+            f"allocated at the call boundary — a previous call leaked "
+            f"a lease ({partition})"
+        )
+    if part["reserved"] != 0:
+        raise SanitizerError(
+            f"{context}: {part['reserved']} reserved KV block(s) never "
+            f"refunded at the call boundary ({partition})"
+        )
+    if part["free"] + part["parked"] != alloc.num_blocks:
+        raise SanitizerError(
+            f"{context}: free+parked != pool at the call boundary — "
+            f"block(s) fell out of the partition ({partition})"
+        )
+    audit_prefix_tree(engine, context=context)
+    audit_host_cache(engine, context=context)
 
 
 # ---------------------------------------------------------------------------
@@ -358,10 +416,10 @@ def install(engine_cls: Optional[type] = None) -> bool:
     original: Callable = engine_cls.serve
 
     def serve_with_audits(self, requests, cancel=None, heartbeat=None,
-                          tracer=None):
+                          tracer=None, **kw):
         results, metrics = original(
             self, requests, cancel=cancel, heartbeat=heartbeat,
-            tracer=tracer,
+            tracer=tracer, **kw,
         )
         audit_pool_partition(metrics, context="sanitizer[pool]")
         audit_prefix_tree(self, context="sanitizer[radix]")

@@ -1120,3 +1120,122 @@ def test_tiered_int8_demotion_serves_close_but_lossy():
     assert m["host_cache_bytes_peak"] > 0
     assert (m["kv_spilled_blocks_final"]
             == m["host_cache_entries_final"])
+
+
+# ---------------------------------------------------------------------------
+# engine-lifetime KV state (round 16): warm-engine exactness, cross-call
+# prefix reuse, the call-boundary audits, and the reset escape hatch
+
+
+def _warm_queue(v, rng, n=4, shared_len=24, tail_len=8, budget=12):
+    shared = rng.randint(0, v, size=shared_len).tolist()
+    return [
+        ServeRequest(prompt=shared + rng.randint(0, v, size=tail_len)
+                     .tolist(), max_new_tokens=budget)
+        for _ in range(n)
+    ]
+
+
+def test_warm_engine_second_call_token_identical_to_cold():
+    """The tentpole's exactness gate: a second serve() on a WARM engine
+    (pool + radix tree + counters inherited from call one) commits
+    token-identical results to a cold engine serving the same queue —
+    cross-call reuse is scheduling, never semantics."""
+    v = 32
+    cfg, fwd = _cyclic_model(v, -1)
+    reqs = _warm_queue(v, np.random.RandomState(21))
+
+    def mk():
+        return ServingEngine(fwd, {}, cfg, batch_size=2, max_len=128,
+                             chunk=4, kv_block_size=8)
+
+    cold_results, cold_m = mk().serve(reqs)
+    warm_eng = mk()
+    warm_eng.serve(reqs)
+    warm_results, warm_m = warm_eng.serve(reqs)
+    for c, w in zip(cold_results, warm_results):
+        assert c.tokens == w.tokens
+    assert warm_m["engine_serve_calls"] == 2
+    # the warm tree answers every full-block span of every prompt
+    assert warm_m["prefix_hit_tokens"] > cold_m["prefix_hit_tokens"]
+
+
+def test_cross_call_prefix_hits_warm_vs_cold():
+    """Cross-call attribution: hits against blocks REGISTERED BY A
+    PRIOR CALL are > 0 on the warm path and exactly 0 cold (a fresh
+    engine per call has no inherited tree)."""
+    v = 32
+    cfg, fwd = _cyclic_model(v, -1)
+    reqs = _warm_queue(v, np.random.RandomState(22))
+
+    def mk():
+        return ServingEngine(fwd, {}, cfg, batch_size=2, max_len=128,
+                             chunk=4, kv_block_size=8)
+
+    _, m_cold1 = mk().serve(reqs)
+    _, m_cold2 = mk().serve(reqs)
+    assert m_cold1["prefix_hit_tokens_cross_call"] == 0
+    assert m_cold2["prefix_hit_tokens_cross_call"] == 0
+
+    eng = mk()
+    _, m1 = eng.serve(reqs)
+    _, m2 = eng.serve(reqs)
+    assert m1["prefix_hit_tokens_cross_call"] == 0
+    assert m2["prefix_hit_tokens_cross_call"] > 0
+    assert m2["prefix_hit_requests_cross_call"] > 0
+    # warm full-queue replay: EVERY hit token matched an inherited block
+    assert (m2["prefix_hit_tokens_cross_call"]
+            == m2["prefix_hit_tokens"])
+
+
+def test_reset_cache_discards_warm_state():
+    """The escape hatch: reset_cache() rebuilds pool/tree/host tier, so
+    the next call is cold (0 cross-call hits) and the reset is counted
+    in the metrics ledger."""
+    v = 32
+    cfg, fwd = _cyclic_model(v, -1)
+    reqs = _warm_queue(v, np.random.RandomState(23))
+    eng = ServingEngine(fwd, {}, cfg, batch_size=2, max_len=128,
+                        chunk=4, kv_block_size=8)
+    eng.serve(reqs)
+    eng.reset_cache()
+    _, m = eng.serve(reqs)
+    assert m["prefix_hit_tokens_cross_call"] == 0
+    assert m["cache_resets"] == 1
+    assert m["engine_serve_calls"] == 2
+
+
+def test_dirty_pool_trips_warm_boundary_audit():
+    """Satellite (c), audit half: a dirty pool at a call boundary —
+    a leaked reservation, or a block missing from the free/parked
+    partition — trips the sanitizer's warm-boundary audit BEFORE the
+    next call builds on corrupted state, and reset_cache() recovers."""
+    from nexus_tpu.testing.sanitizers import (
+        SanitizerError,
+        audit_warm_boundary,
+    )
+
+    v = 32
+    cfg, fwd = _cyclic_model(v, -1)
+    reqs = _warm_queue(v, np.random.RandomState(24))
+    eng = ServingEngine(fwd, {}, cfg, batch_size=2, max_len=128,
+                        chunk=4, kv_block_size=8)
+    eng.serve(reqs)
+    audit_warm_boundary(eng)  # clean boundary passes
+
+    # leak a reservation (admit() with no matching lease release)
+    eng._alloc.admit(1)
+    with np.testing.assert_raises(SanitizerError):
+        audit_warm_boundary(eng)
+    # the serve() entry check trips the same way when armed
+    eng._sanitize = True
+    with np.testing.assert_raises(SanitizerError):
+        eng.serve(reqs)
+    eng.reset_cache()
+    results, m = eng.serve(reqs)  # warm-entry audit passes post-reset
+    assert all(r is not None for r in results)
+
+    # variant: a block that fell out of the partition entirely
+    eng._alloc._free.pop()
+    with np.testing.assert_raises(SanitizerError):
+        audit_warm_boundary(eng)

@@ -1,0 +1,407 @@
+"""Arrival traces + open-loop streaming (runtime/traffic.py, round 16).
+
+Three layers, each deterministic:
+
+  * the TRACE itself: pure-seeded synthesis (same seed → byte-identical
+    trace), versioned dict round-trip, and the four traffic shapes —
+    Poisson / bursty arrivals, Zipf-shared prefixes, multi-turn
+    sessions, branching fan-outs — asserted structurally;
+  * the SOURCE protocol on a fake clock: poll delivers exactly the due
+    events, due/exhausted expose backlog, wait advances toward the next
+    arrival through the injected sleep;
+  * STREAMED ADMISSION: an engine fed by a source commits the same
+    tokens as the closed-loop replay of the identical queue, measures
+    queue time from trace ARRIVAL (not serve() entry), counts external
+    backlog into its live queue-depth gauge, and a live ServeFleet
+    drains a streamed trace to zero lost requests.
+
+This module is the ``make traffic-smoke`` payload — everything runs on
+the stub model in seconds on CPU, sanitizer-armed in CI.
+"""
+
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nexus_tpu.runtime.serving import ServeRequest, ServingEngine
+from nexus_tpu.runtime.traffic import (
+    TRACE_VERSION,
+    ListSource,
+    Trace,
+    TraceEvent,
+    TraceSource,
+    synthesize_trace,
+)
+
+
+def _cyclic_model(v: int):
+    cfg = SimpleNamespace(
+        n_layers=1, n_kv_heads=1, head_dim=8, dtype=jnp.float32,
+        max_seq_len=512, vocab_size=v,
+    )
+
+    def fwd(params, cfg_, tokens, cache):
+        logits = jax.nn.one_hot((tokens + 1) % v, v) * 10.0
+        new = {k: x for k, x in cache.items() if k != "n_valid"}
+        nv = cache.get("n_valid")
+        adv = tokens.shape[1] if nv is None else nv
+        new["length"] = cache["length"] + adv
+        return logits.astype(jnp.float32), new
+
+    return cfg, fwd
+
+
+def _cyclic_completion(v: int):
+    """The stub model's exact greedy rule, as a trace completion_fn:
+    next = (last + 1) % v, repeatedly."""
+
+    def complete(prompt, budget):
+        out, cur = [], int(prompt[-1])
+        for _ in range(int(budget)):
+            cur = (cur + 1) % v
+            out.append(cur)
+        return out
+
+    return complete
+
+
+class FakeClock:
+    """now() + a sleep that ADVANCES it — the whole stream replays
+    deterministically with zero wall time."""
+
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, s: float) -> None:
+        self.t += float(s)
+
+
+# ------------------------------------------------------------------ trace
+
+def test_trace_roundtrip_and_version_pin():
+    tr = synthesize_trace(seed=3, requests=10, duration_s=1.0,
+                          vocab_size=32, multi_turn_frac=0.3,
+                          branch_frac=0.2, fanout=2)
+    d = tr.to_dict()
+    assert d["trace_version"] == TRACE_VERSION
+    assert Trace.from_dict(d).to_dict() == d
+    bad = dict(d, trace_version=TRACE_VERSION + 1)
+    with pytest.raises(ValueError):
+        Trace.from_dict(bad)
+
+
+def test_synthesis_is_pure_seeded_and_sorted():
+    kw = dict(seed=9, requests=14, duration_s=2.0, arrival="bursty",
+              vocab_size=64, multi_turn_frac=0.25, branch_frac=0.25,
+              fanout=3, turns=2)
+    a, b = synthesize_trace(**kw), synthesize_trace(**kw)
+    assert a.to_dict() == b.to_dict()
+    times = [ev.arrival_s for ev in a.events]
+    assert times == sorted(times)
+    assert all(t >= 0.0 for t in times)
+    c = synthesize_trace(**dict(kw, seed=10))
+    assert c.to_dict() != a.to_dict()
+
+
+def test_arrival_processes_shape():
+    po = synthesize_trace(seed=1, requests=32, duration_s=4.0,
+                          arrival="poisson", vocab_size=32)
+    bu = synthesize_trace(seed=1, requests=32, duration_s=4.0,
+                          arrival="bursty", burst_duty=0.2,
+                          burst_count=4, vocab_size=32)
+    assert len(po) == len(bu) == 32
+    # bursty: every arrival lands inside one of the 4 duty windows, so
+    # the largest inter-arrival gap spans an off period — far beyond
+    # anything the duty windows themselves contain
+    gaps = np.diff([ev.arrival_s for ev in bu.events])
+    assert gaps.max() > 0.3  # off-period gap at span=1.0, width=0.2
+    with pytest.raises(ValueError):
+        synthesize_trace(arrival="sawtooth")
+
+
+def test_zipf_prefixes_are_shared_and_skewed():
+    tr = synthesize_trace(seed=4, requests=40, duration_s=2.0,
+                          vocab_size=64, n_prefixes=4, zipf_a=2.0,
+                          prefix_tokens=16, tail_tokens=4)
+    heads = [tuple(ev.prompt[:16]) for ev in tr.events]
+    counts = sorted(
+        (heads.count(h) for h in set(heads)), reverse=True
+    )
+    assert len(counts) <= 4
+    # rank-2.0 power law over 4 prefixes: the head rank dominates
+    assert counts[0] >= 2 * counts[-1]
+    # tails are unique — prefix sharing is the ONLY overlap
+    assert len({tuple(ev.prompt) for ev in tr.events}) == len(tr)
+
+
+def test_multi_turn_sessions_chain_history():
+    v = 32
+    tr = synthesize_trace(seed=6, requests=8, duration_s=1.0,
+                          vocab_size=v, multi_turn_frac=1.0, turns=3,
+                          max_new_tokens=6, think_s=0.5,
+                          completion_fn=_cyclic_completion(v))
+    sessions = {}
+    for ev in tr.events:
+        sessions.setdefault(ev.session, []).append(ev)
+    assert len(sessions) == 8
+    comp = _cyclic_completion(v)
+    for evs in sessions.values():
+        evs.sort(key=lambda e: e.turn)
+        assert [e.turn for e in evs] == [0, 1, 2]
+        assert [e.kind for e in evs] == ["turn"] * 3
+        for prev, nxt in zip(evs, evs[1:]):
+            # successor prompt = full prior history + EXACT completion
+            # (the stub's greedy rule) + a fresh user tail
+            history = prev.prompt + comp(prev.prompt, 6)
+            assert nxt.prompt[:len(history)] == history
+            assert len(nxt.prompt) > len(history)
+            assert nxt.arrival_s > prev.arrival_s
+
+
+def test_branching_fanout_shares_history():
+    v = 32
+    tr = synthesize_trace(seed=8, requests=4, duration_s=0.5,
+                          vocab_size=v, branch_frac=1.0, fanout=3,
+                          max_new_tokens=5, think_s=0.3,
+                          completion_fn=_cyclic_completion(v))
+    fams = {}
+    for ev in tr.events:
+        fams.setdefault(ev.session, []).append(ev)
+    assert len(fams) == 4
+    comp = _cyclic_completion(v)
+    for evs in fams.values():
+        evs.sort(key=lambda e: e.turn)
+        root, children = evs[0], evs[1:]
+        assert root.kind == "single" and len(children) == 3
+        history = root.prompt + comp(root.prompt, 5)
+        tails = set()
+        for ch in children:
+            assert ch.kind == "branch"
+            assert ch.prompt[:len(history)] == history
+            tails.add(tuple(ch.prompt[len(history):]))
+            # near-simultaneous: the whole fan-out lands within the
+            # jitter window after the root's think time
+            assert root.arrival_s + 0.3 <= ch.arrival_s
+            assert ch.arrival_s <= root.arrival_s + 0.3 + 0.05 + 1e-9
+        assert len(tails) == 3  # branches diverge in their tails
+
+
+# ----------------------------------------------------------------- source
+
+def test_trace_source_poll_due_wait_on_fake_clock():
+    events = [
+        TraceEvent(arrival_s=t, prompt=[1, 2, 3], max_new_tokens=4)
+        for t in (0.0, 0.5, 0.5, 2.0)
+    ]
+    tr = Trace(name="t", seed=0, events=events)
+    clk = FakeClock()
+    src = TraceSource(tr, deadline_s=9.0, sleep=clk.sleep,
+                      max_wait_s=10.0)
+    assert len(src) == 4 and not src.exhausted()
+    first = src.poll(0.0)
+    assert len(first) == 1 and src.delivered == 1
+    assert first[0].arrival_s == 0.0 and first[0].deadline_s == 9.0
+    assert src.due(0.6) == 2  # peek does not deliver
+    assert src.poll(0.6) and src.delivered == 3
+    # wait sleeps exactly to the next arrival (uncapped here)
+    clk.t = 0.6
+    src.wait(clk())
+    assert clk.t == pytest.approx(2.0)
+    assert src.poll(clk()) and src.exhausted()
+    assert src.poll(99.0) == [] and src.due(99.0) == 0
+
+
+def test_trace_source_speed_compresses_arrivals():
+    tr = Trace(name="t", seed=0, events=[
+        TraceEvent(arrival_s=4.0, prompt=[1], max_new_tokens=1)
+    ])
+    src = TraceSource(tr, speed=4.0)
+    assert src.due(0.9) == 0 and src.due(1.0) == 1
+    req = src.poll(1.0)[0]
+    assert req.arrival_s == pytest.approx(1.0)  # stamped in wall units
+
+
+def test_list_source_stamps_arrivals():
+    reqs = [(1.0, ServeRequest(prompt=[1, 2], max_new_tokens=2)),
+            (0.25, ServeRequest(prompt=[3, 4], max_new_tokens=2))]
+    src = ListSource(reqs, max_wait_s=10.0)
+    got = src.poll(0.3)
+    assert len(got) == 1 and got[0].prompt == [3, 4]
+    assert got[0].arrival_s == pytest.approx(0.25)
+    assert src.due(2.0) == 1 and not src.exhausted()
+    assert src.poll(2.0)[0].arrival_s == pytest.approx(1.0)
+    assert src.exhausted()
+
+
+# ----------------------------------------------------- streamed admission
+
+def test_engine_streamed_serve_matches_closed_loop():
+    """The tentpole's exactness half at the streaming seam: a trace
+    streamed into serve() on a fake clock commits token-identical
+    results to the closed-loop replay of the same queue, with every
+    queue_s anchored at the trace arrival (>= 0 even though most
+    requests did not exist at serve() entry)."""
+    v = 32
+    cfg, fwd = _cyclic_model(v)
+    tr = synthesize_trace(seed=5, requests=10, duration_s=1.0,
+                          vocab_size=v, n_prefixes=2, prefix_tokens=16,
+                          tail_tokens=4, max_new_tokens=6,
+                          multi_turn_frac=0.3, branch_frac=0.2,
+                          fanout=2, completion_fn=_cyclic_completion(v))
+    clk = FakeClock()
+    eng = ServingEngine(fwd, {}, cfg, batch_size=2, max_len=256,
+                        chunk=4, kv_block_size=8, clock=clk)
+    src = TraceSource(tr, sleep=clk.sleep)
+    streamed, m = eng.serve([], source=src)
+    assert m["streamed_requests"] == len(tr) == m["requests"]
+    assert all(r is not None for r in streamed)
+
+    closed_eng = ServingEngine(fwd, {}, cfg, batch_size=2, max_len=256,
+                               chunk=4, kv_block_size=8)
+    closed, _ = closed_eng.serve(tr.to_requests())
+    for s, c in zip(streamed, closed):
+        assert s.tokens == c.tokens
+    for s in streamed:
+        assert s.queue_s >= 0.0
+        assert s.latency_s >= s.queue_s - 1e-9
+
+
+def test_queue_s_measures_from_arrival_not_serve_entry():
+    """Satellite (a): a request stamped as having arrived BEFORE the
+    call (negative arrival_s — e.g. it waited in a fleet inbox) charges
+    that wait to queue_s and latency_s; ttft_s stays admission-based."""
+    v = 16
+    cfg, fwd = _cyclic_model(v)
+    clk = FakeClock()
+    eng = ServingEngine(fwd, {}, cfg, batch_size=2, max_len=96,
+                        chunk=4, kv_block_size=8, clock=clk)
+    reqs = [ServeRequest(prompt=[1, 2, 3], max_new_tokens=4,
+                         arrival_s=-2.5),
+            ServeRequest(prompt=[4, 5, 6], max_new_tokens=4)]
+    results, m = eng.serve(reqs)
+    early, fresh = results
+    assert early.queue_s >= 2.5
+    assert early.latency_s >= 2.5
+    assert fresh.queue_s < 2.5
+    assert early.ttft_s < 2.5  # admission → first token, NOT arrival
+    # the rollup percentiles anchor at arrival too
+    assert m["ttft_p95_s"] >= 2.5
+
+
+def test_ext_backlog_feeds_queue_depth_gauge():
+    """Satellite (b): the live serve_queue_depth gauge counts the
+    EXTERNAL pending stream (here a constant fleet-inbox depth of 5) on
+    top of the in-call queue, so autoscaler/p2c reads see real
+    backlog."""
+    from nexus_tpu.utils.telemetry import (
+        METRIC_SERVE_QUEUE_DEPTH,
+        get_client,
+    )
+
+    v = 16
+    cfg, fwd = _cyclic_model(v)
+    tag = "engine:traffic-backlog-test"
+    eng = ServingEngine(fwd, {}, cfg, batch_size=2, max_len=96,
+                        chunk=4, kv_block_size=8, gauge_tags=[tag])
+    reqs = [ServeRequest(prompt=[1, i + 2], max_new_tokens=4)
+            for i in range(3)]
+    eng.serve(reqs, ext_backlog=lambda: 5)
+    sample = get_client().get_tagged(METRIC_SERVE_QUEUE_DEPTH, [tag])
+    assert sample is not None
+    # the final wave's publication: 0 in-call pending + 5 external
+    assert sample.value == 5.0
+
+
+def test_fleet_run_stream_drains_trace():
+    """Open-loop fleet drive: a streamed trace reaches zero lost
+    requests, report['streamed'] counts deliveries, and every stitched
+    result carries arrival-anchored (non-negative) queue attribution."""
+    from nexus_tpu.cluster.store import ClusterStore
+    from nexus_tpu.fleet.fleet import ServeFleet
+    from nexus_tpu.fleet.router import PrefixAffinityRouter
+
+    v = 32
+    cfg, fwd = _cyclic_model(v)
+    tr = synthesize_trace(seed=12, requests=6, duration_s=0.4,
+                          vocab_size=v, n_prefixes=2, prefix_tokens=8,
+                          tail_tokens=4, max_new_tokens=4)
+
+    def make_engine(rid):
+        return ServingEngine(fwd, {}, cfg, batch_size=2, max_len=96,
+                             chunk=4, kv_block_size=8,
+                             gauge_tags=[f"engine:{rid}"])
+
+    fleet = ServeFleet(
+        make_engine, ClusterStore("traffic-stream-test"),
+        "traffic-test", "stream", replicas=2,
+        router=PrefixAffinityRouter([], block_size=8),
+        ttl_seconds=0.4, slo_s=5.0,
+    )
+    results, report = fleet.run_stream(TraceSource(tr), timeout_s=60.0)
+    assert report["requests_lost"] == 0
+    assert report["streamed"] == len(tr) == len(results)
+    assert report["slo"]["ok_under_slo"] == len(tr)
+    for r in results:
+        assert r.queue_s >= 0.0
+        assert r.latency_s >= r.queue_s - 1e-9
+
+
+def test_run_template_runtime_open_loop_arrival():
+    """serve.arrival=poisson drives the product runtime path open-loop:
+    the template seed synthesizes a versioned trace, requests stream
+    into the running engine as their arrivals come due, and the
+    rollups/metrics record the streamed admission."""
+    from nexus_tpu.api.runtime_spec import (
+        JaxXlaRuntime, ModelRef, ParallelismSpec, ServeSpec, TpuSliceSpec,
+        TrainSpec,
+    )
+    from nexus_tpu.runtime.entrypoints import run_template_runtime
+
+    rt = JaxXlaRuntime(
+        mode="serve",
+        model=ModelRef(family="llama", preset="tiny",
+                       overrides={"dtype": "float32"}),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1", slice_count=1),
+        parallelism=ParallelismSpec(),
+        train=TrainSpec(batch_size=2, seq_len=64),
+        serve=ServeSpec(
+            num_requests=5, prompt_length_min=4, prompt_length_max=10,
+            max_new_min=3, max_new_max=6, chunk=4,
+            arrival="poisson", arrival_duration_s=0.3,
+        ),
+    )
+    assert rt.validate() == []
+    m = run_template_runtime(rt)
+    assert m["mode"] == "serve"
+    assert m["arrival"] == "poisson"
+    assert m["trace_version"] == 1
+    assert m["trace_events"] == 5
+    # every request entered through the stream, none at serve() entry
+    assert m["streamed_requests"] == 5
+    assert m["finished_requests"] == 5
+    assert m["request_latency_p50_s"] > 0
+
+    # an unknown arrival process is a spec error, not a runtime abort
+    bad = JaxXlaRuntime(
+        mode="serve",
+        model=ModelRef(family="llama", preset="tiny"),
+        tpu=TpuSliceSpec(accelerator="v5e", topology="1x1", slice_count=1),
+        parallelism=ParallelismSpec(),
+        serve=ServeSpec(arrival="sawtooth"),
+    )
+    assert any("serve.arrival" in e for e in bad.validate())
+
+    # trace knobs survive the spec dict roundtrip (camelCase wire form)
+    d = rt.serve.to_dict()
+    assert d["arrival"] == "poisson"
+    assert d["arrivalDurationSeconds"] == 0.3
+    rt2 = ServeSpec.from_dict(d)
+    assert rt2.arrival == "poisson"
+    assert rt2.arrival_duration_s == 0.3
+    assert rt2.trace_fanout == 3
